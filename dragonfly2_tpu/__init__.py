@@ -1,0 +1,20 @@
+"""dragonfly2_tpu — a TPU-native framework with the capabilities of Dragonfly2.
+
+A from-scratch rebuild of the capability surface of the reference
+(RandySun01/Dragonfly2, a Go P2P file-distribution system): peer scheduling
+with a batched XLA-compiled parent-selection evaluator, a *real* trainer
+(GraphSAGE parent ranker + MLP probe-RTT regressor — left as TODO stubs in the
+reference, trainer/training/training.go:82-98), network-topology probing with
+EWMA RTT tracking, download/topology trace recording, a versioned model
+registry with native serving, and a host-side control plane.
+
+Design stance (see SURVEY.md §7): cluster state is struct-of-arrays, the
+per-task peer DAG is edge-index/adjacency tensors, candidate filtering and
+scoring are masked batched array programs under `jax.jit`, training is
+`shard_map` data-parallel with `psum` gradients over a `jax.sharding.Mesh`.
+Host-side Python keeps only what must touch the network.
+"""
+
+__version__ = "0.1.0"
+
+from dragonfly2_tpu.config.constants import Constants  # noqa: F401

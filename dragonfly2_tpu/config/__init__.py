@@ -1,0 +1,21 @@
+from dragonfly2_tpu.config.constants import Constants
+from dragonfly2_tpu.config.config import (
+    Config,
+    EvaluatorConfig,
+    ProbeConfig,
+    SchedulerConfig,
+    StorageConfig,
+    TrainerConfig,
+    DynConfig,
+)
+
+__all__ = [
+    "Constants",
+    "Config",
+    "EvaluatorConfig",
+    "ProbeConfig",
+    "SchedulerConfig",
+    "StorageConfig",
+    "TrainerConfig",
+    "DynConfig",
+]

@@ -1,0 +1,249 @@
+"""Config system: typed dataclasses + YAML/env loading + dynamic overrides.
+
+Capability parity with the reference's cobra/viper static config
+(scheduler/config/config.go, cmd/dependency/dependency.go:61-93, env prefix
+``DRAGONFLY_``) and the dynconfig layer that polls the manager for
+cluster-scoped runtime values with a local cache fallback
+(internal/dynconfig/dynconfig.go, scheduler/config/dynconfig.go).
+
+TPU-first difference: config carries the *shapes* of the compiled kernels
+(batch sizes, capacities) so everything downstream stays static-shaped under
+``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import threading
+import time
+from typing import Any, Callable
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+
+ENV_PREFIX = "DRAGONFLY_"
+
+
+@dataclasses.dataclass
+class EvaluatorConfig:
+    # "default" | "nt" | "ml" — unlike the reference (evaluator.go:84-86,
+    # where "ml" silently falls back to base), "ml" here is actually wired to
+    # a served model (registry/serving.py).
+    algorithm: str = "default"
+    batch_tasks: int = CONSTANTS.EVAL_BATCH_TASKS
+    batch_candidates: int = CONSTANTS.EVAL_BATCH_CANDIDATES
+
+
+@dataclasses.dataclass
+class ProbeConfig:
+    queue_length: int = CONSTANTS.PROBE_QUEUE_LENGTH
+    ewma_weight: float = CONSTANTS.EWMA_WEIGHT
+    ping_timeout_ns: int = CONSTANTS.PING_TIMEOUT_NS
+    find_probed_hosts_limit: int = CONSTANTS.FIND_PROBED_HOSTS_LIMIT
+    interval_seconds: float = 20 * 60.0
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    filter_parent_limit: int = CONSTANTS.FILTER_PARENT_LIMIT
+    candidate_parent_limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT
+    retry_limit: int = CONSTANTS.RETRY_LIMIT
+    retry_back_to_source_limit: int = CONSTANTS.RETRY_BACK_TO_SOURCE_LIMIT
+    retry_interval_seconds: float = CONSTANTS.RETRY_INTERVAL_SECONDS
+    # capacities for the struct-of-arrays cluster state (state/cluster.py)
+    max_hosts: int = 16384
+    max_peers_per_task: int = 256
+    max_tasks: int = 4096
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    data_dir: str = "data"
+    max_size_mb: int = CONSTANTS.STORAGE_MAX_SIZE_MB
+    max_backups: int = CONSTANTS.STORAGE_MAX_BACKUPS
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    interval_seconds: int = CONSTANTS.TRAIN_INTERVAL_SECONDS
+    upload_timeout_seconds: int = CONSTANTS.TRAIN_UPLOAD_TIMEOUT_SECONDS
+    upload_chunk_bytes: int = CONSTANTS.TRAIN_UPLOAD_CHUNK_BYTES
+    batch_size: int = 256
+    learning_rate: float = 1e-3
+    epochs: int = 10
+    hidden_dim: int = 128
+    checkpoint_dir: str = "checkpoints"
+
+
+@dataclasses.dataclass
+class Config:
+    name: str = "dragonfly2-tpu"
+    evaluator: EvaluatorConfig = dataclasses.field(default_factory=EvaluatorConfig)
+    probe: ProbeConfig = dataclasses.field(default_factory=ProbeConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    trainer: TrainerConfig = dataclasses.field(default_factory=TrainerConfig)
+
+    @classmethod
+    def load(cls, path: str | os.PathLike | None = None) -> "Config":
+        """Load from a YAML/JSON file, then apply DRAGONFLY_* env overrides.
+
+        Env override syntax mirrors the reference's viper env binding:
+        ``DRAGONFLY_SCHEDULER_FILTER_PARENT_LIMIT=20`` maps to
+        ``scheduler.filter_parent_limit``.
+        """
+        cfg = cls()
+        if path is not None:
+            text = pathlib.Path(path).read_text()
+            data = _parse_config_text(text)
+            _apply_dict(cfg, data)
+        _apply_env(cfg)
+        return cfg
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _parse_config_text(text: str) -> dict:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+
+            return yaml.safe_load(text) or {}
+        except ImportError:
+            return _parse_simple_yaml(text)
+
+
+def _parse_simple_yaml(text: str) -> dict:
+    """Two-level key: value parser so config files work without PyYAML."""
+    root: dict[str, Any] = {}
+    section: dict[str, Any] | None = None
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip() or ":" not in line:
+            continue
+        key, _, value = line.partition(":")
+        value = value.strip()
+        indented = key.startswith((" ", "\t"))
+        key = key.strip()
+        if not indented:
+            if value == "":
+                section = {}
+                root[key] = section
+            else:
+                section = None
+                root[key] = _coerce(value)
+        elif section is not None:
+            section[key] = _coerce(value)
+    return root
+
+
+def _coerce(value: str) -> Any:
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            pass
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    return value.strip("'\"")
+
+
+def _apply_dict(cfg: Any, data: dict) -> None:
+    for key, value in (data or {}).items():
+        if not hasattr(cfg, key):
+            continue
+        current = getattr(cfg, key)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            _apply_dict(current, value)
+        else:
+            setattr(cfg, key, value)
+
+
+def _apply_env(cfg: Config) -> None:
+    for name, value in os.environ.items():
+        if not name.startswith(ENV_PREFIX):
+            continue
+        parts = name[len(ENV_PREFIX):].lower().split("_")
+        # Longest-prefix match of parts[0] against section names.
+        for i in range(len(parts), 0, -1):
+            section_name = "_".join(parts[:i])
+            if hasattr(cfg, section_name):
+                section = getattr(cfg, section_name)
+                field = "_".join(parts[i:])
+                if field and hasattr(section, field):
+                    setattr(section, field, _coerce(value))
+                elif not field and not dataclasses.is_dataclass(section):
+                    # whole suffix names a top-level scalar, e.g. DRAGONFLY_NAME
+                    setattr(cfg, section_name, _coerce(value))
+                break
+
+
+class DynConfig:
+    """Runtime-overridable config view with local snapshot fallback.
+
+    Mirrors internal/dynconfig/dynconfig.go: a resolver callable (standing in
+    for the manager RPC) is polled at ``refresh_interval``; on resolver
+    failure the last snapshot (persisted to ``cache_path``) keeps serving.
+    """
+
+    def __init__(
+        self,
+        base: Config,
+        resolver: Callable[[], dict] | None = None,
+        refresh_interval: float = 60.0,
+        cache_path: str | os.PathLike | None = None,
+    ):
+        self._base = base
+        self._resolver = resolver
+        self._refresh_interval = refresh_interval
+        self._cache_path = pathlib.Path(cache_path) if cache_path else None
+        self._overrides: dict = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+        if self._cache_path and self._cache_path.exists():
+            try:
+                self._overrides = json.loads(self._cache_path.read_text())
+            except (json.JSONDecodeError, OSError):
+                self._overrides = {}
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        self._maybe_refresh()
+        with self._lock:
+            if dotted in self._overrides:
+                return self._overrides[dotted]
+        obj: Any = self._base
+        for part in dotted.split("."):
+            if not hasattr(obj, part):
+                return default
+            obj = getattr(obj, part)
+        return obj
+
+    def _maybe_refresh(self) -> None:
+        if self._resolver is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_refresh < self._refresh_interval:
+                return
+            self._last_refresh = now
+        try:
+            fresh = self._resolver()
+        except Exception:
+            return  # keep serving the cached snapshot
+        with self._lock:
+            self._overrides = dict(fresh)
+            if self._cache_path:
+                try:
+                    self._cache_path.parent.mkdir(parents=True, exist_ok=True)
+                    self._cache_path.write_text(json.dumps(self._overrides))
+                except OSError:
+                    pass
+
+    def refresh_now(self) -> None:
+        self._last_refresh = 0.0
+        self._maybe_refresh()

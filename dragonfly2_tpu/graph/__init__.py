@@ -1,0 +1,3 @@
+from dragonfly2_tpu.graph.dag import TaskDAG, DAGError, batch_can_add_edge, batch_reachable
+
+__all__ = ["TaskDAG", "DAGError", "batch_can_add_edge", "batch_reachable"]

@@ -1,0 +1,229 @@
+"""Per-task peer DAG — dual representation: mutable host-side adjacency +
+batched device reachability kernels.
+
+Capability parity with the reference's generic concurrent DAG
+(pkg/graph/dag/dag.go:49-368: AddVertex/DeleteVertex/AddEdge with cycle
+check `CanAddEdge`, DeleteEdge, in/out-degree, GetRandomVertices) used for
+per-task peer graphs (scheduler/resource/task.go:155).
+
+TPU-first split (SURVEY.md §7 stage 3): the *mutation* path (one edge at a
+time, at announce-stream rate) stays host-side on dense-int adjacency — a
+numpy bitset matrix per task, capacity-bounded — while the *query* path the
+evaluator needs (per-tick `in_degree` and `can_add_edge` for B x K
+candidates across many tasks) is a batched jitted kernel over stacked
+bitset adjacency: reachability via bounded frontier expansion on bit-packed
+rows (child reaches parent => adding parent->child closes a cycle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DAGError(Exception):
+    pass
+
+
+class TaskDAG:
+    """Fixed-capacity DAG over peer slots 0..P-1 with uint64 bitset rows.
+
+    `adj[u]` holds the bitset of direct children of u (edge u->v).
+    """
+
+    def __init__(self, capacity: int = 256):
+        if capacity % 64 != 0:
+            raise ValueError("capacity must be a multiple of 64")
+        self.capacity = capacity
+        self.words = capacity // 64
+        self.adj = np.zeros((capacity, self.words), np.uint64)
+        self.present = np.zeros(capacity, bool)
+        self.in_degree = np.zeros(capacity, np.int32)
+        self.out_degree = np.zeros(capacity, np.int32)
+
+    # ------------------------------------------------------------ vertices
+
+    def add_vertex(self, v: int) -> None:
+        if self.present[v]:
+            raise DAGError(f"vertex {v} already exists")
+        self.present[v] = True
+
+    def ensure_vertex(self, v: int) -> None:
+        self.present[v] = True
+
+    def delete_vertex(self, v: int) -> None:
+        """Remove v and all incident edges (dag.go DeleteVertex)."""
+        if not self.present[v]:
+            return
+        word, bit = divmod(v, 64)
+        mask = np.uint64(1) << np.uint64(bit)
+        # in-edges: every u with bit v set
+        parents = np.nonzero(self.adj[:, word] & mask)[0]
+        for u in parents:
+            self.adj[u, word] &= ~mask
+            self.out_degree[u] -= 1
+        # out-edges of v
+        children = self._children(v)
+        self.in_degree[children] -= 1
+        self.adj[v] = 0
+        self.out_degree[v] = 0
+        self.in_degree[v] = 0
+        self.present[v] = False
+
+    def _children(self, u: int) -> np.ndarray:
+        bits = self.adj[u]
+        out = []
+        for w in range(self.words):
+            word = int(bits[w])
+            while word:
+                b = word & -word
+                out.append(w * 64 + b.bit_length() - 1)
+                word ^= b
+        return np.asarray(out, dtype=np.int64)
+
+    # --------------------------------------------------------------- edges
+
+    def has_edge(self, u: int, v: int) -> bool:
+        word, bit = divmod(v, 64)
+        return bool(self.adj[u, word] & (np.uint64(1) << np.uint64(bit)))
+
+    def reachable(self, src: int, dst: int) -> bool:
+        """BFS over bitset rows: can src reach dst? (dag.go DFS :84-86)"""
+        if src == dst:
+            return True
+        frontier = np.zeros(self.words, np.uint64)
+        word, bit = divmod(src, 64)
+        frontier[word] = np.uint64(1) << np.uint64(bit)
+        visited = frontier.copy()
+        dw, db = divmod(dst, 64)
+        dmask = np.uint64(1) << np.uint64(db)
+        while frontier.any():
+            nxt = np.zeros(self.words, np.uint64)
+            for w in range(self.words):
+                word_bits = int(frontier[w])
+                while word_bits:
+                    b = word_bits & -word_bits
+                    u = w * 64 + b.bit_length() - 1
+                    nxt |= self.adj[u]
+                    word_bits ^= b
+            nxt &= ~visited
+            if nxt[dw] & dmask:
+                return True
+            visited |= nxt
+            frontier = nxt
+        return False
+
+    def can_add_edge(self, u: int, v: int) -> bool:
+        """Edge u->v is legal iff both exist, it's not a self-loop or
+        duplicate, and v cannot already reach u (dag.go CanAddEdge)."""
+        if u == v or not (self.present[u] and self.present[v]):
+            return False
+        if self.has_edge(u, v):
+            return False
+        return not self.reachable(v, u)
+
+    def add_edge(self, u: int, v: int) -> None:
+        if not self.can_add_edge(u, v):
+            raise DAGError(f"edge {u}->{v} rejected (missing vertex, duplicate, or cycle)")
+        word, bit = divmod(v, 64)
+        self.adj[u, word] |= np.uint64(1) << np.uint64(bit)
+        self.out_degree[u] += 1
+        self.in_degree[v] += 1
+
+    def delete_edge(self, u: int, v: int) -> None:
+        if not self.has_edge(u, v):
+            return
+        word, bit = divmod(v, 64)
+        self.adj[u, word] &= ~(np.uint64(1) << np.uint64(bit))
+        self.out_degree[u] -= 1
+        self.in_degree[v] -= 1
+
+    def delete_in_edges(self, v: int) -> None:
+        """Drop all parent->v edges (task.DeletePeerInEdges)."""
+        word, bit = divmod(v, 64)
+        mask = np.uint64(1) << np.uint64(bit)
+        parents = np.nonzero(self.adj[:, word] & mask)[0]
+        for u in parents:
+            self.adj[u, word] &= ~mask
+            self.out_degree[u] -= 1
+        self.in_degree[v] = 0
+
+    def delete_out_edges(self, u: int) -> None:
+        children = self._children(u)
+        self.in_degree[children] -= 1
+        self.adj[u] = 0
+        self.out_degree[u] = 0
+
+    def random_vertices(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        """Uniform sample of up to n present vertices (dag.go GetRandomVertices
+        — the LoadRandomPeers feed for candidate filtering)."""
+        live = np.nonzero(self.present)[0]
+        if live.size == 0:
+            return live
+        take = min(n, live.size)
+        return rng.choice(live, size=take, replace=False)
+
+    def vertex_count(self) -> int:
+        return int(self.present.sum())
+
+    def edge_count(self) -> int:
+        return int(self.out_degree.sum())
+
+
+# ----------------------------------------------------------------- device
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def batch_reachable(adj: jax.Array, src: jax.Array, dst: jax.Array, max_depth: int = 0):
+    """Batched reachability on stacked bool adjacency.
+
+    adj:  (B, P, P) bool — adj[b, u, v] means edge u->v in graph b
+    src:  (B, Q) int32 start vertices
+    dst:  (B, Q) int32 targets
+    Returns (B, Q) bool. Frontier expansion is a bool matmul per step —
+    MXU-friendly — run P steps (or `max_depth`) under lax.fori_loop with
+    early saturation via the visited mask.
+    """
+    b, p, _ = adj.shape
+    q = src.shape[1]
+    depth = max_depth or p
+    adj_f = adj.astype(jnp.float32)
+
+    frontier = jax.nn.one_hot(src, p, dtype=jnp.float32)  # (B, Q, P)
+    visited = frontier
+
+    def body(_, carry):
+        frontier, visited = carry
+        nxt = jnp.einsum("bqp,bpr->bqr", frontier, adj_f)
+        nxt = jnp.where(nxt > 0, 1.0, 0.0) * (1.0 - visited)
+        visited = jnp.clip(visited + nxt, 0.0, 1.0)
+        return nxt, visited
+
+    _, visited = jax.lax.fori_loop(0, depth, body, (frontier, visited))
+    hit = jnp.take_along_axis(visited, dst[..., None], axis=-1)[..., 0]
+    return hit > 0
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def batch_can_add_edge(
+    adj: jax.Array,        # (B, P, P) bool
+    present: jax.Array,    # (B, P) bool
+    parent: jax.Array,     # (B, K) int32 proposed parent vertex
+    child: jax.Array,      # (B,) int32 child vertex
+    max_depth: int = 0,
+):
+    """(B, K) bool: adding parent->child keeps the graph acyclic and simple.
+
+    Mirrors TaskDAG.can_add_edge for a whole evaluator batch in one call:
+    illegal if self-loop, either vertex absent, duplicate edge, or child
+    already reaches parent.
+    """
+    b, k = parent.shape
+    child_b = jnp.broadcast_to(child[:, None], (b, k))
+    cycle = batch_reachable(adj, child_b, parent, max_depth)
+    parent_present = jnp.take_along_axis(present, parent, axis=1)
+    child_present = jnp.take_along_axis(present, child[:, None], axis=1)
+    dup = adj[jnp.arange(b)[:, None], parent, child_b]
+    return (parent != child_b) & parent_present & child_present & ~dup & ~cycle

@@ -1,0 +1,3 @@
+from dragonfly2_tpu.ops import evaluator, segment, topk, ewma
+
+__all__ = ["evaluator", "segment", "topk", "ewma"]

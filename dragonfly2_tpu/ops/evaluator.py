@@ -1,0 +1,267 @@
+"""Batched parent-selection evaluator — the scheduler's hot path as one
+jit-compiled array program.
+
+Semantics parity (re-derived, not translated) with the reference's
+evaluator family:
+
+- base linear blend 0.2/0.2/0.15/0.15/0.15/0.15 over piece, upload-success,
+  free-upload, host-type, IDC, location scores
+  (scheduler/scheduling/evaluator/evaluator_base.go:28-46,71-188);
+- network-topology blend with the extra 0.12 probe-RTT term
+  `(1s - avgRTT)/1s` and 0.11 host-type/IDC/location weights
+  (evaluator_network_topology.go:30-51,96-109,217-224);
+- IsBadNode: bad states, then piece-cost outlier detection — 20x-mean rule
+  under 30 samples, mean+3*sigma beyond (evaluator.go:93-129);
+- candidate filtering: blocklist, same-host, rootless-normal-parent,
+  bad-node, no-free-upload, DAG-cycle rules
+  (scheduler/scheduling/scheduling.go:500-571).
+
+Where the reference scores ONE child's parents per call behind a mutex,
+this kernel scores (B tasks x K candidates) per device call with masked
+vector ops and `lax.top_k` — BASELINE.json configs[2]'s 1k x 64 shape in a
+single XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+from dragonfly2_tpu.ops.topk import masked_top_k
+from dragonfly2_tpu.state.fsm import BAD_NODE_STATES, PeerState
+
+_BASE_WEIGHTS = dict(
+    piece=CONSTANTS.W_FINISHED_PIECE,
+    upload=CONSTANTS.W_UPLOAD_SUCCESS,
+    free_upload=CONSTANTS.W_FREE_UPLOAD,
+    host_type=CONSTANTS.W_HOST_TYPE,
+    idc=CONSTANTS.W_IDC,
+    location=CONSTANTS.W_LOCATION,
+    probe=0.0,
+)
+
+_NT_WEIGHTS = dict(
+    piece=CONSTANTS.NT_W_FINISHED_PIECE,
+    upload=CONSTANTS.NT_W_UPLOAD_SUCCESS,
+    free_upload=CONSTANTS.NT_W_FREE_UPLOAD,
+    host_type=CONSTANTS.NT_W_HOST_TYPE,
+    idc=CONSTANTS.NT_W_IDC,
+    location=CONSTANTS.NT_W_LOCATION,
+    probe=CONSTANTS.NT_W_PROBE,
+)
+
+MAX_SCORE = jnp.float32(CONSTANTS.MAX_SCORE)
+MIN_SCORE = jnp.float32(CONSTANTS.MIN_SCORE)
+
+# int8 codes of states where IsBadNode short-circuits true (evaluator.go:94-96);
+# single source of truth lives in state/fsm.py.
+_BAD_STATES = tuple(sorted(int(s) for s in BAD_NODE_STATES))
+
+
+def piece_score(finished, child_finished, total):
+    """finished/total when total is known, else raw finished-count delta
+    (evaluator_base.go:86-99). Unbounded by design."""
+    total_f = total.astype(jnp.float32)[..., None]
+    known = total_f > 0
+    normalized = finished.astype(jnp.float32) / jnp.maximum(total_f, 1.0)
+    delta = finished.astype(jnp.float32) - child_finished.astype(jnp.float32)[..., None]
+    return jnp.where(known, normalized, delta)
+
+
+def upload_success_score(upload_count, upload_failed):
+    """(uc-ufc)/uc; never-scheduled hosts get max (evaluator_base.go:102-115)."""
+    uc = upload_count.astype(jnp.float32)
+    ufc = upload_failed.astype(jnp.float32)
+    ratio = (uc - ufc) / jnp.maximum(uc, 1.0)
+    score = jnp.where(uc < ufc, MIN_SCORE, ratio)
+    return jnp.where((upload_count == 0) & (upload_failed == 0), MAX_SCORE, score)
+
+
+def free_upload_score(upload_limit, upload_used):
+    free = (upload_limit - upload_used).astype(jnp.float32)
+    limit = upload_limit.astype(jnp.float32)
+    ok = (limit > 0) & (free > 0)
+    return jnp.where(ok, free / jnp.maximum(limit, 1.0), MIN_SCORE)
+
+
+def host_type_score(host_type, peer_state):
+    """Seed peers max out while Received/Running, else 0; normal hosts 0.5
+    (evaluator_base.go:129-143)."""
+    is_normal = host_type == 0
+    active = (peer_state == int(PeerState.RECEIVED_NORMAL)) | (
+        peer_state == int(PeerState.RUNNING)
+    )
+    seed_score = jnp.where(active, MAX_SCORE, MIN_SCORE)
+    return jnp.where(is_normal, MAX_SCORE * 0.5, seed_score)
+
+
+def idc_affinity_score(parent_idc, child_idc):
+    child = child_idc[..., None]
+    both = (parent_idc != 0) & (child != 0)
+    return jnp.where(both & (parent_idc == child), MAX_SCORE, MIN_SCORE).astype(jnp.float32)
+
+
+def location_affinity_score(parent_loc, child_loc):
+    """Leading-element match depth / 5, exact match = 1.0, either side
+    empty = 0 (evaluator_base.go:159-188). Operates on per-element hash
+    codes; code 0 = absent element."""
+    child = child_loc[:, None, :]  # (B,1,L)
+    both_present = (parent_loc[..., 0] != 0) & (child[..., 0] != 0)
+    exact = jnp.all(parent_loc == child, axis=-1)
+    elem_eq = (parent_loc == child) & (parent_loc != 0) & (child != 0)
+    # prefix length: cumulative AND of leading matches
+    prefix = jnp.cumprod(elem_eq.astype(jnp.int32), axis=-1)
+    depth = prefix.sum(axis=-1).astype(jnp.float32) / CONSTANTS.MAX_LOCATION_ELEMENTS
+    score = jnp.where(exact, MAX_SCORE, depth)
+    return jnp.where(both_present, score, MIN_SCORE)
+
+
+def probe_score(avg_rtt_ns, has_rtt):
+    """(pingTimeout - avgRTT) / pingTimeout, 0 when unprobed
+    (evaluator_network_topology.go:217-224)."""
+    timeout = jnp.float32(CONSTANTS.PING_TIMEOUT_NS)
+    return jnp.where(has_rtt, (timeout - avg_rtt_ns) / timeout, MIN_SCORE)
+
+
+def _blend(feats: dict, weights: dict) -> jax.Array:
+    score = (
+        weights["piece"]
+        * piece_score(
+            feats["finished_pieces"], feats["child_finished_pieces"], feats["total_piece_count"]
+        )
+        + weights["upload"]
+        * upload_success_score(feats["upload_count"], feats["upload_failed_count"])
+        + weights["free_upload"] * free_upload_score(feats["upload_limit"], feats["upload_used"])
+        + weights["host_type"] * host_type_score(feats["host_type"], feats["peer_state"])
+        + weights["idc"] * idc_affinity_score(feats["parent_idc"], feats["child_idc"])
+        + weights["location"]
+        * location_affinity_score(feats["parent_location"], feats["child_location"])
+    )
+    if weights["probe"]:
+        score = score + weights["probe"] * probe_score(feats["avg_rtt_ns"], feats["has_rtt"])
+    return score
+
+
+def evaluate(feats: dict, algorithm: str = "default") -> jax.Array:
+    """Scores (B, K) for every candidate. `algorithm` in {default, nt}."""
+    weights = _NT_WEIGHTS if algorithm == "nt" else _BASE_WEIGHTS
+    return _blend(feats, weights)
+
+
+def is_bad_node(piece_costs, piece_cost_count, peer_state):
+    """(B, K) bool — replicate IsBadNode's sampled-outlier rule on padded
+    cost rings ordered oldest->newest (evaluator.go:93-129)."""
+    c = piece_costs.shape[-1]
+    count = piece_cost_count.astype(jnp.int32)
+    idx = jnp.arange(c, dtype=jnp.int32)
+    prev_mask = idx[None, None, :] < (count[..., None] - 1)  # all but the newest
+    prev_n = jnp.maximum(count - 1, 1).astype(jnp.float32)
+
+    prev_sum = jnp.where(prev_mask, piece_costs, 0.0).sum(axis=-1)
+    mean = prev_sum / prev_n
+    var = jnp.where(prev_mask, (piece_costs - mean[..., None]) ** 2, 0.0).sum(axis=-1) / prev_n
+    std = jnp.sqrt(var)
+
+    last_idx = jnp.clip(count - 1, 0, c - 1)
+    last = jnp.take_along_axis(piece_costs, last_idx[..., None], axis=-1)[..., 0]
+
+    small_sample = count < CONSTANTS.NORMAL_DISTRIBUTION_LEN
+    outlier_small = last > mean * CONSTANTS.BAD_NODE_MEAN_MULTIPLIER
+    outlier_normal = last > mean + CONSTANTS.BAD_NODE_SIGMA * std
+    cost_bad = jnp.where(small_sample, outlier_small, outlier_normal)
+    cost_bad = jnp.where(count < CONSTANTS.MIN_AVAILABLE_COST_LEN, False, cost_bad)
+
+    state_bad = jnp.zeros(peer_state.shape, bool)
+    for code in _BAD_STATES:
+        state_bad = state_bad | (peer_state == code)
+    return state_bad | cost_bad
+
+
+def filter_candidates(
+    feats: dict,
+    blocklist: jax.Array | None = None,
+    in_degree: jax.Array | None = None,
+    can_add_edge: jax.Array | None = None,
+) -> jax.Array:
+    """(B, K) bool eligibility mask — scheduling.go:500-571 as vector ops.
+
+    `in_degree`/`can_add_edge` come from the graph engine (graph/dag.py);
+    None means "no DAG constraint" (trace replay mode).
+    """
+    mask = feats["valid"]
+    if blocklist is not None:
+        mask = mask & ~blocklist
+    # Same host can't serve itself (scheduling.go:519-525).
+    mask = mask & (feats["parent_host_id"] != feats["child_host_id"][..., None])
+    # A normal-host parent must itself have a parent, or have finished /
+    # gone back-to-source (scheduling.go:534-544).
+    state = feats["peer_state"]
+    rooted = (
+        (state == int(PeerState.BACK_TO_SOURCE))
+        | (state == int(PeerState.SUCCEEDED))
+        | (feats["host_type"] != 0)
+    )
+    if in_degree is not None:
+        rooted = rooted | (in_degree > 0)
+    mask = mask & rooted
+    # Bad nodes are skipped (scheduling.go:546-550).
+    mask = mask & ~is_bad_node(feats["piece_costs"], feats["piece_cost_count"], state)
+    # Saturated uploaders are skipped (scheduling.go:552-557).
+    mask = mask & ((feats["upload_limit"] - feats["upload_used"]) > 0)
+    # Edges that would create a cycle are skipped (scheduling.go:559-563).
+    if can_add_edge is not None:
+        mask = mask & can_add_edge
+    return mask
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm", "limit"))
+def schedule_candidate_parents(
+    feats: dict,
+    blocklist: jax.Array | None = None,
+    in_degree: jax.Array | None = None,
+    can_add_edge: jax.Array | None = None,
+    algorithm: str = "default",
+    limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
+):
+    """Filter + score + select top-`limit` parents for B children at once.
+
+    Returns dict with `scores` (B,K), `mask` (B,K), `selected` (B,limit)
+    candidate indices, `selected_valid` (B,limit), `selected_scores`.
+    One device call per scheduler tick — the <1ms p50 path.
+    """
+    mask = filter_candidates(feats, blocklist, in_degree, can_add_edge)
+    scores = evaluate(feats, algorithm)
+    values, indices, valid = masked_top_k(scores, mask, limit)
+    return {
+        "scores": scores,
+        "mask": mask,
+        "selected": indices,
+        "selected_valid": valid,
+        "selected_scores": values,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm",))
+def find_success_parent(
+    feats: dict,
+    blocklist: jax.Array | None = None,
+    in_degree: jax.Array | None = None,
+    can_add_edge: jax.Array | None = None,
+    algorithm: str = "default",
+):
+    """Best already-Succeeded parent per child (FindSuccessParent,
+    scheduling.go:442-497): the reference runs the full
+    filterCandidateParents first (:478) and then keeps only Succeeded
+    candidates (:484-489), so every filter rule applies here too."""
+    mask = filter_candidates(feats, blocklist, in_degree, can_add_edge)
+    mask = mask & (feats["peer_state"] == int(PeerState.SUCCEEDED))
+    scores = evaluate(feats, algorithm)
+    values, indices, valid = masked_top_k(scores, mask, 1)
+    return {
+        "parent": indices[..., 0],
+        "found": valid[..., 0],
+        "score": values[..., 0],
+    }

@@ -1,0 +1,85 @@
+"""Probe RTT ring buffers + folded EWMA — device-resident network topology.
+
+Replaces the reference's Redis probe lists (`probes:src:dst` RPUSH/LPOP,
+queue length 5) and its moving-average recomputation on every enqueue
+(scheduler/networktopology/probes.go:145-221): avg starts at the oldest
+probe and folds `avg = W*avg + (1-W)*sample` over the queue in order, with
+W = 0.1 (probes.go:39). Here the whole pair set is a (N, Q) ring-buffer
+array updated by one scattered device call per probe batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+
+W = CONSTANTS.EWMA_WEIGHT  # weight on the running average
+
+
+def _ordered(ring: jax.Array, cursor: jax.Array, count: jax.Array) -> jax.Array:
+    """Return ring contents oldest->newest along the last axis."""
+    q = ring.shape[-1]
+    idx = jnp.arange(q, dtype=jnp.int32)
+    start = jnp.where(count[..., None] >= q, cursor[..., None], 0)
+    gather = (start + idx) % q
+    return jnp.take_along_axis(ring, gather, axis=-1)
+
+
+def fold_average(ring: jax.Array, cursor: jax.Array, count: jax.Array) -> jax.Array:
+    """Folded moving average over each pair's queue (probes.go:175-200).
+
+    Empty queues yield 0. Q is static (default 5) so the fold unrolls.
+    """
+    ordered = _ordered(ring, cursor, count)
+    q = ring.shape[-1]
+    avg = ordered[..., 0]
+    for i in range(1, q):
+        has = count > i
+        avg = jnp.where(has, W * avg + (1.0 - W) * ordered[..., i], avg)
+    return jnp.where(count > 0, avg, 0.0)
+
+
+@jax.jit
+def enqueue(
+    ring: jax.Array,       # (N, Q) float32 rtt ns
+    cursor: jax.Array,     # (N,) int32 next write slot
+    count: jax.Array,      # (N,) int32 valid entries
+    pair_idx: jax.Array,   # (M,) int32 pairs receiving a new probe
+    rtt_ns: jax.Array,     # (M,) float32
+):
+    """Scatter M new probes into their pair rings, drop the oldest where
+    full, and return recomputed averages for ALL pairs.
+
+    Duplicate pair ids within one batch keep the last write (scatter
+    semantics); callers batch at most one probe per pair per tick.
+    """
+    write_slot = cursor[pair_idx]
+    ring = ring.at[pair_idx, write_slot].set(rtt_ns)
+    q = ring.shape[-1]
+    cursor = cursor.at[pair_idx].set((write_slot + 1) % q)
+    count = count.at[pair_idx].set(jnp.minimum(count[pair_idx] + 1, q))
+    avg = fold_average(ring, cursor, count)
+    return ring, cursor, count, avg
+
+
+@jax.jit
+def probed_count_increment(probed_count: jax.Array, host_idx: jax.Array) -> jax.Array:
+    """INCR probed-count:host for each probed destination (probes.go:214-218)."""
+    ones = jnp.ones(host_idx.shape, probed_count.dtype)
+    return probed_count.at[host_idx].add(ones)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def least_probed_hosts(probed_count: jax.Array, alive: jax.Array, noise_key: jax.Array, k: int = CONSTANTS.FIND_PROBED_HOSTS_LIMIT):
+    """Pick up to k alive hosts, least-probed first with random tie-break —
+    FindProbedHosts semantics (networktopology/network_topology.go:190-257)."""
+    n = probed_count.shape[0]
+    jitter = jax.random.uniform(noise_key, (n,), minval=0.0, maxval=0.5)
+    keys = jnp.where(alive, probed_count.astype(jnp.float32) + jitter, jnp.inf)
+    _, idx = jax.lax.top_k(-keys, k)
+    valid = jnp.take(alive, idx)
+    return idx, valid

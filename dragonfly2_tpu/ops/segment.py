@@ -1,0 +1,42 @@
+"""Segment reductions — the graph-aggregation primitive.
+
+Where the reference walks pointer DAGs (pkg/graph/dag/dag.go), the TPU
+build lowers neighborhood aggregation to `jax.ops.segment_sum` over COO
+edge arrays (SURVEY.md §2.6/§7): gather node states at edge endpoints,
+reduce by segment id. All wrappers take a static `num_segments` so shapes
+stay compile-time constant.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_mean(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    totals = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    ones = jnp.ones(data.shape[:1], dtype=data.dtype)
+    counts = jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+    counts = jnp.maximum(counts, 1)
+    if data.ndim > 1:
+        counts = counts.reshape((-1,) + (1,) * (data.ndim - 1))
+    return totals / counts
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def segment_count(segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    ones = jnp.ones(segment_ids.shape, dtype=jnp.int32)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)

@@ -1,0 +1,27 @@
+"""Masked top-k selection.
+
+The TPU-native replacement for the reference's sort-by-score parent
+selection (evaluator_base.go:59-68 sort.Slice + scheduling.go candidate
+truncation): invalid candidates are pushed to -inf so `lax.top_k` never
+picks them, and validity flows back out as a mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
+    """Top-k along the last axis honoring a validity mask.
+
+    Returns (values, indices, valid): `valid[i, j]` is False for slots that
+    had fewer than j+1 valid candidates. Ties break toward lower index
+    (lax.top_k is stable in that sense).
+    """
+    masked = jnp.where(mask, scores, NEG_INF)
+    values, indices = jax.lax.top_k(masked, k)
+    valid = values > NEG_INF
+    return values, indices, valid

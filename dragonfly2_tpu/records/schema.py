@@ -1,0 +1,280 @@
+"""Trace record schemas — the training dataset's shape.
+
+Capability parity with /root/reference/scheduler/storage/types.go:
+``Download`` (:189-225, peer + task + host features, up to 20 ``Parent``s
+each with up to 10 ``Piece`` costs) and ``NetworkTopology`` (:285-297,
+``SrcHost`` + up to 5 ``DestHost``s with EWMA ``Probes.AverageRTT``), with
+host stat sub-structs from scheduler/resource/host.go:210-330.
+
+Records are plain dataclasses with ``flatten()``/``unflatten()`` to a flat
+``dict[str, str|int|float]`` whose keys are dotted paths with fixed-width
+list expansion (``parents.3.pieces.7.cost``) — i.e. a *columnar* layout:
+every record of a type has the same columns, so a CSV file of them maps
+1:1 onto the padded dense arrays the TPU kernels consume
+(records/features.py). Ragged reality (fewer parents/pieces) is encoded by
+zero-filled columns + count fields, which become masks on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import get_args, get_origin, get_type_hints
+
+
+@dataclasses.dataclass
+class CPUStat:
+    logical_count: int = 0
+    physical_count: int = 0
+    percent: float = 0.0
+    process_percent: float = 0.0
+
+
+@dataclasses.dataclass
+class MemoryStat:
+    total: int = 0
+    available: int = 0
+    used: int = 0
+    used_percent: float = 0.0
+    process_used: int = 0
+    free: int = 0
+
+
+@dataclasses.dataclass
+class NetworkStat:
+    tcp_connection_count: int = 0
+    upload_tcp_connection_count: int = 0
+    location: str = ""
+    idc: str = ""
+
+
+@dataclasses.dataclass
+class DiskStat:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    used_percent: float = 0.0
+    inodes_total: int = 0
+    inodes_used: int = 0
+    inodes_free: int = 0
+    inodes_used_percent: float = 0.0
+
+
+@dataclasses.dataclass
+class BuildInfo:
+    git_version: str = ""
+    git_commit: str = ""
+    go_version: str = ""
+    platform: str = ""
+
+
+@dataclasses.dataclass
+class TaskRecord:
+    id: str = ""
+    url: str = ""
+    type: str = ""
+    content_length: int = 0
+    total_piece_count: int = 0
+    back_to_source_limit: int = 0
+    back_to_source_peer_count: int = 0
+    state: str = ""
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclasses.dataclass
+class HostRecord:
+    id: str = ""
+    type: str = ""
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    download_port: int = 0
+    os: str = ""
+    platform: str = ""
+    platform_family: str = ""
+    platform_version: str = ""
+    kernel_version: str = ""
+    concurrent_upload_limit: int = 0
+    concurrent_upload_count: int = 0
+    upload_count: int = 0
+    upload_failed_count: int = 0
+    cpu: CPUStat = dataclasses.field(default_factory=CPUStat)
+    memory: MemoryStat = dataclasses.field(default_factory=MemoryStat)
+    network: NetworkStat = dataclasses.field(default_factory=NetworkStat)
+    disk: DiskStat = dataclasses.field(default_factory=DiskStat)
+    build: BuildInfo = dataclasses.field(default_factory=BuildInfo)
+    scheduler_cluster_id: int = 0
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclasses.dataclass
+class PieceRecord:
+    length: int = 0
+    cost: int = 0  # nanoseconds
+    created_at: int = 0
+
+
+@dataclasses.dataclass
+class ParentRecord:
+    id: str = ""
+    tag: str = ""
+    application: str = ""
+    state: str = ""
+    cost: int = 0
+    upload_piece_count: int = 0
+    finished_piece_count: int = 0
+    host: HostRecord = dataclasses.field(default_factory=HostRecord)
+    pieces: list[PieceRecord] = dataclasses.field(default_factory=list)  # maxlen 10
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclasses.dataclass
+class ErrorRecord:
+    code: str = ""
+    message: str = ""
+
+
+@dataclasses.dataclass
+class DownloadRecord:
+    id: str = ""
+    tag: str = ""
+    application: str = ""
+    state: str = ""
+    error: ErrorRecord = dataclasses.field(default_factory=ErrorRecord)
+    cost: int = 0
+    finished_piece_count: int = 0
+    task: TaskRecord = dataclasses.field(default_factory=TaskRecord)
+    host: HostRecord = dataclasses.field(default_factory=HostRecord)
+    parents: list[ParentRecord] = dataclasses.field(default_factory=list)  # maxlen 20
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclasses.dataclass
+class ProbesRecord:
+    average_rtt: int = 0  # nanoseconds, EWMA
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclasses.dataclass
+class SrcHostRecord:
+    id: str = ""
+    type: str = ""
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    network: NetworkStat = dataclasses.field(default_factory=NetworkStat)
+
+
+@dataclasses.dataclass
+class DestHostRecord:
+    id: str = ""
+    type: str = ""
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    network: NetworkStat = dataclasses.field(default_factory=NetworkStat)
+    probes: ProbesRecord = dataclasses.field(default_factory=ProbesRecord)
+
+
+@dataclasses.dataclass
+class NetworkTopologyRecord:
+    id: str = ""
+    host: SrcHostRecord = dataclasses.field(default_factory=SrcHostRecord)
+    dest_hosts: list[DestHostRecord] = dataclasses.field(default_factory=list)  # maxlen 5
+    created_at: int = 0
+
+
+# Fixed list widths per (record type, field): types.go csv[] tags.
+LIST_WIDTHS: dict[tuple[type, str], int] = {
+    (ParentRecord, "pieces"): 10,
+    (DownloadRecord, "parents"): 20,
+    (NetworkTopologyRecord, "dest_hosts"): 5,
+}
+
+
+def _list_width(cls: type, field: str) -> int:
+    try:
+        return LIST_WIDTHS[(cls, field)]
+    except KeyError:
+        raise TypeError(f"no fixed width declared for list field {cls.__name__}.{field}")
+
+
+def _element_type(cls: type, field_name: str) -> type:
+    hints = get_type_hints(cls)
+    tp = hints[field_name]
+    if get_origin(tp) in (list, typing.List):
+        return get_args(tp)[0]
+    raise TypeError(f"{cls.__name__}.{field_name} is not a list field")
+
+
+def flatten(record) -> dict:
+    """Flatten a record into an ordered flat dict of scalar columns."""
+    out: dict = {}
+    _flatten_into(record, "", out)
+    return out
+
+
+def _flatten_into(obj, prefix: str, out: dict) -> None:
+    cls = type(obj)
+    for f in dataclasses.fields(cls):
+        key = f"{prefix}{f.name}"
+        value = getattr(obj, f.name)
+        if dataclasses.is_dataclass(value):
+            _flatten_into(value, key + ".", out)
+        elif isinstance(value, list):
+            width = _list_width(cls, f.name)
+            elem_cls = _element_type(cls, f.name)
+            if len(value) > width:
+                raise ValueError(f"{cls.__name__}.{f.name} has {len(value)} items, max {width}")
+            out[key + ".count"] = len(value)
+            for i in range(width):
+                elem = value[i] if i < len(value) else elem_cls()
+                _flatten_into(elem, f"{key}.{i}.", out)
+        else:
+            out[key] = value
+
+
+def header(cls_or_obj) -> list[str]:
+    obj = cls_or_obj() if isinstance(cls_or_obj, type) else cls_or_obj
+    return list(flatten(obj).keys())
+
+
+def unflatten(cls: type, row: dict):
+    """Rebuild a record from a flat column dict (inverse of flatten)."""
+    obj = cls()
+    _unflatten_into(obj, "", row)
+    return obj
+
+
+def _unflatten_into(obj, prefix: str, row: dict) -> None:
+    cls = type(obj)
+    hints = get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        key = f"{prefix}{f.name}"
+        current = getattr(obj, f.name)
+        if dataclasses.is_dataclass(current):
+            _unflatten_into(current, key + ".", row)
+        elif isinstance(current, list):
+            width = _list_width(cls, f.name)
+            elem_cls = _element_type(cls, f.name)
+            count = int(row.get(key + ".count", 0))
+            items = []
+            for i in range(min(count, width)):
+                elem = elem_cls()
+                _unflatten_into(elem, f"{key}.{i}.", row)
+                items.append(elem)
+            setattr(obj, f.name, items)
+        else:
+            tp = hints[f.name]
+            raw = row.get(key, "")
+            if tp is int:
+                setattr(obj, f.name, int(float(raw)) if raw != "" else 0)
+            elif tp is float:
+                setattr(obj, f.name, float(raw) if raw != "" else 0.0)
+            else:
+                setattr(obj, f.name, str(raw))

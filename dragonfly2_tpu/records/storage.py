@@ -1,0 +1,198 @@
+"""Buffered CSV trace storage with size-based rotation and max-backups.
+
+Capability parity with /root/reference/scheduler/storage/storage.go:
+``Create{Download,NetworkTopology}`` buffered appends, rotation at
+``max_size/max_backups`` (:412-475), ``List``/``Open``/``Clear`` and the
+count accessors, plus the trainer-side per-host variants
+(/root/reference/trainer/storage/storage.go:44-148).
+
+Python/TPU difference: rows are written through the columnar ``flatten()``
+layout (records/schema.py), so a file can be bulk-loaded straight into
+numpy columns without per-row object decoding (records/features.py).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import pathlib
+import threading
+from typing import Iterator, Type
+
+from dragonfly2_tpu.records import schema as _schema
+from dragonfly2_tpu.records.schema import DownloadRecord, NetworkTopologyRecord
+
+DOWNLOAD_FILE_PREFIX = "download"
+NETWORK_TOPOLOGY_FILE_PREFIX = "networktopology"
+CSV_EXT = ".csv"
+
+
+class _RotatingCSV:
+    """One record type's rotating CSV set: <prefix>.csv + <prefix>-N.csv backups."""
+
+    def __init__(self, base_dir: pathlib.Path, prefix: str, record_cls: type,
+                 max_size_bytes: int, max_backups: int):
+        self.base_dir = base_dir
+        self.prefix = prefix
+        self.record_cls = record_cls
+        self.max_size_bytes = max_size_bytes
+        self.max_backups = max_backups
+        self.header = _schema.header(record_cls())
+        self._lock = threading.Lock()
+        self._count = 0
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+
+    @property
+    def active_path(self) -> pathlib.Path:
+        return self.base_dir / f"{self.prefix}{CSV_EXT}"
+
+    def backup_paths(self) -> list[pathlib.Path]:
+        return sorted(
+            self.base_dir.glob(f"{self.prefix}-*{CSV_EXT}"),
+            key=lambda p: int(p.stem.rsplit("-", 1)[1]),
+        )
+
+    def all_paths(self) -> list[pathlib.Path]:
+        paths = self.backup_paths()
+        if self.active_path.exists():
+            paths.append(self.active_path)
+        return paths
+
+    def create(self, record) -> None:
+        row = _schema.flatten(record)
+        line = _csv_line(self.header, row)
+        with self._lock:
+            path = self.active_path
+            new_file = not path.exists() or path.stat().st_size == 0
+            if not new_file and path.stat().st_size + len(line) > self.max_size_bytes:
+                self._rotate_locked()
+                new_file = True
+            with path.open("a", newline="") as f:
+                if new_file:
+                    f.write(_csv_line(self.header, dict(zip(self.header, self.header))))
+                f.write(line)
+            self._count += 1
+
+    def _rotate_locked(self) -> None:
+        backups = self.backup_paths()
+        next_idx = int(backups[-1].stem.rsplit("-", 1)[1]) + 1 if backups else 1
+        self.active_path.rename(self.base_dir / f"{self.prefix}-{next_idx}{CSV_EXT}")
+        backups = self.backup_paths()
+        # max_backups counts the active file too, mirroring the reference.
+        while len(backups) > self.max_backups - 1:
+            backups.pop(0).unlink()
+
+    def iter_records(self) -> Iterator:
+        for path in self.all_paths():
+            with path.open(newline="") as f:
+                for row in csv.DictReader(f):
+                    yield _schema.unflatten(self.record_cls, row)
+
+    def count(self) -> int:
+        return self._count
+
+    def open_bytes(self) -> bytes:
+        """Concatenated raw bytes of all rotations (announcer upload path)."""
+        buf = io.BytesIO()
+        for path in self.all_paths():
+            buf.write(path.read_bytes())
+        return buf.getvalue()
+
+    def clear(self) -> None:
+        with self._lock:
+            for path in self.all_paths():
+                path.unlink(missing_ok=True)
+            self._count = 0
+
+
+def _csv_line(header: list[str], row: dict) -> str:
+    out = io.StringIO()
+    csv.writer(out, lineterminator="\n").writerow([row.get(h, "") for h in header])
+    return out.getvalue()
+
+
+class TraceStorage:
+    """Scheduler-side trace recorder: download.csv + networktopology.csv."""
+
+    def __init__(self, data_dir: str | pathlib.Path, max_size_mb: int = 100, max_backups: int = 10):
+        base = pathlib.Path(data_dir)
+        max_bytes = max_size_mb * (1 << 20)
+        self.downloads = _RotatingCSV(base, DOWNLOAD_FILE_PREFIX, DownloadRecord, max_bytes, max_backups)
+        self.topologies = _RotatingCSV(base, NETWORK_TOPOLOGY_FILE_PREFIX, NetworkTopologyRecord, max_bytes, max_backups)
+
+    def create_download(self, record: DownloadRecord) -> None:
+        self.downloads.create(record)
+
+    def create_network_topology(self, record: NetworkTopologyRecord) -> None:
+        self.topologies.create(record)
+
+    def list_downloads(self) -> list[DownloadRecord]:
+        return list(self.downloads.iter_records())
+
+    def list_network_topologies(self) -> list[NetworkTopologyRecord]:
+        return list(self.topologies.iter_records())
+
+    def open_download(self) -> bytes:
+        return self.downloads.open_bytes()
+
+    def open_network_topology(self) -> bytes:
+        return self.topologies.open_bytes()
+
+    def clear(self) -> None:
+        self.downloads.clear()
+        self.topologies.clear()
+
+
+class HostTraceStorage:
+    """Trainer-side per-host dataset store (trainer/storage/storage.go).
+
+    The trainer receives per-scheduler-host dataset streams; each host's
+    rows land in ``download-<hostid>.csv`` / ``networktopology-<hostid>.csv``.
+    """
+
+    def __init__(self, data_dir: str | pathlib.Path):
+        self.base = pathlib.Path(data_dir)
+        self.base.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, prefix: str, host_id: str) -> pathlib.Path:
+        return self.base / f"{prefix}-{host_id}{CSV_EXT}"
+
+    def append_download_bytes(self, host_id: str, data: bytes) -> None:
+        with self._path(DOWNLOAD_FILE_PREFIX, host_id).open("ab") as f:
+            f.write(data)
+
+    def append_network_topology_bytes(self, host_id: str, data: bytes) -> None:
+        with self._path(NETWORK_TOPOLOGY_FILE_PREFIX, host_id).open("ab") as f:
+            f.write(data)
+
+    def _iter(self, prefix: str, cls: Type) -> Iterator:
+        for path in sorted(self.base.glob(f"{prefix}-*{CSV_EXT}")):
+            with path.open(newline="") as f:
+                reader = csv.reader(f)
+                header = None
+                for values in reader:
+                    # Concatenated uploads repeat the header mid-file.
+                    if _looks_like_header(values):
+                        header = values
+                        continue
+                    if header is None:
+                        continue
+                    yield _schema.unflatten(cls, dict(zip(header, values)))
+
+    def list_downloads(self) -> list[DownloadRecord]:
+        return list(self._iter(DOWNLOAD_FILE_PREFIX, DownloadRecord))
+
+    def list_network_topologies(self) -> list[NetworkTopologyRecord]:
+        return list(self._iter(NETWORK_TOPOLOGY_FILE_PREFIX, NetworkTopologyRecord))
+
+    def clear_downloads(self) -> None:
+        for path in self.base.glob(f"{DOWNLOAD_FILE_PREFIX}-*{CSV_EXT}"):
+            path.unlink(missing_ok=True)
+
+    def clear_network_topologies(self) -> None:
+        for path in self.base.glob(f"{NETWORK_TOPOLOGY_FILE_PREFIX}-*{CSV_EXT}"):
+            path.unlink(missing_ok=True)
+
+
+def _looks_like_header(values: list[str]) -> bool:
+    return bool(values) and values[0] in ("id",) and not values[0].isdigit()

@@ -1,0 +1,13 @@
+from dragonfly2_tpu.state.fsm import PeerState, TaskState, HostType, PeerEvent, TaskEvent
+
+__all__ = ["PeerState", "TaskState", "HostType", "PeerEvent", "TaskEvent", "ClusterState"]
+
+
+def __getattr__(name):
+    # Lazy: cluster depends on records.features, which imports state.fsm —
+    # eager import here would make that a cycle.
+    if name == "ClusterState":
+        from dragonfly2_tpu.state.cluster import ClusterState
+
+        return ClusterState
+    raise AttributeError(name)

@@ -1,0 +1,359 @@
+"""Struct-of-arrays cluster state with fixed capacities and free lists.
+
+The TPU-native replacement for the reference's pointer-graph resource layer
+(scheduler/resource/: Host host.go:126-337, Task task.go:105-155, Peer
+peer.go:137 + managers with TTL GC). Instead of millions of tiny objects
+behind mutexes, cluster state is a set of preallocated numpy columns; every
+entity is a row index. The batched evaluator tick gathers candidate rows
+into `records.features.CandidateFeatures` and makes ONE device call — the
+"persistent batched scoring" design from SURVEY.md §7 that keeps p50 < 1ms.
+
+Capacity limits replace the reference's unbounded maps; slot reuse is via
+free lists, and TTL GC (pkg/gc semantics) is a vectorised sweep over the
+`updated_at` column.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+from dragonfly2_tpu.records.features import (
+    NUM_HOST_FEATURES,
+    CandidateFeatures,
+    MAX_LOC,
+)
+from dragonfly2_tpu.state.fsm import (
+    HostType,
+    PeerEvent,
+    PeerState,
+    TaskEvent,
+    TaskState,
+    peer_transition,
+    task_transition,
+)
+
+_NO_SLOT = -1
+
+
+class CapacityError(RuntimeError):
+    pass
+
+
+class _FreeList:
+    def __init__(self, capacity: int):
+        self._free = list(range(capacity - 1, -1, -1))
+
+    def acquire(self, kind: str) -> int:
+        if not self._free:
+            raise CapacityError(f"{kind} table full")
+        return self._free.pop()
+
+    def release(self, idx: int) -> None:
+        self._free.append(idx)
+
+    def used(self, capacity: int) -> int:
+        return capacity - len(self._free)
+
+
+class ClusterState:
+    def __init__(
+        self,
+        max_hosts: int = 16384,
+        max_tasks: int = 4096,
+        max_peers: int = 65536,
+        piece_cost_capacity: int = CONSTANTS.PIECE_COST_CAPACITY,
+        piece_bitset_words: int = 64,  # 64*64 = 4096 pieces per peer
+    ):
+        self.max_hosts = max_hosts
+        self.max_tasks = max_tasks
+        self.max_peers = max_peers
+        self.piece_cost_capacity = piece_cost_capacity
+        self.piece_bitset_words = piece_bitset_words
+
+        # --- hosts ---
+        self.host_alive = np.zeros(max_hosts, bool)
+        self.host_id_hash = np.zeros(max_hosts, np.int64)
+        self.host_type = np.zeros(max_hosts, np.int8)
+        self.host_idc = np.zeros(max_hosts, np.int64)
+        self.host_location = np.zeros((max_hosts, MAX_LOC), np.int64)
+        self.host_upload_limit = np.zeros(max_hosts, np.int32)
+        self.host_upload_used = np.zeros(max_hosts, np.int32)
+        self.host_upload_count = np.zeros(max_hosts, np.int64)
+        self.host_upload_failed = np.zeros(max_hosts, np.int64)
+        self.host_numeric = np.zeros((max_hosts, NUM_HOST_FEATURES), np.float32)
+        self.host_updated_at = np.zeros(max_hosts, np.float64)
+        self._host_free = _FreeList(max_hosts)
+        self._host_by_id: dict[str, int] = {}
+
+        # --- tasks ---
+        self.task_alive = np.zeros(max_tasks, bool)
+        self.task_state = np.zeros(max_tasks, np.int8)
+        self.task_total_pieces = np.zeros(max_tasks, np.int32)
+        self.task_content_length = np.zeros(max_tasks, np.int64)
+        self.task_back_to_source_limit = np.zeros(max_tasks, np.int32)
+        self.task_back_to_source_count = np.zeros(max_tasks, np.int32)
+        self.task_updated_at = np.zeros(max_tasks, np.float64)
+        self._task_free = _FreeList(max_tasks)
+        self._task_by_id: dict[str, int] = {}
+        self._task_id: list[str | None] = [None] * max_tasks
+
+        # --- peers ---
+        self.peer_alive = np.zeros(max_peers, bool)
+        self.peer_state = np.zeros(max_peers, np.int8)
+        self.peer_task = np.full(max_peers, _NO_SLOT, np.int32)
+        self.peer_host = np.full(max_peers, _NO_SLOT, np.int32)
+        self.peer_finished_bitset = np.zeros((max_peers, piece_bitset_words), np.uint64)
+        self.peer_finished_count = np.zeros(max_peers, np.int32)
+        self.peer_piece_costs = np.zeros((max_peers, piece_cost_capacity), np.float32)
+        self.peer_piece_cost_count = np.zeros(max_peers, np.int32)
+        self.peer_cost_cursor = np.zeros(max_peers, np.int32)
+        self.peer_updated_at = np.zeros(max_peers, np.float64)
+        self._peer_free = _FreeList(max_peers)
+        self._peer_by_id: dict[str, int] = {}
+        self._peer_id: list[str | None] = [None] * max_peers
+
+    # ------------------------------------------------------------- hosts
+
+    def upsert_host(
+        self,
+        host_id: str,
+        *,
+        id_hash: int,
+        host_type: HostType = HostType.NORMAL,
+        idc: int = 0,
+        location: np.ndarray | None = None,
+        upload_limit: int = 50,
+        upload_count: int = 0,
+        upload_failed: int = 0,
+        numeric: np.ndarray | None = None,
+    ) -> int:
+        idx = self._host_by_id.get(host_id)
+        if idx is None:
+            idx = self._host_free.acquire("host")
+            self._host_by_id[host_id] = idx
+            # Zero every column: the slot may be reused from a removed host
+            # and absent kwargs below must not inherit its values.
+            self.host_upload_used[idx] = 0
+            self.host_location[idx] = 0
+            self.host_numeric[idx] = 0
+        self.host_alive[idx] = True
+        self.host_id_hash[idx] = id_hash
+        self.host_type[idx] = int(host_type)
+        self.host_idc[idx] = idc
+        if location is not None:
+            self.host_location[idx] = location
+        self.host_upload_limit[idx] = upload_limit
+        self.host_upload_count[idx] = upload_count
+        self.host_upload_failed[idx] = upload_failed
+        if numeric is not None:
+            self.host_numeric[idx] = numeric
+        self.host_updated_at[idx] = time.time()
+        return idx
+
+    def host_index(self, host_id: str) -> int | None:
+        return self._host_by_id.get(host_id)
+
+    def remove_host(self, host_id: str) -> None:
+        idx = self._host_by_id.pop(host_id, None)
+        if idx is None:
+            return
+        self.host_alive[idx] = False
+        self._host_free.release(idx)
+
+    def host_free_upload(self, idx: int) -> int:
+        return int(self.host_upload_limit[idx] - self.host_upload_used[idx])
+
+    # ------------------------------------------------------------- tasks
+
+    def upsert_task(
+        self,
+        task_id: str,
+        *,
+        total_pieces: int = 0,
+        content_length: int = 0,
+        back_to_source_limit: int = 3,
+    ) -> int:
+        idx = self._task_by_id.get(task_id)
+        if idx is None:
+            idx = self._task_free.acquire("task")
+            self._task_by_id[task_id] = idx
+            self._task_id[idx] = task_id
+            self.task_state[idx] = int(TaskState.PENDING)
+            self.task_back_to_source_count[idx] = 0
+        self.task_alive[idx] = True
+        self.task_total_pieces[idx] = total_pieces
+        self.task_content_length[idx] = content_length
+        self.task_back_to_source_limit[idx] = back_to_source_limit
+        self.task_updated_at[idx] = time.time()
+        return idx
+
+    def task_index(self, task_id: str) -> int | None:
+        return self._task_by_id.get(task_id)
+
+    def task_event(self, idx: int, event: TaskEvent) -> None:
+        current = TaskState(int(self.task_state[idx]))
+        self.task_state[idx] = int(task_transition(current, event))
+        self.task_updated_at[idx] = time.time()
+
+    def remove_task(self, task_id: str) -> None:
+        idx = self._task_by_id.pop(task_id, None)
+        if idx is None:
+            return
+        self.task_alive[idx] = False
+        self._task_id[idx] = None
+        self._task_free.release(idx)
+
+    # ------------------------------------------------------------- peers
+
+    def add_peer(self, peer_id: str, task_idx: int, host_idx: int) -> int:
+        existing = self._peer_by_id.get(peer_id)
+        if existing is not None:
+            return existing
+        idx = self._peer_free.acquire("peer")
+        self._peer_by_id[peer_id] = idx
+        self._peer_id[idx] = peer_id
+        self.peer_alive[idx] = True
+        self.peer_state[idx] = int(PeerState.PENDING)
+        self.peer_task[idx] = task_idx
+        self.peer_host[idx] = host_idx
+        self.peer_finished_bitset[idx] = 0
+        self.peer_finished_count[idx] = 0
+        self.peer_piece_costs[idx] = 0
+        self.peer_piece_cost_count[idx] = 0
+        self.peer_cost_cursor[idx] = 0
+        self.peer_updated_at[idx] = time.time()
+        return idx
+
+    def peer_index(self, peer_id: str) -> int | None:
+        return self._peer_by_id.get(peer_id)
+
+    def peer_event(self, idx: int, event: PeerEvent) -> None:
+        current = PeerState(int(self.peer_state[idx]))
+        self.peer_state[idx] = int(peer_transition(current, event))
+        self.peer_updated_at[idx] = time.time()
+
+    def remove_peer(self, peer_id: str) -> None:
+        idx = self._peer_by_id.pop(peer_id, None)
+        if idx is None:
+            return
+        self.peer_alive[idx] = False
+        self._peer_id[idx] = None
+        self._peer_free.release(idx)
+
+    def record_piece(self, peer_idx: int, piece_number: int, cost_ns: float) -> None:
+        """Piece finished: set bitset bit, append cost to the ring buffer
+        (the IsBadNode sample window, evaluator.go:102-128)."""
+        word, bit = divmod(piece_number, 64)
+        if word < self.piece_bitset_words:
+            mask = np.uint64(1) << np.uint64(bit)
+            if not (self.peer_finished_bitset[peer_idx, word] & mask):
+                self.peer_finished_bitset[peer_idx, word] |= mask
+                self.peer_finished_count[peer_idx] += 1
+        cursor = int(self.peer_cost_cursor[peer_idx])
+        self.peer_piece_costs[peer_idx, cursor] = cost_ns
+        self.peer_cost_cursor[peer_idx] = (cursor + 1) % self.piece_cost_capacity
+        self.peer_piece_cost_count[peer_idx] = min(
+            int(self.peer_piece_cost_count[peer_idx]) + 1, self.piece_cost_capacity
+        )
+        self.peer_updated_at[peer_idx] = time.time()
+
+    def peer_piece_costs_ordered(self, peer_idx: int) -> np.ndarray:
+        """Costs oldest->newest (ring unrolled) for the 3-sigma rule."""
+        count = int(self.peer_piece_cost_count[peer_idx])
+        cursor = int(self.peer_cost_cursor[peer_idx])
+        ring = self.peer_piece_costs[peer_idx]
+        if count < self.piece_cost_capacity:
+            return ring[:count].copy()
+        return np.concatenate([ring[cursor:], ring[:cursor]])
+
+    # ------------------------------------------------------- GC sweeps
+
+    def gc_peers(self, ttl_seconds: float, now: float | None = None) -> int:
+        """Vectorised TTL sweep (pkg/gc + peer_manager RunGC semantics)."""
+        now = time.time() if now is None else now
+        stale = self.peer_alive & (now - self.peer_updated_at > ttl_seconds)
+        reaped = 0
+        for idx in np.nonzero(stale)[0]:
+            pid = self._peer_id[idx]
+            if pid is not None:
+                self.remove_peer(pid)
+                reaped += 1
+        return reaped
+
+    def counts(self) -> dict[str, int]:
+        return {
+            "hosts": self._host_free.used(self.max_hosts),
+            "tasks": self._task_free.used(self.max_tasks),
+            "peers": self._peer_free.used(self.max_peers),
+        }
+
+    # ------------------------------------------- evaluator batch gather
+
+    def gather_candidates(
+        self,
+        child_peer_idx: np.ndarray,
+        candidate_peer_idx: np.ndarray,
+        candidate_valid: np.ndarray,
+        avg_rtt_ns: np.ndarray | None = None,
+        has_rtt: np.ndarray | None = None,
+    ) -> CandidateFeatures:
+        """Gather evaluator inputs for B children x K candidate peers.
+
+        All index math is vectorised numpy; the result feeds the jitted
+        kernel in ops/evaluator.py unchanged.
+        """
+        b, k = candidate_peer_idx.shape
+        safe_cand = np.where(candidate_valid, candidate_peer_idx, 0)
+        cand_host = self.peer_host[safe_cand]
+        safe_cand_host = np.clip(cand_host, 0, None)
+        child_host = self.peer_host[child_peer_idx]
+        safe_child_host = np.clip(child_host, 0, None)
+
+        feats = CandidateFeatures.zeros(b, k, self.piece_cost_capacity)
+        feats.valid = candidate_valid & self.peer_alive[safe_cand]
+        feats.finished_pieces = self.peer_finished_count[safe_cand]
+        feats.child_finished_pieces = self.peer_finished_count[child_peer_idx]
+        feats.total_piece_count = self.task_total_pieces[
+            np.clip(self.peer_task[child_peer_idx], 0, None)
+        ]
+        feats.upload_count = self.host_upload_count[safe_cand_host]
+        feats.upload_failed_count = self.host_upload_failed[safe_cand_host]
+        feats.upload_limit = self.host_upload_limit[safe_cand_host]
+        feats.upload_used = self.host_upload_used[safe_cand_host]
+        feats.host_type = self.host_type[safe_cand_host]
+        feats.peer_state = self.peer_state[safe_cand]
+        feats.parent_idc = self.host_idc[safe_cand_host]
+        feats.child_idc = self.host_idc[safe_child_host]
+        feats.parent_location = self.host_location[safe_cand_host]
+        feats.child_location = self.host_location[safe_child_host]
+        feats.parent_host_id = self.host_id_hash[safe_cand_host]
+        feats.child_host_id = self.host_id_hash[safe_child_host]
+        feats.piece_costs = _ordered_costs_batch(
+            self.peer_piece_costs[safe_cand],
+            self.peer_cost_cursor[safe_cand],
+            self.peer_piece_cost_count[safe_cand],
+            self.piece_cost_capacity,
+        )
+        feats.piece_cost_count = self.peer_piece_cost_count[safe_cand]
+        feats.numeric = self.host_numeric[safe_cand_host]
+        feats.child_numeric = self.host_numeric[safe_child_host]
+        if avg_rtt_ns is not None:
+            feats.avg_rtt_ns = avg_rtt_ns.astype(np.float32)
+        if has_rtt is not None:
+            feats.has_rtt = has_rtt
+        return feats
+
+
+def _ordered_costs_batch(
+    costs: np.ndarray, cursor: np.ndarray, count: np.ndarray, capacity: int
+) -> np.ndarray:
+    """Unroll (..., C) ring buffers so index 0 is oldest, count-1 is newest."""
+    idx = np.arange(capacity)
+    # For full rings start at cursor; for partial rings the data already
+    # starts at 0 (cursor == count position).
+    start = np.where(count[..., None] >= capacity, cursor[..., None], 0)
+    gather = (start + idx) % capacity
+    return np.take_along_axis(costs, gather, axis=-1)

@@ -1,0 +1,3 @@
+from dragonfly2_tpu.utils import idgen, digest, hashring
+
+__all__ = ["idgen", "digest", "hashring"]

@@ -1,0 +1,61 @@
+"""Consistent-hash ring for task -> scheduler affinity.
+
+Capability parity with pkg/balancer/consistent_hashing.go:40-57 + the
+dynconfig-fed resolver (pkg/resolver/): every request for a given task id
+must land on the same scheduler so its in-memory DAG/state is authoritative.
+Implemented as a sorted ring of virtual-node hashes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode("utf-8")).digest()[:8], "big")
+
+
+class HashRing:
+    def __init__(self, nodes: list[str] | None = None, replicas: int = 64):
+        self._replicas = replicas
+        self._ring: list[int] = []
+        self._members: dict[int, str] = {}
+        self._nodes: set[str] = set()
+        for node in nodes or []:
+            self.add(node)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for i in range(self._replicas):
+            h = _hash(f"{node}#{i}")
+            idx = bisect.bisect(self._ring, h)
+            self._ring.insert(idx, h)
+            self._members[h] = node
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.remove(node)
+        for i in range(self._replicas):
+            h = _hash(f"{node}#{i}")
+            idx = bisect.bisect_left(self._ring, h)
+            if idx < len(self._ring) and self._ring[idx] == h:
+                self._ring.pop(idx)
+                self._members.pop(h, None)
+
+    def pick(self, key: str) -> str | None:
+        """Pick the node owning `key` (e.g. a task id)."""
+        if not self._ring:
+            return None
+        h = _hash(key)
+        idx = bisect.bisect(self._ring, h) % len(self._ring)
+        return self._members[self._ring[idx]]
+
+    def nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def __len__(self) -> int:
+        return len(self._nodes)

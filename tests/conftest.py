@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax loads.
+
+Mirrors the reference's approach of unit-testing "multi-node" logic without
+a cluster (SURVEY.md §4): sharding/collective code paths run on
+xla_force_host_platform_device_count=8 CPU devices; numeric kernels run on
+the CPU backend with fixed seeds. No TPU needed in CI.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,148 @@
+"""Struct-of-arrays cluster state tests (reference behaviors:
+scheduler/resource managers + FSMs)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.state import ClusterState, PeerEvent, PeerState, TaskEvent, TaskState
+from dragonfly2_tpu.state.fsm import HostType, InvalidTransition, peer_transition
+
+
+def make_state():
+    return ClusterState(max_hosts=16, max_tasks=8, max_peers=32, piece_cost_capacity=8)
+
+
+def test_host_lifecycle_and_freelist_reuse():
+    s = make_state()
+    a = s.upsert_host("h1", id_hash=111, host_type=HostType.SUPER, upload_limit=10)
+    b = s.upsert_host("h2", id_hash=222)
+    assert a != b
+    assert s.host_index("h1") == a
+    assert s.host_type[a] == int(HostType.SUPER)
+    # upsert same id updates in place
+    assert s.upsert_host("h1", id_hash=111, upload_limit=99) == a
+    assert s.host_upload_limit[a] == 99
+    s.remove_host("h1")
+    assert s.host_index("h1") is None
+    assert not s.host_alive[a]
+    c = s.upsert_host("h3", id_hash=333)
+    assert c == a  # slot reused
+
+
+def test_slot_reuse_does_not_leak_columns():
+    s = make_state()
+    loc = np.array([11, 22, 33, 0, 0], np.int64)
+    num = np.full(s.host_numeric.shape[1], 7.0, np.float32)
+    a = s.upsert_host("old", id_hash=1, location=loc, numeric=num)
+    s.host_upload_used[a] = 49
+    s.remove_host("old")
+    b = s.upsert_host("new", id_hash=2)  # no location/numeric kwargs
+    assert b == a
+    assert s.host_location[b].sum() == 0
+    assert s.host_numeric[b].sum() == 0
+    assert s.host_upload_used[b] == 0
+
+
+def test_capacity_error():
+    s = ClusterState(max_hosts=2, max_tasks=2, max_peers=2)
+    s.upsert_host("a", id_hash=1)
+    s.upsert_host("b", id_hash=2)
+    with pytest.raises(Exception):
+        s.upsert_host("c", id_hash=3)
+
+
+def test_peer_fsm_paths():
+    s = make_state()
+    h = s.upsert_host("h", id_hash=1)
+    t = s.upsert_task("t", total_pieces=10)
+    p = s.add_peer("p", t, h)
+    assert s.peer_state[p] == int(PeerState.PENDING)
+    s.peer_event(p, PeerEvent.REGISTER_NORMAL)
+    s.peer_event(p, PeerEvent.DOWNLOAD)
+    assert s.peer_state[p] == int(PeerState.RUNNING)
+    s.peer_event(p, PeerEvent.DOWNLOAD_SUCCEEDED)
+    assert s.peer_state[p] == int(PeerState.SUCCEEDED)
+    with pytest.raises(InvalidTransition):
+        s.peer_event(p, PeerEvent.DOWNLOAD)  # Succeeded -> Running illegal
+    s.peer_event(p, PeerEvent.LEAVE)
+    assert s.peer_state[p] == int(PeerState.LEAVE)
+
+
+def test_peer_transition_table_matches_reference():
+    # back-to-source path (peer.go:85-109)
+    st = peer_transition(PeerState.RECEIVED_NORMAL, PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+    assert st == PeerState.BACK_TO_SOURCE
+    assert peer_transition(st, PeerEvent.DOWNLOAD_SUCCEEDED) == PeerState.SUCCEEDED
+    # Succeeded can fail (e.g. validation failure)
+    assert peer_transition(PeerState.SUCCEEDED, PeerEvent.DOWNLOAD_FAILED) == PeerState.FAILED
+
+
+def test_task_fsm():
+    s = make_state()
+    t = s.upsert_task("t")
+    s.task_event(t, TaskEvent.DOWNLOAD)
+    assert s.task_state[t] == int(TaskState.RUNNING)
+    s.task_event(t, TaskEvent.DOWNLOAD_SUCCEEDED)
+    # succeeded task can re-enter running (task.go transitions)
+    s.task_event(t, TaskEvent.DOWNLOAD)
+    assert s.task_state[t] == int(TaskState.RUNNING)
+
+
+def test_record_piece_ring_and_bitset():
+    s = make_state()
+    h = s.upsert_host("h", id_hash=1)
+    t = s.upsert_task("t", total_pieces=100)
+    p = s.add_peer("p", t, h)
+    for i in range(5):
+        s.record_piece(p, i, 10.0 * (i + 1))
+    assert s.peer_finished_count[p] == 5
+    # duplicate piece number doesn't double count
+    s.record_piece(p, 0, 60.0)
+    assert s.peer_finished_count[p] == 5
+    costs = s.peer_piece_costs_ordered(p)
+    assert costs.tolist() == [10.0, 20.0, 30.0, 40.0, 50.0, 60.0]
+    # overflow the 8-slot ring: oldest drops
+    for i in range(5, 9):
+        s.record_piece(p, i, 100.0 + i)
+    costs = s.peer_piece_costs_ordered(p)
+    assert len(costs) == 8
+    assert costs[-1] == 108.0 and costs[0] == 30.0
+
+
+def test_gc_peers():
+    s = make_state()
+    h = s.upsert_host("h", id_hash=1)
+    t = s.upsert_task("t")
+    s.add_peer("old", t, h)
+    s.add_peer("new", t, h)
+    s.peer_updated_at[s.peer_index("old")] -= 1000
+    reaped = s.gc_peers(ttl_seconds=500)
+    assert reaped == 1
+    assert s.peer_index("old") is None and s.peer_index("new") is not None
+
+
+def test_gather_candidates_feeds_evaluator():
+    from dragonfly2_tpu.ops import evaluator as ev
+
+    s = make_state()
+    hosts = [s.upsert_host(f"h{i}", id_hash=100 + i, upload_limit=10) for i in range(4)]
+    t = s.upsert_task("t", total_pieces=50)
+    child = s.add_peer("child", t, hosts[0])
+    parents = [s.add_peer(f"p{i}", t, hosts[i + 1]) for i in range(3)]
+    for i, p in enumerate(parents):
+        s.peer_event(p, PeerEvent.REGISTER_NORMAL)
+        s.peer_event(p, PeerEvent.DOWNLOAD)
+        s.peer_event(p, PeerEvent.DOWNLOAD_SUCCEEDED)
+        for piece in range(i + 2):
+            s.record_piece(p, piece, 50.0)
+
+    cand = np.array([parents + [0]], np.int32)
+    valid = np.array([[True, True, True, False]])
+    feats = s.gather_candidates(np.array([child]), cand, valid)
+    assert feats.valid.tolist() == [[True, True, True, False]]
+    assert feats.finished_pieces[0, :3].tolist() == [2, 3, 4]
+    assert feats.total_piece_count[0] == 50
+
+    out = ev.schedule_candidate_parents(feats.as_dict(), limit=2)
+    sel_valid = np.asarray(out["selected_valid"])
+    assert sel_valid[0].sum() == 2

@@ -1,0 +1,76 @@
+"""Config loading, env overrides, dynconfig fallback (reference:
+internal/dynconfig + scheduler/config)."""
+
+import json
+
+from dragonfly2_tpu.config import Config, DynConfig
+
+
+def test_defaults_mirror_reference_constants():
+    cfg = Config()
+    assert cfg.scheduler.filter_parent_limit == 15
+    assert cfg.scheduler.candidate_parent_limit == 4
+    assert cfg.scheduler.retry_limit == 5
+    assert cfg.probe.queue_length == 5
+    assert cfg.probe.ewma_weight == 0.1
+    assert cfg.storage.max_size_mb == 100
+    assert cfg.storage.max_backups == 10
+    assert cfg.trainer.interval_seconds == 7 * 24 * 3600
+
+
+def test_load_yaml_like_file(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        """
+name: test-cluster
+scheduler:
+  filter_parent_limit: 30
+  retry_limit: 7
+probe:
+  queue_length: 9
+""",
+    )
+    cfg = Config.load(p)
+    assert cfg.name == "test-cluster"
+    assert cfg.scheduler.filter_parent_limit == 30
+    assert cfg.scheduler.retry_limit == 7
+    assert cfg.probe.queue_length == 9
+    # untouched values keep defaults
+    assert cfg.scheduler.candidate_parent_limit == 4
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("DRAGONFLY_SCHEDULER_FILTER_PARENT_LIMIT", "21")
+    monkeypatch.setenv("DRAGONFLY_PROBE_QUEUE_LENGTH", "3")
+    monkeypatch.setenv("DRAGONFLY_NAME", "prod-scheduler")
+    cfg = Config.load()
+    assert cfg.scheduler.filter_parent_limit == 21
+    assert cfg.probe.queue_length == 3
+    assert cfg.name == "prod-scheduler"
+
+
+def test_dynconfig_overrides_and_fallback(tmp_path):
+    calls = {"n": 0}
+
+    def resolver():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise ConnectionError("manager down")
+        return {"scheduler.filter_parent_limit": 99}
+
+    cache = tmp_path / "dynconfig.json"
+    dyn = DynConfig(Config(), resolver=resolver, refresh_interval=0.0, cache_path=cache)
+    assert dyn.get("scheduler.filter_parent_limit") == 99
+    # resolver now fails; cached override keeps serving
+    dyn.refresh_now()
+    assert dyn.get("scheduler.filter_parent_limit") == 99
+    assert json.loads(cache.read_text())["scheduler.filter_parent_limit"] == 99
+    # values without overrides come from the base config
+    assert dyn.get("scheduler.retry_limit") == 5
+
+
+def test_dynconfig_cache_survives_restart(tmp_path):
+    cache = tmp_path / "dynconfig.json"
+    cache.write_text(json.dumps({"probe.queue_length": 11}))
+    dyn = DynConfig(Config(), resolver=None, cache_path=cache)
+    assert dyn.get("probe.queue_length") == 11

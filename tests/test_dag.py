@@ -1,0 +1,119 @@
+"""DAG engine tests (reference: pkg/graph/dag/dag_test.go behaviors) plus
+differential host-vs-device checks for the batched kernels."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.graph import TaskDAG, DAGError, batch_can_add_edge, batch_reachable
+
+
+def test_add_edge_and_degrees():
+    g = TaskDAG(64)
+    for v in (0, 1, 2):
+        g.add_vertex(v)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    assert g.has_edge(0, 1) and g.has_edge(1, 2)
+    assert g.in_degree[1] == 1 and g.in_degree[2] == 1 and g.in_degree[0] == 0
+    assert g.out_degree[0] == 1 and g.out_degree[2] == 0
+    assert g.vertex_count() == 3 and g.edge_count() == 2
+
+
+def test_cycle_rejected():
+    g = TaskDAG(64)
+    for v in (0, 1, 2):
+        g.add_vertex(v)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    assert not g.can_add_edge(2, 0)  # 0 reaches 2, closing the loop
+    with pytest.raises(DAGError):
+        g.add_edge(2, 0)
+    assert not g.can_add_edge(0, 0)  # self loop
+    assert not g.can_add_edge(0, 1)  # duplicate
+    assert not g.can_add_edge(0, 5)  # absent vertex
+
+
+def test_delete_vertex_clears_incident_edges():
+    g = TaskDAG(64)
+    for v in (0, 1, 2):
+        g.add_vertex(v)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    g.delete_vertex(1)
+    assert g.vertex_count() == 2 and g.edge_count() == 0
+    assert g.in_degree[2] == 0 and g.out_degree[0] == 0
+    # 2 -> 0 is now legal: the old path is gone
+    assert g.can_add_edge(2, 0)
+
+
+def test_delete_in_out_edges():
+    g = TaskDAG(64)
+    for v in range(4):
+        g.add_vertex(v)
+    g.add_edge(0, 2)
+    g.add_edge(1, 2)
+    g.add_edge(2, 3)
+    g.delete_in_edges(2)
+    assert g.in_degree[2] == 0 and g.out_degree[0] == 0 and g.out_degree[1] == 0
+    assert g.has_edge(2, 3)
+    g.delete_out_edges(2)
+    assert g.edge_count() == 0
+
+
+def test_random_vertices(rng):
+    g = TaskDAG(64)
+    for v in range(10):
+        g.add_vertex(v)
+    got = g.random_vertices(5, rng)
+    assert len(got) == 5 and len(set(got.tolist())) == 5
+    assert all(g.present[v] for v in got)
+    assert len(g.random_vertices(50, rng)) == 10  # capped at live count
+
+
+def _random_dag(p, n_edges, rng):
+    g = TaskDAG(p)
+    for v in range(p):
+        g.add_vertex(v)
+    adj = np.zeros((p, p), bool)
+    added = 0
+    while added < n_edges:
+        u, v = int(rng.integers(p)), int(rng.integers(p))
+        if g.can_add_edge(u, v):
+            g.add_edge(u, v)
+            adj[u, v] = True
+            added += 1
+    return g, adj
+
+
+def test_batch_reachable_matches_host(rng):
+    p = 64
+    g, adj = _random_dag(p, 120, rng)
+    src = rng.integers(0, p, (1, 32)).astype(np.int32)
+    dst = rng.integers(0, p, (1, 32)).astype(np.int32)
+    got = np.asarray(batch_reachable(adj[None], src, dst))
+    for q in range(32):
+        assert got[0, q] == g.reachable(int(src[0, q]), int(dst[0, q])), q
+
+
+def test_batch_can_add_edge_matches_host(rng):
+    p = 64
+    graphs = [_random_dag(p, 100, rng) for _ in range(3)]
+    adj = np.stack([a for _, a in graphs])
+    present = np.ones((3, p), bool)
+    child = rng.integers(0, p, (3,)).astype(np.int32)
+    parent = rng.integers(0, p, (3, 16)).astype(np.int32)
+    got = np.asarray(batch_can_add_edge(adj, present, parent, child))
+    for b, (g, _) in enumerate(graphs):
+        for k in range(16):
+            assert got[b, k] == g.can_add_edge(int(parent[b, k]), int(child[b])), (b, k)
+
+
+def test_batch_can_add_edge_respects_present_mask(rng):
+    p = 64
+    g, adj = _random_dag(p, 50, rng)
+    present = np.ones((1, p), bool)
+    present[0, 5] = False
+    parent = np.array([[5, 6]], np.int32)
+    child = np.array([7], np.int32)
+    got = np.asarray(batch_can_add_edge(adj[None], present, parent, child))
+    assert not got[0, 0]  # absent parent

@@ -1,0 +1,204 @@
+"""Differential tests: the batched evaluator kernel vs a straight-line
+Python oracle of the reference semantics (evaluator_base.go:71-188,
+evaluator_network_topology.go:96-224, evaluator.go:93-129,
+scheduling.go:500-571)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+from dragonfly2_tpu.ops import evaluator as ev
+from dragonfly2_tpu.records import synth
+from dragonfly2_tpu.records.features import downloads_to_eval_batch
+from dragonfly2_tpu.state.fsm import BAD_NODE_STATES, HostType, PeerState
+
+
+# ----------------------------------------------------------------- oracle
+
+def oracle_score(f, i, j, algorithm="default"):
+    if algorithm == "nt":
+        w = (0.2, 0.2, 0.15, 0.11, 0.11, 0.11, 0.12)
+    else:
+        w = (0.2, 0.2, 0.15, 0.15, 0.15, 0.15, 0.0)
+    w_piece, w_up, w_free, w_type, w_idc, w_loc, w_probe = w
+
+    total = int(f.total_piece_count[i])
+    if total > 0:
+        piece = int(f.finished_pieces[i, j]) / total
+    else:
+        piece = float(f.finished_pieces[i, j]) - float(f.child_finished_pieces[i])
+
+    uc, ufc = int(f.upload_count[i, j]), int(f.upload_failed_count[i, j])
+    if uc < ufc:
+        upload = 0.0
+    elif uc == 0 and ufc == 0:
+        upload = 1.0
+    else:
+        upload = (uc - ufc) / uc
+
+    limit, used = int(f.upload_limit[i, j]), int(f.upload_used[i, j])
+    free = limit - used
+    free_score = free / limit if (limit > 0 and free > 0) else 0.0
+
+    if f.host_type[i, j] != int(HostType.NORMAL):
+        active = f.peer_state[i, j] in (int(PeerState.RECEIVED_NORMAL), int(PeerState.RUNNING))
+        type_score = 1.0 if active else 0.0
+    else:
+        type_score = 0.5
+
+    p_idc, c_idc = int(f.parent_idc[i, j]), int(f.child_idc[i])
+    idc = 1.0 if (p_idc != 0 and c_idc != 0 and p_idc == c_idc) else 0.0
+
+    p_loc, c_loc = f.parent_location[i, j], f.child_location[i]
+    if p_loc[0] == 0 or c_loc[0] == 0:
+        loc = 0.0
+    elif (p_loc == c_loc).all():
+        loc = 1.0
+    else:
+        depth = 0
+        for a, b in zip(p_loc, c_loc):
+            if a == 0 or b == 0 or a != b:
+                break
+            depth += 1
+        loc = depth / 5
+    score = (
+        w_piece * piece + w_up * upload + w_free * free_score
+        + w_type * type_score + w_idc * idc + w_loc * loc
+    )
+    if w_probe:
+        probe = (
+            (CONSTANTS.PING_TIMEOUT_NS - float(f.avg_rtt_ns[i, j])) / CONSTANTS.PING_TIMEOUT_NS
+            if f.has_rtt[i, j]
+            else 0.0
+        )
+        score += w_probe * probe
+    return score
+
+
+def oracle_is_bad(f, i, j):
+    if PeerState(int(f.peer_state[i, j])) in BAD_NODE_STATES:
+        return True
+    n = int(f.piece_cost_count[i, j])
+    if n < 2:
+        return False
+    costs = f.piece_costs[i, j, :n].astype(float)
+    last, prev = costs[-1], costs[:-1]
+    mean = prev.mean()
+    if n < 30:
+        return last > mean * 20
+    return last > mean + 3 * prev.std()  # population std, like stats.StandardDeviation
+
+
+# ---------------------------------------------------------------- fixtures
+
+@pytest.fixture(scope="module")
+def batch():
+    cluster = synth.make_cluster(64, seed=7)
+    records = synth.gen_download_records(cluster, 32)
+    feats = downloads_to_eval_batch(records, batch_tasks=32, batch_candidates=20)
+    rng = np.random.default_rng(1)
+    # exercise every branch: scatter states, rtt, zero-limit hosts
+    feats.peer_state = rng.integers(0, 10, feats.peer_state.shape).astype(np.int8)
+    feats.has_rtt = rng.random(feats.has_rtt.shape) < 0.5
+    feats.avg_rtt_ns = (rng.random(feats.avg_rtt_ns.shape) * 2e9).astype(np.float32)
+    zero = rng.random(feats.upload_limit.shape) < 0.1
+    feats.upload_limit[zero] = 0
+    return feats
+
+
+def test_scores_match_oracle(batch):
+    for algorithm in ("default", "nt"):
+        got = np.asarray(ev.evaluate(batch.as_dict(), algorithm))
+        for i in range(0, batch.valid.shape[0], 5):
+            for j in range(batch.valid.shape[1]):
+                if not batch.valid[i, j]:
+                    continue
+                want = oracle_score(batch, i, j, algorithm)
+                assert got[i, j] == pytest.approx(want, rel=1e-5), (algorithm, i, j)
+
+
+def test_is_bad_node_matches_oracle(batch):
+    got = np.asarray(ev.is_bad_node(batch.piece_costs, batch.piece_cost_count, batch.peer_state))
+    for i in range(batch.valid.shape[0]):
+        for j in range(batch.valid.shape[1]):
+            assert got[i, j] == oracle_is_bad(batch, i, j), (i, j)
+
+
+def test_is_bad_node_three_sigma_branch():
+    """n >= 30 uses mean+3*sigma; a clear outlier flips it."""
+    c = CONSTANTS.PIECE_COST_CAPACITY
+    costs = np.zeros((1, 2, c), np.float32)
+    count = np.full((1, 2), 30, np.int32)
+    state = np.full((1, 2), int(PeerState.RUNNING), np.int8)
+    base = 100 + np.arange(29, dtype=np.float32)  # tight spread
+    costs[0, 0, :29] = base
+    costs[0, 0, 29] = 100.0   # normal last cost
+    costs[0, 1, :29] = base
+    costs[0, 1, 29] = 1e6     # wild outlier
+    got = np.asarray(ev.is_bad_node(costs, count, state))
+    assert not got[0, 0]
+    assert got[0, 1]
+
+
+def test_filter_respects_reference_rules(batch):
+    feats = batch.as_dict()
+    mask = np.asarray(ev.filter_candidates(feats))
+    bad = np.asarray(ev.is_bad_node(batch.piece_costs, batch.piece_cost_count, batch.peer_state))
+    for i in range(batch.valid.shape[0]):
+        for j in range(batch.valid.shape[1]):
+            if not batch.valid[i, j]:
+                assert not mask[i, j]
+                continue
+            expect = True
+            if batch.parent_host_id[i, j] == batch.child_host_id[i]:
+                expect = False
+            state = int(batch.peer_state[i, j])
+            rooted = state in (int(PeerState.BACK_TO_SOURCE), int(PeerState.SUCCEEDED)) or (
+                batch.host_type[i, j] != 0
+            )
+            if not rooted:
+                expect = False
+            if bad[i, j]:
+                expect = False
+            if batch.upload_limit[i, j] - batch.upload_used[i, j] <= 0:
+                expect = False
+            assert mask[i, j] == expect, (i, j)
+
+
+def test_schedule_candidate_parents_selects_best(batch):
+    out = ev.schedule_candidate_parents(batch.as_dict(), algorithm="default", limit=4)
+    scores = np.asarray(out["scores"])
+    mask = np.asarray(out["mask"])
+    sel = np.asarray(out["selected"])
+    sel_valid = np.asarray(out["selected_valid"])
+    for i in range(scores.shape[0]):
+        eligible = np.nonzero(mask[i])[0]
+        want_n = min(len(eligible), 4)
+        assert sel_valid[i].sum() == want_n
+        if want_n:
+            # selected set == top-want_n by score among eligible
+            order = eligible[np.argsort(-scores[i, eligible], kind="stable")]
+            assert set(sel[i, :want_n].tolist()) == set(order[:want_n].tolist())
+            # and in descending score order
+            got_scores = scores[i, sel[i, :want_n]]
+            assert (np.diff(got_scores) <= 1e-6).all()
+
+
+def test_find_success_parent(batch):
+    """Reference runs the full candidate filter first (scheduling.go:478)
+    then keeps Succeeded parents (:484-489)."""
+    out = ev.find_success_parent(batch.as_dict())
+    scores = np.asarray(ev.evaluate(batch.as_dict()))
+    fmask = np.asarray(ev.filter_candidates(batch.as_dict()))
+    found = np.asarray(out["found"])
+    parent = np.asarray(out["parent"])
+    for i in range(scores.shape[0]):
+        succeeded = [
+            j
+            for j in range(batch.valid.shape[1])
+            if fmask[i, j] and batch.peer_state[i, j] == int(PeerState.SUCCEEDED)
+        ]
+        assert found[i] == bool(succeeded)
+        if succeeded:
+            best = max(succeeded, key=lambda j: (scores[i, j], -j))
+            assert scores[i, parent[i]] == pytest.approx(scores[i, best])

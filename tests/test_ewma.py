@@ -1,0 +1,69 @@
+"""Probe ring buffer + folded EWMA tests (reference:
+scheduler/networktopology/probes_test.go behaviors)."""
+
+import numpy as np
+
+from dragonfly2_tpu.ops import ewma
+
+
+def python_fold(samples, w=0.1):
+    if not samples:
+        return 0.0
+    avg = samples[0]
+    for s in samples[1:]:
+        avg = w * avg + (1 - w) * s
+    return avg
+
+
+def test_fold_average_matches_reference_fold():
+    q = 5
+    ring = np.zeros((3, q), np.float32)
+    cursor = np.zeros(3, np.int32)
+    count = np.zeros(3, np.int32)
+    # pair 0: 3 samples (partial); pair 1: empty; pair 2: full wrapped ring
+    ring[0, :3] = [10.0, 20.0, 30.0]
+    cursor[0], count[0] = 3, 3
+    samples2 = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]  # last 5 live, cursor wrapped
+    for i, s in enumerate(samples2):
+        ring[2, i % q] = s
+    cursor[2], count[2] = len(samples2) % q, q
+    got = np.asarray(ewma.fold_average(ring, cursor, count))
+    assert got[0] == np.float32(python_fold([10.0, 20.0, 30.0]))
+    assert got[1] == 0.0
+    assert np.isclose(got[2], python_fold(samples2[-5:]), rtol=1e-6)
+
+
+def test_enqueue_drops_oldest_and_updates_average():
+    q = 5
+    n = 4
+    ring = np.zeros((n, q), np.float32)
+    cursor = np.zeros(n, np.int32)
+    count = np.zeros(n, np.int32)
+    history = {i: [] for i in range(n)}
+    rng = np.random.default_rng(2)
+    for step in range(12):
+        pair = np.array([int(rng.integers(n))], np.int32)
+        rtt = np.array([float(rng.uniform(1, 100))], np.float32)
+        history[int(pair[0])].append(float(rtt[0]))
+        ring, cursor, count, avg = ewma.enqueue(ring, cursor, count, pair, rtt)
+        ring, cursor, count, avg = map(np.asarray, (ring, cursor, count, avg))
+        for i in range(n):
+            assert count[i] == min(len(history[i]), q)
+            want = python_fold(history[i][-q:])
+            assert np.isclose(avg[i], want, rtol=1e-5), (step, i)
+
+
+def test_probed_count_and_least_probed():
+    import jax
+
+    probed = np.array([5, 0, 2, 9, 1], np.int64)
+    probed = np.asarray(ewma.probed_count_increment(probed, np.array([1, 1, 4], np.int32)))
+    assert probed.tolist() == [5, 2, 2, 9, 2]
+
+    alive = np.array([True, True, True, True, False])
+    idx, valid = ewma.least_probed_hosts(probed, alive, jax.random.key(0), k=3)
+    idx, valid = np.asarray(idx), np.asarray(valid)
+    assert valid.all()
+    assert 3 not in idx.tolist()  # most-probed host not picked
+    assert 4 not in idx.tolist()  # dead host not picked
+    assert set(idx.tolist()) == {0, 1, 2}

@@ -1,0 +1,43 @@
+"""Consistent-hash ring tests (reference: pkg/balancer consistent hashing)."""
+
+from dragonfly2_tpu.utils.hashring import HashRing
+
+
+def test_pick_is_stable():
+    ring = HashRing(["s1", "s2", "s3"])
+    keys = [f"task-{i}" for i in range(200)]
+    first = [ring.pick(k) for k in keys]
+    assert first == [ring.pick(k) for k in keys]
+
+
+def test_distribution_roughly_even():
+    ring = HashRing(["s1", "s2", "s3", "s4"], replicas=128)
+    counts = {}
+    for i in range(4000):
+        n = ring.pick(f"task-{i}")
+        counts[n] = counts.get(n, 0) + 1
+    assert set(counts) == {"s1", "s2", "s3", "s4"}
+    for c in counts.values():
+        assert 0.5 * 1000 < c < 1.7 * 1000
+
+
+def test_remove_only_moves_owned_keys():
+    ring = HashRing(["s1", "s2", "s3"])
+    keys = [f"task-{i}" for i in range(500)]
+    before = {k: ring.pick(k) for k in keys}
+    ring.remove("s2")
+    after = {k: ring.pick(k) for k in keys}
+    for k in keys:
+        if before[k] != "s2":
+            assert after[k] == before[k], "key moved despite its node staying"
+        else:
+            assert after[k] in ("s1", "s3")
+
+
+def test_empty_and_single():
+    ring = HashRing()
+    assert ring.pick("x") is None
+    ring.add("only")
+    assert ring.pick("x") == "only"
+    ring.add("only")  # idempotent
+    assert len(ring) == 1

@@ -1,0 +1,60 @@
+"""ID generation semantics (reference: pkg/idgen/*_test.go patterns)."""
+
+from dragonfly2_tpu.utils import digest, idgen
+
+
+def test_task_id_v1_deterministic():
+    a = idgen.task_id_v1("https://example.com/a.bin", tag="t", application="app")
+    b = idgen.task_id_v1("https://example.com/a.bin", tag="t", application="app")
+    assert a == b
+    assert len(a) == 64
+
+
+def test_task_id_v1_fields_matter():
+    base = idgen.task_id_v1("https://example.com/a.bin")
+    assert idgen.task_id_v1("https://example.com/a.bin", tag="x") != base
+    assert idgen.task_id_v1("https://example.com/a.bin", application="y") != base
+    assert idgen.task_id_v1("https://example.com/a.bin", digest="sha256:00") != base
+
+
+def test_task_id_v1_filtered_query_params():
+    with_token = idgen.task_id_v1("https://e.com/a?x=1&token=abc", filtered_query_params="token")
+    other_token = idgen.task_id_v1("https://e.com/a?x=1&token=zzz", filtered_query_params="token")
+    assert with_token == other_token
+    assert with_token != idgen.task_id_v1("https://e.com/a?x=2&token=abc", filtered_query_params="token")
+
+
+def test_filtered_urls_sort_query_keys():
+    """Go's url.Values.Encode() sorts keys — param order must not change
+    the task identity once any filter applies."""
+    a = idgen.task_id_v1("https://e.com/a?b=2&a=1", filtered_query_params="x")
+    b = idgen.task_id_v1("https://e.com/a?a=1&b=2", filtered_query_params="x")
+    assert a == b
+
+
+def test_parent_task_id_ignores_range():
+    ranged = idgen.task_id_v1("https://e.com/a", byte_range="0-99")
+    parent = idgen.parent_task_id_v1("https://e.com/a", byte_range="0-99")
+    plain = idgen.task_id_v1("https://e.com/a")
+    assert ranged != plain
+    assert parent == plain
+
+
+def test_task_id_v2_always_includes_fields():
+    # v2 hashes empty fields too, so it differs from a bare sha256 of the url.
+    v2 = idgen.task_id_v2("https://e.com/a")
+    assert v2 == digest.sha256_from_strings("https://e.com/a", "", "", "", "0")
+
+
+def test_host_and_peer_ids():
+    assert idgen.host_id_v1("node-1", 8002) == "node-1-8002"
+    h = idgen.host_id_v2("10.0.0.1", "node-1")
+    assert h == digest.sha256_from_strings("10.0.0.1", "node-1")
+    assert idgen.peer_id_v2() != idgen.peer_id_v2()
+    assert idgen.seed_peer_id_v1("10.0.0.1").endswith("_Seed")
+
+
+def test_stable_hash64_stability():
+    assert digest.stable_hash64("idc-a") == digest.stable_hash64("idc-a")
+    assert digest.stable_hash64("idc-a") != digest.stable_hash64("idc-b")
+    assert digest.stable_hash64("x") >= 0
