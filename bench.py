@@ -1,0 +1,96 @@
+"""Headline benchmark: scheduler parent-selection p50 latency.
+
+North star (BASELINE.md / BASELINE.json): p50 < 1 ms for batched parent
+selection at the 1k-concurrent-tasks x 64-candidates shape on a cluster
+with 10k+ peers — the workload the reference serves one-peer-at-a-time in
+Go behind mutexes (scheduler/scheduling/scheduling.go), here ONE
+jit-compiled device call (dragonfly2_tpu/ops/evaluator.py).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline = baseline_ms / measured_ms (>1 means faster than the 1 ms
+target; the reference publishes no numbers of its own, BASELINE.md).
+
+Robustness: the tunneled dev TPU shows multi-minute slow windows where
+every dispatch costs ~70 ms (see .claude/skills/verify/SKILL.md); each
+trial is paired with a trivial-dispatch control and the p50 is taken over
+trials whose control stayed sane.
+"""
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 1.0
+BATCH_TASKS = 1024
+BATCH_CANDIDATES = 64
+NUM_HOSTS = 10_000
+TRIALS = 200
+CONTROL_THRESHOLD_MS = 5.0
+
+
+def main() -> int:
+    import jax
+
+    from dragonfly2_tpu.ops import evaluator as ev
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.features import downloads_to_eval_batch
+
+    # Build a 10k-host cluster and replay its traces as scoring requests.
+    cluster = synth.make_cluster(NUM_HOSTS, seed=0)
+    records = synth.gen_download_records(
+        cluster, BATCH_TASKS, num_tasks=256, max_parents=20
+    )
+    feats = downloads_to_eval_batch(records, BATCH_TASKS, BATCH_CANDIDATES)
+    rng = np.random.default_rng(0)
+    # randomize states/rtt so every branch is live
+    feats.peer_state = rng.integers(5, 8, feats.peer_state.shape).astype(np.int8)
+    feats.has_rtt = rng.random(feats.has_rtt.shape) < 0.7
+    feats.avg_rtt_ns = (rng.random(feats.avg_rtt_ns.shape) * 5e7).astype(np.float32)
+
+    d = jax.device_put(feats.as_dict())
+    control_in = jax.device_put(np.ones((8, 128), np.float32))
+    control = jax.jit(lambda x: x + 1)
+
+    def call():
+        return ev.schedule_candidate_parents(d, algorithm="nt", limit=4)
+
+    # warmup / compile
+    jax.block_until_ready(call())
+    jax.block_until_ready(control(control_in))
+
+    samples = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(control(control_in))
+        control_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        jax.block_until_ready(call())
+        kernel_ms = (time.perf_counter() - t0) * 1e3
+        if control_ms < CONTROL_THRESHOLD_MS:
+            samples.append(kernel_ms)
+    if not samples:  # every window was bad; report unfiltered
+        for _ in range(50):
+            t0 = time.perf_counter()
+            jax.block_until_ready(call())
+            samples.append((time.perf_counter() - t0) * 1e3)
+
+    p50 = statistics.median(samples)
+    print(
+        json.dumps(
+            {
+                "metric": "scheduler_parent_selection_p50_ms_1024x64",
+                "value": round(p50, 4),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / p50, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

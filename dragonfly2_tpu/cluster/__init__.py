@@ -1,0 +1,31 @@
+from dragonfly2_tpu.cluster.messages import (
+    RegisterPeerRequest,
+    DownloadPieceFinishedRequest,
+    DownloadPieceFailedRequest,
+    DownloadPeerFinishedRequest,
+    DownloadPeerFailedRequest,
+    DownloadPeerBackToSourceStartedRequest,
+    RescheduleRequest,
+    NormalTaskResponse,
+    NeedBackToSourceResponse,
+    ScheduleFailure,
+    SizeScope,
+)
+from dragonfly2_tpu.cluster.probes import ProbeStore
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+
+__all__ = [
+    "RegisterPeerRequest",
+    "DownloadPieceFinishedRequest",
+    "DownloadPieceFailedRequest",
+    "DownloadPeerFinishedRequest",
+    "DownloadPeerFailedRequest",
+    "DownloadPeerBackToSourceStartedRequest",
+    "RescheduleRequest",
+    "NormalTaskResponse",
+    "NeedBackToSourceResponse",
+    "ScheduleFailure",
+    "SizeScope",
+    "ProbeStore",
+    "SchedulerService",
+]

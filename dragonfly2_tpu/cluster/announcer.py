@@ -1,0 +1,77 @@
+"""Announcer: streams the scheduler's trace datasets to the trainer.
+
+Capability parity with scheduler/announcer/announcer.go:127-235: every
+``Trainer.Interval`` (default 7 days, config/constants.go:197-201) both CSV
+datasets are streamed in 128 MiB chunks under a 1h timeout — here to any
+``TrainerSink`` (the in-proc TrainerService or a gRPC client edge), keyed
+by this scheduler's host id exactly like TrainGnn/TrainMlpRequest
+(trainer/service/service_v1.go:59-162).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Protocol
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+from dragonfly2_tpu.records.storage import TraceStorage
+
+
+class TrainerSink(Protocol):
+    def train_mlp_chunk(self, host_id: str, data: bytes) -> None: ...
+    def train_gnn_chunk(self, host_id: str, data: bytes) -> None: ...
+    def train_finish(self, host_id: str) -> None: ...
+    def train_abort(self, host_id: str) -> None: ...
+
+
+def _chunks(blob: bytes, chunk_size: int) -> Iterator[bytes]:
+    for off in range(0, len(blob), chunk_size):
+        yield blob[off : off + chunk_size]
+
+
+class Announcer:
+    def __init__(
+        self,
+        host_id: str,
+        storage: TraceStorage,
+        trainer: TrainerSink,
+        interval_seconds: float = CONSTANTS.TRAIN_INTERVAL_SECONDS,
+        chunk_bytes: int = CONSTANTS.TRAIN_UPLOAD_CHUNK_BYTES,
+        keepalive=None,
+    ):
+        self.host_id = host_id
+        self.storage = storage
+        self.trainer = trainer
+        self.interval_seconds = interval_seconds
+        self.chunk_bytes = chunk_bytes
+        self.keepalive = keepalive
+        self._last_upload = 0.0
+        self.uploads = 0
+
+    def maybe_announce(self, now: float | None = None) -> bool:
+        """Upload both datasets if the interval has elapsed (announcer.go:127)."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_upload < self.interval_seconds:
+            return False
+        self._last_upload = now
+        self.announce_to_trainer()
+        return True
+
+    def announce_to_trainer(self) -> None:
+        """Stream download.csv (mlp) + networktopology.csv (gnn) in chunks;
+        abort clears the trainer's partial files (announcer.go:142-235 +
+        trainer error path service_v1.go:117-131)."""
+        try:
+            for chunk in _chunks(self.storage.open_download(), self.chunk_bytes):
+                self.trainer.train_mlp_chunk(self.host_id, chunk)
+            for chunk in _chunks(self.storage.open_network_topology(), self.chunk_bytes):
+                self.trainer.train_gnn_chunk(self.host_id, chunk)
+            self.trainer.train_finish(self.host_id)
+            self.uploads += 1
+        except Exception:
+            self.trainer.train_abort(self.host_id)
+            raise
+
+    def keepalive_once(self) -> None:
+        if self.keepalive is not None:
+            self.keepalive(self.host_id)

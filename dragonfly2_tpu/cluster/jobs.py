@@ -1,0 +1,103 @@
+"""Async jobs: preheat fan-out and peer listing.
+
+Capability parity with the machinery(Redis)-backed job layer: manager-side
+CreatePreheat resolves content into tasks and fans group jobs out to
+scheduler queues (manager/job/preheat.go:73-286); scheduler-side workers
+consume `preheat` (seed-peer TriggerDownloadTask, scheduler/job/job.go:152)
+and `sync_peers` (:224). Here the queue is in-proc (the gRPC/Redis edge can
+wrap it); preheat triggers registration of a seed peer on the scheduler the
+hash ring assigns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import uuid
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.utils.hashring import HashRing
+from dragonfly2_tpu.utils import idgen
+
+
+class JobState(str, enum.Enum):
+    PENDING = "PENDING"
+    SUCCESS = "SUCCESS"
+    FAILURE = "FAILURE"
+
+
+@dataclasses.dataclass
+class PreheatRequest:
+    urls: list[str]
+    tag: str = ""
+    application: str = ""
+    piece_length: int = 4 << 20
+    filtered_query_params: list[str] | None = None
+
+
+@dataclasses.dataclass
+class JobResult:
+    job_id: str
+    state: JobState
+    task_ids: list[str]
+    detail: dict = dataclasses.field(default_factory=dict)
+
+
+class JobManager:
+    """Routes jobs to schedulers by task-id consistent hashing — the same
+    affinity the reference gets from pkg/balancer."""
+
+    def __init__(self, schedulers: dict[str, SchedulerService], seed_hosts: list[msg.HostInfo]):
+        self.schedulers = schedulers
+        self.ring = HashRing(list(schedulers))
+        self.seed_hosts = [h for h in seed_hosts]
+        self._seed_rr = itertools.cycle(range(max(len(self.seed_hosts), 1)))
+        self.jobs: dict[str, JobResult] = {}
+
+    def create_preheat(self, req: PreheatRequest) -> JobResult:
+        """Resolve urls -> task ids, register a seed peer per task on the
+        owning scheduler (preheat.go:90-286 + scheduler job.go:152-221)."""
+        job_id = str(uuid.uuid4())
+        task_ids = []
+        failures = {}
+        for url in req.urls:
+            task_id = idgen.task_id_v2(
+                url,
+                tag=req.tag,
+                application=req.application,
+                piece_length=req.piece_length,
+                filtered_query_params=req.filtered_query_params,
+            )
+            task_ids.append(task_id)
+            scheduler_name = self.ring.pick(task_id)
+            if scheduler_name is None or not self.seed_hosts:
+                failures[task_id] = "no scheduler or seed hosts"
+                continue
+            seed = self.seed_hosts[next(self._seed_rr) % len(self.seed_hosts)]
+            scheduler = self.schedulers[scheduler_name]
+            scheduler.register_peer(
+                msg.RegisterPeerRequest(
+                    peer_id=f"{seed.host_id[:16]}-{uuid.uuid4()}",
+                    task_id=task_id,
+                    host=seed,
+                    url=url,
+                    content_length=-1,
+                    piece_length=req.piece_length,
+                    tag=req.tag,
+                    application=req.application,
+                    priority=1,
+                )
+            )
+        state = JobState.FAILURE if failures else JobState.SUCCESS
+        result = JobResult(job_id, state, task_ids, {"failures": failures})
+        self.jobs[job_id] = result
+        return result
+
+    def sync_peers(self) -> dict[str, dict]:
+        """Collect per-scheduler entity counts (scheduler/job/job.go:224)."""
+        return {name: s.counts() for name, s in self.schedulers.items()}
+
+    def get(self, job_id: str) -> JobResult | None:
+        return self.jobs.get(job_id)

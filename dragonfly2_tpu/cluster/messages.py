@@ -1,0 +1,157 @@
+"""Scheduler control-plane message set — the AnnouncePeer v2 oneof as typed
+dataclasses.
+
+Capability parity with the d7y.io/api schedulerv2 message set consumed by
+scheduler/service/service_v2.go:89-204 (RegisterPeerRequest,
+DownloadPieceFinished/Failed, DownloadPeerFinished/Failed,
+DownloadPeerBackToSourceStarted, Reschedule) and the responses the
+scheduling loop sends (NormalTaskResponse with candidate parents,
+NeedBackToSourceResponse, scheduling.go:85-213). Transport-neutral: the
+asyncio gRPC edge (cluster/rpc.py) and in-proc tests both speak these.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class SizeScope(enum.IntEnum):
+    """Task size classes driving the register fast paths
+    (service_v1.go:1005-1110 / service_v2 handleRegisterPeerRequest)."""
+
+    NORMAL = 0
+    SMALL = 1
+    TINY = 2
+    EMPTY = 3
+
+    @staticmethod
+    def of(content_length: int, piece_length: int = 4 << 20) -> "SizeScope":
+        if content_length == 0:
+            return SizeScope.EMPTY
+        if content_length <= 128:  # TinyFileSize
+            return SizeScope.TINY
+        if content_length <= piece_length:
+            return SizeScope.SMALL
+        return SizeScope.NORMAL
+
+
+@dataclasses.dataclass
+class HostInfo:
+    host_id: str
+    hostname: str = ""
+    ip: str = ""
+    host_type: str = "normal"
+    idc: str = ""
+    location: str = ""
+    port: int = 8002
+    download_port: int = 8001
+    concurrent_upload_limit: int = 50
+    upload_count: int = 0
+    upload_failed_count: int = 0
+
+
+@dataclasses.dataclass
+class RegisterPeerRequest:
+    peer_id: str
+    task_id: str
+    host: HostInfo
+    url: str = ""
+    content_length: int = -1  # -1 unknown
+    piece_length: int = 4 << 20
+    total_piece_count: int = 0
+    priority: int = 0
+    tag: str = ""
+    application: str = ""
+
+
+@dataclasses.dataclass
+class DownloadPieceFinishedRequest:
+    peer_id: str
+    piece_number: int
+    length: int
+    cost_ns: int
+    parent_peer_id: str = ""
+
+
+@dataclasses.dataclass
+class DownloadPieceFailedRequest:
+    peer_id: str
+    parent_peer_id: str
+    temporary: bool = True
+
+
+@dataclasses.dataclass
+class DownloadPeerFinishedRequest:
+    peer_id: str
+    content_length: int = 0
+    piece_count: int = 0
+
+
+@dataclasses.dataclass
+class DownloadPeerFailedRequest:
+    peer_id: str
+    description: str = ""
+
+
+@dataclasses.dataclass
+class DownloadPeerBackToSourceStartedRequest:
+    peer_id: str
+    description: str = ""
+
+
+@dataclasses.dataclass
+class DownloadPeerBackToSourceFinishedRequest:
+    peer_id: str
+    content_length: int = 0
+    piece_count: int = 0
+
+
+@dataclasses.dataclass
+class DownloadPeerBackToSourceFailedRequest:
+    peer_id: str
+    description: str = ""
+
+
+@dataclasses.dataclass
+class RescheduleRequest:
+    peer_id: str
+    candidate_parent_ids: list[str] = dataclasses.field(default_factory=list)
+    description: str = ""
+
+
+# --------------------------------------------------------------- responses
+
+@dataclasses.dataclass
+class CandidateParent:
+    peer_id: str
+    host_id: str
+    ip: str
+    port: int
+    download_port: int
+    state: str
+    score: float
+
+
+@dataclasses.dataclass
+class NormalTaskResponse:
+    peer_id: str
+    candidate_parents: list[CandidateParent]
+
+
+@dataclasses.dataclass
+class NeedBackToSourceResponse:
+    peer_id: str
+    description: str
+
+
+@dataclasses.dataclass
+class EmptyTaskResponse:
+    peer_id: str
+
+
+@dataclasses.dataclass
+class ScheduleFailure:
+    peer_id: str
+    code: str
+    description: str

@@ -1,0 +1,662 @@
+"""Scheduler service: the v2 announce-stream business logic driving the
+batched device evaluator.
+
+Capability parity with scheduler/service/service_v2.go (AnnouncePeer
+dispatch :89-204, handleRegisterPeerRequest :820 with size-scope fast
+paths, piece/peer finished/failed handlers :947-1314, Reschedule :972) and
+scheduler/scheduling/scheduling.go (ScheduleCandidateParents retry loop
+:85-213, filter :500-571), plus the Download-record emission on completion
+(service_v1.go:1418-1632).
+
+TPU-first inversion (SURVEY.md §7 hard part (b)): instead of scoring one
+peer at a time under a mutex, register/reschedule requests ACCUMULATE in a
+pending queue; `tick()` gathers ALL of them into one (B, K) batch —
+candidates sampled per-task from the DAG (LoadRandomPeers semantics),
+probe RTTs gathered from the ProbeStore — and makes ONE device call, then
+applies DAG edges and emits per-peer responses. p50 latency = tick period
++ one kernel, amortised across every concurrent request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import numpy as np
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.probes import ProbeStore
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.graph.dag import DAGError, TaskDAG
+from dragonfly2_tpu.ops import evaluator as ev
+from dragonfly2_tpu.records.features import (
+    host_numeric_features,
+    idc_code,
+    location_codes,
+)
+from dragonfly2_tpu.records.schema import (
+    DownloadRecord,
+    HostRecord,
+    NetworkStat,
+    ParentRecord,
+    PieceRecord,
+    TaskRecord,
+)
+from dragonfly2_tpu.records.storage import TraceStorage
+from dragonfly2_tpu.state.cluster import ClusterState
+from dragonfly2_tpu.state.fsm import HostType, PeerEvent, PeerState, TaskEvent, TaskState
+from dragonfly2_tpu.utils.digest import stable_hash64
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _Pending:
+    peer_id: str
+    blocklist: set[str]
+    retries: int = 0
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class _PeerMeta:
+    """Host-side per-peer bookkeeping beyond the SoA columns."""
+
+    peer_id: str
+    task_id: str
+    host_id: str
+    tag: str = ""
+    application: str = ""
+    dag_slot: int = -1
+    parents: dict[str, dict] = dataclasses.field(default_factory=dict)  # parent peer_id -> stats
+    held_parents: set[str] = dataclasses.field(default_factory=set)  # upload slots held
+    created_at_ns: int = 0
+
+
+class SchedulerService:
+    def __init__(
+        self,
+        config: Config | None = None,
+        storage: TraceStorage | None = None,
+        probes: ProbeStore | None = None,
+        ml_evaluator=None,
+        seed: int = 0,
+    ):
+        self.config = config or Config()
+        sched = self.config.scheduler
+        self.state = ClusterState(
+            max_hosts=sched.max_hosts,
+            max_tasks=sched.max_tasks,
+            max_peers=sched.max_hosts * 4,
+        )
+        self.storage = storage
+        self.probes = probes
+        self.ml_evaluator = ml_evaluator
+        self.rng = np.random.default_rng(seed)
+        self.algorithm = self.config.evaluator.algorithm
+        self._dags: dict[str, TaskDAG] = {}
+        self._dag_capacity = _round_up_64(sched.max_peers_per_task)
+        self._peer_meta: dict[str, _PeerMeta] = {}
+        self._task_peers: dict[str, list[str]] = {}
+        self._dag_slot_peer: dict[str, dict[int, str]] = {}
+        self._pending: dict[str, _Pending] = {}
+        self._host_info: dict[str, msg.HostInfo] = {}
+
+    # ============================================================ messages
+
+    def handle(self, request):
+        """Dispatch one announce-stream message (service_v2.go:89-204)."""
+        handlers = {
+            msg.RegisterPeerRequest: self.register_peer,
+            msg.DownloadPieceFinishedRequest: self.piece_finished,
+            msg.DownloadPieceFailedRequest: self.piece_failed,
+            msg.DownloadPeerFinishedRequest: self.peer_finished,
+            msg.DownloadPeerFailedRequest: self.peer_failed,
+            msg.DownloadPeerBackToSourceStartedRequest: self.back_to_source_started,
+            msg.DownloadPeerBackToSourceFinishedRequest: self.back_to_source_finished,
+            msg.DownloadPeerBackToSourceFailedRequest: self.back_to_source_failed,
+            msg.RescheduleRequest: self.reschedule,
+        }
+        handler = handlers.get(type(request))
+        if handler is None:
+            raise TypeError(f"unhandled message {type(request).__name__}")
+        return handler(request)
+
+    def announce_host(self, host: msg.HostInfo) -> int:
+        """AnnounceHost: upsert SoA host row (service_v2 AnnounceHost)."""
+        self._host_info[host.host_id] = host
+        rec = HostRecord(
+            id=host.host_id,
+            type=host.host_type,
+            hostname=host.hostname,
+            ip=host.ip,
+            port=host.port,
+            download_port=host.download_port,
+            concurrent_upload_limit=host.concurrent_upload_limit,
+            upload_count=host.upload_count,
+            upload_failed_count=host.upload_failed_count,
+            network=NetworkStat(location=host.location, idc=host.idc),
+        )
+        return self.state.upsert_host(
+            host.host_id,
+            id_hash=stable_hash64(host.host_id),
+            host_type=HostType.from_name(host.host_type),
+            idc=idc_code(host.idc),
+            location=location_codes(host.location),
+            upload_limit=host.concurrent_upload_limit,
+            upload_count=host.upload_count,
+            upload_failed=host.upload_failed_count,
+            numeric=host_numeric_features(rec),
+        )
+
+    def leave_host(self, host_id: str) -> None:
+        """LeaveHost: drop the host and every peer on it (service_v2)."""
+        for peer_id, meta in list(self._peer_meta.items()):
+            if meta.host_id == host_id:
+                self._leave_peer(peer_id)
+        self.state.remove_host(host_id)
+        self._host_info.pop(host_id, None)
+
+    def register_peer(self, req: msg.RegisterPeerRequest):
+        """handleRegisterPeerRequest (+ handleResource): upsert host/task/
+        peer, size-scope dispatch, queue normal peers for scheduling."""
+        if req.host.host_id not in self._host_info:
+            self.announce_host(req.host)
+        host_idx = self.state.host_index(req.host.host_id)
+        total_pieces = req.total_piece_count
+        if total_pieces == 0 and req.content_length > 0:
+            total_pieces = -(-req.content_length // req.piece_length)
+        task_idx = self.state.upsert_task(
+            req.task_id,
+            total_pieces=max(total_pieces, 0),
+            content_length=max(req.content_length, 0),
+            back_to_source_limit=self.config.scheduler.retry_back_to_source_limit,
+        )
+        if self.state.task_state[task_idx] != int(TaskState.RUNNING):
+            self.state.task_event(task_idx, TaskEvent.DOWNLOAD)
+
+        # Re-register of a known peer is load-not-create (service_v2
+        # handleResource): keep its FSM/DAG state, just leave it queued.
+        if self.state.peer_index(req.peer_id) is not None:
+            idx = self.state.peer_index(req.peer_id)
+            if self.state.peer_state[idx] == int(PeerState.RUNNING):
+                self._pending.setdefault(
+                    req.peer_id, _Pending(peer_id=req.peer_id, blocklist=set())
+                )
+            return None
+
+        peer_idx = self.state.add_peer(req.peer_id, task_idx, host_idx)
+        dag = self._task_dag(req.task_id)
+        slot = self._alloc_dag_slot(req.task_id, req.peer_id, dag)
+        self._peer_meta[req.peer_id] = _PeerMeta(
+            peer_id=req.peer_id,
+            task_id=req.task_id,
+            host_id=req.host.host_id,
+            tag=req.tag,
+            application=req.application,
+            dag_slot=slot,
+            created_at_ns=time.time_ns(),
+        )
+        self._task_peers.setdefault(req.task_id, []).append(req.peer_id)
+
+        scope = (
+            msg.SizeScope.of(req.content_length, req.piece_length)
+            if req.content_length >= 0
+            else msg.SizeScope.NORMAL
+        )
+        if scope == msg.SizeScope.EMPTY:
+            self.state.peer_event(peer_idx, PeerEvent.REGISTER_EMPTY)
+            return msg.EmptyTaskResponse(peer_id=req.peer_id)
+        if scope == msg.SizeScope.TINY:
+            # v2 semantics: tiny tasks fetch inline from a peer's download
+            # port; scheduling still picks who serves it.
+            self.state.peer_event(peer_idx, PeerEvent.REGISTER_TINY)
+        elif scope == msg.SizeScope.SMALL:
+            self.state.peer_event(peer_idx, PeerEvent.REGISTER_SMALL)
+        else:
+            self.state.peer_event(peer_idx, PeerEvent.REGISTER_NORMAL)
+        self.state.peer_event(peer_idx, PeerEvent.DOWNLOAD)
+        self._pending[req.peer_id] = _Pending(peer_id=req.peer_id, blocklist=set())
+        return None  # response arrives from tick()
+
+    def reschedule(self, req: msg.RescheduleRequest):
+        """RescheduleRequest (:972): drop given parents, re-queue."""
+        meta = self._peer_meta.get(req.peer_id)
+        if meta is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self._release_parent_slots(req.peer_id)
+        dag = self._task_dag(meta.task_id)
+        dag.delete_in_edges(meta.dag_slot)
+        pending = self._pending.get(req.peer_id) or _Pending(peer_id=req.peer_id, blocklist=set())
+        pending.blocklist |= set(req.candidate_parent_ids)
+        pending.retries += 1
+        self._pending[req.peer_id] = pending
+        return None
+
+    def piece_finished(self, req: msg.DownloadPieceFinishedRequest):
+        """DownloadPieceFinished (:1102): bitset + cost ring on the child,
+        upload accounting on the parent host."""
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.record_piece(idx, req.piece_number, float(req.cost_ns))
+        if req.parent_peer_id:
+            meta = self._peer_meta.get(req.peer_id)
+            pidx = self.state.peer_index(req.parent_peer_id)
+            if meta is not None and pidx is not None:
+                stats = meta.parents.setdefault(
+                    req.parent_peer_id, {"pieces": [], "bytes": 0}
+                )
+                if len(stats["pieces"]) < 10:
+                    stats["pieces"].append(
+                        PieceRecord(length=req.length, cost=req.cost_ns, created_at=time.time_ns())
+                    )
+                stats["bytes"] += req.length
+                host_idx = self.state.peer_host[pidx]
+                self.state.host_upload_count[host_idx] += 1
+        return None
+
+    def piece_failed(self, req: msg.DownloadPieceFailedRequest):
+        """DownloadPieceFailed: parent host failure accounting + reschedule
+        away from it."""
+        pidx = self.state.peer_index(req.parent_peer_id)
+        if pidx is not None:
+            host_idx = self.state.peer_host[pidx]
+            self.state.host_upload_failed[host_idx] += 1
+        return self.reschedule(
+            msg.RescheduleRequest(
+                peer_id=req.peer_id, candidate_parent_ids=[req.parent_peer_id]
+            )
+        )
+
+    def peer_finished(self, req: msg.DownloadPeerFinishedRequest):
+        """DownloadPeerFinished (:991): FSM -> Succeeded, free parent upload
+        slots, emit the Download trace record."""
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+        self._release_parent_slots(req.peer_id)
+        self._pending.pop(req.peer_id, None)
+        self._write_download_record(req.peer_id, "Succeeded")
+        return None
+
+    def peer_failed(self, req: msg.DownloadPeerFailedRequest):
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
+        self._release_parent_slots(req.peer_id)
+        self._pending.pop(req.peer_id, None)
+        self._write_download_record(req.peer_id, "Failed")
+        return None
+
+    def back_to_source_started(self, req: msg.DownloadPeerBackToSourceStartedRequest):
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.peer_event(idx, PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+        task_idx = self.state.peer_task[idx]
+        self.state.task_back_to_source_count[task_idx] += 1
+        self._pending.pop(req.peer_id, None)
+        return None
+
+    def back_to_source_finished(self, req: msg.DownloadPeerBackToSourceFinishedRequest):
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+        if req.piece_count:
+            task_idx = self.state.peer_task[idx]
+            self.state.task_total_pieces[task_idx] = req.piece_count
+        self._write_download_record(req.peer_id, "Succeeded")
+        return None
+
+    def back_to_source_failed(self, req: msg.DownloadPeerBackToSourceFailedRequest):
+        idx = self.state.peer_index(req.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
+        task_idx = self.state.peer_task[idx]
+        if self.state.task_state[task_idx] == int(TaskState.RUNNING):
+            self.state.task_event(task_idx, TaskEvent.DOWNLOAD_FAILED)
+        self._write_download_record(req.peer_id, "Failed")
+        return None
+
+    def leave_peer(self, peer_id: str) -> None:
+        self._leave_peer(peer_id)
+
+    # ============================================================== tick
+
+    def tick(self) -> list:
+        """Run ONE batched scheduling round over every pending peer.
+
+        scheduling.go:85-213's per-peer retry loop, inverted: back-to-source
+        and retry-exhaustion decided host-side, everything else in a single
+        (B, K) device call.
+        """
+        responses: list = []
+        work: list[_Pending] = []
+        for pending in list(self._pending.values()):
+            decision = self._pre_schedule(pending)
+            if decision is not None:
+                responses.append(decision)
+                self._pending.pop(pending.peer_id, None)
+            else:
+                work.append(pending)
+        if not work:
+            return responses
+
+        k = self.config.scheduler.filter_parent_limit
+        b = len(work)
+        cand_peer_idx = np.zeros((b, k), np.int32)
+        cand_valid = np.zeros((b, k), bool)
+        child_peer_idx = np.zeros(b, np.int32)
+        blocklist = np.zeros((b, k), bool)
+        in_degree = np.zeros((b, k), np.int32)
+        can_add_edge = np.zeros((b, k), bool)
+        cand_ids: list[list[str]] = []
+        child_host_slots = np.zeros(b, np.int32)
+        cand_host_slots = np.zeros((b, k), np.int32)
+
+        for i, pending in enumerate(work):
+            meta = self._peer_meta[pending.peer_id]
+            child_peer_idx[i] = self.state.peer_index(pending.peer_id)
+            child_host_slots[i] = self.state.peer_host[child_peer_idx[i]]
+            dag = self._task_dag(meta.task_id)
+            sampled = dag.random_vertices(k, self.rng)
+            slot_to_peer = self._dag_slot_peer.get(meta.task_id, {})
+            ids = []
+            j = 0
+            for slot in sampled:
+                pid = slot_to_peer.get(int(slot))
+                if pid is None or pid == pending.peer_id:
+                    continue
+                pidx = self.state.peer_index(pid)
+                if pidx is None:
+                    continue
+                cand_peer_idx[i, j] = pidx
+                cand_valid[i, j] = True
+                blocklist[i, j] = pid in pending.blocklist
+                in_degree[i, j] = dag.in_degree[slot]
+                can_add_edge[i, j] = dag.can_add_edge(int(slot), meta.dag_slot)
+                cand_host_slots[i, j] = self.state.peer_host[pidx]
+                ids.append(pid)
+                j += 1
+                if j >= k:
+                    break
+            cand_ids.append(ids)
+
+        avg_rtt = has_rtt = None
+        if self.probes is not None and self.algorithm == "nt":
+            avg_rtt, has_rtt = self.probes.gather_candidate_rtt(child_host_slots, cand_host_slots)
+        feats = self.state.gather_candidates(
+            child_peer_idx, cand_peer_idx, cand_valid, avg_rtt, has_rtt
+        )
+
+        limit = self.config.scheduler.candidate_parent_limit
+        if self.ml_evaluator is not None and self.algorithm == "ml":
+            out = self.ml_evaluator.schedule(
+                feats.as_dict(), child_host_slots, cand_host_slots,
+                blocklist, in_degree, can_add_edge, limit=limit,
+            )
+        else:
+            algorithm = self.algorithm if self.algorithm in ("default", "nt") else "default"
+            out = ev.schedule_candidate_parents(
+                feats.as_dict(), blocklist, in_degree, can_add_edge,
+                algorithm=algorithm, limit=limit,
+            )
+        selected = np.asarray(out["selected"])
+        selected_valid = np.asarray(out["selected_valid"])
+        selected_scores = np.asarray(out["selected_scores"])
+
+        for i, pending in enumerate(work):
+            meta = self._peer_meta[pending.peer_id]
+            parents = []
+            for j in range(limit):
+                if not selected_valid[i, j]:
+                    break
+                pid = cand_ids[i][selected[i, j]] if selected[i, j] < len(cand_ids[i]) else None
+                if pid is None:
+                    continue
+                parents.append((pid, float(selected_scores[i, j])))
+            if not parents:
+                pending.retries += 1
+                continue  # stays pending for the next tick (retry loop)
+            response = self._apply_selection(pending, meta, parents)
+            if response is None:
+                continue  # all selections DAG-rejected; stays pending
+            responses.append(response)
+            self._pending.pop(pending.peer_id, None)
+        return responses
+
+    # ============================================================ helpers
+
+    def _pre_schedule(self, pending: _Pending):
+        """Back-to-source / retry-exhaustion decisions (scheduling.go:95-159)."""
+        sched = self.config.scheduler
+        idx = self.state.peer_index(pending.peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(pending.peer_id, "NotFound", "peer vanished")
+        if self.state.peer_state[idx] != int(PeerState.RUNNING):
+            return msg.ScheduleFailure(
+                pending.peer_id, "FailedPrecondition",
+                f"peer state {PeerState(int(self.state.peer_state[idx])).display} not Running",
+            )
+        task_idx = self.state.peer_task[idx]
+        if (
+            pending.retries >= sched.retry_back_to_source_limit
+            and self.state.task_back_to_source_count[task_idx]
+            < self.state.task_back_to_source_limit[task_idx]
+        ):
+            return msg.NeedBackToSourceResponse(
+                pending.peer_id, f"scheduling exceeded RetryBackToSourceLimit {pending.retries}"
+            )
+        if pending.retries >= sched.retry_limit:
+            return msg.ScheduleFailure(
+                pending.peer_id, "FailedPrecondition",
+                f"scheduling exceeded RetryLimit {pending.retries}",
+            )
+        return None
+
+    def _apply_selection(self, pending: _Pending, meta: _PeerMeta, parents: list[tuple[str, float]]):
+        dag = self._task_dag(meta.task_id)
+        kept = []
+        for pid, score in parents:
+            pmeta = self._peer_meta.get(pid)
+            if pmeta is None:
+                continue
+            try:
+                dag.add_edge(pmeta.dag_slot, meta.dag_slot)
+            except DAGError:
+                continue
+            pidx = self.state.peer_index(pid)
+            self.state.host_upload_used[self.state.peer_host[pidx]] += 1
+            meta.held_parents.add(pid)
+            host = self._host_info.get(pmeta.host_id)
+            kept.append(
+                msg.CandidateParent(
+                    peer_id=pid,
+                    host_id=pmeta.host_id,
+                    ip=host.ip if host else "",
+                    port=host.port if host else 0,
+                    download_port=host.download_port if host else 0,
+                    state=PeerState(int(self.state.peer_state[pidx])).display,
+                    score=score,
+                )
+            )
+        if not kept:
+            pending.retries += 1
+            self._pending[pending.peer_id] = pending
+            return None  # caller keeps the peer pending for the next tick
+        return msg.NormalTaskResponse(peer_id=pending.peer_id, candidate_parents=kept)
+
+    def _release_parent_slots(self, peer_id: str) -> None:
+        """Free the upload slots this child holds on its parents' hosts.
+
+        Tracked explicitly in meta.held_parents (not derived from DAG edges)
+        so release is idempotent across reschedule/finish/leave orderings.
+        """
+        meta = self._peer_meta.get(peer_id)
+        if meta is None:
+            return
+        for pid in meta.held_parents:
+            pidx = self.state.peer_index(pid)
+            if pidx is not None:
+                host_idx = self.state.peer_host[pidx]
+                self.state.host_upload_used[host_idx] = max(
+                    0, int(self.state.host_upload_used[host_idx]) - 1
+                )
+        meta.held_parents.clear()
+
+    def _write_download_record(self, peer_id: str, state: str) -> None:
+        if self.storage is None:
+            return
+        meta = self._peer_meta.get(peer_id)
+        idx = self.state.peer_index(peer_id)
+        if meta is None or idx is None:
+            return
+        task_idx = self.state.peer_task[idx]
+        now_ns = time.time_ns()
+        parents = []
+        for pid, stats in list(meta.parents.items())[:20]:
+            pmeta = self._peer_meta.get(pid)
+            pidx = self.state.peer_index(pid)
+            if pmeta is None or pidx is None:
+                continue
+            phost = self._host_info.get(pmeta.host_id)
+            parents.append(
+                ParentRecord(
+                    id=pid,
+                    tag=pmeta.tag,
+                    application=pmeta.application,
+                    state=PeerState(int(self.state.peer_state[pidx])).display,
+                    cost=sum(p.cost for p in stats["pieces"]),
+                    upload_piece_count=len(stats["pieces"]),
+                    finished_piece_count=int(self.state.peer_finished_count[pidx]),
+                    host=self._host_record(phost) if phost else HostRecord(id=pmeta.host_id),
+                    pieces=stats["pieces"],
+                    created_at=pmeta.created_at_ns,
+                    updated_at=now_ns,
+                )
+            )
+        host = self._host_info.get(meta.host_id)
+        record = DownloadRecord(
+            id=peer_id,
+            tag=meta.tag,
+            application=meta.application,
+            state=state,
+            cost=now_ns - meta.created_at_ns,
+            finished_piece_count=int(self.state.peer_finished_count[idx]),
+            task=TaskRecord(
+                id=meta.task_id,
+                type="standard",
+                content_length=int(self.state.task_content_length[task_idx]),
+                total_piece_count=int(self.state.task_total_pieces[task_idx]),
+                back_to_source_limit=int(self.state.task_back_to_source_limit[task_idx]),
+                back_to_source_peer_count=int(self.state.task_back_to_source_count[task_idx]),
+                state=TaskState(int(self.state.task_state[task_idx])).display,
+                created_at=meta.created_at_ns,
+                updated_at=now_ns,
+            ),
+            host=self._host_record(host) if host else HostRecord(id=meta.host_id),
+            parents=parents,
+            created_at=meta.created_at_ns,
+            updated_at=now_ns,
+        )
+        self.storage.create_download(record)
+
+    def _host_record(self, host: msg.HostInfo) -> HostRecord:
+        return HostRecord(
+            id=host.host_id,
+            type=host.host_type,
+            hostname=host.hostname,
+            ip=host.ip,
+            port=host.port,
+            download_port=host.download_port,
+            concurrent_upload_limit=host.concurrent_upload_limit,
+            upload_count=host.upload_count,
+            upload_failed_count=host.upload_failed_count,
+            network=NetworkStat(location=host.location, idc=host.idc),
+        )
+
+    def _task_dag(self, task_id: str) -> TaskDAG:
+        dag = self._dags.get(task_id)
+        if dag is None:
+            dag = TaskDAG(self._dag_capacity)
+            self._dags[task_id] = dag
+        return dag
+
+    def _alloc_dag_slot(self, task_id: str, peer_id: str, dag: TaskDAG) -> int:
+        slots = self._dag_slot_peer.setdefault(task_id, {})
+        for slot in range(dag.capacity):
+            if not dag.present[slot]:
+                dag.ensure_vertex(slot)
+                slots[slot] = peer_id
+                return slot
+        raise RuntimeError(f"task {task_id} peer DAG full ({dag.capacity})")
+
+    def _leave_peer(self, peer_id: str) -> None:
+        meta = self._peer_meta.get(peer_id)
+        if meta is None:
+            return
+        # Free slots this child holds, and slots children hold on THIS peer's
+        # host (its out-edges die with the vertex).
+        self._release_parent_slots(peer_id)
+        for child_meta in self._peer_meta.values():
+            if peer_id in child_meta.held_parents:
+                child_meta.held_parents.discard(peer_id)
+                idx_self = self.state.peer_index(peer_id)
+                if idx_self is not None:
+                    host_idx = self.state.peer_host[idx_self]
+                    self.state.host_upload_used[host_idx] = max(
+                        0, int(self.state.host_upload_used[host_idx]) - 1
+                    )
+        self._peer_meta.pop(peer_id, None)
+        idx = self.state.peer_index(peer_id)
+        if idx is not None and self.state.peer_state[idx] != int(PeerState.LEAVE):
+            self.state.peer_event(idx, PeerEvent.LEAVE)
+        dag = self._task_dag(meta.task_id)
+        dag.delete_vertex(meta.dag_slot)
+        self._dag_slot_peer.get(meta.task_id, {}).pop(meta.dag_slot, None)
+        peers = self._task_peers.get(meta.task_id)
+        if peers and peer_id in peers:
+            peers.remove(peer_id)
+        self._pending.pop(peer_id, None)
+        self.state.remove_peer(peer_id)
+
+    def snapshot_topology(self, now_ns: int | None = None) -> int:
+        """Write the probe graph to trace storage (the networktopology
+        Snapshot ticker, network_topology.go:124-138). Returns rows written."""
+        if self.probes is None or self.storage is None:
+            return 0
+        now_ns = time.time_ns() if now_ns is None else now_ns
+        host_info = {}
+        for host_id, info in self._host_info.items():
+            slot = self.state.host_index(host_id)
+            if slot is None:
+                continue
+            host_info[slot] = {
+                "id": host_id,
+                "type": info.host_type,
+                "hostname": info.hostname,
+                "ip": info.ip,
+                "port": info.port,
+                "location": info.location,
+                "idc": info.idc,
+            }
+        records = self.probes.snapshot(host_info, now_ns)
+        for rec in records:
+            self.storage.create_network_topology(rec)
+        return len(records)
+
+    def counts(self) -> dict:
+        c = self.state.counts()
+        c["pending"] = len(self._pending)
+        c["tasks_with_dag"] = len(self._dags)
+        return c
+
+
+def _round_up_64(n: int) -> int:
+    return ((n + 63) // 64) * 64

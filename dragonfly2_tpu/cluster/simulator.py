@@ -1,0 +1,202 @@
+"""Mini-cluster simulator — the e2e harness standing in for the reference's
+kind-cluster tests (SURVEY.md §4 tier 2: "multi-process mini-cluster ...
+spawn scheduler + N fake peers").
+
+Fake peer daemons drive a real SchedulerService through the full message
+protocol: register -> receive parents -> "download" pieces with latencies
+drawn from the synthetic latent model (records/synth.py: host quality +
+IDC-structured RTT) -> report piece/peer results -> probe RTTs. Produces
+real Download/NetworkTopology traces via the service's storage, so the
+whole loop (schedule -> trace -> train -> serve) runs in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+
+import numpy as np
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.records import synth
+from dragonfly2_tpu.utils import idgen
+
+
+@dataclasses.dataclass
+class SimStats:
+    registered: int = 0
+    completed: int = 0
+    back_to_source: int = 0
+    failed: int = 0
+    pieces: int = 0
+    schedule_failures: int = 0
+
+
+class ClusterSimulator:
+    def __init__(
+        self,
+        scheduler: SchedulerService,
+        num_hosts: int = 64,
+        num_tasks: int = 16,
+        seed: int = 0,
+        piece_length: int = 4 << 20,
+    ):
+        self.scheduler = scheduler
+        self.cluster = synth.make_cluster(num_hosts, seed=seed)
+        self.rng = self.cluster.rng
+        self.piece_length = piece_length
+        self.stats = SimStats()
+        self._host_info: dict[str, msg.HostInfo] = {}
+        self._tasks = []
+        for t in range(num_tasks):
+            url = f"https://origin.example.com/blob-{t}.bin"
+            pieces = self.rng.randint(2, 32)
+            self._tasks.append(
+                {
+                    "url": url,
+                    "task_id": idgen.task_id_v2(url, tag="sim", piece_length=piece_length),
+                    "pieces": pieces,
+                    "content_length": pieces * piece_length,
+                }
+            )
+        for h in self.cluster.hosts:
+            info = msg.HostInfo(
+                host_id=h.id,
+                hostname=h.hostname,
+                ip=h.ip,
+                host_type="super" if h.is_seed else "normal",
+                idc=h.idc,
+                location=h.location,
+                concurrent_upload_limit=h.concurrent_upload_limit,
+                upload_count=h.upload_count,
+                upload_failed_count=h.upload_failed_count,
+            )
+            self._host_info[h.id] = info
+            self.scheduler.announce_host(info)
+        self._hosts_by_id = {h.id: h for h in self.cluster.hosts}
+        self._peer_host: dict[str, str] = {}
+
+    # ------------------------------------------------------------- driving
+
+    def start_download(self, host=None, task=None) -> str:
+        host = host or self.rng.choice(self.cluster.hosts)
+        task = task or self.rng.choice(self._tasks)
+        peer_id = str(uuid.uuid4())
+        self._peer_host[peer_id] = host.id
+        self.scheduler.register_peer(
+            msg.RegisterPeerRequest(
+                peer_id=peer_id,
+                task_id=task["task_id"],
+                host=self._host_info[host.id],
+                url=task["url"],
+                content_length=task["content_length"],
+                piece_length=self.piece_length,
+                total_piece_count=task["pieces"],
+                tag="sim",
+                application="simulator",
+            )
+        )
+        self.stats.registered += 1
+        self._task_of = getattr(self, "_task_of", {})
+        self._task_of[peer_id] = task
+        return peer_id
+
+    def run_round(self, new_downloads: int = 8) -> list:
+        """One simulation round: start downloads, tick the scheduler, act on
+        every response like a dfdaemon would."""
+        for _ in range(new_downloads):
+            self.start_download()
+        responses = self.scheduler.tick()
+        for resp in responses:
+            self._act(resp)
+        return responses
+
+    def _act(self, resp) -> None:
+        if isinstance(resp, msg.NormalTaskResponse):
+            self._download_from_parents(resp)
+        elif isinstance(resp, msg.NeedBackToSourceResponse):
+            self._back_to_source(resp.peer_id)
+        elif isinstance(resp, msg.EmptyTaskResponse):
+            self.stats.completed += 1
+        elif isinstance(resp, msg.ScheduleFailure):
+            if resp.code == "Retry":
+                return  # stays pending; next tick retries
+            self.stats.schedule_failures += 1
+
+    def _download_from_parents(self, resp: msg.NormalTaskResponse) -> None:
+        peer_id = resp.peer_id
+        child_host = self._hosts_by_id[self._peer_host[peer_id]]
+        task = self._task_of[peer_id]
+        n_pieces = task["pieces"]
+        parents = resp.candidate_parents
+        for piece in range(n_pieces):
+            parent = parents[piece % len(parents)]
+            parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
+            rtt = self.cluster.rtt_ns(child_host, parent_host)
+            service_ms = self.piece_length / (max(parent_host.quality, 0.05) * 100e6) * 1e3
+            cost = int(rtt + service_ms * self.rng.lognormvariate(0.0, 0.25) * 1e6)
+            self.scheduler.piece_finished(
+                msg.DownloadPieceFinishedRequest(
+                    peer_id=peer_id,
+                    piece_number=piece,
+                    length=self.piece_length,
+                    cost_ns=cost,
+                    parent_peer_id=parent.peer_id,
+                )
+            )
+            self.stats.pieces += 1
+        self.scheduler.peer_finished(
+            msg.DownloadPeerFinishedRequest(
+                peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces
+            )
+        )
+        self.stats.completed += 1
+
+    def _back_to_source(self, peer_id: str) -> None:
+        task = self._task_of[peer_id]
+        self.scheduler.back_to_source_started(
+            msg.DownloadPeerBackToSourceStartedRequest(peer_id=peer_id)
+        )
+        self.scheduler.back_to_source_finished(
+            msg.DownloadPeerBackToSourceFinishedRequest(
+                peer_id=peer_id, content_length=task["content_length"], piece_count=task["pieces"]
+            )
+        )
+        self.stats.back_to_source += 1
+        self.stats.completed += 1
+
+    def run_probe_round(self, sources: int = 8) -> int:
+        """Probe cycle (SyncProbes flow, SURVEY.md §3.3): random sources ping
+        scheduler-chosen least-probed targets; results land in the ProbeStore."""
+        import jax
+
+        probes = self.scheduler.probes
+        if probes is None:
+            return 0
+        n = 0
+        alive = np.asarray(self.scheduler.state.host_alive[: self.scheduler.state.max_hosts])
+        for _ in range(sources):
+            src = self.rng.choice(self.cluster.hosts)
+            src_slot = self.scheduler.state.host_index(src.id)
+            if src_slot is None:
+                continue
+            targets = probes.find_probed_hosts(
+                alive, jax.random.key(self.rng.randint(0, 1 << 30)), k=5
+            )
+            slot_to_host = {
+                self.scheduler.state.host_index(h.id): h for h in self.cluster.hosts
+                if self.scheduler.state.host_index(h.id) is not None
+            }
+            srcs, dsts, rtts = [], [], []
+            for t in targets:
+                dst = slot_to_host.get(int(t))
+                if dst is None or dst.id == src.id:
+                    continue
+                srcs.append(src_slot)
+                dsts.append(int(t))
+                rtts.append(float(self.cluster.rtt_ns(src, dst)))
+            if srcs:
+                probes.enqueue(np.asarray(srcs), np.asarray(dsts), np.asarray(rtts))
+                n += len(srcs)
+        return n

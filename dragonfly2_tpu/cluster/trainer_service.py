@@ -1,0 +1,136 @@
+"""Trainer service: ingest per-host datasets, train both models, publish to
+the registry — the reference's Train RPC with the TODO bodies filled in.
+
+Parity: trainer/service/service_v1.go:59-162 (per-host dataset files from
+chunked streams, cleanup on error, training kicked on stream end) +
+trainer/training/training.go:60-98 (trainGNN ∥ trainMLP — empty stubs in
+the reference, real `training/train.py` runs here) + the CreateModel
+upload the reference never wires (manager_server_v1.go:802-952 →
+registry.create_model_version + evaluation metrics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from dragonfly2_tpu.config.config import TrainerConfig
+from dragonfly2_tpu.records.features import (
+    downloads_to_ranking_dataset,
+    topology_to_pairs,
+)
+from dragonfly2_tpu.records.storage import HostTraceStorage
+from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_GNN,
+    MODEL_TYPE_MLP,
+    ModelEvaluation,
+    ModelRegistry,
+    ModelVersion,
+)
+from dragonfly2_tpu.training.train import TrainResult, train_gnn, train_mlp
+
+logger = logging.getLogger(__name__)
+
+GNN_MODEL_NAME = "parent-ranker"
+MLP_MODEL_NAME = "rtt-regressor"
+
+
+@dataclasses.dataclass
+class TrainOutcome:
+    host_id: str
+    gnn: ModelVersion | None
+    mlp: ModelVersion | None
+    gnn_result: TrainResult | None
+    mlp_result: TrainResult | None
+
+
+class TrainerService:
+    """In-proc trainer; the gRPC edge adapts chunk streams onto these calls."""
+
+    def __init__(
+        self,
+        storage: HostTraceStorage,
+        registry: ModelRegistry,
+        config: TrainerConfig | None = None,
+        mesh=None,
+        auto_activate: bool = True,
+    ):
+        self.storage = storage
+        self.registry = registry
+        self.config = config or TrainerConfig()
+        self.mesh = mesh
+        # The reference leaves activation to an operator (manager/service/
+        # model.go:109); auto_activate closes the loop unattended.
+        self.auto_activate = auto_activate
+
+    # ------------------------------------------------- TrainerSink protocol
+
+    def train_mlp_chunk(self, host_id: str, data: bytes) -> None:
+        self.storage.append_download_bytes(host_id, data)
+
+    def train_gnn_chunk(self, host_id: str, data: bytes) -> None:
+        self.storage.append_network_topology_bytes(host_id, data)
+
+    def train_abort(self, host_id: str) -> None:
+        """Stream error: clear ONLY the failing host's partial files
+        (service_v1.go:117-131); other schedulers' uploads survive."""
+        self.storage.clear_host(host_id)
+
+    def train_finish(self, host_id: str) -> TrainOutcome:
+        """Stream end: train GNN ∥ MLP, publish versions, clear datasets
+        (training.go:60-98's errgroup, realized)."""
+        outcome = TrainOutcome(host_id, None, None, None, None)
+        try:
+            downloads = self.storage.list_downloads()
+            topologies = self.storage.list_network_topologies()
+            if downloads:
+                ds, graph = downloads_to_ranking_dataset(downloads)
+                result = train_gnn(ds, graph, self.config, mesh=self.mesh)
+                outcome.gnn_result = result
+                outcome.gnn = self._publish(
+                    GNN_MODEL_NAME, MODEL_TYPE_GNN, host_id, result,
+                    ModelEvaluation(
+                        recall=result.eval_metrics.get("recall", 0.0),
+                        precision=result.eval_metrics.get("precision", 0.0),
+                        f1_score=result.eval_metrics.get("f1", 0.0),
+                    ),
+                    extra={"num_downloads": len(downloads), "num_hosts": len(graph.host_ids)},
+                )
+            if topologies:
+                x, y = topology_to_pairs(topologies)
+                if x.shape[0] >= 8:
+                    result = train_mlp(x, y, self.config, mesh=self.mesh)
+                    outcome.mlp_result = result
+                    outcome.mlp = self._publish(
+                        MLP_MODEL_NAME, MODEL_TYPE_MLP, host_id, result,
+                        ModelEvaluation(
+                            mse=result.eval_metrics.get("mse", 0.0),
+                            mae=result.eval_metrics.get("mae", 0.0),
+                        ),
+                        extra={"num_pairs": int(x.shape[0])},
+                    )
+        finally:
+            self.storage.clear_downloads()
+            self.storage.clear_network_topologies()
+        return outcome
+
+    def _publish(self, name, model_type, host_id, result: TrainResult,
+                 evaluation: ModelEvaluation, extra: dict) -> ModelVersion:
+        mv = self.registry.create_model_version(
+            name=name,
+            model_type=model_type,
+            scheduler_host_id=host_id,
+            params=result.params,
+            evaluation=evaluation,
+            metadata={
+                "steps": result.steps,
+                "final_loss": result.losses[-1] if result.losses else None,
+                "samples_per_sec": result.samples_per_sec,
+                "hidden_dim": self.config.hidden_dim,
+                **extra,
+            },
+        )
+        if self.auto_activate:
+            self.registry.activate(mv.model_id, mv.version)
+        logger.info("published %s v%d (%s)", mv.model_id, mv.version, name)
+        return mv

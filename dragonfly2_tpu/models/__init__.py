@@ -1,0 +1,5 @@
+from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
+from dragonfly2_tpu.models.graphsage import GraphSAGERanker
+from dragonfly2_tpu.models import metrics
+
+__all__ = ["ProbeRTTRegressor", "GraphSAGERanker", "metrics"]

@@ -1,0 +1,133 @@
+"""GraphSAGE parent-peer ranker — the model the reference's trainGNN stub
+was meant to produce (trainer/training/training.go:82-90; intended
+manager-side registry type "gnn", manager/models/model.go:19-46).
+
+Design (TPU-first, see PAPERS.md "Fast Training of Sparse GNNs on Dense
+Hardware" for the dense-hardware framing):
+
+- The host interaction graph (records/features.HostGraph) is COO edge
+  arrays; neighborhood aggregation is `jax.ops.segment_sum`/mean over
+  edge-gathered node states — no sparse matrices, MXU-shaped Dense layers.
+- Two GraphSAGE layers embed every host; a pairwise scoring head ranks a
+  child's candidate parents from [child_emb, parent_emb, pair feats].
+- Listwise softmax cross-entropy against observed piece throughput: the
+  planted signal in download traces (records/synth.py) and the real signal
+  in production traces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SAGELayer(nn.Module):
+    """h_v' = act(W_self h_v + W_neigh mean_{u in N(v)} h_u + W_e mean e_uv)."""
+
+    features: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, nodes, edge_src, edge_dst, edge_feats, num_nodes: int):
+        nodes = nodes.astype(self.compute_dtype)
+        # Segment reductions accumulate in float32 (bf16 accumulation drifts
+        # and breaks shard/replica equivalence); matmuls stay compute_dtype
+        # for the MXU.
+        msgs = nodes[edge_dst].astype(jnp.float32)
+        ones = jnp.ones((edge_src.shape[0], 1), jnp.float32)
+        agg = jax.ops.segment_sum(msgs, edge_src, num_segments=num_nodes)
+        cnt = jax.ops.segment_sum(ones, edge_src, num_segments=num_nodes)
+        agg = (agg / jnp.maximum(cnt, 1.0)).astype(self.compute_dtype)
+        e_agg = jax.ops.segment_sum(
+            edge_feats.astype(jnp.float32), edge_src, num_segments=num_nodes
+        )
+        e_agg = (e_agg / jnp.maximum(cnt, 1.0)).astype(self.compute_dtype)
+        out = (
+            nn.Dense(self.features, dtype=self.compute_dtype, name="self")(nodes)
+            + nn.Dense(self.features, dtype=self.compute_dtype, use_bias=False, name="neigh")(agg)
+            + nn.Dense(self.features, dtype=self.compute_dtype, use_bias=False, name="edge")(e_agg)
+        )
+        return nn.gelu(out)
+
+
+class GraphSAGERanker(nn.Module):
+    hidden_dim: int = 128
+    num_layers: int = 2
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.sage = [
+            SAGELayer(self.hidden_dim, self.compute_dtype, name=f"sage_{i}")
+            for i in range(self.num_layers)
+        ]
+        self.head_0 = nn.Dense(self.hidden_dim, dtype=self.compute_dtype, name="head_0")
+        self.head_1 = nn.Dense(self.hidden_dim // 2, dtype=self.compute_dtype, name="head_1")
+        self.head_out = nn.Dense(1, dtype=self.compute_dtype, name="head_out")
+
+    def embed(self, node_feats, edge_src, edge_dst, edge_feats):
+        """Host embeddings from the interaction graph (also callable alone
+        via apply(..., method='embed') — the serving path caches these)."""
+        n = node_feats.shape[0]
+        h = node_feats
+        for layer in self.sage:
+            h = layer(h, edge_src, edge_dst, edge_feats, n)
+        return h
+
+    def score(self, child_emb, parent_emb, pair_feats):
+        """child_emb (B,D) + parent_emb (B,P,D) + pair_feats (B,P,F) -> (B,P)."""
+        b, p, _ = parent_emb.shape
+        child = jnp.broadcast_to(child_emb[:, None, :], (b, p, child_emb.shape[-1]))
+        x = jnp.concatenate(
+            [child.astype(self.compute_dtype), parent_emb.astype(self.compute_dtype),
+             pair_feats.astype(self.compute_dtype)],
+            axis=-1,
+        )
+        x = nn.gelu(self.head_0(x))
+        x = nn.gelu(self.head_1(x))
+        return self.head_out(x)[..., 0].astype(jnp.float32)
+
+    def __call__(self, graph, child_idx, parent_idx, pair_feats):
+        """Full forward: embed the graph, gather per-example embeddings, score.
+
+        graph: dict(node_feats, edge_src, edge_dst, edge_feats)
+        child_idx (B,), parent_idx (B,P), pair_feats (B,P,F) -> scores (B,P)
+        """
+        emb = self.embed(
+            graph["node_feats"], graph["edge_src"], graph["edge_dst"], graph["edge_feats"]
+        )
+        return self.score(emb[child_idx], emb[parent_idx], pair_feats)
+
+
+def listwise_rank_loss(scores: jax.Array, throughput: jax.Array, mask: jax.Array,
+                       temperature: float = 1.0) -> jax.Array:
+    """Listwise softmax CE: target distribution = softmax of observed
+    log-throughput over valid candidates; rows need >= 2 valid entries."""
+    neg = jnp.float32(-1e30)
+    logits = jnp.where(mask, scores, neg)
+    target_logits = jnp.where(mask, throughput / temperature, neg)
+    target = jax.nn.softmax(target_logits, axis=-1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per_row = -(target * jnp.where(mask, logp, 0.0)).sum(-1)
+    row_ok = mask.sum(-1) >= 2
+    return (per_row * row_ok).sum() / jnp.maximum(row_ok.sum(), 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class RankBatch:
+    """One padded training batch for the ranker (pytree via dataclass fields)."""
+
+    child_idx: jax.Array     # (B,)
+    parent_idx: jax.Array    # (B, P)
+    pair_feats: jax.Array    # (B, P, F)
+    throughput: jax.Array    # (B, P)
+    mask: jax.Array          # (B, P)
+
+
+jax.tree_util.register_dataclass(
+    RankBatch,
+    data_fields=["child_idx", "parent_idx", "pair_feats", "throughput", "mask"],
+    meta_fields=[],
+)

@@ -1,0 +1,56 @@
+"""Model evaluation metrics — the fields the manager's model registry
+records per version: Recall / Precision / F1 / MSE / MAE
+(manager/types/model.go:58-64, persisted via CreateModel
+manager/rpcserver/manager_server_v1.go:880-952).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mse(pred: jax.Array, target: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    err = (pred - target) ** 2
+    if mask is None:
+        return err.mean()
+    return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def mae(pred: jax.Array, target: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    err = jnp.abs(pred - target)
+    if mask is None:
+        return err.mean()
+    return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def top1_selection_stats(scores: jax.Array, throughput: jax.Array, mask: jax.Array,
+                         good_quantile: float = 0.75):
+    """Precision/recall/F1 of the ranker's top-1 pick per row.
+
+    A candidate is "relevant" if its observed throughput is in the top
+    (1-good_quantile) share of its row's valid candidates. The ranker's
+    pick is a true positive when it selects a relevant candidate. With one
+    pick per row, precision = fraction of rows whose pick was relevant;
+    recall = TP / total relevant; F1 combines them.
+    """
+    neg = jnp.float32(-1e30)
+    valid_rows = mask.sum(-1) >= 2
+    masked_tp = jnp.where(mask, throughput, neg)
+    thresh = jnp.nanquantile(
+        jnp.where(mask, throughput, jnp.nan), good_quantile, axis=-1, method="nearest"
+    )
+    relevant = mask & (throughput >= thresh[..., None]) & jnp.isfinite(masked_tp)
+    pick = jnp.argmax(jnp.where(mask, scores, neg), axis=-1)
+    picked_relevant = jnp.take_along_axis(relevant, pick[..., None], axis=-1)[..., 0]
+    tp = (picked_relevant & valid_rows).sum()
+    n_rows = jnp.maximum(valid_rows.sum(), 1)
+    n_relevant = jnp.maximum((relevant & valid_rows[..., None]).sum(), 1)
+    precision = tp / n_rows
+    recall = tp / n_relevant
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-9)
+    return {"precision": precision, "recall": recall, "f1": f1}
+
+
+def regression_report(pred, target, mask=None) -> dict:
+    return {"mse": float(mse(pred, target, mask)), "mae": float(mae(pred, target, mask))}

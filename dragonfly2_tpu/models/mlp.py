@@ -1,0 +1,39 @@
+"""MLP probe-RTT regressor — the model the reference's trainMLP stub was
+meant to produce (trainer/training/training.go:92-98, fed by
+TrainMlpRequest download/networktopology datasets, trainer/service/
+service_v1.go:59-162).
+
+Input: pairwise (src, dst) host features (records/features.topology_to_pairs,
+NUM_PAIR_FEATURES columns). Output: predicted log1p(average RTT in ms).
+bfloat16 matmuls on the MXU with float32 params and loss.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ProbeRTTRegressor(nn.Module):
+    hidden_dim: int = 128
+    num_layers: int = 3
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        for _ in range(self.num_layers - 1):
+            x = nn.Dense(self.hidden_dim, dtype=self.compute_dtype)(x)
+            x = nn.gelu(x)
+        x = nn.Dense(1, dtype=self.compute_dtype)(x)
+        return x[..., 0].astype(jnp.float32)
+
+
+def mse_loss(model: ProbeRTTRegressor, params, x: jax.Array, y: jax.Array,
+             mask: jax.Array | None = None) -> jax.Array:
+    pred = model.apply(params, x)
+    err = (pred - y) ** 2
+    if mask is not None:
+        return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return err.mean()

@@ -217,6 +217,20 @@ def filter_candidates(
     return mask
 
 
+def _filter_and_select(feats, scores, blocklist, in_degree, can_add_edge, limit):
+    """Shared contract of every scheduling path: eligibility mask + masked
+    top-k over the provided scores."""
+    mask = filter_candidates(feats, blocklist, in_degree, can_add_edge)
+    values, indices, valid = masked_top_k(scores, mask, limit)
+    return {
+        "scores": scores,
+        "mask": mask,
+        "selected": indices,
+        "selected_valid": valid,
+        "selected_scores": values,
+    }
+
+
 @functools.partial(jax.jit, static_argnames=("algorithm", "limit"))
 def schedule_candidate_parents(
     feats: dict,
@@ -232,16 +246,24 @@ def schedule_candidate_parents(
     candidate indices, `selected_valid` (B,limit), `selected_scores`.
     One device call per scheduler tick — the <1ms p50 path.
     """
-    mask = filter_candidates(feats, blocklist, in_degree, can_add_edge)
     scores = evaluate(feats, algorithm)
-    values, indices, valid = masked_top_k(scores, mask, limit)
-    return {
-        "scores": scores,
-        "mask": mask,
-        "selected": indices,
-        "selected_valid": valid,
-        "selected_scores": values,
-    }
+    return _filter_and_select(feats, scores, blocklist, in_degree, can_add_edge, limit)
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def select_with_scores(
+    feats: dict,
+    scores: jax.Array,
+    blocklist: jax.Array | None = None,
+    in_degree: jax.Array | None = None,
+    can_add_edge: jax.Array | None = None,
+    limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
+):
+    """Like schedule_candidate_parents but with externally supplied scores —
+    the "ml" algorithm path where a served model (registry/serving.py)
+    replaces the linear blend while every filter rule still applies
+    (the wiring the reference leaves dead: evaluator.go:84-86)."""
+    return _filter_and_select(feats, scores, blocklist, in_degree, can_add_edge, limit)
 
 
 @functools.partial(jax.jit, static_argnames=("algorithm",))

@@ -1,0 +1,17 @@
+from dragonfly2_tpu.parallel.mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated,
+    shard_batch,
+    DP_AXIS,
+    GRAPH_AXIS,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "DP_AXIS",
+    "GRAPH_AXIS",
+]

@@ -1,0 +1,76 @@
+"""Device mesh + sharding helpers — the distributed backbone.
+
+Where the reference scales with gRPC streams + a consistent-hash balancer
+over TCP (SURVEY.md §2.6), the TPU build scales with a
+`jax.sharding.Mesh`: data parallelism over the `dp` axis (batch sharded,
+params replicated, XLA inserts the grad all-reduce over ICI) and graph
+parallelism over the `graph` axis (edge shards aggregated with `psum` —
+training/train.py:embed_graph_sharded). Multi-host extends the same mesh
+across DCN via jax's multi-slice support; nothing here assumes a single
+process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+GRAPH_AXIS = "graph"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    dp: int | None = None,
+    graph: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (dp, graph) mesh. Defaults: all devices on the dp axis."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if dp is None:
+        if n % graph != 0:
+            raise ValueError(f"{n} devices not divisible by graph={graph}")
+        dp = n // graph
+    if dp * graph != n:
+        raise ValueError(f"mesh {dp}x{graph} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, graph)
+    return Mesh(arr, (DP_AXIS, GRAPH_AXIS))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (batch) dim over dp, replicate the rest."""
+    return NamedSharding(mesh, P(DP_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree):
+    """device_put every leaf with its leading dim sharded over dp.
+
+    Leaves whose batch dim is not divisible by the dp size are padded:
+    bool leaves (masks) with False — so padded rows drop out of any
+    masked loss/metric — and other leaves by repeating the last element,
+    which keeps index leaves in-range.
+    """
+    dp = mesh.shape[DP_AXIS]
+
+    def put(x):
+        x = np.asarray(x)
+        b = x.shape[0]
+        if b % dp:
+            pad = dp - (b % dp)
+            if x.dtype == np.bool_:
+                fill = np.zeros((pad,) + x.shape[1:], x.dtype)
+            else:
+                fill = np.repeat(x[-1:], pad, axis=0)
+            x = np.concatenate([x, fill], axis=0)
+        return jax.device_put(x, batch_sharding(mesh, x.ndim))
+
+    return jax.tree_util.tree_map(put, tree)

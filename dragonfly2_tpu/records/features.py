@@ -43,6 +43,15 @@ HOST_NUMERIC_FEATURES = [
 ]
 NUM_HOST_FEATURES = len(HOST_NUMERIC_FEATURES)
 
+# Fixed per-feature scales applied at extraction so every consumer (trainer,
+# server, metrics) sees O(1)-magnitude inputs; schema-derived constants, not
+# data statistics, so train/serve stay consistent by construction.
+HOST_FEATURE_SCALE = np.array(
+    [1.0, 50.0, 50.0, 50.0, 10.0, 10.0, 1.0, 8.0, 8.0, 100.0, 100.0, 100.0],
+    dtype=np.float32,
+)
+EDGE_FEATURE_SCALE = np.array([20.0, 5.0], dtype=np.float32)  # [log1p tput, log1p count]
+
 
 def location_codes(location: str) -> np.ndarray:
     """Hash each `|`-separated element; 0 = absent (evaluator_base.go:159-188)."""
@@ -73,22 +82,25 @@ def host_numeric_features(h: HostRecord) -> np.ndarray:
     success_ratio = (
         (h.upload_count - h.upload_failed_count) / h.upload_count if h.upload_count > 0 else 1.0
     )
-    return np.array(
-        [
-            1.0 if HostType.from_name(h.type) != HostType.NORMAL else 0.0,
-            h.concurrent_upload_limit,
-            h.concurrent_upload_count,
-            free_upload,
-            np.log1p(max(h.upload_count, 0)),
-            np.log1p(max(h.upload_failed_count, 0)),
-            success_ratio,
-            np.log1p(max(h.network.tcp_connection_count, 0)),
-            np.log1p(max(h.network.upload_tcp_connection_count, 0)),
-            h.cpu.percent,
-            h.memory.used_percent,
-            h.disk.used_percent,
-        ],
-        dtype=np.float32,
+    return (
+        np.array(
+            [
+                1.0 if HostType.from_name(h.type) != HostType.NORMAL else 0.0,
+                h.concurrent_upload_limit,
+                h.concurrent_upload_count,
+                free_upload,
+                np.log1p(max(h.upload_count, 0)),
+                np.log1p(max(h.upload_failed_count, 0)),
+                success_ratio,
+                np.log1p(max(h.network.tcp_connection_count, 0)),
+                np.log1p(max(h.network.upload_tcp_connection_count, 0)),
+                h.cpu.percent,
+                h.memory.used_percent,
+                h.disk.used_percent,
+            ],
+            dtype=np.float32,
+        )
+        / HOST_FEATURE_SCALE
     )
 
 
@@ -317,12 +329,25 @@ def downloads_to_ranking_dataset(
             edge_stats.setdefault((ci, pi), []).append(tput)
 
     if edge_stats:
-        keys = list(edge_stats.keys())
+        # Both directions: child->parent lets children aggregate who served
+        # them; parent->child lets a parent's own serving history (the
+        # quality signal) reach ITS embedding. Mirrored pairs that already
+        # exist as forward edges are MERGED so no directed edge appears
+        # twice (duplicate edges would double-count neighbors in the
+        # segment mean).
+        directed: dict[tuple[int, int], list[float]] = {}
+        for (a, b), v in edge_stats.items():
+            directed.setdefault((a, b), []).extend(v)
+            directed.setdefault((b, a), []).extend(v)
+        keys = list(directed.keys())
         edge_src = np.asarray([k[0] for k in keys], np.int32)
         edge_dst = np.asarray([k[1] for k in keys], np.int32)
-        edge_feats = np.asarray(
-            [[np.log1p(np.mean(v)), np.log1p(len(v))] for v in edge_stats.values()],
-            np.float32,
+        edge_feats = (
+            np.asarray(
+                [[np.log1p(np.mean(v)), np.log1p(len(v))] for v in directed.values()],
+                np.float32,
+            )
+            / EDGE_FEATURE_SCALE
         )
     else:
         edge_src = np.zeros((0,), np.int32)

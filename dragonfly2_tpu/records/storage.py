@@ -193,6 +193,12 @@ class HostTraceStorage:
         for path in self.base.glob(f"{NETWORK_TOPOLOGY_FILE_PREFIX}-*{CSV_EXT}"):
             path.unlink(missing_ok=True)
 
+    def clear_host(self, host_id: str) -> None:
+        """Drop one host's partial datasets (trainer error path,
+        service_v1.go:117-131 — scoped to the failing stream only)."""
+        self._path(DOWNLOAD_FILE_PREFIX, host_id).unlink(missing_ok=True)
+        self._path(NETWORK_TOPOLOGY_FILE_PREFIX, host_id).unlink(missing_ok=True)
+
 
 def _looks_like_header(values: list[str]) -> bool:
     return bool(values) and values[0] in ("id",) and not values[0].isdigit()

@@ -1,0 +1,4 @@
+from dragonfly2_tpu.registry.registry import ModelRegistry, ModelVersion, ModelEvaluation
+from dragonfly2_tpu.registry.serving import ModelServer, MLEvaluator
+
+__all__ = ["ModelRegistry", "ModelVersion", "ModelEvaluation", "ModelServer", "MLEvaluator"]

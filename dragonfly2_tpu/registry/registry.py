@@ -1,0 +1,182 @@
+"""Versioned model registry — the manager's model lifecycle, natively.
+
+Capability parity with the reference's registry spread across
+manager/rpcserver/manager_server_v1.go:802-952 (CreateModel: model bytes ->
+object storage, metadata+evaluation -> DB), manager/types/model.go:58-75
+(evaluation fields Recall/Precision/F1/MSE/MAE; object keys
+``<id>/<version>/model.graphdef`` + ``<id>/config.pbtxt``) and
+manager/service/model.go:109-190 (activate a version = flip DB state +
+rewrite the Triton version policy).
+
+TPU-first difference: no Triton sidecar — artifacts are orbax-saved flax
+params plus a JSON manifest, laid out ``<model_id>/<version>/params/`` so
+the same "activate = flip the active pointer" operation drives the
+in-scheduler jit-compiled server (registry/serving.py). Storage is a
+filesystem dir standing in for the object-store bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+from dragonfly2_tpu.utils.idgen import model_id as make_model_id
+
+MODEL_TYPE_GNN = "gnn"
+MODEL_TYPE_MLP = "mlp"
+
+STATE_INACTIVE = "inactive"
+STATE_ACTIVE = "active"
+
+
+@dataclasses.dataclass
+class ModelEvaluation:
+    """manager/types/model.go:58-64."""
+
+    recall: float = 0.0
+    precision: float = 0.0
+    f1_score: float = 0.0
+    mse: float = 0.0
+    mae: float = 0.0
+
+
+@dataclasses.dataclass
+class ModelVersion:
+    model_id: str
+    name: str
+    type: str
+    version: int
+    state: str
+    evaluation: ModelEvaluation
+    scheduler_host_id: str
+    created_at: float
+    metadata: dict = dataclasses.field(default_factory=dict)
+
+
+class ModelRegistry:
+    """Filesystem-backed registry: <base>/<model_id>/<version>/{params/, version.json}
+    plus <base>/<model_id>/model.json recording the active version."""
+
+    def __init__(self, base_dir: str | pathlib.Path):
+        self.base = pathlib.Path(base_dir).absolute()
+        self.base.mkdir(parents=True, exist_ok=True)
+        self._ckpt = ocp.StandardCheckpointer()
+
+    # -------------------------------------------------------------- write
+
+    def create_model_version(
+        self,
+        name: str,
+        model_type: str,
+        scheduler_host_id: str,
+        params: Any,
+        evaluation: ModelEvaluation,
+        metadata: dict | None = None,
+    ) -> ModelVersion:
+        """CreateModel semantics (manager_server_v1.go:802-952): next version
+        number, artifacts + evaluation stored, version starts inactive."""
+        if model_type not in (MODEL_TYPE_GNN, MODEL_TYPE_MLP):
+            raise ValueError(f"unknown model type {model_type!r}")
+        mid = make_model_id(name, scheduler_host_id)
+        versions = self.list_versions(mid)
+        next_version = max((v.version for v in versions), default=0) + 1
+        vdir = self.base / mid / str(next_version)
+        vdir.mkdir(parents=True, exist_ok=True)
+        self._ckpt.save(vdir / "params", params)
+        self._ckpt.wait_until_finished()
+        mv = ModelVersion(
+            model_id=mid,
+            name=name,
+            type=model_type,
+            version=next_version,
+            state=STATE_INACTIVE,
+            evaluation=evaluation,
+            scheduler_host_id=scheduler_host_id,
+            created_at=time.time(),
+            metadata=metadata or {},
+        )
+        (vdir / "version.json").write_text(json.dumps(dataclasses.asdict(mv), indent=2))
+        model_manifest = self.base / mid / "model.json"
+        if not model_manifest.exists():
+            model_manifest.write_text(
+                json.dumps({"model_id": mid, "name": name, "type": model_type, "active_version": None})
+            )
+        return mv
+
+    def activate(self, model_id: str, version: int) -> None:
+        """Flip the active version pointer; exactly one version active —
+        manager/service/model.go:109-151's transactional state flip."""
+        if not (self.base / model_id / str(version) / "version.json").exists():
+            raise FileNotFoundError(f"{model_id} v{version} not found")
+        manifest_path = self.base / model_id / "model.json"
+        manifest = json.loads(manifest_path.read_text())
+        for v in self.list_versions(model_id):
+            self._set_state(model_id, v.version, STATE_ACTIVE if v.version == version else STATE_INACTIVE)
+        manifest["active_version"] = version
+        manifest_path.write_text(json.dumps(manifest))
+
+    def delete_version(self, model_id: str, version: int) -> None:
+        vdir = self.base / model_id / str(version)
+        if not vdir.exists():
+            return
+        manifest_path = self.base / model_id / "model.json"
+        if manifest_path.exists():
+            manifest = json.loads(manifest_path.read_text())
+            if manifest.get("active_version") == version:
+                raise ValueError("cannot delete the active version")
+        import shutil
+
+        shutil.rmtree(vdir)
+
+    def _set_state(self, model_id: str, version: int, state: str) -> None:
+        path = self.base / model_id / str(version) / "version.json"
+        data = json.loads(path.read_text())
+        data["state"] = state
+        path.write_text(json.dumps(data, indent=2))
+
+    # --------------------------------------------------------------- read
+
+    def list_models(self) -> list[dict]:
+        out = []
+        for manifest in sorted(self.base.glob("*/model.json")):
+            out.append(json.loads(manifest.read_text()))
+        return out
+
+    def list_versions(self, model_id: str) -> list[ModelVersion]:
+        out = []
+        for vjson in sorted(
+            (self.base / model_id).glob("*/version.json"),
+            key=lambda p: int(p.parent.name),
+        ):
+            out.append(_version_from_json(json.loads(vjson.read_text())))
+        return out
+
+    def active_version(self, model_id: str) -> ModelVersion | None:
+        manifest_path = self.base / model_id / "model.json"
+        if not manifest_path.exists():
+            return None
+        active = json.loads(manifest_path.read_text()).get("active_version")
+        if active is None:
+            return None
+        vjson = self.base / model_id / str(active) / "version.json"
+        return _version_from_json(json.loads(vjson.read_text()))
+
+    def load_params(self, model_id: str, version: int, template: Any = None) -> Any:
+        path = self.base / model_id / str(version) / "params"
+        if template is not None:
+            return self._ckpt.restore(path, target=template)
+        return self._ckpt.restore(path)
+
+    def model_id(self, name: str, scheduler_host_id: str) -> str:
+        return make_model_id(name, scheduler_host_id)
+
+
+def _version_from_json(data: dict) -> ModelVersion:
+    data = dict(data)
+    data["evaluation"] = ModelEvaluation(**data["evaluation"])
+    return ModelVersion(**data)

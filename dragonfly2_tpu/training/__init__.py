@@ -1,0 +1,15 @@
+from dragonfly2_tpu.training.train import (
+    TrainResult,
+    train_mlp,
+    train_gnn,
+    embed_graph_sharded,
+)
+from dragonfly2_tpu.training.checkpoint import TrainCheckpointer
+
+__all__ = [
+    "TrainResult",
+    "train_mlp",
+    "train_gnn",
+    "embed_graph_sharded",
+    "TrainCheckpointer",
+]

@@ -1,0 +1,42 @@
+"""Train-state checkpointing (orbax) — the capability the reference lacks
+entirely (SURVEY.md §5: "no ML checkpointing (no training)"), layered the
+way its data plane does resume: restartable state on disk + versioned
+artifacts in the registry (registry/).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    """Step-indexed checkpoints of {params, opt_state, step, metadata}."""
+
+    def __init__(self, directory: str | pathlib.Path, max_to_keep: int = 3):
+        self.directory = pathlib.Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, step: int | None = None, template: Any = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if template is not None:
+            return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+        return self._mngr.restore(step)
+
+    def close(self) -> None:
+        self._mngr.close()

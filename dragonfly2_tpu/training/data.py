@@ -1,0 +1,83 @@
+"""Training input pipeline: ragged traces -> fixed-shape device batches.
+
+The hard part called out in SURVEY.md §7 stage 4: padding/bucketing the
+ragged <=20-parent x <=10-piece lists without exploding compile count.
+Strategy: ONE static batch shape per model (B fixed, P fixed at the
+record-schema bound), minibatches cycled with a seeded permutation; the
+final short batch is padded with mask=False rows, so every `jit` sees one
+shape.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from dragonfly2_tpu.models.graphsage import RankBatch
+from dragonfly2_tpu.records.features import HostGraph, RankingDataset
+
+
+def minibatches(
+    n: int, batch_size: int, rng: np.random.Generator, shuffle: bool = True
+) -> Iterator[np.ndarray]:
+    """Yield index arrays of EXACTLY batch_size (last one wraps around),
+    keeping shapes static across steps."""
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    for start in range(0, n, batch_size):
+        idx = order[start : start + batch_size]
+        if len(idx) < batch_size:
+            idx = np.concatenate([idx, order[: batch_size - len(idx)]])
+        yield idx
+
+
+def rank_batches(
+    ds: RankingDataset, batch_size: int, rng: np.random.Generator, shuffle: bool = True
+) -> Iterator[RankBatch]:
+    n = ds.child.shape[0]
+    pair_feats = np.concatenate(
+        [ds.same_idc[..., None], ds.loc_match[..., None]], axis=-1
+    ).astype(np.float32)
+    for idx in minibatches(n, batch_size, rng, shuffle):
+        yield RankBatch(
+            child_idx=ds.child_host_idx[idx],
+            parent_idx=ds.parent_host_idx[idx],
+            pair_feats=pair_feats[idx],
+            throughput=ds.throughput[idx],
+            mask=ds.mask[idx],
+        )
+
+
+def graph_arrays(graph: HostGraph, pad_edges_to: int | None = None) -> dict:
+    """HostGraph -> dict of arrays for GraphSAGERanker, with optional edge
+    padding to a static bucket size (padded edges point at node 0 with zero
+    features and a zero segment weight is unnecessary because zero feature
+    messages only perturb node 0's mean; we instead route padded edges to a
+    dedicated sink: the LAST node slot, appended here)."""
+    node_feats = graph.node_feats
+    e = graph.edge_src.shape[0]
+    if pad_edges_to is not None and pad_edges_to > e:
+        pad = pad_edges_to - e
+        # sink node appended so padded edges never touch real hosts
+        node_feats = np.concatenate(
+            [node_feats, np.zeros((1,) + node_feats.shape[1:], node_feats.dtype)]
+        )
+        sink = node_feats.shape[0] - 1
+        edge_src = np.concatenate([graph.edge_src, np.full(pad, sink, np.int32)])
+        edge_dst = np.concatenate([graph.edge_dst, np.full(pad, sink, np.int32)])
+        edge_feats = np.concatenate(
+            [graph.edge_feats, np.zeros((pad,) + graph.edge_feats.shape[1:], np.float32)]
+        )
+    else:
+        edge_src, edge_dst, edge_feats = graph.edge_src, graph.edge_dst, graph.edge_feats
+    return {
+        "node_feats": node_feats.astype(np.float32),
+        "edge_src": edge_src.astype(np.int32),
+        "edge_dst": edge_dst.astype(np.int32),
+        "edge_feats": edge_feats.astype(np.float32),
+    }
+
+
+def edge_bucket(e: int, granularity: int = 4096) -> int:
+    """Round edge count up to a bucket so graph growth rarely recompiles."""
+    return max(granularity, ((e + granularity - 1) // granularity) * granularity)

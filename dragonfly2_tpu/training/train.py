@@ -1,0 +1,277 @@
+"""Sharded training loops — making trainer/training/training.go:60-98 real.
+
+The reference spells out the intended pipeline in TODO comments (load from
+storage -> preprocess -> train -> upload model); here it exists:
+
+- `train_mlp`: probe-RTT regressor over topology pairs.
+- `train_gnn`: GraphSAGE ranker over download traces + host graph.
+
+Parallelism: data-parallel over the mesh's `dp` axis — batches sharded on
+their leading dim, params replicated, XLA inserts the gradient all-reduce
+over ICI (the pjit recipe from the scaling playbook). For graphs too big
+for one chip, `embed_graph_sharded` shards the EDGE set over the mesh and
+combines partial segment-sums with `psum` under `shard_map` — the
+"pkg/graph DAG ops lower to scatter/segment_sum with psum across chips"
+north star (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from dragonfly2_tpu.config.config import TrainerConfig
+from dragonfly2_tpu.models.graphsage import GraphSAGERanker, RankBatch, listwise_rank_loss
+from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
+from dragonfly2_tpu.models import metrics as M
+from dragonfly2_tpu.parallel.mesh import DP_AXIS, GRAPH_AXIS, replicated, shard_batch
+from dragonfly2_tpu.records.features import HostGraph, RankingDataset
+from dragonfly2_tpu.training import data as D
+
+
+@dataclasses.dataclass
+class TrainResult:
+    params: dict
+    losses: list[float]
+    eval_metrics: dict[str, float]
+    samples_per_sec: float
+    steps: int
+
+
+def _make_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def train_mlp(
+    x: np.ndarray,
+    y: np.ndarray,
+    config: TrainerConfig | None = None,
+    mesh=None,
+    seed: int = 0,
+    eval_fraction: float = 0.2,
+) -> TrainResult:
+    """Train the probe-RTT regressor; returns params + MSE/MAE on held-out
+    pairs (the registry's evaluation fields)."""
+    config = config or TrainerConfig()
+    rng = np.random.default_rng(seed)
+    n = x.shape[0]
+    perm = rng.permutation(n)
+    n_eval = max(1, int(n * eval_fraction))
+    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+
+    model = ProbeRTTRegressor(hidden_dim=config.hidden_dim)
+    params = model.init(jax.random.key(seed), jnp.zeros((1, x.shape[1]), jnp.float32))
+    optimizer = optax.adamw(config.learning_rate)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, batch):
+        pred = model.apply(params, batch["x"])
+        return ((pred - batch["y"]) ** 2 * batch["w"]).sum() / jnp.maximum(batch["w"].sum(), 1.0)
+
+    step = _make_step(loss_fn, optimizer)
+    if mesh is not None:
+        params = jax.device_put(params, replicated(mesh))
+        opt_state = jax.device_put(opt_state, replicated(mesh))
+
+    losses = []
+    t0 = time.perf_counter()
+    n_samples = 0
+    for _ in range(config.epochs):
+        for idx in D.minibatches(len(train_idx), min(config.batch_size, len(train_idx)), rng):
+            batch = {
+                "x": x[train_idx[idx]],
+                "y": y[train_idx[idx]],
+                "w": np.ones(len(idx), np.float32),
+            }
+            batch = shard_batch(mesh, batch) if mesh is not None else jax.device_put(batch)
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+            n_samples += len(idx)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    pred = model.apply(params, jnp.asarray(x[eval_idx]))
+    eval_metrics = M.regression_report(np.asarray(pred), y[eval_idx])
+    return TrainResult(
+        params=params,
+        losses=losses,
+        eval_metrics=eval_metrics,
+        samples_per_sec=n_samples / max(dt, 1e-9),
+        steps=len(losses),
+    )
+
+
+def train_gnn(
+    ds: RankingDataset,
+    graph: HostGraph,
+    config: TrainerConfig | None = None,
+    mesh=None,
+    seed: int = 0,
+    eval_fraction: float = 0.2,
+) -> TrainResult:
+    """Train the GraphSAGE parent ranker; eval = precision/recall/F1 of its
+    top-1 parent picks on held-out downloads (manager/types/model.go:58-64)."""
+    config = config or TrainerConfig()
+    rng = np.random.default_rng(seed)
+    n = ds.child.shape[0]
+    perm = rng.permutation(n)
+    n_eval = max(1, int(n * eval_fraction))
+    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+
+    garrs = D.graph_arrays(graph, pad_edges_to=D.edge_bucket(graph.edge_src.shape[0]))
+    model = GraphSAGERanker(hidden_dim=config.hidden_dim)
+    sample = _take_rank_batch(ds, train_idx[: min(2, len(train_idx))])
+    params = model.init(
+        jax.random.key(seed), garrs, sample.child_idx, sample.parent_idx, sample.pair_feats
+    )
+    optimizer = optax.adamw(config.learning_rate)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, batch: RankBatch):
+        scores = model.apply(params, garrs_dev, batch.child_idx, batch.parent_idx, batch.pair_feats)
+        return listwise_rank_loss(scores, batch.throughput, batch.mask)
+
+    if mesh is not None:
+        params = jax.device_put(params, replicated(mesh))
+        opt_state = jax.device_put(opt_state, replicated(mesh))
+        garrs_dev = jax.device_put(garrs, replicated(mesh))
+    else:
+        garrs_dev = jax.device_put(garrs)
+
+    step = _make_step(loss_fn, optimizer)
+
+    sub = _subset_rank_dataset(ds, train_idx)
+    losses = []
+    t0 = time.perf_counter()
+    n_samples = 0
+    batch_size = min(config.batch_size, len(train_idx))
+    for _ in range(config.epochs):
+        for batch in D.rank_batches(sub, batch_size, rng):
+            batch = shard_batch(mesh, batch) if mesh is not None else jax.device_put(batch)
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+            n_samples += batch_size
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    eval_batch = _take_rank_batch(ds, eval_idx)
+    scores = model.apply(
+        params, garrs_dev, eval_batch.child_idx, eval_batch.parent_idx, eval_batch.pair_feats
+    )
+    stats = M.top1_selection_stats(
+        np.asarray(scores), eval_batch.throughput, eval_batch.mask
+    )
+    eval_metrics = {k: float(v) for k, v in stats.items()}
+    return TrainResult(
+        params=params,
+        losses=losses,
+        eval_metrics=eval_metrics,
+        samples_per_sec=n_samples / max(dt, 1e-9),
+        steps=len(losses),
+    )
+
+
+def _take_rank_batch(ds: RankingDataset, idx: np.ndarray) -> RankBatch:
+    pair_feats = np.concatenate(
+        [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
+    ).astype(np.float32)
+    return RankBatch(
+        child_idx=ds.child_host_idx[idx],
+        parent_idx=ds.parent_host_idx[idx],
+        pair_feats=pair_feats,
+        throughput=ds.throughput[idx],
+        mask=ds.mask[idx],
+    )
+
+
+def _subset_rank_dataset(ds: RankingDataset, idx: np.ndarray) -> RankingDataset:
+    return RankingDataset(
+        child=ds.child[idx],
+        parents=ds.parents[idx],
+        same_idc=ds.same_idc[idx],
+        loc_match=ds.loc_match[idx],
+        mask=ds.mask[idx],
+        throughput=ds.throughput[idx],
+        child_host_idx=ds.child_host_idx[idx],
+        parent_host_idx=ds.parent_host_idx[idx],
+    )
+
+
+def embed_graph_sharded(model: GraphSAGERanker, params, graph_arrays: dict, mesh):
+    """Host embeddings with the EDGE set sharded across the whole mesh.
+
+    Each device owns an edge shard, computes partial neighbor sums via
+    `segment_sum` into a full-size node accumulator, then `psum` over both
+    mesh axes combines partials — ICI traffic is 2 x nodes x dim per layer
+    instead of the whole edge list. This is the scale path for 1M-piece /
+    10k-peer traces (BASELINE.json configs[3]).
+    """
+    n_nodes = graph_arrays["node_feats"].shape[0]
+    axes = (DP_AXIS, GRAPH_AXIS)
+    n_shards = mesh.size
+
+    # Pad the edge set to a multiple of the shard count; pads carry weight 0
+    # so their segment contributions vanish.
+    e = graph_arrays["edge_src"].shape[0]
+    pad = (-e) % n_shards
+    edge_src = jnp.concatenate([jnp.asarray(graph_arrays["edge_src"]), jnp.zeros(pad, jnp.int32)])
+    edge_dst = jnp.concatenate([jnp.asarray(graph_arrays["edge_dst"]), jnp.zeros(pad, jnp.int32)])
+    edge_feats = jnp.concatenate(
+        [jnp.asarray(graph_arrays["edge_feats"]),
+         jnp.zeros((pad,) + graph_arrays["edge_feats"].shape[1:], jnp.float32)]
+    )
+    edge_weight = jnp.concatenate([jnp.ones(e, jnp.float32), jnp.zeros(pad, jnp.float32)])
+
+    def shard_fn(node_feats, edge_src, edge_dst, edge_feats, edge_weight):
+        h = node_feats
+        w = edge_weight.astype(jnp.float32)[:, None]
+        for i in range(model.num_layers):
+            layer_params = params["params"][f"sage_{i}"]
+            h_c = h.astype(model.compute_dtype)
+            # float32 segment accumulation, matching SAGELayer exactly
+            ef = edge_feats.astype(jnp.float32) * w
+            msgs = h_c[edge_dst].astype(jnp.float32) * w
+            agg = jax.ops.segment_sum(msgs, edge_src, num_segments=n_nodes)
+            cnt = jax.ops.segment_sum(w, edge_src, num_segments=n_nodes)
+            e_agg = jax.ops.segment_sum(ef, edge_src, num_segments=n_nodes)
+            # combine partial sums from every edge shard over ICI
+            agg = jax.lax.psum(agg, axes)
+            cnt = jax.lax.psum(cnt, axes)
+            e_agg = jax.lax.psum(e_agg, axes)
+            agg = (agg / jnp.maximum(cnt, 1.0)).astype(model.compute_dtype)
+            e_agg = (e_agg / jnp.maximum(cnt, 1.0)).astype(model.compute_dtype)
+            out = (
+                h_c @ layer_params["self"]["kernel"].astype(model.compute_dtype)
+                + layer_params["self"]["bias"].astype(model.compute_dtype)
+                + agg @ layer_params["neigh"]["kernel"].astype(model.compute_dtype)
+                + e_agg @ layer_params["edge"]["kernel"].astype(model.compute_dtype)
+            )
+            h = jax.nn.gelu(out)
+        return h
+
+    edge_spec = P((DP_AXIS, GRAPH_AXIS))
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), edge_spec, edge_spec, edge_spec, edge_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)(
+        jnp.asarray(graph_arrays["node_feats"]), edge_src, edge_dst, edge_feats, edge_weight
+    )

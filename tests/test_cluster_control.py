@@ -1,0 +1,265 @@
+"""Scheduler service tests: the announce-stream protocol against a live
+service with in-memory state (SURVEY.md §4 tier 1: multi-node logic driven
+without a cluster)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.probes import ProbeStore
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.records.storage import TraceStorage
+from dragonfly2_tpu.state.fsm import PeerState
+
+
+def host(i, seed=False, idc="idc-a"):
+    return msg.HostInfo(
+        host_id=f"host-{i}",
+        hostname=f"node-{i}",
+        ip=f"10.0.0.{i}",
+        host_type="super" if seed else "normal",
+        idc=idc,
+        location=f"na|zone-1|rack-{i % 4}",
+    )
+
+
+def register(svc, peer_id, task_id, h, pieces=4):
+    return svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id=peer_id,
+            task_id=task_id,
+            host=h,
+            url="https://e.com/blob",
+            content_length=pieces * (4 << 20),
+            total_piece_count=pieces,
+        )
+    )
+
+
+def seeded_service(storage=None, config=None):
+    svc = SchedulerService(config=config, storage=storage)
+    # a seed peer that has succeeded -> eligible parent
+    register(svc, "seed-peer", "task-1", host(0, seed=True))
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id="seed-peer", piece_count=4))
+    svc.tick()  # flush seed's own (now moot) pending entry
+    return svc
+
+
+def test_register_and_schedule_from_seed():
+    svc = seeded_service()
+    assert register(svc, "child-1", "task-1", host(1)) is None
+    responses = svc.tick()
+    normal = [r for r in responses if isinstance(r, msg.NormalTaskResponse)]
+    assert len(normal) == 1
+    assert normal[0].peer_id == "child-1"
+    parents = normal[0].candidate_parents
+    assert parents and parents[0].peer_id == "seed-peer"
+    assert parents[0].state == "Succeeded"
+    # DAG edge exists: seed -> child
+    meta_child = svc._peer_meta["child-1"]
+    meta_seed = svc._peer_meta["seed-peer"]
+    assert svc._task_dag("task-1").has_edge(meta_seed.dag_slot, meta_child.dag_slot)
+    # parent's host upload slot consumed
+    seed_host_idx = svc.state.host_index("host-0")
+    assert svc.state.host_upload_used[seed_host_idx] == 1
+
+
+def test_double_register_is_idempotent():
+    """Re-register of a live peer is load-not-create (service_v2
+    handleResource), not an FSM violation."""
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    register(svc, "child-1", "task-1", host(1))  # duplicate
+    assert svc.counts()["peers"] == 2  # seed + child, not 3
+    responses = svc.tick()
+    assert sum(isinstance(r, msg.NormalTaskResponse) for r in responses) == 1
+
+
+def test_empty_scope_fast_path():
+    svc = SchedulerService()
+    resp = svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id="p-empty", task_id="t-empty", host=host(5), content_length=0
+        )
+    )
+    assert isinstance(resp, msg.EmptyTaskResponse)
+    idx = svc.state.peer_index("p-empty")
+    assert svc.state.peer_state[idx] == int(PeerState.RECEIVED_EMPTY)
+
+
+def test_reschedule_blocklists_parent():
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    svc.reschedule(
+        msg.RescheduleRequest(peer_id="child-1", candidate_parent_ids=["seed-peer"])
+    )
+    responses = svc.tick()
+    # only candidate is blocklisted -> no NormalTaskResponse for child-1
+    assert not any(
+        isinstance(r, msg.NormalTaskResponse) and r.peer_id == "child-1" for r in responses
+    )
+    assert "child-1" in svc._pending
+
+
+def test_retries_escalate_to_back_to_source_then_failure():
+    svc = SchedulerService()  # no parents at all
+    register(svc, "lonely", "task-x", host(2))
+    responses = []
+    for _ in range(10):
+        responses += svc.tick()
+        if responses:
+            break
+    # with zero candidates, retries grow until back-to-source is offered
+    b2s = [r for r in responses if isinstance(r, msg.NeedBackToSourceResponse)]
+    assert b2s and b2s[0].peer_id == "lonely"
+    # simulate the peer going back to source and finishing
+    svc.back_to_source_started(msg.DownloadPeerBackToSourceStartedRequest(peer_id="lonely"))
+    svc.back_to_source_finished(
+        msg.DownloadPeerBackToSourceFinishedRequest(peer_id="lonely", piece_count=4)
+    )
+    idx = svc.state.peer_index("lonely")
+    assert svc.state.peer_state[idx] == int(PeerState.SUCCEEDED)
+
+
+def test_retry_limit_failure_when_b2s_exhausted():
+    cfg = Config()
+    cfg.scheduler.retry_back_to_source_limit = 1
+    svc = SchedulerService(config=cfg)
+    register(svc, "lonely", "task-x", host(2), pieces=4)
+    # consume the task's back-to-source budget
+    t = svc.state.task_index("task-x")
+    svc.state.task_back_to_source_count[t] = svc.state.task_back_to_source_limit[t]
+    failures = []
+    for _ in range(10):
+        failures += [r for r in svc.tick() if isinstance(r, msg.ScheduleFailure)]
+        if failures:
+            break
+    assert failures and "RetryLimit" in failures[0].description
+
+
+def test_piece_and_peer_finished_bookkeeping(tmp_path):
+    storage = TraceStorage(tmp_path)
+    svc = seeded_service(storage=storage)
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    for piece in range(4):
+        svc.piece_finished(
+            msg.DownloadPieceFinishedRequest(
+                peer_id="child-1",
+                piece_number=piece,
+                length=4 << 20,
+                cost_ns=50_000_000,
+                parent_peer_id="seed-peer",
+            )
+        )
+    child_idx = svc.state.peer_index("child-1")
+    assert svc.state.peer_finished_count[child_idx] == 4
+    seed_host_idx = svc.state.host_index("host-0")
+    assert svc.state.host_upload_count[seed_host_idx] == 4
+    assert svc.state.host_upload_used[seed_host_idx] == 1
+
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id="child-1", piece_count=4))
+    assert svc.state.peer_state[child_idx] == int(PeerState.SUCCEEDED)
+    assert svc.state.host_upload_used[seed_host_idx] == 0  # slot released
+
+    records = storage.list_downloads()
+    child_records = [r for r in records if r.id == "child-1"]
+    assert len(child_records) == 1
+    rec = child_records[0]
+    assert rec.state == "Succeeded"
+    assert rec.task.id == "task-1"
+    assert len(rec.parents) == 1 and rec.parents[0].id == "seed-peer"
+    assert len(rec.parents[0].pieces) == 4
+    assert rec.parents[0].pieces[0].cost == 50_000_000
+
+
+def test_piece_failed_reschedules_and_counts():
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    svc.piece_failed(
+        msg.DownloadPieceFailedRequest(peer_id="child-1", parent_peer_id="seed-peer")
+    )
+    seed_host_idx = svc.state.host_index("host-0")
+    assert svc.state.host_upload_failed[seed_host_idx] == 1
+    assert "child-1" in svc._pending
+    assert "seed-peer" in svc._pending["child-1"].blocklist
+
+
+def test_reschedule_releases_upload_slots():
+    """Dropping parents must free their hosts' upload slots; repeated
+    reschedules must not leak (code-review regression)."""
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    seed_host_idx = svc.state.host_index("host-0")
+    assert svc.state.host_upload_used[seed_host_idx] == 1
+    for _ in range(3):
+        svc.reschedule(msg.RescheduleRequest(peer_id="child-1"))
+        svc.tick()
+    # slot count reflects at most the current edge, never accumulates
+    assert svc.state.host_upload_used[seed_host_idx] <= 1
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id="child-1", piece_count=4))
+    assert svc.state.host_upload_used[seed_host_idx] == 0
+
+
+def test_leave_parent_releases_its_upload_slots():
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    seed_host_idx = svc.state.host_index("host-0")
+    assert svc.state.host_upload_used[seed_host_idx] == 1
+    svc.leave_peer("seed-peer")
+    assert svc.state.host_upload_used[seed_host_idx] == 0
+    # child's held set no longer references the gone parent
+    assert "seed-peer" not in svc._peer_meta["child-1"].held_parents
+
+
+def test_snapshot_topology_includes_network_fields(tmp_path):
+    from dragonfly2_tpu.cluster.probes import ProbeStore
+    import numpy as np
+
+    storage = TraceStorage(tmp_path)
+    probes = ProbeStore(max_pairs=64, max_hosts=32)
+    svc = SchedulerService(storage=storage, probes=probes)
+    svc.announce_host(host(0, idc="idc-x"))
+    svc.announce_host(host(1, idc="idc-y"))
+    src = svc.state.host_index("host-0")
+    dst = svc.state.host_index("host-1")
+    probes.enqueue(np.array([src]), np.array([dst]), np.array([3e6], np.float32))
+    assert svc.snapshot_topology(now_ns=5) == 1
+    rec = storage.list_network_topologies()[0]
+    assert rec.host.network.idc == "idc-x"
+    assert rec.dest_hosts[0].network.idc == "idc-y"
+    assert rec.host.network.location.startswith("na|")
+
+
+def test_leave_host_drops_peers():
+    svc = seeded_service()
+    register(svc, "child-1", "task-1", host(1))
+    svc.tick()
+    svc.leave_host("host-1")
+    assert svc.state.peer_index("child-1") is None
+    assert svc.state.host_index("host-1") is None
+    assert "child-1" not in svc._peer_meta
+
+
+def test_nt_algorithm_uses_probe_store():
+    cfg = Config()
+    cfg.evaluator.algorithm = "nt"
+    probes = ProbeStore(max_pairs=256, max_hosts=64)
+    svc = SchedulerService(config=cfg, probes=probes)
+    svc.algorithm = "nt"
+    register(svc, "seed-peer", "task-1", host(0, seed=True))
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id="seed-peer", piece_count=4))
+    svc.tick()
+    register(svc, "child-1", "task-1", host(1))
+    # probe parent-host -> child-host direction
+    src = svc.state.host_index("host-0")
+    dst = svc.state.host_index("host-1")
+    probes.enqueue(np.array([src]), np.array([dst]), np.array([2e6], np.float32))
+    responses = svc.tick()
+    normal = [r for r in responses if isinstance(r, msg.NormalTaskResponse)]
+    assert normal and normal[0].candidate_parents[0].peer_id == "seed-peer"

@@ -1,0 +1,38 @@
+"""Preheat / sync-peers job tests (reference: manager+scheduler job layer)."""
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.jobs import JobManager, JobState, PreheatRequest
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+
+
+def seed_host(i):
+    return msg.HostInfo(
+        host_id=f"seed-{i}", hostname=f"seed-{i}", ip=f"10.1.0.{i}", host_type="super"
+    )
+
+
+def test_preheat_fans_out_by_hash_ring():
+    schedulers = {"s1": SchedulerService(), "s2": SchedulerService()}
+    jm = JobManager(schedulers, [seed_host(0), seed_host(1)])
+    urls = [f"https://reg.example.com/layers/{i}" for i in range(12)]
+    result = jm.create_preheat(PreheatRequest(urls=urls, tag="preheat"))
+    assert result.state == JobState.SUCCESS
+    assert len(result.task_ids) == 12
+    counts = jm.sync_peers()
+    total_tasks = sum(c["tasks"] for c in counts.values())
+    total_peers = sum(c["peers"] for c in counts.values())
+    assert total_tasks == 12
+    assert total_peers == 12  # one seed registration per task
+    # consistent hashing actually split the work
+    assert counts["s1"]["tasks"] > 0 and counts["s2"]["tasks"] > 0
+    # same urls preheat to the same schedulers (stable affinity)
+    jm2 = JobManager({"s1": SchedulerService(), "s2": SchedulerService()}, [seed_host(0)])
+    result2 = jm2.create_preheat(PreheatRequest(urls=urls, tag="preheat"))
+    assert result2.task_ids == result.task_ids
+
+
+def test_preheat_without_seeds_fails():
+    jm = JobManager({"s1": SchedulerService()}, [])
+    result = jm.create_preheat(PreheatRequest(urls=["https://e.com/x"]))
+    assert result.state == JobState.FAILURE
+    assert jm.get(result.job_id) is result

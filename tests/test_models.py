@@ -1,0 +1,82 @@
+"""Model unit tests: shapes, loss behavior, metrics (numeric tier of
+SURVEY.md §4's test strategy — fixed seeds, CPU backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models import GraphSAGERanker, ProbeRTTRegressor, metrics as M
+from dragonfly2_tpu.models.graphsage import listwise_rank_loss
+
+
+def test_mlp_forward_shape_and_dtype():
+    model = ProbeRTTRegressor(hidden_dim=16)
+    x = jnp.ones((5, 8))
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (5,)
+    assert out.dtype == jnp.float32
+
+
+def test_graphsage_forward_shape():
+    model = GraphSAGERanker(hidden_dim=16)
+    garrs = {
+        "node_feats": jnp.ones((10, 12)),
+        "edge_src": jnp.array([0, 1, 2], jnp.int32),
+        "edge_dst": jnp.array([3, 4, 5], jnp.int32),
+        "edge_feats": jnp.ones((3, 2)),
+    }
+    child = jnp.array([0, 1], jnp.int32)
+    parents = jnp.array([[2, 3, 4], [5, 6, 7]], jnp.int32)
+    pair = jnp.ones((2, 3, 2))
+    params = model.init(jax.random.key(0), garrs, child, parents, pair)
+    scores = model.apply(params, garrs, child, parents, pair)
+    assert scores.shape == (2, 3)
+    emb = model.apply(
+        params, garrs["node_feats"], garrs["edge_src"], garrs["edge_dst"],
+        garrs["edge_feats"], method="embed",
+    )
+    assert emb.shape[0] == 10
+    s2 = model.apply(params, emb[child], emb[parents], pair, method="score")
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(scores), rtol=1e-5)
+
+
+def test_listwise_loss_prefers_aligned_scores():
+    mask = jnp.ones((1, 4), bool)
+    tput = jnp.array([[1.0, 5.0, 2.0, 0.5]])
+    aligned = listwise_rank_loss(tput * 2, tput, mask)
+    anti = listwise_rank_loss(-tput, tput, mask)
+    assert float(aligned) < float(anti)
+
+
+def test_listwise_loss_ignores_masked_and_single_rows():
+    mask = jnp.array([[True, False, False, False]])
+    tput = jnp.array([[1.0, 99.0, 99.0, 99.0]])
+    loss = listwise_rank_loss(jnp.zeros((1, 4)), tput, mask)
+    assert float(loss) == 0.0  # <2 valid candidates -> row skipped
+
+
+def test_selection_stats_perfect_ranker():
+    tput = jnp.array([[1.0, 3.0, 2.0, 0.0], [5.0, 1.0, 4.0, 2.0]])
+    mask = jnp.ones((2, 4), bool)
+    stats = M.top1_selection_stats(tput, tput, mask)  # scores == throughput
+    assert float(stats["precision"]) == 1.0
+    assert 0 < float(stats["recall"]) <= 1.0
+    assert float(stats["f1"]) > 0
+
+
+def test_selection_stats_bad_ranker():
+    tput = jnp.array([[1.0, 3.0, 2.0, 0.0]])
+    mask = jnp.ones((1, 4), bool)
+    stats = M.top1_selection_stats(-tput, tput, mask)  # picks the worst
+    assert float(stats["precision"]) == 0.0
+
+
+def test_regression_metrics():
+    pred = jnp.array([1.0, 2.0, 3.0])
+    target = jnp.array([1.0, 2.0, 5.0])
+    assert float(M.mse(pred, target)) == pytest.approx(4.0 / 3)
+    assert float(M.mae(pred, target)) == pytest.approx(2.0 / 3)
+    mask = jnp.array([1.0, 1.0, 0.0])
+    assert float(M.mse(pred, target, mask)) == pytest.approx(0.0)

@@ -1,0 +1,75 @@
+"""ProbeStore tests (reference: scheduler/networktopology behaviors)."""
+
+import numpy as np
+
+import jax
+
+from dragonfly2_tpu.cluster.probes import ProbeStore
+
+
+def python_fold(samples, w=0.1):
+    avg = samples[0]
+    for s in samples[1:]:
+        avg = w * avg + (1 - w) * s
+    return avg
+
+
+def test_enqueue_and_average():
+    store = ProbeStore(max_pairs=16, max_hosts=8, queue_length=5)
+    history = []
+    for rtt in [10.0, 20.0, 30.0]:
+        history.append(rtt)
+        store.enqueue(np.array([0]), np.array([1]), np.array([rtt], np.float32))
+    got = store.average_rtt(0, 1)
+    assert got is not None
+    assert np.isclose(got, python_fold(history), rtol=1e-5)
+    assert store.average_rtt(1, 0) is None  # direction matters
+    assert store.average_rtt(0, 5) is None  # never probed
+
+
+def test_queue_bounded_drop_oldest():
+    store = ProbeStore(max_pairs=16, max_hosts=8, queue_length=3)
+    samples = [1.0, 2.0, 3.0, 4.0, 5.0]
+    for s in samples:
+        store.enqueue(np.array([2]), np.array([3]), np.array([s], np.float32))
+    assert np.isclose(store.average_rtt(2, 3), python_fold(samples[-3:]), rtol=1e-5)
+
+
+def test_gather_candidate_rtt_direction():
+    """Evaluator scores parent->child probes (evaluator_network_topology
+    .go:217: Probes(parent.ID, child.ID))."""
+    store = ProbeStore(max_pairs=16, max_hosts=8)
+    store.enqueue(np.array([4]), np.array([7]), np.array([5e6], np.float32))
+    child = np.array([7])
+    cands = np.array([[4, 5]])
+    avg, has = store.gather_candidate_rtt(child, cands)
+    assert has[0, 0] and not has[0, 1]
+    assert avg[0, 0] == np.float32(5e6)
+
+
+def test_probed_count_and_find():
+    store = ProbeStore(max_pairs=64, max_hosts=8)
+    # host 1 probed 3x, host 2 once
+    for _ in range(3):
+        store.enqueue(np.array([0]), np.array([1]), np.array([1e6], np.float32))
+    store.enqueue(np.array([0]), np.array([2]), np.array([1e6], np.float32))
+    alive = np.zeros(8, bool)
+    alive[[1, 2, 3]] = True
+    picked = store.find_probed_hosts(alive, jax.random.key(0), k=2)
+    assert set(picked.tolist()) == {2, 3}  # least-probed alive
+
+
+def test_snapshot_records():
+    store = ProbeStore(max_pairs=64, max_hosts=8)
+    store.enqueue(np.array([0, 0, 1]), np.array([1, 2, 2]), np.array([1e6, 2e6, 3e6], np.float32))
+    info = {
+        0: {"id": "h0", "hostname": "a", "ip": "10.0.0.0", "port": 1},
+        1: {"id": "h1", "hostname": "b", "ip": "10.0.0.1", "port": 1},
+        2: {"id": "h2", "hostname": "c", "ip": "10.0.0.2", "port": 1},
+    }
+    records = store.snapshot(info, now_ns=123)
+    assert {r.host.id for r in records} == {"h0", "h1"}
+    h0 = next(r for r in records if r.host.id == "h0")
+    assert {d.id for d in h0.dest_hosts} == {"h1", "h2"}
+    assert all(d.probes.average_rtt > 0 for d in h0.dest_hosts)
+    assert h0.created_at == 123

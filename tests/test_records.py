@@ -104,3 +104,17 @@ def test_host_trace_storage_concatenated_uploads(tmp_path):
 
     trainer_store.clear_downloads()
     assert trainer_store.list_downloads() == []
+
+
+def test_host_trace_storage_clear_host_scoped(tmp_path):
+    """Abort of one host's stream must not destroy other hosts' datasets."""
+    _, downloads, _ = _sample_records(n=3)
+    sched_store = TraceStorage(tmp_path / "s")
+    for r in downloads:
+        sched_store.create_download(r)
+    blob = sched_store.open_download()
+    store = HostTraceStorage(tmp_path / "t")
+    store.append_download_bytes("hostA", blob)
+    store.append_download_bytes("hostB", blob)
+    store.clear_host("hostA")
+    assert len(store.list_downloads()) == len(downloads)  # hostB intact

@@ -1,0 +1,135 @@
+"""Model registry + native serving tests (reference behaviors:
+manager CreateModel / activate flips / the ml evaluator wiring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models import ProbeRTTRegressor
+from dragonfly2_tpu.registry import MLEvaluator, ModelEvaluation, ModelRegistry, ModelServer
+from dragonfly2_tpu.registry.registry import MODEL_TYPE_MLP, STATE_ACTIVE, STATE_INACTIVE
+
+
+@pytest.fixture
+def mlp_setup():
+    model = ProbeRTTRegressor(hidden_dim=8)
+    x = jnp.ones((2, 8))
+    params = model.init(jax.random.key(0), x)
+    return model, params, x
+
+
+def test_create_and_versioning(tmp_path, mlp_setup):
+    _, params, _ = mlp_setup
+    reg = ModelRegistry(tmp_path)
+    v1 = reg.create_model_version(
+        "rtt-regressor", MODEL_TYPE_MLP, "sched-host", params,
+        ModelEvaluation(mse=0.5, mae=0.3),
+    )
+    v2 = reg.create_model_version(
+        "rtt-regressor", MODEL_TYPE_MLP, "sched-host", params, ModelEvaluation(mse=0.2),
+    )
+    assert (v1.version, v2.version) == (1, 2)
+    assert v1.model_id == v2.model_id
+    versions = reg.list_versions(v1.model_id)
+    assert [v.version for v in versions] == [1, 2]
+    assert all(v.state == STATE_INACTIVE for v in versions)
+    assert versions[0].evaluation.mse == 0.5
+    assert reg.active_version(v1.model_id) is None
+
+
+def test_activation_flips_exactly_one(tmp_path, mlp_setup):
+    _, params, _ = mlp_setup
+    reg = ModelRegistry(tmp_path)
+    mv = reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
+    reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
+    reg.activate(mv.model_id, 1)
+    states = {v.version: v.state for v in reg.list_versions(mv.model_id)}
+    assert states == {1: STATE_ACTIVE, 2: STATE_INACTIVE}
+    reg.activate(mv.model_id, 2)
+    states = {v.version: v.state for v in reg.list_versions(mv.model_id)}
+    assert states == {1: STATE_INACTIVE, 2: STATE_ACTIVE}
+    assert reg.active_version(mv.model_id).version == 2
+    with pytest.raises(ValueError):
+        reg.delete_version(mv.model_id, 2)  # active version protected
+    reg.delete_version(mv.model_id, 1)
+    assert [v.version for v in reg.list_versions(mv.model_id)] == [2]
+
+
+def test_load_params_roundtrip(tmp_path, mlp_setup):
+    model, params, x = mlp_setup
+    reg = ModelRegistry(tmp_path)
+    mv = reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
+    loaded = reg.load_params(mv.model_id, mv.version, template=params)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(loaded, x)), np.asarray(model.apply(params, x))
+    )
+
+
+def test_model_server_hot_swap(tmp_path, mlp_setup):
+    model, params, x = mlp_setup
+    reg = ModelRegistry(tmp_path)
+    server = ModelServer(reg, "m", "h", MODEL_TYPE_MLP, template_params=params, model=model)
+    assert not server.ready
+    assert not server.refresh()  # nothing registered yet
+
+    mv = reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
+    assert not server.refresh()  # created but not active
+    reg.activate(mv.model_id, 1)
+    assert server.refresh()
+    assert server.ready and server.version == 1
+    out1 = np.asarray(server.infer_mlp(x))
+
+    # publish v2 with perturbed params; activation flips serving
+    bumped = jax.tree_util.tree_map(lambda a: a + 1.0, params)
+    mv2 = reg.create_model_version("m", MODEL_TYPE_MLP, "h", bumped, ModelEvaluation())
+    reg.activate(mv2.model_id, 2)
+    assert server.refresh()
+    out2 = np.asarray(server.infer_mlp(x))
+    assert server.version == 2
+    assert not np.allclose(out1, out2)
+    assert not server.refresh()  # idempotent
+
+
+def test_ml_evaluator_fallback_and_served(tmp_path):
+    """MLEvaluator uses the rule blend until a GNN is active, then the model."""
+    from dragonfly2_tpu.models import GraphSAGERanker
+    from dragonfly2_tpu.records.features import CandidateFeatures
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_GNN
+    from dragonfly2_tpu.state.fsm import PeerState
+
+    b, k, h = 3, 4, 12
+    feats = CandidateFeatures.zeros(b, k)
+    feats.valid[:] = True
+    feats.peer_state[:] = int(PeerState.SUCCEEDED)
+    feats.upload_limit[:] = 10
+    feats.parent_host_id[:] = np.arange(1, b * k + 1).reshape(b, k)
+    feats.child_host_id[:] = 0
+
+    model = GraphSAGERanker()
+    garrs = {
+        "node_feats": np.random.default_rng(0).normal(size=(h, 12)).astype(np.float32),
+        "edge_src": np.array([0, 1], np.int32),
+        "edge_dst": np.array([2, 3], np.int32),
+        "edge_feats": np.ones((2, 2), np.float32),
+    }
+    child = np.zeros(b, np.int32)
+    cands = np.arange(b * k, dtype=np.int32).reshape(b, k) % h
+    pair = np.zeros((b, k, 2), np.float32)
+    params = model.init(jax.random.key(0), garrs, child, cands, pair)
+
+    reg = ModelRegistry(tmp_path)
+    server = ModelServer(reg, "ranker", "h", MODEL_TYPE_GNN, template_params=params)
+    evaluator = MLEvaluator(server)
+
+    out_fallback = evaluator.schedule(feats.as_dict(), child, cands)
+    assert np.asarray(out_fallback["selected_valid"]).any()
+
+    mv = reg.create_model_version("ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    reg.activate(mv.model_id, 1)
+    assert server.refresh()
+    evaluator.refresh_embeddings(garrs)
+    out_ml = evaluator.schedule(feats.as_dict(), child, cands)
+    assert np.asarray(out_ml["selected_valid"]).any()
+    # ml scores come from the net, not the rule blend
+    assert not np.allclose(np.asarray(out_ml["scores"]), np.asarray(out_fallback["scores"]))

@@ -1,0 +1,99 @@
+"""Training-loop tests: convergence on planted signal, DP sharding
+equivalence, checkpoint roundtrip (SURVEY.md §4 numeric tier)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.config.config import TrainerConfig
+from dragonfly2_tpu.models import GraphSAGERanker
+from dragonfly2_tpu.parallel import make_mesh
+from dragonfly2_tpu.records import synth
+from dragonfly2_tpu.records.features import (
+    downloads_to_ranking_dataset,
+    topology_to_pairs,
+)
+from dragonfly2_tpu.training import (
+    TrainCheckpointer,
+    embed_graph_sharded,
+    train_gnn,
+    train_mlp,
+)
+from dragonfly2_tpu.training.data import edge_bucket, graph_arrays
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return synth.make_cluster(80, seed=11)
+
+
+@pytest.fixture(scope="module")
+def mlp_data(cluster):
+    topos = synth.gen_network_topology_records(cluster, 300)
+    return topology_to_pairs(topos)
+
+
+@pytest.fixture(scope="module")
+def rank_data(cluster):
+    records = synth.gen_download_records(cluster, 200, num_tasks=16)
+    return downloads_to_ranking_dataset(records)
+
+
+def test_mlp_learns_rtt_structure(mlp_data):
+    x, y = mlp_data
+    cfg = TrainerConfig(epochs=8, batch_size=64, hidden_dim=32, learning_rate=3e-3)
+    res = train_mlp(x, y, cfg, seed=0)
+    assert res.losses[-1] < res.losses[0] * 0.5
+    # better than predicting the mean (variance baseline)
+    assert res.eval_metrics["mse"] < float(np.var(y)) * 0.7
+    assert res.samples_per_sec > 0
+
+
+def test_mlp_dp_sharded_matches_semantics(mlp_data):
+    x, y = mlp_data
+    cfg = TrainerConfig(epochs=2, batch_size=64, hidden_dim=32)
+    mesh = make_mesh(8)
+    res = train_mlp(x, y, cfg, mesh=mesh, seed=0)
+    assert np.isfinite(res.losses).all()
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_gnn_learns_to_rank(rank_data):
+    ds, graph = rank_data
+    cfg = TrainerConfig(epochs=6, batch_size=64, hidden_dim=32, learning_rate=3e-3)
+    res = train_gnn(ds, graph, cfg, seed=0)
+    assert res.losses[-1] < res.losses[0]
+    # top-1 picks should beat random (1/valid-candidates ~ 0.25 relevance rate)
+    assert res.eval_metrics["precision"] > 0.3
+
+
+def test_gnn_sharded_embed_matches_replicated(rank_data):
+    ds, graph = rank_data
+    cfg = TrainerConfig(epochs=1, batch_size=32, hidden_dim=32)
+    mesh = make_mesh(8, graph=2)
+    res = train_gnn(ds, graph, cfg, mesh=mesh, seed=0)
+    model = GraphSAGERanker(hidden_dim=32)
+    ga = graph_arrays(graph, pad_edges_to=edge_bucket(graph.edge_src.shape[0], 512))
+    ref = model.apply(
+        res.params, ga["node_feats"], ga["edge_src"], ga["edge_dst"], ga["edge_feats"],
+        method="embed",
+    )
+    sharded = embed_graph_sharded(model, res.params, ga, mesh)
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(sharded, np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_checkpoint_roundtrip(tmp_path, mlp_data):
+    x, y = mlp_data
+    cfg = TrainerConfig(epochs=1, batch_size=64, hidden_dim=16)
+    res = train_mlp(x, y, cfg, seed=0)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    state = {"params": res.params, "step": res.steps}
+    ckpt.save(res.steps, state)
+    assert ckpt.latest_step() == res.steps
+    restored = ckpt.restore(template=state)
+    leaves_a = [np.asarray(v) for v in __import__("jax").tree_util.tree_leaves(res.params)]
+    leaves_b = [np.asarray(v) for v in __import__("jax").tree_util.tree_leaves(restored["params"])]
+    for a, b in zip(leaves_a, leaves_b):
+        np.testing.assert_array_equal(a, b)
+    ckpt.close()
