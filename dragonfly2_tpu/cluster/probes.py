@@ -52,7 +52,7 @@ class ProbeStore:
         self.cursor = jnp.zeros(max_pairs, jnp.int32)
         self.count = jnp.zeros(max_pairs, jnp.int32)
         self.average = np.zeros(max_pairs, np.float32)  # host-readable mirror
-        self.probed_count = jnp.zeros(max_hosts, jnp.int64)
+        self.probed_count = jnp.zeros(max_hosts, jnp.int32)
         self._pair_index: dict[tuple[int, int], int] = {}
         self._pairs_by_src: dict[int, list[int]] = {}
         self._pair_dst: list[int] = []

@@ -8,13 +8,30 @@ the CPU backend with fixed seeds. No TPU needed in CI.
 
 import os
 
+# Env vars alone are not enough: in this image jax is pre-imported at
+# interpreter startup (a .pth hook) with JAX_PLATFORMS already resolved, so
+# the config must be updated through jax.config before first backend use.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    # Backend already initialized (a plugin touched jax before conftest) —
+    # the env vars above were then read at init and did the same job.
+    pass
+
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end tests")
 
 
 @pytest.fixture
