@@ -1,0 +1,9 @@
+from dragonfly2_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    serve_metrics,
+)
+from dragonfly2_tpu.telemetry.tracing import Span, Tracer, default_tracer  # noqa: F401

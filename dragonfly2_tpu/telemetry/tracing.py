@@ -1,0 +1,123 @@
+"""Span tracing at service boundaries.
+
+Capability parity with the reference's OpenTelemetry usage: every binary
+initializes a tracer with an exporter (cmd/dependency/dependency.go:263-280
+jaeger flag) and services create spans at boundaries (scheduler service,
+client conductor/piece_downloader, manager jobs). This implementation is
+OTel-shaped (trace_id/span_id/parent, attributes, events, status) with
+pluggable exporters: in-memory (tests), JSONL file, or a user callable —
+zero required external infrastructure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import json
+import pathlib
+import secrets
+import threading
+import time
+from typing import Any, Callable
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "dragonfly2_tpu_span", default=None
+)
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start_ns: int
+    end_ns: int | None = None
+    attributes: dict[str, Any] = dataclasses.field(default_factory=dict)
+    events: list[dict] = dataclasses.field(default_factory=list)
+    status: str = "OK"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name, "ts_ns": time.time_ns(), **attrs})
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.status = "ERROR"
+        self.add_event("exception", type=type(exc).__name__, message=str(exc))
+
+    def duration_ms(self) -> float | None:
+        if self.end_ns is None:
+            return None
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Tracer:
+    def __init__(self, service: str = "dragonfly2-tpu"):
+        self.service = service
+        self._exporters: list[Callable[[Span], None]] = []
+        self._lock = threading.Lock()
+
+    def add_exporter(self, fn: Callable[[Span], None]) -> None:
+        self._exporters.append(fn)
+
+    def export_to_memory(self) -> list[Span]:
+        """Attach an in-memory exporter; returns the live list of spans."""
+        spans: list[Span] = []
+        self.add_exporter(spans.append)
+        return spans
+
+    def export_to_file(self, path: str | pathlib.Path) -> None:
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        lock = threading.Lock()
+
+        def write(span: Span) -> None:
+            with lock, open(path, "a") as f:
+                f.write(json.dumps(span.to_dict()) + "\n")
+
+        self.add_exporter(write)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        parent = _current_span.get()
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            parent_id=parent.span_id if parent else None,
+            start_ns=time.time_ns(),
+            attributes={"service": self.service, **attributes},
+        )
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException as e:
+            span.record_exception(e)
+            raise
+        finally:
+            span.end_ns = time.time_ns()
+            _current_span.reset(token)
+            with self._lock:
+                exporters = list(self._exporters)
+            for fn in exporters:
+                try:
+                    fn(span)
+                except Exception:  # noqa: BLE001 - exporters must not break the traced path
+                    pass
+
+
+def current_span() -> Span | None:
+    return _current_span.get()
+
+
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _DEFAULT

@@ -1,0 +1,48 @@
+"""Exponential-backoff retry loop.
+
+Capability parity with pkg/retry/retry.go `Run(ctx, initBackoff,
+maxBackoff, maxAttempts, f)`: f returns (result, cancel, err); cancel=True
+aborts the loop immediately (non-retryable), otherwise failures back off
+exponentially up to maxBackoff for maxAttempts tries.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class Cancel(Exception):
+    """Raise inside the retried callable to abort without further attempts."""
+
+    def __init__(self, cause: Exception | None = None):
+        super().__init__(str(cause) if cause else "cancelled")
+        self.cause = cause
+
+
+def run(
+    fn: Callable[[], T],
+    init_backoff: float = 0.2,
+    max_backoff: float = 5.0,
+    max_attempts: int = 3,
+    sleep: Callable[[float], Any] = time.sleep,
+) -> T:
+    """Call fn until it succeeds, backing off exponentially between
+    failures. Raises the last error after max_attempts, or the Cancel cause
+    immediately."""
+    delay = init_backoff
+    last: Exception | None = None
+    for attempt in range(max_attempts):
+        try:
+            return fn()
+        except Cancel as c:
+            raise (c.cause or c)
+        except Exception as e:  # noqa: BLE001 - retry treats any error as retryable
+            last = e
+            if attempt + 1 < max_attempts:
+                sleep(min(delay, max_backoff))
+                delay *= 2
+    assert last is not None
+    raise last

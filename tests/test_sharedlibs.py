@@ -1,0 +1,268 @@
+"""Shared-libraries layer: cache, gc, retry, containers, errors, dynconfig,
+plugins, dfpath, dflog — the pkg/ + internal/ equivalents (SURVEY.md §2.5)."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils import dferrors, dfpath, plugins, retry
+from dragonfly2_tpu.utils.cache import Cache, CacheKeyExists
+from dragonfly2_tpu.utils.container import Bitset, RingBuffer, SafeSet
+from dragonfly2_tpu.utils.dynconfig import Dynconfig
+from dragonfly2_tpu.utils.gc import GC, Task
+
+# ------------------------------------------------------------------- cache
+
+
+def test_cache_set_get_expire():
+    c = Cache(default_expiration=0.05)
+    c.set("a", 1)
+    c.set("b", 2, ttl=10.0)
+    c.set_default("forever", 3)  # default 0.05s
+    c.set("never", 4, ttl=0)  # no expiration
+    assert c.get("a") == 1
+    time.sleep(0.08)
+    assert c.get("a") is None
+    assert c.get("b") == 2
+    assert c.get("never") == 4
+
+
+def test_cache_add_and_scan_and_keys():
+    c = Cache()
+    c.add("networktopology:h1:h2", 1)
+    with pytest.raises(CacheKeyExists):
+        c.add("networktopology:h1:h2", 2)
+    c.set("networktopology:h1:h3", 2)
+    c.set("probes:h1:h2", 3)
+    assert sorted(c.scan("networktopology:")) == [
+        "networktopology:h1:h2",
+        "networktopology:h1:h3",
+    ]
+    assert c.scan("networktopology:", limit=1) == ["networktopology:h1:h2"] or len(
+        c.scan("networktopology:", limit=1)
+    ) == 1
+    assert c.scan("probes:", limit=0) == []
+    assert c.item_count() == 3
+
+
+def test_cache_evicted_callback_and_janitor():
+    c = Cache(default_expiration=0.03, cleanup_interval=0.02)
+    evicted = []
+    c.on_evicted(lambda k, v: evicted.append((k, v)))
+    c.set("x", 42)
+    time.sleep(0.12)
+    assert ("x", 42) in evicted
+    c.close()
+
+
+def test_cache_save_load(tmp_path):
+    c = Cache()
+    c.set("k", {"nested": [1, 2]}, ttl=100)
+    c.set("gone", 1, ttl=0.01)
+    time.sleep(0.03)
+    p = tmp_path / "cache.bin"
+    c.save_file(str(p))
+    c2 = Cache()
+    c2.load_file(str(p))
+    assert c2.get("k") == {"nested": [1, 2]}
+    assert c2.get("gone") is None
+
+
+# --------------------------------------------------------------------- gc
+
+
+def test_gc_run_and_validation():
+    runs = []
+    g = GC()
+    g.add(Task(id="t", interval=10.0, timeout=5.0, runner=lambda: runs.append(1)))
+    with pytest.raises(ValueError):
+        g.add(Task(id="t", interval=10.0, timeout=5.0, runner=lambda: None))
+    with pytest.raises(ValueError):
+        g.add(Task(id="bad", interval=1.0, timeout=2.0, runner=lambda: None))
+    g.run("t")
+    g.run_all()
+    assert len(runs) == 2
+    with pytest.raises(KeyError):
+        g.run("missing")
+
+
+def test_gc_periodic_and_restart():
+    done = threading.Event()
+    g = GC()
+    g.add(Task(id="tick", interval=0.02, timeout=0.02, runner=done.set))
+    g.start()
+    assert done.wait(1.0)
+    g.stop()
+    # restart after stop: loops must run again, and tasks added after a
+    # stop must actually tick
+    done.clear()
+    late = threading.Event()
+    g.add(Task(id="late", interval=0.02, timeout=0.02, runner=late.set))
+    g.start()
+    assert done.wait(1.0) and late.wait(1.0)
+    g.stop()
+
+
+# ------------------------------------------------------------------- retry
+
+
+def test_retry_succeeds_after_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry.run(flaky, init_backoff=0.001, max_attempts=5) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_exhausts_and_cancel():
+    with pytest.raises(OSError):
+        retry.run(lambda: (_ for _ in ()).throw(OSError("always")), init_backoff=0.001, max_attempts=2)
+
+    def cancelled():
+        raise retry.Cancel(ValueError("fatal"))
+
+    with pytest.raises(ValueError, match="fatal"):
+        retry.run(cancelled, init_backoff=0.001, max_attempts=5)
+
+
+# -------------------------------------------------------------- containers
+
+
+def test_safe_set():
+    s = SafeSet([1, 2])
+    assert s.add(3)
+    assert not s.add(3)
+    assert s.contains(1, 2, 3)
+    s.delete(2)
+    assert not s.contains(2)
+    assert len(s) == 2
+
+
+def test_bitset_finished_pieces():
+    b = Bitset()
+    for piece in (0, 63, 64, 1000):
+        b.set(piece)
+    assert b.test(63) and b.test(1000)
+    assert not b.test(62) and not b.test(5000)
+    assert b.count() == 4
+    b.clear(63)
+    assert not b.test(63)
+    # round-trip through raw words (the device-array lift)
+    b2 = Bitset()
+    b2.set_words(b.words())
+    assert b2.test(64) and b2.count() == 3
+
+
+def test_ring_buffer_drop_oldest():
+    r = RingBuffer(3)
+    assert r.push(1) is None
+    r.push(2)
+    r.push(3)
+    assert r.push(4) == 1  # evicts oldest, probe-queue semantics
+    assert r.items() == [2, 3, 4]
+    assert r.peek_oldest() == 2 and r.peek_newest() == 4
+
+
+# ------------------------------------------------------------------ errors
+
+
+def test_dferrors_wire_roundtrip():
+    e = dferrors.NotFound("peer x missing")
+    wire = e.to_wire()
+    back = dferrors.DFError.from_wire(wire)
+    assert isinstance(back, dferrors.NotFound)
+    assert back.message == "peer x missing"
+    # unknown code degrades to INTERNAL rather than crashing the handler
+    odd = dferrors.DFError.from_wire({"code": "SomethingNew", "message": "m"})
+    assert odd.code == dferrors.Code.INTERNAL
+    # str() reflects the overridden code
+    assert str(dferrors.DFError("", code=dferrors.Code.NOT_FOUND)) == "NotFound"
+
+
+# ---------------------------------------------------------------- dynconfig
+
+
+def test_dynconfig_poll_cache_fallback(tmp_path):
+    calls = {"n": 0, "fail": False}
+
+    def client():
+        calls["n"] += 1
+        if calls["fail"]:
+            raise ConnectionError("manager down")
+        return {"schedulers": ["s1"], "v": calls["n"]}
+
+    seen = []
+    dc = Dynconfig(client, tmp_path / "dynconfig.json", expire=100.0)
+    dc.register(seen.append)
+    assert dc.get()["schedulers"] == ["s1"]
+    assert dc.get()["v"] == 1  # cached, no second fetch
+    assert calls["n"] == 1
+    assert seen and seen[0]["v"] == 1
+
+    calls["fail"] = True
+    assert dc.refresh()["v"] == 1  # disk fallback serves the last snapshot
+
+    # a fresh instance with a dead source still comes up from disk, and its
+    # observers hear about the fallback config too
+    dc2 = Dynconfig(client, tmp_path / "dynconfig.json", expire=100.0)
+    seen2 = []
+    dc2.register(seen2.append)
+    assert dc2.get()["v"] == 1
+    assert seen2 and seen2[0]["v"] == 1
+
+
+def test_dynconfig_no_cache_raises(tmp_path):
+    def dead():
+        raise ConnectionError("down")
+
+    dc = Dynconfig(dead, tmp_path / "none.json", expire=1.0)
+    with pytest.raises(dferrors.Unavailable):
+        dc.get()
+
+
+# ------------------------------------------------------------------ plugins
+
+
+def test_plugin_load(tmp_path):
+    (tmp_path / "df_evaluator_plugin_custom.py").write_text(
+        "def dragonfly_plugin_init(options):\n"
+        "    return {'name': 'custom', 'opts': options}\n"
+    )
+    p = plugins.load(tmp_path, "evaluator", "custom", {"w": 2})
+    assert p == {"name": "custom", "opts": {"w": 2}}
+    import sys
+
+    assert "df_evaluator_plugin_custom" in sys.modules  # picklable classes
+    with pytest.raises(FileNotFoundError):
+        plugins.load(tmp_path, "searcher", "missing")
+    with pytest.raises(ValueError):
+        plugins.load(tmp_path, "nonsense-type", "x")
+
+
+# ---------------------------------------------------------- dfpath + dflog
+
+
+def test_dfpath_layout(tmp_path, monkeypatch):
+    monkeypatch.setenv("DRAGONFLY_TPU_HOME", str(tmp_path))
+    paths = dfpath.new_paths("scheduler")
+    assert paths.work_home == tmp_path / "scheduler"
+    for p in (paths.cache_dir, paths.log_dir, paths.data_dir, paths.plugin_dir):
+        assert p.is_dir()
+    assert paths.lock_file("daemon").name == "daemon.lock"
+
+
+def test_dflog_scoped(tmp_path, caplog):
+    from dragonfly2_tpu.utils import dflog
+
+    dflog.init_logging(tmp_path, console=False)
+    log = dflog.with_scope(dflog.get("core"), task_id="t1", peer_id="p1")
+    with caplog.at_level(logging.INFO, logger="dragonfly2_tpu.core"):
+        log.info("hello")
+    assert "[task_id=t1 peer_id=p1] hello" in caplog.text

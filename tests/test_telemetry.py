@@ -1,0 +1,113 @@
+"""Telemetry: metrics exposition + span tracing (SURVEY.md §5)."""
+
+import json
+import urllib.request
+
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry import tracing
+
+
+def test_counter_gauge_histogram_expose():
+    reg = m.Registry()
+    c = reg.counter(
+        "dragonfly_scheduler_announce_peer_total", "announce totals", ("priority",)
+    )
+    c.labels("LEVEL0").inc()
+    c.labels("LEVEL0").inc(2)
+    g = reg.gauge("dragonfly_scheduler_concurrent_schedule", "gauge")
+    g.set(7)
+    g.inc()
+    h = reg.histogram(
+        "dragonfly_scheduler_download_duration_seconds", buckets=(0.1, 1.0, 10.0)
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100.0)
+
+    text = reg.expose()
+    assert 'dragonfly_scheduler_announce_peer_total{priority="LEVEL0"} 3.0' in text
+    assert "dragonfly_scheduler_concurrent_schedule 8.0" in text
+    assert 'le="+Inf"} 3' in text
+    assert "download_duration_seconds_count 3" in text
+    assert "# TYPE dragonfly_scheduler_download_duration_seconds histogram" in text
+    assert c.value("LEVEL0") == 3.0
+
+
+def test_registry_dedup_and_timer():
+    reg = m.Registry()
+    a = reg.counter("x_total")
+    b = reg.counter("x_total")
+    assert a is b
+    h = reg.histogram("t_seconds", buckets=(10.0,))
+    with m.Timer(h.labels()):
+        pass
+    assert "t_seconds_count 1" in reg.expose()
+
+
+def test_registry_rejects_type_and_label_conflicts():
+    import pytest
+
+    reg = m.Registry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("a",))
+
+
+def test_labeled_gauge_dec_and_label_escaping():
+    reg = m.Registry()
+    g = reg.gauge("concurrent", labels=("host",))
+    g.labels("h1").inc(3)
+    g.labels("h1").dec()
+    assert g.value("h1") == 2.0
+    c = reg.counter("nl", labels=("v",))
+    c.labels("line1\nline2").inc()
+    text = reg.expose()
+    assert 'nl{v="line1\\nline2"} 1.0' in text
+
+
+def test_metrics_http_server():
+    reg = m.Registry()
+    reg.counter("served_total").inc()
+    server = m.serve_metrics(reg, port=0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "served_total 1.0" in body
+    finally:
+        server.shutdown()
+
+
+def test_tracing_nesting_and_export(tmp_path):
+    tracer = tracing.Tracer("scheduler")
+    spans = tracer.export_to_memory()
+    path = tmp_path / "spans.jsonl"
+    tracer.export_to_file(path)
+
+    with tracer.span("announce_peer", peer_id="p1") as outer:
+        with tracer.span("schedule_tick") as inner:
+            inner.add_event("batched", size=32)
+        assert tracing.current_span() is outer
+    assert tracing.current_span() is None
+
+    assert [s.name for s in spans] == ["schedule_tick", "announce_peer"]
+    child, parent = spans
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.span_id
+    assert parent.attributes["peer_id"] == "p1"
+    assert parent.duration_ms() is not None
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2 and lines[1]["name"] == "announce_peer"
+
+
+def test_tracing_error_status():
+    tracer = tracing.Tracer()
+    spans = tracer.export_to_memory()
+    try:
+        with tracer.span("failing"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert spans[0].status == "ERROR"
+    assert spans[0].events[0]["type"] == "RuntimeError"
