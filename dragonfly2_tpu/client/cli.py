@@ -1,0 +1,154 @@
+"""CLI entry points: dfget / dfcache / dfstore equivalents.
+
+Capability parity with client/dfget (single-URL P2P download with
+back-source fallback, dfget.go:47-141), client/dfcache (stat/import/
+export/delete of cached tasks, dfcache.go) and client/dfstore's
+GetObject/PutObject surface (dfstore.go) re-pointed at local task storage
+(the object-storage daemon API is served by manager-lite; this CLI covers
+the file-path surface). One binary, subcommands — `python -m
+dragonfly2_tpu.client.cli <cmd>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import pathlib
+import sys
+
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata
+from dragonfly2_tpu.utils import idgen
+from dragonfly2_tpu.utils.digest import md5_from_bytes, sha256_from_reader
+
+
+def _parse_scheduler(value: str) -> tuple[str, int]:
+    host, _, port = value.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def _dfget(args) -> int:
+    daemon = Daemon(
+        data_dir=args.data_dir,
+        scheduler_addresses=[_parse_scheduler(s) for s in args.scheduler],
+        ip=args.ip,
+    )
+    await daemon.start()
+    try:
+        ts = await daemon.download(
+            args.url,
+            tag=args.tag,
+            application=args.application,
+            piece_length=args.piece_length,
+            back_source_allowed=not args.no_back_source,
+        )
+        await daemon.export_file(ts, args.output)
+        print(f"downloaded {ts.meta.content_length} bytes -> {args.output}")
+        return 0
+    finally:
+        await daemon.stop()
+
+
+def _dfcache(args) -> int:
+    storage = StorageManager(args.data_dir)
+    if args.action == "stat":
+        ts = storage.get(args.task_id)
+        if ts is None:
+            print("not found", file=sys.stderr)
+            return 1
+        print(
+            f"task {ts.meta.task_id}: done={ts.meta.done} "
+            f"pieces={ts.meta.finished_count()}/{ts.meta.total_pieces} "
+            f"bytes={ts.meta.content_length}"
+        )
+        return 0
+    if args.action == "delete":
+        return 0 if storage.delete_task(args.task_id) else 1
+    if args.action == "export":
+        ts = storage.find_completed_task(args.task_id)
+        if ts is None:
+            print("task not completed locally", file=sys.stderr)
+            return 1
+        pathlib.Path(args.output).write_bytes(ts.data_path.read_bytes())
+        return 0
+    if args.action == "import":
+        data = pathlib.Path(args.path).read_bytes()
+        task_id = args.task_id or idgen.task_id_v1(f"file://{pathlib.Path(args.path).resolve()}")
+        ts = storage.register_task(TaskMetadata(task_id=task_id, peer_id="import"))
+        piece_length = ts.meta.piece_length
+        for n in range(0, max((len(data) + piece_length - 1) // piece_length, 1)):
+            chunk = data[n * piece_length : (n + 1) * piece_length]
+            ts.write_piece(n, n * piece_length, chunk, digest=md5_from_bytes(chunk))
+        ts.mark_done(len(data), max((len(data) + piece_length - 1) // piece_length, 1))
+        print(task_id)
+        return 0
+    raise AssertionError(args.action)
+
+
+def _dfstore(args) -> int:
+    storage = StorageManager(args.data_dir)
+    if args.action == "get":
+        ts = storage.find_completed_task(args.task_id)
+        if ts is None:
+            print("not found", file=sys.stderr)
+            return 1
+        sys.stdout.buffer.write(ts.data_path.read_bytes())
+        return 0
+    if args.action == "put":
+        ns = argparse.Namespace(
+            action="import", data_dir=args.data_dir, path=args.path, task_id=args.task_id
+        )
+        return _dfcache(ns)
+    if args.action == "sum":
+        ts = storage.get(args.task_id)
+        if ts is None:
+            return 1
+        with open(ts.data_path, "rb") as f:
+            print(sha256_from_reader(f))
+        return 0
+    raise AssertionError(args.action)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="dragonfly2-tpu-client")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    get = sub.add_parser("dfget", help="download a URL through the P2P mesh")
+    get.add_argument("url")
+    get.add_argument("-o", "--output", required=True)
+    get.add_argument("--scheduler", action="append", required=True, help="host:port")
+    get.add_argument("--data-dir", default=".dfget-data")
+    get.add_argument("--ip", default="127.0.0.1")
+    get.add_argument("--tag", default="")
+    get.add_argument("--application", default="")
+    get.add_argument("--piece-length", type=int, default=4 << 20)
+    get.add_argument("--no-back-source", action="store_true")
+
+    cache = sub.add_parser("dfcache", help="local task cache ops")
+    cache.add_argument("action", choices=("stat", "import", "export", "delete"))
+    cache.add_argument("--data-dir", default=".dfget-data")
+    cache.add_argument("--task-id", default="")
+    cache.add_argument("--path", default="")
+    cache.add_argument("-o", "--output", default="")
+
+    store = sub.add_parser("dfstore", help="object-ish get/put over task storage")
+    store.add_argument("action", choices=("get", "put", "sum"))
+    store.add_argument("--data-dir", default=".dfget-data")
+    store.add_argument("--task-id", default="")
+    store.add_argument("--path", default="")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "dfget":
+        return asyncio.run(_dfget(args))
+    if args.cmd == "dfcache":
+        return _dfcache(args)
+    if args.cmd == "dfstore":
+        return _dfstore(args)
+    raise AssertionError(args.cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,318 @@
+"""Peer-task conductor: the download hot loop.
+
+Capability parity with client/daemon/peer/peertask_conductor.go — register
+with the scheduler (:249), receive candidate parents (:659
+receivePeerPacket), learn what each parent holds (the piece-task
+synchronizer, peertask_piecetask_synchronizer.go — here the parent's
+/pieces JSON), dispatch piece fetches across N workers (:1010
+downloadPieceWorker), report piece results on the announce stream
+(:1211 ReportPieceResult), fall back to source when the scheduler says so
+or parents run dry (backSource paths), finish with
+DownloadPeerFinished. Blocking piece IO runs in a thread pool under the
+asyncio control loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import urllib.request
+
+from dragonfly2_tpu.client.dispatcher import PieceDispatcher, TrafficShaper
+from dragonfly2_tpu.client.piece_manager import PieceManager
+from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata, TaskStorage
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.rpc.client import SchedulerConnection
+from dragonfly2_tpu.utils import dferrors
+
+logger = logging.getLogger(__name__)
+
+
+class PeerTaskConductor:
+    def __init__(
+        self,
+        conn: SchedulerConnection,
+        storage: StorageManager,
+        host: msg.HostInfo,
+        peer_id: str,
+        task_id: str,
+        url: str,
+        piece_length: int = 4 << 20,
+        workers: int = 4,
+        schedule_timeout: float = 10.0,
+        shaper: TrafficShaper | None = None,
+        back_source_allowed: bool = True,
+    ):
+        self.conn = conn
+        self.storage = storage
+        self.host = host
+        self.peer_id = peer_id
+        self.task_id = task_id
+        self.url = url
+        self.piece_length = piece_length
+        self.workers = workers
+        self.schedule_timeout = schedule_timeout
+        self.shaper = shaper
+        self.back_source_allowed = back_source_allowed
+        self.piece_manager = PieceManager()
+        self.dispatcher = PieceDispatcher()
+        self._parents: dict[str, msg.CandidateParent] = {}
+        self._parent_pieces: dict[str, dict] = {}  # parent peer_id -> /pieces doc
+        self._needed: set[int] = set()
+        self._inflight: set[int] = set()
+        self._failed_parents: set[str] = set()
+        self._done = asyncio.Event()
+        self._error: Exception | None = None
+
+    # ---------------------------------------------------------------- run
+
+    async def run(self) -> TaskStorage:
+        """Drive the task to completion; returns the local TaskStorage."""
+        ts = self.storage.register_task(
+            TaskMetadata(
+                task_id=self.task_id,
+                peer_id=self.peer_id,
+                url=self.url,
+                piece_length=self.piece_length,
+            )
+        )
+        if ts.meta.done:
+            return ts  # local reuse, no network (taskManager dedup)
+        queue = self.conn.subscribe(self.peer_id)
+        try:
+            content_length = self._probe_content_length()
+            await self.conn.send(
+                msg.RegisterPeerRequest(
+                    peer_id=self.peer_id,
+                    task_id=self.task_id,
+                    host=self.host,
+                    url=self.url,
+                    content_length=content_length,
+                    piece_length=self.piece_length,
+                )
+            )
+            if self.shaper is not None:
+                self.shaper.register_task(self.task_id)
+            await self._drive(ts, queue)
+            if self._error is not None:
+                raise self._error
+            return ts
+        finally:
+            if self.shaper is not None:
+                self.shaper.unregister_task(self.task_id)
+            self.conn.unsubscribe(self.peer_id)
+
+    def _probe_content_length(self) -> int:
+        from dragonfly2_tpu.client import source as source_pkg
+
+        try:
+            return source_pkg.content_length(self.url)
+        except dferrors.DFError:
+            return -1
+
+    async def _drive(self, ts: TaskStorage, queue: asyncio.Queue) -> None:
+        while not self._done.is_set():
+            try:
+                response = await asyncio.wait_for(queue.get(), self.schedule_timeout)
+            except asyncio.TimeoutError:
+                if self.back_source_allowed:
+                    logger.warning("%s: schedule timeout, back-to-source", self.peer_id)
+                    await self._back_to_source(ts)
+                    return
+                self._error = dferrors.DeadlineExceeded(
+                    f"{self.peer_id}: no schedule response in {self.schedule_timeout}s"
+                )
+                return
+            if isinstance(response, msg.EmptyTaskResponse):
+                ts.mark_done(0, 0)
+                await self._finish(ts)
+                return
+            if isinstance(response, msg.NeedBackToSourceResponse):
+                await self._back_to_source(ts)
+                return
+            if isinstance(response, msg.ScheduleFailure):
+                if self.back_source_allowed:
+                    await self._back_to_source(ts)
+                    return
+                self._error = dferrors.FailedPrecondition(
+                    f"schedule failed: {response.code} {response.description}"
+                )
+                return
+            if isinstance(response, msg.NormalTaskResponse):
+                done = await self._download_from_parents(ts, response.candidate_parents)
+                if done:
+                    await self._finish(ts)
+                    return
+                # parents exhausted: ask for different ones
+                await self.conn.send(
+                    msg.RescheduleRequest(
+                        peer_id=self.peer_id,
+                        candidate_parent_ids=sorted(self._failed_parents),
+                        description="parents exhausted",
+                    )
+                )
+
+    # ------------------------------------------------------------- parents
+
+    async def _download_from_parents(
+        self, ts: TaskStorage, parents: list[msg.CandidateParent]
+    ) -> bool:
+        """Pull every needed piece from the given parents; True if the task
+        completed."""
+        for parent in parents:
+            self._parents[parent.peer_id] = parent
+        live = [p for p in parents if p.peer_id not in self._failed_parents]
+        if not live:
+            return False
+        # sync piece inventories (the synchronizer step)
+        docs = await asyncio.gather(
+            *(asyncio.to_thread(self._fetch_piece_doc, p) for p in live)
+        )
+        total_pieces = ts.meta.total_pieces
+        content_length = ts.meta.content_length
+        for parent, doc in zip(live, docs):
+            if doc is None:
+                self._failed_parents.add(parent.peer_id)
+                continue
+            self._parent_pieces[parent.peer_id] = doc
+            if doc.get("done") and doc.get("total_pieces", -1) >= 0:
+                total_pieces = doc["total_pieces"]
+                content_length = doc["content_length"]
+        if total_pieces is None or total_pieces < 0:
+            return False
+        have = set(ts.finished_pieces())
+        self._needed = set(range(total_pieces)) - have
+        if not self._needed:
+            ts.mark_done(content_length, total_pieces)
+            return True
+
+        # queue (piece, parent) jobs for every needed piece a parent holds
+        for parent_id, doc in self._parent_pieces.items():
+            if parent_id in self._failed_parents:
+                continue
+            available = {p["number"] for p in doc.get("pieces", [])}
+            for number in self._needed & available:
+                self.dispatcher.put(number, parent_id)
+
+        workers = [
+            asyncio.create_task(self._piece_worker(ts)) for _ in range(self.workers)
+        ]
+        await asyncio.gather(*workers)
+        if not self._needed:
+            ts.mark_done(content_length, total_pieces)
+            return True
+        return False
+
+    def _fetch_piece_doc(self, parent: msg.CandidateParent) -> dict | None:
+        url = f"http://{parent.ip}:{parent.download_port}/pieces/{self.task_id}"
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                return json.load(resp)
+        except Exception:  # noqa: BLE001 - any failure marks the parent bad
+            return None
+
+    async def _piece_worker(self, ts: TaskStorage) -> None:
+        """downloadPieceWorker: pop jobs until the queue drains."""
+        while True:
+            job = self.dispatcher.get()
+            if job is None:
+                return
+            number, parent_id = job
+            if number not in self._needed or number in self._inflight:
+                continue
+            parent = self._parents.get(parent_id)
+            if parent is None or parent_id in self._failed_parents:
+                continue
+            doc = self._parent_pieces.get(parent_id, {})
+            piece_meta = next(
+                (p for p in doc.get("pieces", []) if p["number"] == number), None
+            )
+            if piece_meta is None:
+                continue
+            self._inflight.add(number)
+            if self.shaper is not None:
+                await asyncio.to_thread(
+                    self.shaper.acquire, self.task_id, piece_meta["length"]
+                )
+            t0 = time.perf_counter_ns()
+            try:
+                nbytes = await asyncio.to_thread(
+                    self.piece_manager.download_piece_from_parent,
+                    ts, parent.ip, parent.download_port, number, piece_meta["offset"],
+                )
+            except dferrors.DFError as e:
+                self._inflight.discard(number)
+                self._failed_parents.add(parent_id)
+                logger.info("piece %d from %s failed: %s", number, parent_id, e)
+                await self.conn.send(
+                    msg.DownloadPieceFailedRequest(
+                        peer_id=self.peer_id, parent_peer_id=parent_id
+                    )
+                )
+                continue
+            cost = time.perf_counter_ns() - t0
+            self._inflight.discard(number)
+            self._needed.discard(number)
+            self.dispatcher.report_cost(parent_id, cost)
+            if self.shaper is not None:
+                self.shaper.record(self.task_id, nbytes)
+            await self.conn.send(
+                msg.DownloadPieceFinishedRequest(
+                    peer_id=self.peer_id,
+                    piece_number=number,
+                    length=nbytes,
+                    cost_ns=cost,
+                    parent_peer_id=parent_id,
+                )
+            )
+
+    # ------------------------------------------------------------- source
+
+    async def _back_to_source(self, ts: TaskStorage) -> None:
+        await self.conn.send(
+            msg.DownloadPeerBackToSourceStartedRequest(peer_id=self.peer_id)
+        )
+        loop = asyncio.get_running_loop()
+
+        def on_piece(number: int, length: int, cost_ns: int) -> None:
+            asyncio.run_coroutine_threadsafe(
+                self.conn.send(
+                    msg.DownloadPieceFinishedRequest(
+                        peer_id=self.peer_id, piece_number=number,
+                        length=length, cost_ns=cost_ns,
+                    )
+                ),
+                loop,
+            ).result()
+
+        try:
+            content_length, pieces = await asyncio.to_thread(
+                self.piece_manager.download_source, ts, self.url, None, on_piece
+            )
+        except dferrors.DFError as e:
+            self._error = e
+            await self.conn.send(
+                msg.DownloadPeerBackToSourceFailedRequest(
+                    peer_id=self.peer_id, description=str(e)
+                )
+            )
+            self._done.set()
+            return
+        await self.conn.send(
+            msg.DownloadPeerBackToSourceFinishedRequest(
+                peer_id=self.peer_id, content_length=content_length, piece_count=pieces
+            )
+        )
+        self._done.set()
+
+    async def _finish(self, ts: TaskStorage) -> None:
+        await self.conn.send(
+            msg.DownloadPeerFinishedRequest(
+                peer_id=self.peer_id,
+                content_length=ts.meta.content_length,
+                piece_count=ts.meta.total_pieces,
+            )
+        )
+        self._done.set()

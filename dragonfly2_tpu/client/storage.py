@@ -1,0 +1,254 @@
+"""On-disk piece store for the daemon data plane.
+
+Capability parity with client/daemon/storage (storage_manager.go:52-129
+ifaces, local_storage.go): per-task data file + metadata sidecar, piece
+writes at offsets with per-piece digests, FinishedPieces tracking,
+reuse lookup by task id (RegisterTask dedup / FindCompletedTask),
+partial-completion resume (FindPartialCompletedTask :545), TTL +
+disk-usage GC, and persistent-task reload on restart (ReloadPersistentTask
+:674). Single 'simple'-style strategy: one contiguous data file per task.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import threading
+import time
+
+from dragonfly2_tpu.utils import dferrors
+from dragonfly2_tpu.utils.container import Bitset
+from dragonfly2_tpu.utils.digest import md5_from_bytes
+
+
+@dataclasses.dataclass
+class PieceMetadata:
+    number: int
+    offset: int
+    length: int
+    digest: str = ""
+    cost_ns: int = 0
+
+
+@dataclasses.dataclass
+class TaskMetadata:
+    task_id: str
+    peer_id: str
+    url: str = ""
+    content_length: int = -1
+    piece_length: int = 4 << 20
+    total_pieces: int = -1
+    done: bool = False
+    created_at: float = 0.0
+    accessed_at: float = 0.0
+    pieces: dict[int, PieceMetadata] = dataclasses.field(default_factory=dict)
+
+    def finished_count(self) -> int:
+        return len(self.pieces)
+
+
+class TaskStorage:
+    """One task's on-disk state: `<dir>/<task_id>/data` + `metadata.json`."""
+
+    def __init__(self, base: pathlib.Path, meta: TaskMetadata):
+        self.dir = base / meta.task_id
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.data_path = self.dir / "data"
+        self.meta_path = self.dir / "metadata.json"
+        self.meta = meta
+        self._lock = threading.RLock()
+        self._bitset = Bitset()
+        for n in meta.pieces:
+            self._bitset.set(n)
+        if not self.data_path.exists():
+            self.data_path.touch()
+
+    # -------------------------------------------------------------- pieces
+
+    def write_piece(
+        self, number: int, offset: int, data: bytes, digest: str = "", cost_ns: int = 0
+    ) -> PieceMetadata:
+        """Write piece bytes at their offset; validates the digest first
+        (pieceManager digest check before commit)."""
+        if digest:
+            actual = md5_from_bytes(data)
+            if actual != digest:
+                raise dferrors.InvalidArgument(
+                    f"piece {number} digest mismatch: got {actual} want {digest}"
+                )
+        with self._lock:
+            with open(self.data_path, "r+b") as f:
+                f.seek(offset)
+                f.write(data)
+            piece = PieceMetadata(
+                number=number, offset=offset, length=len(data),
+                digest=digest or md5_from_bytes(data), cost_ns=cost_ns,
+            )
+            self.meta.pieces[number] = piece
+            self._bitset.set(number)
+            self.meta.accessed_at = time.time()
+            self._flush_meta()
+            return piece
+
+    def read_piece(self, number: int) -> bytes:
+        with self._lock:
+            piece = self.meta.pieces.get(number)
+            if piece is None:
+                raise dferrors.NotFound(f"piece {number} not stored")
+            self.meta.accessed_at = time.time()
+            with open(self.data_path, "rb") as f:
+                f.seek(piece.offset)
+                return f.read(piece.length)
+
+    def read_range(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            self.meta.accessed_at = time.time()
+            with open(self.data_path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+
+    def has_piece(self, number: int) -> bool:
+        return self._bitset.test(number)
+
+    def finished_pieces(self) -> list[int]:
+        with self._lock:
+            return sorted(self.meta.pieces)
+
+    def mark_done(self, content_length: int | None = None, total_pieces: int | None = None) -> None:
+        with self._lock:
+            self.meta.done = True
+            if content_length is not None:
+                self.meta.content_length = content_length
+            if total_pieces is not None:
+                self.meta.total_pieces = total_pieces
+            self._flush_meta()
+
+    def size_on_disk(self) -> int:
+        try:
+            return self.data_path.stat().st_size
+        except OSError:
+            return 0
+
+    # ---------------------------------------------------------- metadata io
+
+    def _flush_meta(self) -> None:
+        d = dataclasses.asdict(self.meta)
+        d["pieces"] = {str(k): dataclasses.asdict(v) for k, v in self.meta.pieces.items()}
+        tmp = self.meta_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(d))
+        tmp.replace(self.meta_path)
+
+    @staticmethod
+    def load(base: pathlib.Path, task_dir: pathlib.Path) -> "TaskStorage | None":
+        meta_path = task_dir / "metadata.json"
+        try:
+            d = json.loads(meta_path.read_text())
+            pieces = {
+                int(k): PieceMetadata(**v) for k, v in d.pop("pieces", {}).items()
+            }
+            meta = TaskMetadata(**{**d, "pieces": pieces})
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            return None
+        return TaskStorage(base, meta)
+
+
+class StorageManager:
+    """All tasks on this daemon + GC policy.
+
+    GC parity (local_storage + storage manager): TTL on last access, and
+    a high/low-watermark disk-usage sweep evicting least-recently-used
+    completed tasks first.
+    """
+
+    def __init__(
+        self,
+        data_dir: str | pathlib.Path,
+        task_ttl: float = 24 * 3600.0,
+        disk_gc_threshold_bytes: int = 0,  # 0 = unlimited
+    ):
+        self.base = pathlib.Path(data_dir)
+        self.base.mkdir(parents=True, exist_ok=True)
+        self.task_ttl = task_ttl
+        self.disk_gc_threshold_bytes = disk_gc_threshold_bytes
+        self._tasks: dict[str, TaskStorage] = {}
+        self._lock = threading.RLock()
+        self.reload()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def register_task(self, meta: TaskMetadata) -> TaskStorage:
+        with self._lock:
+            ts = self._tasks.get(meta.task_id)
+            if ts is None:
+                meta.created_at = meta.created_at or time.time()
+                meta.accessed_at = time.time()
+                ts = TaskStorage(self.base, meta)
+                self._tasks[meta.task_id] = ts
+            return ts
+
+    def get(self, task_id: str) -> TaskStorage | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def find_completed_task(self, task_id: str) -> TaskStorage | None:
+        ts = self.get(task_id)
+        return ts if ts is not None and ts.meta.done else None
+
+    def find_partial_completed_task(self, task_id: str) -> TaskStorage | None:
+        """Resume point: task exists with some pieces but not done
+        (storage_manager.go:545)."""
+        ts = self.get(task_id)
+        if ts is not None and not ts.meta.done and ts.meta.finished_count() > 0:
+            return ts
+        return None
+
+    def delete_task(self, task_id: str) -> bool:
+        with self._lock:
+            ts = self._tasks.pop(task_id, None)
+        if ts is None:
+            return False
+        import shutil
+
+        shutil.rmtree(ts.dir, ignore_errors=True)
+        return True
+
+    def tasks(self) -> list[TaskStorage]:
+        with self._lock:
+            return list(self._tasks.values())
+
+    def reload(self) -> int:
+        """Reload persisted tasks after restart (ReloadPersistentTask)."""
+        loaded = 0
+        for task_dir in self.base.iterdir() if self.base.exists() else []:
+            if not task_dir.is_dir():
+                continue
+            ts = TaskStorage.load(self.base, task_dir)
+            if ts is not None and ts.meta.task_id not in self._tasks:
+                self._tasks[ts.meta.task_id] = ts
+                loaded += 1
+        return loaded
+
+    # ------------------------------------------------------------------ gc
+
+    def run_gc(self) -> int:
+        """TTL sweep + disk watermark sweep; returns tasks reclaimed."""
+        now = time.time()
+        reclaimed = 0
+        for ts in self.tasks():
+            if now - ts.meta.accessed_at > self.task_ttl:
+                if self.delete_task(ts.meta.task_id):
+                    reclaimed += 1
+        if self.disk_gc_threshold_bytes > 0:
+            usage = sum(ts.size_on_disk() for ts in self.tasks())
+            if usage > self.disk_gc_threshold_bytes:
+                # Evict least-recently-used completed tasks down to 80%.
+                target = int(self.disk_gc_threshold_bytes * 0.8)
+                for ts in sorted(self.tasks(), key=lambda t: t.meta.accessed_at):
+                    if usage <= target:
+                        break
+                    if ts.meta.done:
+                        usage -= ts.size_on_disk()
+                        if self.delete_task(ts.meta.task_id):
+                            reclaimed += 1
+        return reclaimed

@@ -1,0 +1,68 @@
+"""P2P transport: route matching requests through the mesh.
+
+Capability parity with client/daemon/transport/transport.go:458 — a
+RoundTripper that sends requests matching the hijack rules through the P2P
+stream task and everything else direct. Here: `fetch(url)` returns the
+bytes, P2P when a rule matches (daemon.download + local piece store read),
+direct urllib otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import urllib.request
+
+
+@dataclasses.dataclass
+class ProxyRule:
+    """One hijack rule (client/config proxy rules: regx, useHTTPS, direct,
+    redirect)."""
+
+    regex: str
+    use_https: bool = False
+    direct: bool = False
+    redirect: str = ""
+
+    def matches(self, url: str) -> bool:
+        return re.search(self.regex, url) is not None
+
+    def rewrite(self, url: str) -> str:
+        if self.redirect:
+            # reference semantics: redirect replaces the host
+            url = re.sub(r"^(https?://)[^/]+", rf"\g<1>{self.redirect}", url)
+        if self.use_https:
+            url = re.sub(r"^http://", "https://", url)
+        return url
+
+
+class P2PTransport:
+    def __init__(self, daemon, rules: list[ProxyRule] | None = None, timeout: float = 60.0):
+        self.daemon = daemon
+        self.rules = rules or []
+        self.timeout = timeout
+
+    def route(self, url: str) -> tuple[str, ProxyRule | None]:
+        for rule in self.rules:
+            if rule.matches(url):
+                return rule.rewrite(url), rule
+        return url, None
+
+    async def fetch(self, url: str, headers: dict | None = None) -> tuple[bytes, str]:
+        """Returns (body, via) where via is 'p2p' or 'direct'."""
+        target, rule = self.route(url)
+        if rule is not None and not rule.direct:
+            ts = await self.daemon.download(target)
+            data = ts.read_range(0, max(ts.meta.content_length, 0))
+            return data, "p2p"
+        return await self._direct(target, headers), "direct"
+
+    async def _direct(self, url: str, headers: dict | None) -> bytes:
+        import asyncio
+
+        def get():
+            req = urllib.request.Request(url, headers=headers or {})
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+
+        return await asyncio.to_thread(get)

@@ -1,0 +1,140 @@
+"""HTTP piece server: parents serve stored pieces to children.
+
+Capability parity with client/daemon/upload/upload_manager.go:270 (the
+peer-to-peer data path — piece bytes move as HTTP range responses, SURVEY
+§2.6). Routes:
+  GET /download/{task_id}?piece={n}      -> one piece's bytes
+  GET /download/{task_id}                -> whole stored file (Range ok)
+  GET /pieces/{task_id}                  -> stored piece metadata (JSON) —
+                                            the GetPieceTasks/SyncPieceTasks
+                                            equivalent children use to learn
+                                            what a parent can serve
+  GET /healthy                           -> liveness
+Headers carry the piece digest so children can verify before commit.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import urllib.parse
+
+from dragonfly2_tpu.client.storage import StorageManager
+
+
+class UploadServer:
+    def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0):
+        self.storage = storage
+        manager = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                parts = urllib.parse.urlsplit(self.path)
+                if parts.path == "/healthy":
+                    self._reply(200, b"ok")
+                    return
+                if parts.path.startswith("/pieces/"):
+                    self._serve_piece_list(parts.path[len("/pieces/") :])
+                    return
+                if not parts.path.startswith("/download/"):
+                    self._reply(404, b"not found")
+                    return
+                task_id = parts.path[len("/download/") :]
+                ts = manager.storage.get(task_id)
+                if ts is None:
+                    self._reply(404, b"task not stored")
+                    return
+                query = urllib.parse.parse_qs(parts.query)
+                if "piece" in query:
+                    self._serve_piece(ts, int(query["piece"][0]))
+                else:
+                    self._serve_file(ts)
+
+            def _serve_piece_list(self, task_id: str):
+                ts = manager.storage.get(task_id)
+                if ts is None:
+                    self._reply(404, b"task not stored")
+                    return
+                meta = ts.meta
+                body = json.dumps(
+                    {
+                        "task_id": meta.task_id,
+                        "content_length": meta.content_length,
+                        "piece_length": meta.piece_length,
+                        "total_pieces": meta.total_pieces,
+                        "done": meta.done,
+                        "pieces": [
+                            {
+                                "number": p.number,
+                                "offset": p.offset,
+                                "length": p.length,
+                                "digest": p.digest,
+                            }
+                            for p in sorted(meta.pieces.values(), key=lambda p: p.number)
+                        ],
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _serve_piece(self, ts, number: int):
+                if not ts.has_piece(number):
+                    self._reply(404, b"piece not stored")
+                    return
+                piece = ts.meta.pieces[number]
+                data = ts.read_piece(number)
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Dragonfly-Piece-Digest", piece.digest)
+                self.send_header("X-Dragonfly-Piece-Offset", str(piece.offset))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _serve_file(self, ts):
+                size = ts.size_on_disk()
+                range_header = self.headers.get("Range")
+                offset, length = 0, size
+                status = 200
+                if range_header and range_header.startswith("bytes="):
+                    spec = range_header[len("bytes=") :].split("-")
+                    offset = int(spec[0]) if spec[0] else 0
+                    end = int(spec[1]) if len(spec) > 1 and spec[1] else size - 1
+                    length = end - offset + 1
+                    status = 206
+                data = ts.read_range(offset, length)
+                self.send_response(status)
+                if status == 206:
+                    self.send_header(
+                        "Content-Range", f"bytes {offset}-{offset + len(data) - 1}/{size}"
+                    )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _reply(self, code: int, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self.host, self.port
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -155,3 +155,99 @@ class ScheduleFailure:
     peer_id: str
     code: str
     description: str
+
+
+# ------------------------------------------------- host + probe streams
+
+@dataclasses.dataclass
+class AnnounceHostRequest:
+    host: HostInfo
+
+
+@dataclasses.dataclass
+class LeaveHostRequest:
+    host_id: str
+
+
+@dataclasses.dataclass
+class LeavePeerRequest:
+    peer_id: str
+
+
+@dataclasses.dataclass
+class ProbeStartedRequest:
+    """SyncProbes: daemon asks which hosts to ping (service_v2.go:675)."""
+
+    host_id: str
+    count: int = 10
+
+
+@dataclasses.dataclass
+class ProbeTarget:
+    host_id: str
+    ip: str
+    port: int
+
+
+@dataclasses.dataclass
+class ProbeTargetsResponse:
+    targets: list[ProbeTarget]
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    host_id: str
+    rtt_ns: int
+    ok: bool = True
+
+
+@dataclasses.dataclass
+class ProbeFinishedRequest:
+    host_id: str
+    results: list[ProbeResult]
+
+
+# ----------------------------------------------------------------- stat
+
+@dataclasses.dataclass
+class StatPeerRequest:
+    peer_id: str
+
+
+@dataclasses.dataclass
+class StatTaskRequest:
+    task_id: str
+
+
+@dataclasses.dataclass
+class StatResponse:
+    found: bool
+    state: str = ""
+    detail: dict = dataclasses.field(default_factory=dict)
+
+
+# ------------------------------------------------------- trainer stream
+
+@dataclasses.dataclass
+class TrainRequest:
+    """One chunk of the scheduler->trainer dataset upload
+    (trainer/service/service_v1.go:59-162; 128 MiB chunks announcer.go:40).
+    dataset is 'download' or 'networktopology'."""
+
+    host_id: str
+    ip: str
+    hostname: str
+    dataset: str
+    chunk: bytes
+
+
+@dataclasses.dataclass
+class TrainResponse:
+    ok: bool
+    description: str = ""
+
+
+@dataclasses.dataclass
+class RPCError:
+    code: str
+    description: str = ""

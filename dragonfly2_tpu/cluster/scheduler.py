@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 
 import numpy as np
@@ -102,6 +103,10 @@ class SchedulerService:
         self._dag_slot_peer: dict[str, dict[int, str]] = {}
         self._pending: dict[str, _Pending] = {}
         self._host_info: dict[str, msg.HostInfo] = {}
+        # Serializes stream handlers vs the batched tick when the RPC edge
+        # drives them from different threads (rpc/server.py). In-proc tests
+        # and the simulator are single-threaded and unaffected.
+        self.mu = threading.RLock()
 
     # ============================================================ messages
 

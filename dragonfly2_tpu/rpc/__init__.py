@@ -1,0 +1,1 @@
+from dragonfly2_tpu.rpc.wire import decode, encode, register_messages  # noqa: F401

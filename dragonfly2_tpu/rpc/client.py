@@ -1,0 +1,176 @@
+"""Typed RPC clients for the cluster edge.
+
+Capability parity with pkg/rpc clients (pkg/rpc/scheduler/client/
+client_v2.go GetV2/GetV2ByAddr typed surface, retry/backoff interceptors in
+pkg/rpc/interceptor.go) and pkg/balancer's consistent-hashing policy
+(consistent_hashing.go:40-57): a peer picks its scheduler by hashing the
+task id onto the scheduler ring, so every RPC for one task lands on the
+same scheduler — here via utils/hashring + a per-address connection pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.utils.hashring import HashRing
+
+wire.register_module(msg)
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerConnection:
+    """One long-lived announce stream to a scheduler (AnnouncePeer
+    semantics: requests flow up, scheduling responses flow back async)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._responses: dict[str, asyncio.Queue] = {}
+        self._stats: asyncio.Queue = asyncio.Queue()
+        self._probe_targets: asyncio.Queue = asyncio.Queue()
+        self._reader_task: asyncio.Task | None = None
+        self._send_lock = asyncio.Lock()
+
+    async def connect(self) -> "SchedulerConnection":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+        if self._writer:
+            self._writer.close()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            response = await wire.read_frame(self._reader)
+            if response is None:
+                # connection died: wake every waiter with the failure
+                for q in self._responses.values():
+                    q.put_nowait(
+                        msg.ScheduleFailure(peer_id="", code="Unavailable", description="stream closed")
+                    )
+                return
+            if isinstance(response, msg.StatResponse):
+                self._stats.put_nowait(response)
+            elif isinstance(response, msg.ProbeTargetsResponse):
+                self._probe_targets.put_nowait(response)
+            else:
+                peer_id = getattr(response, "peer_id", "")
+                q = self._responses.get(peer_id)
+                if q is not None:
+                    q.put_nowait(response)
+                else:
+                    logger.debug("dropping response for unknown peer %s", peer_id)
+
+    async def send(self, request) -> None:
+        assert self._writer is not None
+        async with self._send_lock:
+            wire.write_frame(self._writer, request)
+            await self._writer.drain()
+
+    def subscribe(self, peer_id: str) -> asyncio.Queue:
+        return self._responses.setdefault(peer_id, asyncio.Queue())
+
+    def unsubscribe(self, peer_id: str) -> None:
+        self._responses.pop(peer_id, None)
+
+    # ---------------------------------------------------- request/response
+
+    async def stat_peer(self, peer_id: str, timeout: float = 5.0) -> msg.StatResponse:
+        await self.send(msg.StatPeerRequest(peer_id=peer_id))
+        return await asyncio.wait_for(self._stats.get(), timeout)
+
+    async def stat_task(self, task_id: str, timeout: float = 5.0) -> msg.StatResponse:
+        await self.send(msg.StatTaskRequest(task_id=task_id))
+        return await asyncio.wait_for(self._stats.get(), timeout)
+
+    async def sync_probes(
+        self, host_id: str, count: int = 10, timeout: float = 5.0
+    ) -> list[msg.ProbeTarget]:
+        await self.send(msg.ProbeStartedRequest(host_id=host_id, count=count))
+        response = await asyncio.wait_for(self._probe_targets.get(), timeout)
+        return response.targets
+
+
+class SchedulerClientPool:
+    """Task-affine scheduler selection over a scheduler set (the
+    consistent-hashing balancer + resolver pair)."""
+
+    def __init__(self, addresses: list[tuple[str, int]]):
+        if not addresses:
+            raise ValueError("need at least one scheduler address")
+        self._ring = HashRing([f"{h}:{p}" for h, p in addresses])
+        self._addr = {f"{h}:{p}": (h, p) for h, p in addresses}
+        self._conns: dict[str, SchedulerConnection] = {}
+        self._lock = asyncio.Lock()
+
+    def update_addresses(self, addresses: list[tuple[str, int]]) -> None:
+        """Dynconfig-driven refresh (pkg/resolver semantics)."""
+        self._ring = HashRing([f"{h}:{p}" for h, p in addresses])
+        self._addr = {f"{h}:{p}": (h, p) for h, p in addresses}
+
+    async def for_task(self, task_id: str) -> SchedulerConnection:
+        key = self._ring.pick(task_id)
+        if key is None:
+            raise RuntimeError("scheduler ring is empty")
+        async with self._lock:
+            conn = self._conns.get(key)
+            if conn is None:
+                host, port = self._addr[key]
+                conn = await SchedulerConnection(host, port).connect()
+                self._conns[key] = conn
+            return conn
+
+    def connections(self) -> list[SchedulerConnection]:
+        return list(self._conns.values())
+
+    async def close(self) -> None:
+        async with self._lock:
+            for conn in self._conns.values():
+                await conn.close()
+            self._conns.clear()
+
+
+class TrainerClient:
+    """Client-streaming dataset upload (trainerv1.Trainer/Train)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    async def train(
+        self, host_id: str, ip: str, hostname: str, datasets: dict[str, bytes],
+        chunk_size: int = 128 << 20,
+    ) -> msg.TrainResponse:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            for dataset, blob in datasets.items():
+                for off in range(0, max(len(blob), 1), chunk_size):
+                    wire.write_frame(
+                        writer,
+                        msg.TrainRequest(
+                            host_id=host_id, ip=ip, hostname=hostname,
+                            dataset=dataset, chunk=blob[off : off + chunk_size],
+                        ),
+                    )
+                    await writer.drain()
+            writer.write_eof()
+            response = await wire.read_frame(reader)
+            if not isinstance(response, msg.TrainResponse):
+                return msg.TrainResponse(ok=False, description="bad trainer reply")
+            return response
+        finally:
+            writer.close()

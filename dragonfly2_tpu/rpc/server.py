@@ -1,0 +1,250 @@
+"""Scheduler RPC server: the asyncio cluster edge.
+
+Capability parity with scheduler/rpcserver (scheduler_server_v2.go:56-166):
+one long-lived connection per daemon carrying AnnouncePeer oneof messages,
+AnnounceHost, SyncProbes, Stat/Leave — dispatched into SchedulerService.
+The TPU-first part is the tick loop: handlers only enqueue; every
+`tick_interval` the service batches ALL pending peers into one device call
+(cluster/scheduler.py tick) and the responses fan back out over whichever
+connections own those peers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.telemetry import default_registry
+
+wire.register_module(msg)
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerRPCServer:
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0, tick_interval: float = 0.005):
+        self.service = service
+        self.host = host
+        self.port = port
+        self.tick_interval = tick_interval
+        self._server: asyncio.AbstractServer | None = None
+        self._peer_conn: dict[str, asyncio.StreamWriter] = {}
+        self._writers: set[asyncio.StreamWriter] = set()
+        self._tick_task: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+        reg = default_registry()
+        self._m_requests = reg.counter(
+            "dragonfly_scheduler_announce_peer_total", "stream messages", ("type",)
+        )
+        self._m_tick = reg.histogram(
+            "dragonfly_scheduler_tick_seconds", "batched schedule tick latency"
+        )
+        self._m_batch = reg.histogram(
+            "dragonfly_scheduler_tick_batch_size", "peers per tick", buckets=(1, 8, 64, 512, 4096)
+        )
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        self._tick_task = asyncio.create_task(self._tick_loop())
+        logger.info("scheduler rpc listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except asyncio.CancelledError:
+                pass
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self._writers):
+            w.close()
+
+    # ---------------------------------------------------------- connection
+
+    async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._writers.add(writer)
+        owned_peers: set[str] = set()
+        try:
+            while True:
+                request = await wire.read_frame(reader)
+                if request is None:
+                    return
+                self._m_requests.labels(type(request).__name__).inc()
+                response = await self._dispatch_locked(request, writer, owned_peers)
+                if response is not None:
+                    wire.write_frame(writer, response)
+                    await writer.drain()
+        except Exception:  # noqa: BLE001 - one bad conn must not kill the server
+            logger.exception("connection handler failed")
+        finally:
+            self._writers.discard(writer)
+            async with self._lock:
+                for peer_id in owned_peers:
+                    self._peer_conn.pop(peer_id, None)
+            writer.close()
+
+    async def _dispatch_locked(self, request, writer, owned_peers: set[str]):
+        """Service mutations run off-loop under service.mu so they never
+        race the batched tick thread or stall the event loop."""
+        # route bookkeeping must happen on-loop (touches asyncio state)
+        peer_id = getattr(request, "peer_id", None)
+        if peer_id is not None and not isinstance(
+            request, (msg.StatPeerRequest, msg.LeavePeerRequest)
+        ):
+            async with self._lock:
+                self._peer_conn[peer_id] = writer
+                owned_peers.add(peer_id)
+
+        def run():
+            with self.service.mu:
+                return self._dispatch(request, owned_peers)
+
+        return await asyncio.to_thread(run)
+
+    def _dispatch(self, request, owned_peers: set[str]):
+        svc = self.service
+        if isinstance(request, msg.AnnounceHostRequest):
+            svc.announce_host(request.host)
+            return None
+        if isinstance(request, msg.LeaveHostRequest):
+            svc.leave_host(request.host_id)
+            return None
+        if isinstance(request, msg.LeavePeerRequest):
+            svc.leave_peer(request.peer_id)
+            owned_peers.discard(request.peer_id)
+            return None
+        if isinstance(request, msg.ProbeStartedRequest):
+            return self._probe_targets(request)
+        if isinstance(request, msg.ProbeFinishedRequest):
+            self._probe_finished(request)
+            return None
+        if isinstance(request, msg.StatPeerRequest):
+            return self._stat_peer(request.peer_id)
+        if isinstance(request, msg.StatTaskRequest):
+            return self._stat_task(request.task_id)
+        # announce-stream oneof (routing already recorded on-loop)
+        return svc.handle(request)
+
+    # --------------------------------------------------------------- probes
+
+    def _probe_targets(self, request: msg.ProbeStartedRequest) -> msg.ProbeTargetsResponse:
+        import jax
+
+        svc = self.service
+        targets: list[msg.ProbeTarget] = []
+        if svc.probes is not None:
+            src_slot = svc.state.host_index(request.host_id)
+            if src_slot is not None:
+                alive = svc.state.host_alive_mask()
+                alive[src_slot] = False
+                key = jax.random.key(time.time_ns() % (1 << 31))
+                for slot in svc.probes.find_probed_hosts(alive, key, request.count):
+                    host_id = svc.state.host_id_at(int(slot))
+                    info = svc._host_info.get(host_id)
+                    if info is not None:
+                        targets.append(
+                            msg.ProbeTarget(host_id=host_id, ip=info.ip, port=info.port)
+                        )
+        return msg.ProbeTargetsResponse(targets=targets)
+
+    def _probe_finished(self, request: msg.ProbeFinishedRequest) -> None:
+        import numpy as np
+
+        svc = self.service
+        if svc.probes is None:
+            return
+        src = svc.state.host_index(request.host_id)
+        if src is None:
+            return
+        dsts, rtts = [], []
+        for r in request.results:
+            if not r.ok:
+                continue
+            dst = svc.state.host_index(r.host_id)
+            if dst is not None:
+                dsts.append(dst)
+                rtts.append(r.rtt_ns)
+        if dsts:
+            svc.probes.enqueue(
+                np.full(len(dsts), src, np.int32),
+                np.asarray(dsts, np.int32),
+                np.asarray(rtts, np.float32),
+            )
+
+    # ----------------------------------------------------------------- stat
+
+    def _stat_peer(self, peer_id: str) -> msg.StatResponse:
+        from dragonfly2_tpu.state.fsm import PeerState
+
+        idx = self.service.state.peer_index(peer_id)
+        if idx is None:
+            return msg.StatResponse(found=False)
+        return msg.StatResponse(
+            found=True,
+            state=PeerState(int(self.service.state.peer_state[idx])).display,
+            detail={"finished_pieces": int(self.service.state.peer_finished_count[idx])},
+        )
+
+    def _stat_task(self, task_id: str) -> msg.StatResponse:
+        from dragonfly2_tpu.state.fsm import TaskState
+
+        idx = self.service.state.task_index(task_id)
+        if idx is None:
+            return msg.StatResponse(found=False)
+        return msg.StatResponse(
+            found=True,
+            state=TaskState(int(self.service.state.task_state[idx])).display,
+            detail={
+                "total_pieces": int(self.service.state.task_total_pieces[idx]),
+                "content_length": int(self.service.state.task_content_length[idx]),
+            },
+        )
+
+    # ----------------------------------------------------------------- tick
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_interval)
+            try:
+                await self._tick_once()
+            except Exception:  # noqa: BLE001 - keep ticking
+                logger.exception("schedule tick failed")
+
+    async def _tick_once(self) -> None:
+        svc = self.service
+        pending = len(svc._pending)
+        if pending == 0:
+            return
+        t0 = time.perf_counter()
+
+        def run():
+            with svc.mu:
+                return svc.tick()
+
+        # The device call blocks; run it off-loop so streams stay live.
+        responses = await asyncio.to_thread(run)
+        self._m_tick.labels().observe(time.perf_counter() - t0)
+        self._m_batch.labels().observe(pending)
+        await self._send_responses(responses)
+
+    async def _send_responses(self, responses) -> None:
+        for response in responses:
+            peer_id = getattr(response, "peer_id", None)
+            async with self._lock:
+                writer = self._peer_conn.get(peer_id)
+            if writer is None:
+                continue
+            try:
+                wire.write_frame(writer, response)
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                async with self._lock:
+                    self._peer_conn.pop(peer_id, None)

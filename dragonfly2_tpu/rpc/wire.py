@@ -1,0 +1,115 @@
+"""Wire codec for the cluster control-plane edge.
+
+Capability parity with pkg/rpc's typed message layer (the d7y.io/api
+protobufs, SURVEY.md L1/L3): every control-plane message is a dataclass
+(cluster/messages.py) encoded as a length-prefixed msgpack frame
+`{"t": <type-name>, "d": <fields>}`. Nested dataclasses, enums, and lists
+round-trip via type hints — no codegen step. gRPC is not used because the
+image ships no protoc python plugin; the framing preserves what matters
+from the reference's transport: long-lived bidirectional typed streams
+(AnnouncePeer, SyncProbes, Trainer.Train).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import struct
+import typing
+
+import msgpack
+
+_REGISTRY: dict[str, type] = {}
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 << 20  # trainer dataset chunks are 128 MiB (announcer.go:40)
+
+
+def register_messages(*classes: type) -> None:
+    for cls in classes:
+        _REGISTRY[cls.__name__] = cls
+
+
+def register_module(module) -> None:
+    for name in dir(module):
+        obj = getattr(module, name)
+        if dataclasses.is_dataclass(obj) and isinstance(obj, type):
+            _REGISTRY[obj.__name__] = obj
+
+
+def _to_plain(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {
+            f.name: _to_plain(getattr(value, f.name)) for f in dataclasses.fields(value)
+        }
+    if isinstance(value, enum.Enum):
+        return value.value
+    if isinstance(value, (list, tuple)):
+        return [_to_plain(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _to_plain(v) for k, v in value.items()}
+    return value
+
+
+def _from_plain(hint, value):
+    origin = typing.get_origin(hint)
+    if origin in (list, tuple):
+        (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
+        seq = [_from_plain(inner, v) for v in value]
+        return seq if origin is list else tuple(seq)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if value is None or not args:
+            return value
+        return _from_plain(args[0], value)
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+            return _instantiate(hint, value)
+        if issubclass(hint, enum.Enum):
+            return hint(value)
+    return value
+
+
+def _instantiate(cls: type, fields: dict):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in fields:
+            kwargs[f.name] = _from_plain(hints.get(f.name, typing.Any), fields[f.name])
+    return cls(**kwargs)
+
+
+def encode(message) -> bytes:
+    name = type(message).__name__
+    if name not in _REGISTRY:
+        raise TypeError(f"message type {name} not registered")
+    payload = msgpack.packb({"t": name, "d": _to_plain(message)}, use_bin_type=True)
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)}")
+    return _LEN.pack(len(payload)) + payload
+
+
+def decode(payload: bytes):
+    obj = msgpack.unpackb(payload, raw=False)
+    cls = _REGISTRY.get(obj.get("t"))
+    if cls is None:
+        raise TypeError(f"unknown message type {obj.get('t')!r}")
+    return _instantiate(cls, obj.get("d", {}))
+
+
+async def read_frame(reader: asyncio.StreamReader) -> object | None:
+    """Read one framed message from an asyncio StreamReader; None on EOF."""
+    try:
+        header = await reader.readexactly(_LEN.size)
+        (length,) = _LEN.unpack(header)
+        if length > MAX_FRAME:
+            raise ValueError(f"frame length {length} exceeds cap")
+        payload = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return decode(payload)
+
+
+def write_frame(writer, message) -> None:
+    writer.write(encode(message))

@@ -87,6 +87,7 @@ class ClusterState:
         self.host_updated_at = np.zeros(max_hosts, np.float64)
         self._host_free = _FreeList(max_hosts)
         self._host_by_id: dict[str, int] = {}
+        self._host_id: list[str | None] = [None] * max_hosts
 
         # --- tasks ---
         self.task_alive = np.zeros(max_tasks, bool)
@@ -134,6 +135,7 @@ class ClusterState:
         if idx is None:
             idx = self._host_free.acquire("host")
             self._host_by_id[host_id] = idx
+            self._host_id[idx] = host_id
             # Zero every column: the slot may be reused from a removed host
             # and absent kwargs below must not inherit its values.
             self.host_upload_used[idx] = 0
@@ -156,11 +158,18 @@ class ClusterState:
     def host_index(self, host_id: str) -> int | None:
         return self._host_by_id.get(host_id)
 
+    def host_id_at(self, idx: int) -> str | None:
+        return self._host_id[idx] if 0 <= idx < self.max_hosts else None
+
+    def host_alive_mask(self) -> np.ndarray:
+        return self.host_alive.copy()
+
     def remove_host(self, host_id: str) -> None:
         idx = self._host_by_id.pop(host_id, None)
         if idx is None:
             return
         self.host_alive[idx] = False
+        self._host_id[idx] = None
         self._host_free.release(idx)
 
     def host_free_upload(self, idx: int) -> int:

@@ -45,31 +45,39 @@ class _Metric:
             )
         return self._child(tuple(str(v) for v in values))
 
+    def _help_lines(self) -> Iterable[str]:
+        help_text = self.help.replace("\\", "\\\\").replace("\n", "\\n")
+        yield f"# HELP {self.name} {help_text}"
+        yield f"# TYPE {self.name} {self.TYPE}"
 
-class Counter(_Metric):
-    TYPE = "counter"
+
+class _ScalarMetric(_Metric):
+    """Shared storage + exposition for single-value-per-labelset metrics."""
 
     def __init__(self, name: str, help_: str = "", label_names: tuple[str, ...] = ()):
         super().__init__(name, help_, label_names)
         self._values: dict[tuple[str, ...], float] = {}
-
-    def _child(self, key: tuple[str, ...]) -> "_CounterChild":
-        return _CounterChild(self, key)
-
-    def inc(self, amount: float = 1.0) -> None:
-        self.labels().inc(amount)
 
     def value(self, *label_values: str) -> float:
         with self._lock:
             return self._values.get(tuple(map(str, label_values)), 0.0)
 
     def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} {self.TYPE}"
+        yield from self._help_lines()
         with self._lock:
             items = list(self._values.items())
         for key, v in items:
             yield f"{self.name}{_fmt_labels(self.label_names, key)} {v}"
+
+
+class Counter(_ScalarMetric):
+    TYPE = "counter"
+
+    def _child(self, key: tuple[str, ...]) -> "_CounterChild":
+        return _CounterChild(self, key)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
 
 
 class _CounterChild:
@@ -84,12 +92,8 @@ class _CounterChild:
             self._parent._values[self._key] = self._parent._values.get(self._key, 0.0) + amount
 
 
-class Gauge(_Metric):
+class Gauge(_ScalarMetric):
     TYPE = "gauge"
-
-    def __init__(self, name: str, help_: str = "", label_names: tuple[str, ...] = ()):
-        super().__init__(name, help_, label_names)
-        self._values: dict[tuple[str, ...], float] = {}
 
     def _child(self, key: tuple[str, ...]) -> "_GaugeChild":
         return _GaugeChild(self, key)
@@ -102,18 +106,6 @@ class Gauge(_Metric):
 
     def dec(self, amount: float = 1.0) -> None:
         self.labels().inc(-amount)
-
-    def value(self, *label_values: str) -> float:
-        with self._lock:
-            return self._values.get(tuple(map(str, label_values)), 0.0)
-
-    def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} {self.TYPE}"
-        with self._lock:
-            items = list(self._values.items())
-        for key, v in items:
-            yield f"{self.name}{_fmt_labels(self.label_names, key)} {v}"
 
 
 class _GaugeChild:
@@ -156,8 +148,7 @@ class Histogram(_Metric):
         self.labels().observe(value)
 
     def expose(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} {self.TYPE}"
+        yield from self._help_lines()
         with self._lock:
             keys = list(self._counts)
             counts = {k: list(v) for k, v in self._counts.items()}
@@ -205,6 +196,15 @@ class Registry:
                     raise ValueError(
                         f"metric {metric.name} already registered as "
                         f"{type(existing).__name__}{existing.label_names}"
+                    )
+                if (
+                    isinstance(existing, Histogram)
+                    and isinstance(metric, Histogram)
+                    and existing.buckets != metric.buckets
+                ):
+                    raise ValueError(
+                        f"metric {metric.name} already registered with buckets "
+                        f"{existing.buckets}"
                     )
                 return existing
             self._metrics[metric.name] = metric

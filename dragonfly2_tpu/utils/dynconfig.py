@@ -32,6 +32,9 @@ class Dynconfig:
         self._cache_path = pathlib.Path(cache_path)
         self._expire = expire
         self._lock = threading.Lock()
+        # Serializes whole refresh cycles (fetch + set + disk write) so a
+        # stalled fetch can't clobber a newer snapshot behind it.
+        self._refresh_lock = threading.Lock()
         self._data: dict | None = None
         self._fetched_at = 0.0
         self._observers: list[Callable[[dict], None]] = []
@@ -44,6 +47,10 @@ class Dynconfig:
 
     def refresh(self) -> dict:
         """Fetch from the source; on failure serve the disk snapshot."""
+        with self._refresh_lock:
+            return self._refresh_locked()
+
+    def _refresh_locked(self) -> dict:
         try:
             data = self._client()
         except Exception as e:  # noqa: BLE001 - any source failure falls back

@@ -1,0 +1,104 @@
+"""dfget/dfcache/dfstore CLI surface (client/dfget dfcache dfstore parity)."""
+
+import asyncio
+import hashlib
+import threading
+
+from dragonfly2_tpu.client import cli
+
+
+def test_dfcache_import_stat_export_delete(tmp_path, capsys):
+    blob = tmp_path / "in.bin"
+    blob.write_bytes(b"hello dragonfly" * 100)
+    data_dir = str(tmp_path / "cache")
+
+    rc = cli.main(["dfcache", "import", "--data-dir", data_dir, "--path", str(blob)])
+    assert rc == 0
+    task_id = capsys.readouterr().out.strip()
+
+    assert cli.main(["dfcache", "stat", "--data-dir", data_dir, "--task-id", task_id]) == 0
+    assert "done=True" in capsys.readouterr().out
+
+    out = tmp_path / "out.bin"
+    assert cli.main(
+        ["dfcache", "export", "--data-dir", data_dir, "--task-id", task_id, "-o", str(out)]
+    ) == 0
+    assert out.read_bytes() == blob.read_bytes()
+
+    assert cli.main(["dfstore", "sum", "--data-dir", data_dir, "--task-id", task_id]) == 0
+    assert (
+        capsys.readouterr().out.strip()
+        == hashlib.sha256(blob.read_bytes()).hexdigest()
+    )
+
+    assert cli.main(["dfcache", "delete", "--data-dir", data_dir, "--task-id", task_id]) == 0
+    assert cli.main(["dfcache", "stat", "--data-dir", data_dir, "--task-id", task_id]) == 1
+
+
+def test_dfget_end_to_end(tmp_path, capsys):
+    """dfget against a live scheduler: back-source path through the real CLI."""
+    import http.server
+
+    payload = bytes(i % 255 for i in range(100_000))
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+
+        def do_GET(self):
+            data = payload
+            r = self.headers.get("Range")
+            status = 200
+            if r and r.startswith("bytes="):
+                spec = r[6:].split("-")
+                start = int(spec[0] or 0)
+                end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
+                data, status = data[start : end + 1], 206
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    origin = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    origin_port = origin.server_address[1]
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 16
+        server = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        host, port = await server.start()
+        out = tmp_path / "fetched.bin"
+        rc = await cli._dfget(
+            cli.build_parser().parse_args(
+                [
+                    "dfget", f"http://127.0.0.1:{origin_port}/blob",
+                    "-o", str(out),
+                    "--scheduler", f"{host}:{port}",
+                    "--data-dir", str(tmp_path / "dfget-data"),
+                    "--piece-length", str(16 * 1024),
+                ]
+            )
+        )
+        await server.stop()
+        return rc, out
+
+    try:
+        rc, out = asyncio.run(run())
+        assert rc == 0
+        assert out.read_bytes() == payload
+    finally:
+        origin.shutdown()
+        origin.server_close()

@@ -1,0 +1,193 @@
+"""Client data plane units: piece store, sources, upload server,
+dispatcher, traffic shaper (SURVEY.md §2.4)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.client import source as source_pkg
+from dragonfly2_tpu.client.dispatcher import PieceDispatcher, TrafficShaper
+from dragonfly2_tpu.client.piece_manager import PieceManager, piece_layout
+from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata
+from dragonfly2_tpu.client.upload import UploadServer
+from dragonfly2_tpu.utils import dferrors
+from dragonfly2_tpu.utils.digest import md5_from_bytes
+
+
+def _store_file(storage: StorageManager, task_id: str, data: bytes, piece_length: int = 64):
+    ts = storage.register_task(
+        TaskMetadata(task_id=task_id, peer_id="p", piece_length=piece_length)
+    )
+    for n, off, length in piece_layout(len(data), piece_length):
+        chunk = data[off : off + length]
+        ts.write_piece(n, off, chunk, digest=md5_from_bytes(chunk))
+    ts.mark_done(len(data), len(piece_layout(len(data), piece_length)))
+    return ts
+
+
+# -------------------------------------------------------------- piece store
+
+
+def test_piece_store_roundtrip_and_digest(tmp_path):
+    storage = StorageManager(tmp_path)
+    data = bytes(range(256)) * 3
+    ts = _store_file(storage, "t1", data, piece_length=100)
+    assert ts.read_piece(0) == data[:100]
+    assert ts.read_range(50, 100) == data[50:150]
+    assert ts.meta.done and ts.meta.total_pieces == 8
+    with pytest.raises(dferrors.InvalidArgument):
+        ts.write_piece(99, 0, b"xx", digest="bogus")
+    with pytest.raises(dferrors.NotFound):
+        ts.read_piece(42)
+
+
+def test_piece_store_reload_and_partial(tmp_path):
+    storage = StorageManager(tmp_path)
+    ts = storage.register_task(TaskMetadata(task_id="t2", peer_id="p", piece_length=4))
+    ts.write_piece(0, 0, b"abcd")
+    ts.write_piece(2, 8, b"ijkl")
+    # restart: a new manager reloads from disk (ReloadPersistentTask)
+    storage2 = StorageManager(tmp_path)
+    ts2 = storage2.get("t2")
+    assert ts2 is not None
+    assert ts2.finished_pieces() == [0, 2]
+    assert storage2.find_partial_completed_task("t2") is ts2
+    assert storage2.find_completed_task("t2") is None
+    ts2.write_piece(1, 4, b"efgh")
+    ts2.mark_done(12, 3)
+    assert storage2.find_completed_task("t2") is ts2
+    assert ts2.read_range(0, 12) == b"abcdefghijkl"
+
+
+def test_storage_gc_ttl_and_watermark(tmp_path):
+    storage = StorageManager(tmp_path, task_ttl=1000.0, disk_gc_threshold_bytes=150)
+    _store_file(storage, "old", b"x" * 100)
+    _store_file(storage, "new", b"y" * 100)
+    storage.get("old").meta.accessed_at = time.time() - 50  # older access
+    # watermark sweep: 200 bytes > 150 threshold -> evict LRU done tasks
+    reclaimed = storage.run_gc()
+    assert reclaimed >= 1
+    assert storage.get("old") is None
+    # TTL sweep
+    storage2 = StorageManager(tmp_path, task_ttl=0.001)
+    time.sleep(0.01)
+    storage2.run_gc()
+    assert storage2.tasks() == []
+
+
+# ------------------------------------------------------------------ source
+
+
+def test_file_source_and_layout(tmp_path):
+    payload = b"0123456789" * 100
+    src = tmp_path / "blob.bin"
+    src.write_bytes(payload)
+    url = f"file://{src}"
+    assert source_pkg.content_length(url) == 1000
+    assert b"".join(source_pkg.download(url)) == payload
+    assert b"".join(source_pkg.download(url, offset=10, length=20)) == payload[10:30]
+    assert piece_layout(1000, 300) == [(0, 0, 300), (1, 300, 300), (2, 600, 300), (3, 900, 100)]
+    with pytest.raises(dferrors.Unavailable):
+        source_pkg.content_length("s3://bucket/key")
+    with pytest.raises(dferrors.InvalidArgument):
+        source_pkg.content_length("gopher://x")
+
+
+def test_download_source_known_length(tmp_path):
+    payload = bytes(i % 251 for i in range(5000))
+    src = tmp_path / "data.bin"
+    src.write_bytes(payload)
+    storage = StorageManager(tmp_path / "store")
+    ts = storage.register_task(
+        TaskMetadata(task_id="src-task", peer_id="p", piece_length=512)
+    )
+    seen = []
+    pm = PieceManager(concurrency=3)
+    total, pieces = pm.download_source(ts, f"file://{src}", on_piece=lambda n, l, c: seen.append(n))
+    assert (total, pieces) == (5000, 10)
+    assert sorted(seen) == list(range(10))
+    assert ts.read_range(0, 5000) == payload
+
+
+# ------------------------------------------------------------ upload server
+
+
+def test_upload_server_piece_and_range(tmp_path):
+    storage = StorageManager(tmp_path)
+    data = b"ABCDEFGHIJKLMNOPQRSTUVWXYZ" * 10
+    _store_file(storage, "up1", data, piece_length=64)
+    server = UploadServer(storage)
+    host, port = server.start()
+    try:
+        doc = json.load(
+            urllib.request.urlopen(f"http://{host}:{port}/pieces/up1", timeout=5)
+        )
+        assert doc["done"] and doc["total_pieces"] == len(doc["pieces"])
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/download/up1?piece=1", timeout=5
+        ) as resp:
+            piece = resp.read()
+            assert piece == data[64:128]
+            assert resp.headers["X-Dragonfly-Piece-Digest"] == md5_from_bytes(piece)
+        req = urllib.request.Request(
+            f"http://{host}:{port}/download/up1", headers={"Range": "bytes=10-19"}
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 206
+            assert resp.read() == data[10:20]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/pieces/missing", timeout=5)
+    finally:
+        server.stop()
+
+
+def test_piece_manager_parent_fetch(tmp_path):
+    parent_storage = StorageManager(tmp_path / "parent")
+    data = bytes(range(200))
+    _store_file(parent_storage, "pf1", data, piece_length=100)
+    server = UploadServer(parent_storage)
+    host, port = server.start()
+    try:
+        child_storage = StorageManager(tmp_path / "child")
+        ts = child_storage.register_task(
+            TaskMetadata(task_id="pf1", peer_id="c", piece_length=100)
+        )
+        pm = PieceManager()
+        assert pm.download_piece_from_parent(ts, host, port, 1, 100) == 100
+        assert ts.read_piece(1) == data[100:]
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- dispatcher + shaper
+
+
+def test_dispatcher_prefers_fast_parents():
+    d = PieceDispatcher(seed=7)
+    d.report_cost("fast", 1_000)
+    d.report_cost("slow", 1_000_000)
+    for n in range(10):
+        d.put(n, "fast")
+        d.put(n, "slow")
+    first_ten = [d.get()[1] for _ in range(10)]
+    assert first_ten.count("fast") == 10  # jitter can't bridge a 1000x gap
+    assert len(d) == 10
+
+
+def test_traffic_shaper_limits_rate():
+    shaper = TrafficShaper(total_rate_bps=100_000, mode="plain")
+    shaper.register_task("t")
+    t0 = time.monotonic()
+    total = 0
+    while total < 30_000:
+        assert shaper.acquire("t", 10_000, timeout=5.0)
+        total += 10_000
+    elapsed = time.monotonic() - t0
+    # 30kB at 100kB/s with a 1s burst allowance: must take measurable time
+    assert elapsed >= 0.1
+    assert not shaper.acquire("t", 10**9, timeout=0.05)  # can't exceed budget
+    unlimited = TrafficShaper()
+    assert unlimited.acquire("any", 10**12)

@@ -1,0 +1,142 @@
+"""Proxy + transport: P2P hijack rules, direct passthrough, registry
+mirror, auth/white-list (client/daemon/proxy + transport parity)."""
+
+import asyncio
+import base64
+import hashlib
+import http.server
+import threading
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.client.proxy import ProxyServer
+from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+PAYLOAD = bytes(i % 253 for i in range(50_000))
+
+
+@pytest.fixture
+def origin():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(PAYLOAD)))
+            self.end_headers()
+
+        def do_GET(self):
+            data = PAYLOAD
+            r = self.headers.get("Range")
+            status = 200
+            if r and r.startswith("bytes="):
+                spec = r[6:].split("-")
+                start = int(spec[0] or 0)
+                end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
+                data, status = data[start : end + 1], 206
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1]
+    server.shutdown()
+    server.server_close()
+
+
+def test_rule_matching_and_rewrite():
+    rule = ProxyRule(regex=r"blobs/sha256", use_https=True, redirect="mirror.local")
+    assert rule.matches("http://reg.io/v2/x/blobs/sha256:abc")
+    assert (
+        rule.rewrite("http://reg.io/v2/x/blobs/sha256:abc")
+        == "https://mirror.local/v2/x/blobs/sha256:abc"
+    )
+    assert not ProxyRule(regex=r"\.tar$").matches("http://a/b.txt")
+
+
+def test_proxy_p2p_and_direct_and_mirror(tmp_path, origin):
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 16
+        sched = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        shost, sport = await sched.start()
+        daemon = Daemon(tmp_path / "d", [(shost, sport)], hostname="proxy-host")
+        await daemon.start()
+        transport = P2PTransport(daemon, rules=[ProxyRule(regex=r"blob\.bin")])
+        proxy = ProxyServer(
+            transport, registry_mirror=f"http://127.0.0.1:{origin}"
+        )
+        phost, pport = await proxy.start()
+
+        def via_proxy(url: str):
+            req = urllib.request.Request(url)
+            req.set_proxy(f"{phost}:{pport}", "http")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read(), resp.headers.get("X-Dragonfly-Via")
+
+        try:
+            # matching URL -> served through the mesh
+            body, via = await asyncio.to_thread(
+                via_proxy, f"http://127.0.0.1:{origin}/blob.bin"
+            )
+            assert body == PAYLOAD and via == "p2p"
+            # non-matching -> direct passthrough
+            body, via = await asyncio.to_thread(
+                via_proxy, f"http://127.0.0.1:{origin}/other.dat"
+            )
+            assert body == PAYLOAD and via == "direct"
+            assert proxy.stats["p2p"] == 1 and proxy.stats["direct"] == 1
+        finally:
+            await proxy.stop()
+            await daemon.stop()
+            await sched.stop()
+
+    asyncio.run(run())
+
+
+def test_proxy_auth_and_whitelist(tmp_path, origin):
+    async def run():
+        transport = P2PTransport(daemon=None, rules=[])
+        proxy = ProxyServer(
+            transport,
+            whitelist_hosts=["allowed.example"],
+            basic_auth=("root", "secret"),
+        )
+        phost, pport = await proxy.start()
+
+        def raw_request(url: str, auth: str | None):
+            req = urllib.request.Request(url)
+            req.set_proxy(f"{phost}:{pport}", "http")
+            if auth:
+                req.add_header(
+                    "Proxy-Authorization",
+                    "Basic " + base64.b64encode(auth.encode()).decode(),
+                )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        try:
+            assert await asyncio.to_thread(
+                raw_request, f"http://127.0.0.1:{origin}/x", None
+            ) == 407
+            assert await asyncio.to_thread(
+                raw_request, f"http://127.0.0.1:{origin}/x", "root:secret"
+            ) == 403  # authed but host not whitelisted
+        finally:
+            await proxy.stop()
+
+    asyncio.run(run())

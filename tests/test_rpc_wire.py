@@ -1,0 +1,71 @@
+"""Wire codec: dataclass<->msgpack roundtrips and stream framing."""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.rpc import wire
+
+wire.register_module(msg)
+
+
+def test_roundtrip_nested():
+    req = msg.RegisterPeerRequest(
+        peer_id="p1",
+        task_id="t1",
+        host=msg.HostInfo(host_id="h1", ip="10.0.0.1", idc="idc-a"),
+        content_length=1234,
+    )
+    out = wire.decode(wire.encode(req)[4:])
+    assert out == req
+    assert isinstance(out.host, msg.HostInfo)
+
+
+def test_roundtrip_lists_and_bytes():
+    resp = msg.NormalTaskResponse(
+        peer_id="p1",
+        candidate_parents=[
+            msg.CandidateParent("pp", "hh", "1.2.3.4", 80, 81, "Running", 0.9)
+        ],
+    )
+    out = wire.decode(wire.encode(resp)[4:])
+    assert out.candidate_parents[0].download_port == 81
+
+    train = msg.TrainRequest(
+        host_id="h", ip="i", hostname="n", dataset="download", chunk=b"\x00\xffdata"
+    )
+    out = wire.decode(wire.encode(train)[4:])
+    assert out.chunk == b"\x00\xffdata"
+
+
+def test_unknown_type_rejected():
+    class NotRegistered:
+        pass
+
+    with pytest.raises(TypeError):
+        wire.encode(NotRegistered())
+
+
+def test_stream_framing():
+    async def run():
+        reader = asyncio.StreamReader()
+        messages = [
+            msg.ProbeStartedRequest(host_id="h", count=3),
+            msg.ProbeFinishedRequest(
+                host_id="h", results=[msg.ProbeResult(host_id="d", rtt_ns=5)]
+            ),
+        ]
+        for item in messages:
+            reader.feed_data(wire.encode(item))
+        reader.feed_eof()
+        got = []
+        while True:
+            item = await wire.read_frame(reader)
+            if item is None:
+                break
+            got.append(item)
+        return messages, got
+
+    messages, got = asyncio.run(run())
+    assert got == messages
