@@ -17,6 +17,7 @@ import pathlib
 import sys
 
 from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.client.piece_manager import piece_layout
 from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata
 from dragonfly2_tpu.utils import idgen
 from dragonfly2_tpu.utils.digest import md5_from_bytes, sha256_from_reader
@@ -75,11 +76,10 @@ def _dfcache(args) -> int:
         data = pathlib.Path(args.path).read_bytes()
         task_id = args.task_id or idgen.task_id_v1(f"file://{pathlib.Path(args.path).resolve()}")
         ts = storage.register_task(TaskMetadata(task_id=task_id, peer_id="import"))
-        piece_length = ts.meta.piece_length
-        for n in range(0, max((len(data) + piece_length - 1) // piece_length, 1)):
-            chunk = data[n * piece_length : (n + 1) * piece_length]
-            ts.write_piece(n, n * piece_length, chunk, digest=md5_from_bytes(chunk))
-        ts.mark_done(len(data), max((len(data) + piece_length - 1) // piece_length, 1))
+        layout = piece_layout(len(data), ts.meta.piece_length)
+        for n, off, length in layout:
+            ts.write_piece(n, off, data[off : off + length], digest=md5_from_bytes(data[off : off + length]))
+        ts.mark_done(len(data), len(layout))
         print(task_id)
         return 0
     raise AssertionError(args.action)

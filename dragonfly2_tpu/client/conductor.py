@@ -82,7 +82,9 @@ class PeerTaskConductor:
             return ts  # local reuse, no network (taskManager dedup)
         queue = self.conn.subscribe(self.peer_id)
         try:
-            content_length = self._probe_content_length()
+            # blocking HEAD off-loop: a blackholed origin must not freeze
+            # every other conductor/proxy on this daemon
+            content_length = await asyncio.to_thread(self._probe_content_length)
             await self.conn.send(
                 msg.RegisterPeerRequest(
                     peer_id=self.peer_id,

@@ -20,6 +20,18 @@ from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
 
 logger = logging.getLogger(__name__)
 
+# Hop-by-hop headers never forwarded upstream (RFC 7230 §6.1), plus the
+# proxy's own credentials — forwarding proxy-authorization would leak the
+# proxy password to every origin.
+_HOP_BY_HOP = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host", "content-length",
+}
+
+
+def _forwardable(headers: dict) -> dict:
+    return {k: v for k, v in headers.items() if k.lower() not in _HOP_BY_HOP}
+
 
 class ProxyServer:
     def __init__(
@@ -84,18 +96,30 @@ class ProxyServer:
                 self.stats["denied"] += 1
                 await self._respond(writer, 403, b"host not in white list")
                 return
+            request_body = b""
+            length = int(headers.get("content-length") or 0)
+            if length:
+                request_body = await reader.readexactly(length)
+            upstream_headers = _forwardable(headers)
             if method != "GET":
-                body = await self.transport._direct(url, headers)
+                try:
+                    body = await self.transport._direct(
+                        url, upstream_headers, method=method, body=request_body or None
+                    )
+                except Exception as e:  # noqa: BLE001 - proxy reports, never dies
+                    await self._respond(writer, 502, str(e).encode())
+                    return
                 await self._respond(writer, 200, body)
                 self.stats["direct"] += 1
                 return
             try:
-                body, via = await self.transport.fetch(url, headers)
+                body, via = await self.transport.fetch(url, upstream_headers)
             except Exception as e:  # noqa: BLE001 - proxy reports, never dies
                 await self._respond(writer, 502, str(e).encode())
                 return
             self.stats[via] += 1
-            await self._respond(writer, 200, body, extra=f"X-Dragonfly-Via: {via}\r\n")
+            status = 206 if "range" in headers else 200
+            await self._respond(writer, status, body, extra=f"X-Dragonfly-Via: {via}\r\n")
         except (ConnectionError, asyncio.IncompleteReadError, ValueError):
             pass
         finally:
@@ -151,7 +175,7 @@ class ProxyServer:
         return any(host == h or host.endswith("." + h) for h in self.whitelist_hosts)
 
     async def _respond(self, writer, status: int, body: bytes, extra: str = ""):
-        reason = {200: "OK", 403: "Forbidden", 404: "Not Found",
+        reason = {200: "OK", 206: "Partial Content", 403: "Forbidden", 404: "Not Found",
                   407: "Proxy Authentication Required", 502: "Bad Gateway"}.get(status, "")
         head = (
             f"HTTP/1.1 {status} {reason}\r\nContent-Length: {len(body)}\r\n"

@@ -56,6 +56,7 @@ class TaskStorage:
         self.dir.mkdir(parents=True, exist_ok=True)
         self.data_path = self.dir / "data"
         self.meta_path = self.dir / "metadata.json"
+        self.pieces_path = self.dir / "pieces.jsonl"
         self.meta = meta
         self._lock = threading.RLock()
         self._bitset = Bitset()
@@ -63,6 +64,8 @@ class TaskStorage:
             self._bitset.set(n)
         if not self.data_path.exists():
             self.data_path.touch()
+        if not self.meta_path.exists():
+            self._flush_meta()
 
     # -------------------------------------------------------------- pieces
 
@@ -88,7 +91,10 @@ class TaskStorage:
             self.meta.pieces[number] = piece
             self._bitset.set(number)
             self.meta.accessed_at = time.time()
-            self._flush_meta()
+            # O(1) durability per piece: append to the journal instead of
+            # rewriting every accumulated entry (which is O(n^2) per task).
+            with open(self.pieces_path, "a") as f:
+                f.write(json.dumps(dataclasses.asdict(piece)) + "\n")
             return piece
 
     def read_piece(self, number: int) -> bytes:
@@ -133,8 +139,10 @@ class TaskStorage:
     # ---------------------------------------------------------- metadata io
 
     def _flush_meta(self) -> None:
+        """Task-level fields only; piece entries live in the append-only
+        journal (pieces.jsonl)."""
         d = dataclasses.asdict(self.meta)
-        d["pieces"] = {str(k): dataclasses.asdict(v) for k, v in self.meta.pieces.items()}
+        d.pop("pieces", None)
         tmp = self.meta_path.with_suffix(".tmp")
         tmp.write_text(json.dumps(d))
         tmp.replace(self.meta_path)
@@ -150,6 +158,17 @@ class TaskStorage:
             meta = TaskMetadata(**{**d, "pieces": pieces})
         except (OSError, json.JSONDecodeError, TypeError, ValueError):
             return None
+        # replay the append-only piece journal (a torn final line from a
+        # crash mid-append is dropped)
+        try:
+            for line in (task_dir / "pieces.jsonl").read_text().splitlines():
+                try:
+                    piece = PieceMetadata(**json.loads(line))
+                except (json.JSONDecodeError, TypeError):
+                    continue
+                meta.pieces[piece.number] = piece
+        except OSError:
+            pass
         return TaskStorage(base, meta)
 
 

@@ -49,20 +49,64 @@ class P2PTransport:
         return url, None
 
     async def fetch(self, url: str, headers: dict | None = None) -> tuple[bytes, str]:
-        """Returns (body, via) where via is 'p2p' or 'direct'."""
+        """Returns (body, via) where via is 'p2p' or 'direct'. The p2p path
+        honors a `Range: bytes=a-b` request header by slicing the cached
+        task (the reference serves ranged requests out of the piece store,
+        transport.go + storage reuse-by-range)."""
+        headers = headers or {}
         target, rule = self.route(url)
         if rule is not None and not rule.direct:
             ts = await self.daemon.download(target)
-            data = ts.read_range(0, max(ts.meta.content_length, 0))
-            return data, "p2p"
+            total = max(ts.meta.content_length, 0)
+            rng = parse_range(_header(headers, "range"), total)
+            if rng is not None:
+                start, end = rng
+                return ts.read_range(start, end - start + 1), "p2p"
+            return ts.read_range(0, total), "p2p"
         return await self._direct(target, headers), "direct"
 
-    async def _direct(self, url: str, headers: dict | None) -> bytes:
+    async def _direct(
+        self,
+        url: str,
+        headers: dict | None,
+        method: str = "GET",
+        body: bytes | None = None,
+    ) -> bytes:
         import asyncio
 
-        def get():
-            req = urllib.request.Request(url, headers=headers or {})
+        def run():
+            req = urllib.request.Request(url, data=body, headers=headers or {}, method=method)
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.read()
 
-        return await asyncio.to_thread(get)
+        return await asyncio.to_thread(run)
+
+
+def parse_range(header: str | None, total: int) -> tuple[int, int] | None:
+    """`bytes=a-b` -> inclusive (start, end) clamped to total; None when
+    absent/unsatisfiable. Suffix form `bytes=-n` means the last n bytes."""
+    if not header:
+        return None
+    m = re.fullmatch(r"bytes=(\d*)-(\d*)", header.strip())
+    if m is None or total <= 0:
+        return None
+    start_s, end_s = m.group(1), m.group(2)
+    if start_s == "" and end_s == "":
+        return None
+    if start_s == "":  # suffix: last n bytes
+        n = min(int(end_s), total)
+        return (total - n, total - 1) if n > 0 else None
+    start = int(start_s)
+    if start >= total:
+        return None
+    end = min(int(end_s), total - 1) if end_s else total - 1
+    if end < start:
+        return None
+    return start, end
+
+
+def _header(headers: dict, name: str) -> str | None:
+    for k, v in headers.items():
+        if k.lower() == name:
+            return v
+    return None

@@ -1,0 +1,310 @@
+"""Manager REST API.
+
+Capability parity with manager/router/router.go:101-246 + manager/handlers
+(gin): `/api/v1` groups — users (signup/signin/refresh_token/reset_password/
+roles), roles, permissions, oauth, clusters, scheduler-clusters, schedulers,
+seed-peer-clusters, seed-peers, peers, buckets, configs, jobs, applications,
+models, personal-access-tokens — JWT-authenticated with RBAC enforcement per
+object group, plus `/oapi/v1` mirrors authenticated by personal access
+token. Built on stdlib ThreadingHTTPServer: the control plane is pure host
+code; nothing here touches the device.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragonfly2_tpu.manager import auth
+from dragonfly2_tpu.manager.models import DuplicateRecord, RecordNotFound
+from dragonfly2_tpu.manager.service import ManagerService
+
+# Route-group -> Database table for the plain CRUD entities.
+CRUD_TABLES = {
+    "oauth": "oauth",
+    "clusters": "clusters",
+    "scheduler-clusters": "scheduler_clusters",
+    "schedulers": "schedulers",
+    "seed-peer-clusters": "seed_peer_clusters",
+    "seed-peers": "seed_peers",
+    "peers": "peers",
+    "buckets": "buckets",
+    "configs": "configs",
+    "applications": "applications",
+    "models": "models",
+}
+
+# Groups the reference leaves unauthenticated (router.go: signup/signin,
+# GET /configs, all /jobs — "TODO Add auth").
+_OPEN_ROUTES = {
+    ("POST", "users", "signup"),
+    ("POST", "users", "signin"),
+    ("POST", "users", "refresh_token"),
+    ("GET", "configs", None),
+    ("*", "jobs", None),
+}
+
+
+class _Request:
+    def __init__(self, method: str, group: str, parts: list[str], body: dict, user: dict | None):
+        self.method = method
+        self.group = group
+        self.parts = parts  # path segments after the group
+        self.body = body
+        self.user = user
+
+
+class ManagerREST:
+    def __init__(self, service: ManagerService, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _run(self):
+                try:
+                    status, payload = outer.handle(
+                        self.command, self.path, self._body(), self.headers
+                    )
+                except DuplicateRecord as e:
+                    status, payload = 409, {"error": str(e)}
+                except (RecordNotFound, KeyError) as e:
+                    status, payload = 404, {"error": str(e)}
+                except PermissionError as e:
+                    status, payload = 401, {"error": str(e)}
+                except ValueError as e:
+                    status, payload = 400, {"error": str(e)}
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                raw = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                try:
+                    return json.loads(self.rfile.read(length))
+                except json.JSONDecodeError:
+                    return {}
+
+            do_GET = do_POST = do_PATCH = do_PUT = do_DELETE = _run
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.host, self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, method: str, path: str, body: dict, headers) -> tuple[int, object]:
+        path = path.split("?", 1)[0].rstrip("/")
+        m = re.match(r"^/(api|oapi)/v1/([-a-z_]+)(?:/(.*))?$", path)
+        if not m:
+            return 404, {"error": f"no route for {path}"}
+        surface, group, rest = m.group(1), m.group(2), m.group(3) or ""
+        parts = [p for p in rest.split("/") if p]
+
+        user = self._authenticate(surface, method, group, parts, headers)
+        req = _Request(method, group, parts, body, user)
+        if group == "users":
+            return self._users(req)
+        if group == "roles":
+            return self._roles(req)
+        if group == "permissions":
+            return 200, [{"object": o, "actions": ["read", "*"]} for o in auth.OBJECTS]
+        if group == "jobs":
+            return self._jobs(req)
+        if group == "models" and method == "PATCH" and len(parts) == 1:
+            return self._update_model(req)
+        if group == "personal-access-tokens":
+            return self._pats(req)
+        table = CRUD_TABLES.get(group)
+        if table is None:
+            return 404, {"error": f"unknown group {group}"}
+        return self._crud(table, req)
+
+    def _authenticate(self, surface, method, group, parts, headers) -> dict | None:
+        sub = parts[0] if parts else None
+        if surface == "api":
+            for om, og, osub in _OPEN_ROUTES:
+                if og == group and (om in ("*", method)) and (osub is None or osub == sub):
+                    return None
+        header = headers.get("Authorization", "")
+        token = header.removeprefix("Bearer ").strip()
+        if surface == "oapi":
+            record = auth.verify_personal_access_token(self.service.db, token)
+            if record is None:
+                raise PermissionError("invalid personal access token")
+            return record
+        claims = self.service.tokens.verify(token)
+        if claims is None:
+            raise PermissionError("invalid or expired token")
+        action = auth.http_method_action(method)
+        if not self.service.enforcer.enforce(claims["name"], group, action):
+            raise PermissionError(f"{claims['name']} lacks {action} on {group}")
+        return claims
+
+    # -------------------------------------------------------------- handlers
+
+    def _crud(self, table: str, req: _Request) -> tuple[int, object]:
+        svc = self.service
+        if req.method == "POST" and not req.parts:
+            if table == "clusters":
+                return 200, svc.create_cluster(req.body)
+            return 200, svc.db.create(table, req.body)
+        if req.method == "GET" and not req.parts:
+            where = {k: v for k, v in req.body.items()} if req.body else None
+            return 200, svc.db.list(table, where)
+        if not req.parts:
+            return 405, {"error": "method not allowed"}
+        record_id = int(req.parts[0])
+        if req.method == "GET":
+            return 200, svc.db.get(table, record_id)
+        if req.method == "PATCH":
+            return 200, svc.db.update(table, record_id, req.body)
+        if req.method == "DELETE":
+            if table == "clusters":
+                svc.delete_cluster(record_id)
+            else:
+                svc.db.delete(table, record_id)
+            return 200, {}
+        if req.method == "PUT" and len(req.parts) == 3:
+            # association routes: /:id/<child-group>/:child_id (router.go
+            # AddSchedulerToSchedulerCluster and friends)
+            child_group, child_id = req.parts[1], int(req.parts[2])
+            return self._associate(table, record_id, child_group, child_id)
+        return 405, {"error": "method not allowed"}
+
+    def _associate(self, table, record_id, child_group, child_id) -> tuple[int, object]:
+        svc = self.service
+        if table == "scheduler_clusters" and child_group == "schedulers":
+            svc.db.update("schedulers", child_id, {"scheduler_cluster_id": record_id})
+        elif table == "seed_peer_clusters" and child_group == "seed-peers":
+            svc.db.update("seed_peers", child_id, {"seed_peer_cluster_id": record_id})
+        elif table == "seed_peer_clusters" and child_group == "scheduler-clusters":
+            spc = svc.db.get("seed_peer_clusters", record_id)
+            ids = set(spc.get("scheduler_cluster_ids", []))
+            ids.add(child_id)
+            svc.db.update("seed_peer_clusters", record_id, {"scheduler_cluster_ids": sorted(ids)})
+        else:
+            return 404, {"error": f"no association {table}/{child_group}"}
+        return 200, {}
+
+    def _users(self, req: _Request) -> tuple[int, object]:
+        svc = self.service
+        if req.method == "POST" and req.parts == ["signup"]:
+            return 200, svc.sign_up(req.body["name"], req.body["password"], req.body.get("email", ""))
+        if req.method == "POST" and req.parts == ["signin"]:
+            token = svc.sign_in(req.body["name"], req.body["password"])
+            return 200, {"token": token}
+        if req.method == "POST" and req.parts == ["refresh_token"]:
+            token = svc.tokens.refresh(req.body.get("token", ""))
+            if token is None:
+                raise PermissionError("cannot refresh")
+            return 200, {"token": token}
+        if req.method == "GET" and not req.parts:
+            return 200, svc.get_users()
+        if not req.parts:
+            return 405, {"error": "method not allowed"}
+        user_id = int(req.parts[0])
+        if req.method == "POST" and req.parts[1:] == ["reset_password"]:
+            svc.reset_password(user_id, req.body["new_password"])
+            return 200, {}
+        if req.method == "GET" and req.parts[1:] == ["roles"]:
+            return 200, svc.enforcer.roles_for_user(svc.get_user(user_id)["name"])
+        if req.parts[1:2] == ["roles"] and len(req.parts) == 3:
+            name = svc.get_user(user_id)["name"]
+            if req.method == "PUT":
+                svc.enforcer.add_role_for_user(name, req.parts[2])
+                return 200, {}
+            if req.method == "DELETE":
+                svc.enforcer.delete_role_for_user(name, req.parts[2])
+                return 200, {}
+        if req.method == "GET":
+            return 200, svc.get_user(user_id)
+        if req.method == "PATCH":
+            return 200, svc.update_user(user_id, req.body)
+        return 405, {"error": "method not allowed"}
+
+    def _roles(self, req: _Request) -> tuple[int, object]:
+        enforcer = self.service.enforcer
+        if req.method == "POST" and not req.parts:
+            role = req.body["role"]
+            for perm in req.body.get("permissions", []):
+                enforcer.add_permission(role, perm["object"], perm["action"])
+            return 200, {}
+        if req.method == "GET" and not req.parts:
+            return 200, enforcer.roles()
+        role = req.parts[0]
+        if req.method == "GET":
+            return 200, [
+                {"object": o, "action": a} for o, a in enforcer.permissions_for_role(role)
+            ]
+        if req.method == "DELETE" and len(req.parts) == 1:
+            self.service.db.remove_rules("p", [role])
+            return 200, {}
+        if req.parts[1:] == ["permissions"]:
+            perm = req.body
+            if req.method == "POST":
+                enforcer.add_permission(role, perm["object"], perm["action"])
+                return 200, {}
+            if req.method == "DELETE":
+                enforcer.delete_permission(role, perm["object"], perm["action"])
+                return 200, {}
+        return 405, {"error": "method not allowed"}
+
+    def _jobs(self, req: _Request) -> tuple[int, object]:
+        svc = self.service
+        if req.method == "POST" and not req.parts:
+            return 200, svc.create_job(req.body)
+        if req.method == "GET" and not req.parts:
+            return 200, svc.db.list("jobs")
+        job_id = int(req.parts[0])
+        if req.method == "GET":
+            return 200, svc.db.get("jobs", job_id)
+        if req.method == "PATCH":
+            return 200, svc.db.update("jobs", job_id, req.body)
+        if req.method == "DELETE":
+            svc.db.delete("jobs", job_id)
+            return 200, {}
+        return 405, {"error": "method not allowed"}
+
+    def _update_model(self, req: _Request) -> tuple[int, object]:
+        """PATCH /models/:id with {"state": "active"} activates that version
+        everywhere (registry + DB mirror), matching
+        manager/service/model.go:109-190."""
+        record = self.service.db.get("models", int(req.parts[0]))
+        if req.body.get("state") == "active" and self.service.registry is not None:
+            self.service.activate_model(record["model_id"], record["version"])
+            return 200, self.service.db.get("models", record["id"])
+        return 200, self.service.db.update("models", record["id"], req.body)
+
+    def _pats(self, req: _Request) -> tuple[int, object]:
+        svc = self.service
+        if req.method == "POST" and not req.parts:
+            body = dict(req.body)
+            if req.user is not None:
+                body.setdefault("user_id", req.user.get("id"))
+            return 200, svc.create_personal_access_token(body)
+        return self._crud("personal_access_tokens", req)

@@ -1,0 +1,255 @@
+"""Manager service-facing RPC: the gRPC surface schedulers and daemons use.
+
+Capability parity with manager/rpcserver (manager_server_v1.go):
+GetScheduler/ListSchedulers for joining daemons, scheduler/seed-peer
+registration (UpdateScheduler/UpdateSeedPeer upserts), the KeepAlive
+client-stream (manager_server_v1.go:955-1000) that flips instances
+active/inactive, CreateModel (:802-952) streaming trained params into the
+registry, and the dynconfig fetch schedulers poll. Same length-prefixed
+msgpack wire protocol as the scheduler edge (rpc/wire.py); params ride as
+msgpack-serializable nested lists produced by the trainer's checkpoint
+codec.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+
+from dragonfly2_tpu.rpc import wire
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ messages
+
+
+@dataclasses.dataclass
+class GetSchedulersRequest:
+    ip: str
+    hostname: str
+    idc: str = ""
+    location: str = ""
+
+
+@dataclasses.dataclass
+class SchedulerEntry:
+    id: int
+    host_name: str
+    ip: str
+    port: int
+    state: str
+    scheduler_cluster_id: int
+
+
+@dataclasses.dataclass
+class GetSchedulersResponse:
+    schedulers: list[SchedulerEntry]
+
+
+@dataclasses.dataclass
+class RegisterInstanceRequest:
+    source_type: str  # "scheduler" | "seed_peer"
+    host_name: str
+    ip: str
+    port: int
+    cluster_id: int
+    idc: str = ""
+    location: str = ""
+
+
+@dataclasses.dataclass
+class RegisterInstanceResponse:
+    id: int
+    cluster_id: int
+
+
+@dataclasses.dataclass
+class KeepAliveRequest:
+    source_type: str
+    host_name: str
+    ip: str
+    cluster_id: int
+
+
+@dataclasses.dataclass
+class CreateModelRequest:
+    name: str
+    type: str
+    scheduler_host_id: str
+    params_blob: bytes
+    evaluation: dict
+
+
+@dataclasses.dataclass
+class CreateModelResponse:
+    model_id: str
+    version: int
+
+
+@dataclasses.dataclass
+class GetDynconfigRequest:
+    scheduler_cluster_id: int
+
+
+@dataclasses.dataclass
+class DynconfigResponse:
+    data: dict
+
+
+@dataclasses.dataclass
+class Ack:
+    ok: bool = True
+    error: str = ""
+
+
+wire.register_messages(
+    GetSchedulersRequest,
+    SchedulerEntry,
+    GetSchedulersResponse,
+    RegisterInstanceRequest,
+    RegisterInstanceResponse,
+    KeepAliveRequest,
+    CreateModelRequest,
+    CreateModelResponse,
+    GetDynconfigRequest,
+    DynconfigResponse,
+    Ack,
+)
+
+
+# -------------------------------------------------------------------- server
+
+
+class ManagerRPCServer:
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        logger.info("manager rpc listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                request = await wire.read_frame(reader)
+                if request is None:
+                    return
+                response = await asyncio.to_thread(self._dispatch, request)
+                if response is not None:
+                    wire.write_frame(writer, response)
+                    await writer.drain()
+        except Exception:  # noqa: BLE001 - one bad conn must not kill the server
+            logger.exception("manager connection handler failed")
+        finally:
+            writer.close()
+
+    def _dispatch(self, request):
+        svc = self.service
+        try:
+            if isinstance(request, GetSchedulersRequest):
+                conditions = {"idc": request.idc, "location": request.location}
+                rows = svc.list_schedulers(request.ip, request.hostname, conditions)
+                return GetSchedulersResponse(
+                    schedulers=[
+                        SchedulerEntry(
+                            id=r["id"],
+                            host_name=r["host_name"],
+                            ip=r["ip"],
+                            port=r.get("port", 0),
+                            state=r["state"],
+                            scheduler_cluster_id=r["scheduler_cluster_id"],
+                        )
+                        for r in rows
+                    ]
+                )
+            if isinstance(request, RegisterInstanceRequest):
+                body = {
+                    "host_name": request.host_name,
+                    "ip": request.ip,
+                    "port": request.port,
+                    "idc": request.idc,
+                    "location": request.location,
+                }
+                if request.source_type == "scheduler":
+                    body["scheduler_cluster_id"] = request.cluster_id
+                    record = svc.register_scheduler(body)
+                else:
+                    body["seed_peer_cluster_id"] = request.cluster_id
+                    record = svc.register_seed_peer(body)
+                return RegisterInstanceResponse(id=record["id"], cluster_id=request.cluster_id)
+            if isinstance(request, KeepAliveRequest):
+                svc.keepalive(request.source_type, request.host_name, request.ip, request.cluster_id)
+                return Ack()
+            if isinstance(request, CreateModelRequest):
+                from dragonfly2_tpu.registry.registry import ModelEvaluation
+                from dragonfly2_tpu.training.checkpoint import params_from_bytes
+
+                params = params_from_bytes(request.params_blob)
+                record = svc.create_model(
+                    request.name,
+                    request.type,
+                    request.scheduler_host_id,
+                    params,
+                    ModelEvaluation(**request.evaluation),
+                )
+                return CreateModelResponse(model_id=record["model_id"], version=record["version"])
+            if isinstance(request, GetDynconfigRequest):
+                return DynconfigResponse(data=svc.scheduler_dynconfig(request.scheduler_cluster_id))
+        except Exception as e:  # noqa: BLE001 - errors cross the wire as acks
+            return Ack(ok=False, error=f"{type(e).__name__}: {e}")
+        return Ack(ok=False, error=f"unknown request {type(request).__name__}")
+
+
+# -------------------------------------------------------------------- client
+
+
+class ManagerClient:
+    """Typed client with one connection, used by schedulers/daemons
+    (pkg/rpc/manager/client surface)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> "ManagerClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+
+    async def call(self, request):
+        async with self._lock:
+            assert self._writer is not None and self._reader is not None
+            wire.write_frame(self._writer, request)
+            await self._writer.drain()
+            response = await wire.read_frame(self._reader)
+        if isinstance(response, Ack) and not response.ok:
+            raise RuntimeError(response.error)
+        return response
+
+    async def keepalive_loop(self, request: KeepAliveRequest, interval: float = 5.0) -> None:
+        """The KeepAlive stream: fire until cancelled."""
+        while True:
+            try:
+                await self.call(request)
+            except (ConnectionError, RuntimeError) as e:
+                logger.warning("keepalive failed: %s", e)
+            await asyncio.sleep(interval)

@@ -1,0 +1,332 @@
+"""Manager service layer: business logic over the Database.
+
+Capability parity with manager/service/*.go (2,459 LoC of per-entity
+logic) + the gRPC-facing parts of manager/rpcserver: user signup/signin,
+cluster composites, scheduler/seed-peer registration and keepalive
+active/inactive flips (manager_server_v1.go:955-1000), searcher-ranked
+scheduler lists for joining daemons (ListSchedulers), model lifecycle
+bridging the DB metadata mirror to the native ModelRegistry (CreateModel,
+manager_server_v1.go:802-952; activate flip manager/service/model.go:
+109-190), preheat job fan-out, and the dynconfig payloads schedulers and
+daemons poll.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from dragonfly2_tpu.manager import auth
+from dragonfly2_tpu.manager.models import Database, DuplicateRecord, RecordNotFound
+from dragonfly2_tpu.manager.searcher import Searcher, new_searcher
+
+# scheduler/seed-peer service states (manager/models/{scheduler,seed_peer}.go)
+STATE_ACTIVE = "active"
+STATE_INACTIVE = "inactive"
+
+KEEPALIVE_TIMEOUT = 60.0  # mark inactive when silent this long
+
+
+class ManagerService:
+    def __init__(
+        self,
+        db: Database | None = None,
+        registry=None,
+        jobs=None,
+        token_authority: auth.TokenAuthority | None = None,
+        searcher: Searcher | None = None,
+        plugin_dir: str | None = None,
+    ):
+        self.db = db or Database()
+        self.registry = registry  # registry.ModelRegistry | None
+        self.jobs = jobs  # cluster.jobs.JobManager | None
+        self.tokens = token_authority or auth.TokenAuthority()
+        self.enforcer = auth.Enforcer(self.db)
+        self.searcher = searcher or new_searcher(plugin_dir)
+        self.enforcer.init_policies()
+        self._ensure_root_user()
+
+    def _ensure_root_user(self) -> None:
+        """First boot creates root/dragonfly with the root role
+        (rbac.go InitRBAC)."""
+        if self.db.count("users") == 0:
+            record = self.db.create(
+                "users",
+                {
+                    "name": "root",
+                    "email": "",
+                    "encrypted_password": auth.hash_password("dragonfly"),
+                    "state": "enable",
+                },
+            )
+            self.enforcer.add_role_for_user(record["name"], auth.ROOT_ROLE)
+
+    # ---------------------------------------------------------------- users
+
+    def sign_up(self, name: str, password: str, email: str = "", **extra) -> dict:
+        record = self.db.create(
+            "users",
+            {
+                "name": name,
+                "email": email,
+                "encrypted_password": auth.hash_password(password),
+                "state": "enable",
+                **extra,
+            },
+        )
+        self.enforcer.add_role_for_user(name, auth.GUEST_ROLE)
+        return _redact_user(record)
+
+    def sign_in(self, name: str, password: str) -> str:
+        user = self.db.find_one("users", {"name": name})
+        if user is None or user.get("state") != "enable":
+            raise PermissionError("unknown or disabled user")
+        if not auth.verify_password(password, user["encrypted_password"]):
+            raise PermissionError("bad credentials")
+        return self.tokens.issue(user["id"], name)
+
+    def reset_password(self, user_id: int, new_password: str) -> None:
+        self.db.update("users", user_id, {"encrypted_password": auth.hash_password(new_password)})
+
+    def get_user(self, user_id: int) -> dict:
+        return _redact_user(self.db.get("users", user_id))
+
+    def get_users(self) -> list[dict]:
+        return [_redact_user(u) for u in self.db.list("users")]
+
+    def update_user(self, user_id: int, patch: dict) -> dict:
+        patch.pop("encrypted_password", None)
+        return _redact_user(self.db.update("users", user_id, patch))
+
+    # ------------------------------------------------------------- clusters
+
+    def create_cluster(self, body: dict) -> dict:
+        """The composite Cluster entity: one scheduler cluster + one
+        seed-peer cluster created together (manager/service/cluster.go
+        CreateCluster creates+associates both)."""
+        name = body["name"]
+        sc = self.db.create(
+            "scheduler_clusters",
+            {
+                "name": f"{name}-scheduler",
+                "bio": body.get("bio", ""),
+                "config": body.get("scheduler_cluster_config", {}),
+                "client_config": body.get("peer_cluster_config", {}),
+                "scopes": body.get("scopes", {}),
+                "is_default": bool(body.get("is_default", False)),
+            },
+        )
+        spc = self.db.create(
+            "seed_peer_clusters",
+            {
+                "name": f"{name}-seed-peer",
+                "bio": body.get("bio", ""),
+                "config": body.get("seed_peer_cluster_config", {}),
+                "scheduler_cluster_ids": [sc["id"]],
+            },
+        )
+        return self.db.create(
+            "clusters",
+            {
+                "name": name,
+                "bio": body.get("bio", ""),
+                "scheduler_cluster_id": sc["id"],
+                "seed_peer_cluster_id": spc["id"],
+                "is_default": bool(body.get("is_default", False)),
+            },
+        )
+
+    def delete_cluster(self, cluster_id: int) -> None:
+        cluster = self.db.get("clusters", cluster_id)
+        for table, key in (
+            ("scheduler_clusters", "scheduler_cluster_id"),
+            ("seed_peer_clusters", "seed_peer_cluster_id"),
+        ):
+            try:
+                self.db.delete(table, cluster[key])
+            except RecordNotFound:
+                pass
+        self.db.delete("clusters", cluster_id)
+
+    # -------------------------------------------- schedulers and seed peers
+
+    def register_scheduler(self, body: dict) -> dict:
+        """Create-or-refresh by unique key, the UpdateScheduler/
+        CreateScheduler pair the gRPC GetScheduler path uses."""
+        body.setdefault("state", STATE_INACTIVE)
+        try:
+            return self.db.create("schedulers", body)
+        except DuplicateRecord:
+            existing = self.db.find_one(
+                "schedulers",
+                {k: body[k] for k in ("host_name", "ip", "scheduler_cluster_id")},
+            )
+            assert existing is not None
+            return self.db.update("schedulers", existing["id"], body)
+
+    def register_seed_peer(self, body: dict) -> dict:
+        body.setdefault("state", STATE_INACTIVE)
+        try:
+            return self.db.create("seed_peers", body)
+        except DuplicateRecord:
+            existing = self.db.find_one(
+                "seed_peers",
+                {k: body[k] for k in ("host_name", "ip", "seed_peer_cluster_id")},
+            )
+            assert existing is not None
+            return self.db.update("seed_peers", existing["id"], body)
+
+    def keepalive(self, source_type: str, host_name: str, ip: str, cluster_id: int) -> None:
+        """Mark the instance active and stamp it (KeepAlive stream recv,
+        manager_server_v1.go:955-1000)."""
+        table, key = _SOURCE_TABLES[source_type]
+        record = self.db.find_one(table, {"host_name": host_name, "ip": ip, key: cluster_id})
+        if record is None:
+            raise RecordNotFound(f"{source_type} {host_name}/{ip} not registered")
+        self.db.update(table, record["id"], {"state": STATE_ACTIVE, "keepalive_at": time.time()})
+
+    def expire_keepalives(self, timeout: float = KEEPALIVE_TIMEOUT) -> int:
+        """Sweep: instances silent > timeout flip inactive (the reference
+        flips on stream disconnect; polling covers crashed hosts too)."""
+        expired = 0
+        deadline = time.time() - timeout
+        for table in ("schedulers", "seed_peers"):
+            for record in self.db.list(table, {"state": STATE_ACTIVE}, per_page=100000):
+                if record.get("keepalive_at", 0) < deadline:
+                    self.db.update(table, record["id"], {"state": STATE_INACTIVE})
+                    expired += 1
+        return expired
+
+    def list_schedulers(self, ip: str, hostname: str, conditions: dict | None = None) -> list[dict]:
+        """Searcher-ranked active schedulers for a joining daemon
+        (manager_server_v1.go ListSchedulers → searcher.FindSchedulerClusters),
+        flattened best-cluster-first — the daemon dynconfig payload."""
+        clusters = []
+        for sc in self.db.list("scheduler_clusters"):
+            active = self.db.list(
+                "schedulers",
+                {"scheduler_cluster_id": sc["id"], "state": STATE_ACTIVE},
+            )
+            clusters.append({**sc, "schedulers": active})
+        try:
+            ranked = self.searcher.find_scheduler_clusters(clusters, ip, hostname, conditions)
+        except ValueError:
+            return []
+        return [s for cluster in ranked for s in cluster["schedulers"]]
+
+    # ---------------------------------------------------------------- models
+
+    def create_model(
+        self, name: str, model_type: str, scheduler_host_id: str, params, evaluation, metadata=None
+    ) -> dict:
+        """CreateModel: artifacts to the registry, metadata mirrored in the
+        DB (manager_server_v1.go:802-952)."""
+        if self.registry is None:
+            raise RuntimeError("manager has no model registry attached")
+        mv = self.registry.create_model_version(
+            name, model_type, scheduler_host_id, params, evaluation, metadata
+        )
+        return self.db.create(
+            "models",
+            {
+                "model_id": mv.model_id,
+                "name": mv.name,
+                "type": mv.type,
+                "version": mv.version,
+                "state": mv.state,
+                "evaluation": vars(mv.evaluation),
+                "scheduler_host_id": scheduler_host_id,
+            },
+        )
+
+    def activate_model(self, model_id: str, version: int) -> None:
+        if self.registry is None:
+            raise RuntimeError("manager has no model registry attached")
+        self.registry.activate(model_id, version)
+        for record in self.db.list("models", {"model_id": model_id}, per_page=100000):
+            state = "active" if record["version"] == version else "inactive"
+            self.db.update("models", record["id"], {"state": state})
+
+    # ----------------------------------------------------------------- jobs
+
+    def create_job(self, body: dict) -> dict:
+        job_type = body.get("type", "preheat")
+        record = self.db.create(
+            "jobs",
+            {
+                "type": job_type,
+                "state": "PENDING",
+                "args": body.get("args", {}),
+                "user_id": body.get("user_id"),
+                "result": {},
+            },
+        )
+        if self.jobs is not None and job_type == "preheat":
+            from dragonfly2_tpu.cluster.jobs import PreheatRequest
+
+            args = body.get("args", {})
+            urls = args.get("urls") or ([args["url"]] if args.get("url") else [])
+            result = self.jobs.create_preheat(
+                PreheatRequest(
+                    urls=urls,
+                    tag=args.get("tag", ""),
+                    application=args.get("application", ""),
+                    piece_length=args.get("piece_length", 4 << 20),
+                )
+            )
+            record = self.db.update(
+                "jobs",
+                record["id"],
+                {
+                    "state": result.state.value,
+                    "result": {"job_id": result.job_id, "task_ids": result.task_ids, **result.detail},
+                },
+            )
+        elif self.jobs is not None and job_type == "sync_peers":
+            record = self.db.update(
+                "jobs", record["id"], {"state": "SUCCESS", "result": self.jobs.sync_peers()}
+            )
+        return record
+
+    # --------------------------------------------------- personal access tokens
+
+    def create_personal_access_token(self, body: dict) -> dict:
+        token = os.urandom(20).hex()
+        return self.db.create(
+            "personal_access_tokens",
+            {
+                "name": body["name"],
+                "bio": body.get("bio", ""),
+                "token": token,
+                "scopes": body.get("scopes", []),
+                "state": "active",
+                "expired_at": body.get("expired_at", time.time() + 365 * 24 * 3600),
+                "user_id": body.get("user_id"),
+            },
+        )
+
+    # ------------------------------------------------------------ dynconfig
+
+    def scheduler_dynconfig(self, scheduler_cluster_id: int) -> dict:
+        """What a scheduler polls: its cluster config + client config +
+        the cluster's seed peers (scheduler/config/dynconfig.go get)."""
+        sc = self.db.get("scheduler_clusters", scheduler_cluster_id)
+        seed_peers = []
+        for spc in self.db.list("seed_peer_clusters", per_page=100000):
+            if scheduler_cluster_id in spc.get("scheduler_cluster_ids", []):
+                seed_peers += self.db.list("seed_peers", {"seed_peer_cluster_id": spc["id"]})
+        return {
+            "scheduler_cluster_config": sc.get("config", {}),
+            "client_config": sc.get("client_config", {}),
+            "seed_peers": seed_peers,
+        }
+
+
+_SOURCE_TABLES = {
+    "scheduler": ("schedulers", "scheduler_cluster_id"),
+    "seed_peer": ("seed_peers", "seed_peer_cluster_id"),
+}
+
+
+def _redact_user(record: dict) -> dict:
+    return {k: v for k, v in record.items() if k != "encrypted_password"}

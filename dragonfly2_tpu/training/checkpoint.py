@@ -40,3 +40,18 @@ class TrainCheckpointer:
 
     def close(self) -> None:
         self._mngr.close()
+
+
+def params_to_bytes(params: Any) -> bytes:
+    """Serialize a params pytree for the wire (the CreateModel stream,
+    manager_server_v1.go:802-952 — the reference ships model.graphdef
+    bytes; here it is msgpack'd arrays)."""
+    from flax import serialization
+
+    return serialization.msgpack_serialize(params)
+
+
+def params_from_bytes(blob: bytes) -> Any:
+    from flax import serialization
+
+    return serialization.msgpack_restore(blob)

@@ -1,0 +1,380 @@
+"""Manager control plane: DB, auth/RBAC, searcher, service, REST, RPC."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.manager import auth
+from dragonfly2_tpu.manager import rpc as mrpc
+from dragonfly2_tpu.manager import searcher as msearcher
+from dragonfly2_tpu.manager.models import Database, DuplicateRecord, RecordNotFound
+from dragonfly2_tpu.manager.rest import ManagerREST
+from dragonfly2_tpu.manager.service import ManagerService
+
+
+# ------------------------------------------------------------------ database
+
+
+def test_database_crud_roundtrip():
+    db = Database()
+    rec = db.create("applications", {"name": "app-1", "url": "http://x", "priority": {"value": 3}})
+    assert rec["id"] == 1 and rec["priority"] == {"value": 3}
+    assert db.get("applications", 1)["name"] == "app-1"
+    db.update("applications", 1, {"bio": "hello"})
+    assert db.get("applications", 1)["bio"] == "hello"
+    assert db.count("applications") == 1
+    db.delete("applications", 1)
+    with pytest.raises(RecordNotFound):
+        db.get("applications", 1)
+
+
+def test_database_unique_key_enforced():
+    db = Database()
+    db.create("schedulers", {"host_name": "h", "ip": "1.2.3.4", "scheduler_cluster_id": 1})
+    with pytest.raises(DuplicateRecord):
+        db.create("schedulers", {"host_name": "h", "ip": "1.2.3.4", "scheduler_cluster_id": 1})
+    # different cluster is fine (uk is composite, manager/models/scheduler.go)
+    db.create("schedulers", {"host_name": "h", "ip": "1.2.3.4", "scheduler_cluster_id": 2})
+
+
+def test_database_list_where_and_pagination():
+    db = Database()
+    for i in range(7):
+        db.create("jobs", {"type": "preheat", "state": "PENDING" if i % 2 else "SUCCESS"})
+    assert len(db.list("jobs", {"state": "PENDING"})) == 3
+    assert len(db.list("jobs", page=2, per_page=5)) == 2
+
+
+# ---------------------------------------------------------------------- auth
+
+
+def test_password_hash_and_verify():
+    enc = auth.hash_password("s3cret")
+    assert auth.verify_password("s3cret", enc)
+    assert not auth.verify_password("wrong", enc)
+
+
+def test_token_issue_verify_expiry_refresh():
+    ta = auth.TokenAuthority(ttl=100)
+    token = ta.issue(7, "alice")
+    claims = ta.verify(token)
+    assert claims["id"] == 7 and claims["name"] == "alice"
+    assert ta.verify(token + "x") is None
+    assert ta.verify(token, now=time.time() + 200) is None
+    assert ta.verify(ta.refresh(token)) is not None
+
+
+def test_rbac_root_all_guest_read():
+    db = Database()
+    enforcer = auth.Enforcer(db)
+    enforcer.init_policies()
+    enforcer.add_role_for_user("admin", auth.ROOT_ROLE)
+    enforcer.add_role_for_user("bob", auth.GUEST_ROLE)
+    assert enforcer.enforce("admin", "clusters", "*")
+    assert enforcer.enforce("bob", "clusters", "read")
+    assert not enforcer.enforce("bob", "clusters", "*")
+    assert not enforcer.enforce("nobody", "clusters", "read")
+    enforcer.delete_role_for_user("bob", auth.GUEST_ROLE)
+    assert not enforcer.enforce("bob", "clusters", "read")
+
+
+def test_personal_access_token_verification():
+    db = Database()
+    now = time.time()
+    db.create(
+        "personal_access_tokens",
+        {"name": "t", "token": "tok123", "state": "active", "expired_at": now + 60},
+    )
+    assert auth.verify_personal_access_token(db, "tok123") is not None
+    assert auth.verify_personal_access_token(db, "nope") is None
+    assert auth.verify_personal_access_token(db, "tok123", now=now + 120) is None
+
+
+# ------------------------------------------------------------------ searcher
+
+
+def test_searcher_weights_match_reference():
+    # cidr(0.3) + hostname(0.3) + idc(0.25) + location(0.14) + default(0.01)
+    scopes = msearcher.Scopes(
+        idc="idc-a", location="area|zone|rack", cidrs=["10.0.0.0/8"], hostnames=["worker-.*"]
+    )
+    score = msearcher.evaluate(
+        "10.1.2.3", "worker-7", {"idc": "idc-a", "location": "area|zone|rack"}, scopes, True
+    )
+    assert score == pytest.approx(1.0)
+    # two of three leading location elements match -> 2/5 of 0.14
+    partial = msearcher.multi_element_affinity_score("area|zone|other", "area|zone|rack")
+    assert partial == pytest.approx(2 / 5)
+    assert msearcher.idc_affinity_score("b", "a|b|c") == 1.0
+    assert msearcher.cidr_affinity_score("192.168.1.1", ["10.0.0.0/8"]) == 0.0
+
+
+def test_searcher_ranks_and_filters_clusters():
+    s = msearcher.Searcher()
+    near = {
+        "name": "near",
+        "scopes": {"idc": "idc-a"},
+        "is_default": False,
+        "schedulers": [{"host_name": "s1"}],
+    }
+    far = {
+        "name": "far",
+        "scopes": {"idc": "idc-z"},
+        "is_default": True,
+        "schedulers": [{"host_name": "s2"}],
+    }
+    empty = {"name": "empty", "scopes": {}, "is_default": True, "schedulers": []}
+    ranked = s.find_scheduler_clusters([far, near, empty], "1.1.1.1", "h", {"idc": "idc-a"})
+    assert [c["name"] for c in ranked] == ["near", "far"]
+    with pytest.raises(ValueError):
+        s.find_scheduler_clusters([empty], "1.1.1.1", "h", {})
+
+
+# ------------------------------------------------------------------- service
+
+
+def make_service(**kw) -> ManagerService:
+    return ManagerService(Database(), **kw)
+
+
+def test_service_root_user_and_signin():
+    svc = make_service()
+    token = svc.sign_in("root", "dragonfly")
+    claims = svc.tokens.verify(token)
+    assert claims["name"] == "root"
+    assert svc.enforcer.enforce("root", "users", "*")
+    with pytest.raises(PermissionError):
+        svc.sign_in("root", "wrong")
+
+
+def test_service_signup_gets_guest_role():
+    svc = make_service()
+    user = svc.sign_up("alice", "pw")
+    assert "encrypted_password" not in user
+    assert svc.enforcer.roles_for_user("alice") == [auth.GUEST_ROLE]
+
+
+def test_service_cluster_composite():
+    svc = make_service()
+    cluster = svc.create_cluster({"name": "c1", "scopes": {"idc": "a"}})
+    assert svc.db.count("scheduler_clusters") == 1
+    assert svc.db.count("seed_peer_clusters") == 1
+    svc.delete_cluster(cluster["id"])
+    assert svc.db.count("scheduler_clusters") == 0
+    assert svc.db.count("clusters") == 0
+
+
+def test_service_keepalive_flips_state():
+    svc = make_service()
+    svc.create_cluster({"name": "c1"})
+    rec = svc.register_scheduler(
+        {"host_name": "sched-1", "ip": "10.0.0.1", "port": 8002, "scheduler_cluster_id": 1}
+    )
+    assert rec["state"] == "inactive"
+    svc.keepalive("scheduler", "sched-1", "10.0.0.1", 1)
+    assert svc.db.get("schedulers", rec["id"])["state"] == "active"
+    # silent instance flips back on sweep
+    svc.db.update("schedulers", rec["id"], {"keepalive_at": time.time() - 120})
+    assert svc.expire_keepalives(timeout=60) == 1
+    assert svc.db.get("schedulers", rec["id"])["state"] == "inactive"
+    with pytest.raises(RecordNotFound):
+        svc.keepalive("scheduler", "ghost", "0.0.0.0", 1)
+
+
+def test_service_list_schedulers_ranked():
+    svc = make_service()
+    svc.create_cluster({"name": "a", "scopes": {"idc": "idc-a"}})
+    svc.create_cluster({"name": "b", "scopes": {"idc": "idc-b"}})
+    for i, cid in ((1, 1), (2, 2)):
+        svc.register_scheduler(
+            {"host_name": f"s{i}", "ip": f"10.0.0.{i}", "port": 8002, "scheduler_cluster_id": cid}
+        )
+        svc.keepalive("scheduler", f"s{i}", f"10.0.0.{i}", cid)
+    ranked = svc.list_schedulers("1.1.1.1", "host", {"idc": "idc-b"})
+    assert [s["host_name"] for s in ranked] == ["s2", "s1"]
+
+
+def test_service_dynconfig_payload():
+    svc = make_service()
+    svc.create_cluster({"name": "c1", "scheduler_cluster_config": {"x": 1}})
+    svc.register_seed_peer(
+        {"host_name": "seed", "ip": "10.0.0.9", "port": 8002, "seed_peer_cluster_id": 1}
+    )
+    payload = svc.scheduler_dynconfig(1)
+    assert payload["scheduler_cluster_config"] == {"x": 1}
+    assert payload["seed_peers"][0]["host_name"] == "seed"
+
+
+def test_service_model_lifecycle(tmp_path):
+    from dragonfly2_tpu.registry.registry import ModelEvaluation, ModelRegistry
+
+    registry = ModelRegistry(tmp_path)
+    svc = make_service(registry=registry)
+    params = {"w": [1.0, 2.0]}
+    rec1 = svc.create_model("ranker", "gnn", "host-1", params, ModelEvaluation(recall=0.9))
+    rec2 = svc.create_model("ranker", "gnn", "host-1", params, ModelEvaluation(recall=0.95))
+    assert rec2["version"] == 2
+    svc.activate_model(rec2["model_id"], 2)
+    states = {r["version"]: r["state"] for r in svc.db.list("models")}
+    assert states == {1: "inactive", 2: "active"}
+    assert registry.active_version(rec2["model_id"]).version == 2
+
+
+# ---------------------------------------------------------------------- REST
+
+
+@pytest.fixture()
+def rest_server():
+    svc = make_service()
+    server = ManagerREST(svc)
+    server.start()
+    yield server
+    server.stop()
+
+
+def _http(server: ManagerREST, method: str, path: str, body=None, token=None):
+    url = f"http://{server.host}:{server.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rest_signin_and_crud(rest_server):
+    status, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "root", "password": "dragonfly"})
+    assert status == 200
+    token = out["token"]
+    status, cluster = _http(
+        rest_server, "POST", "/api/v1/clusters", {"name": "c1", "is_default": True}, token
+    )
+    assert status == 200 and cluster["name"] == "c1"
+    status, clusters = _http(rest_server, "GET", "/api/v1/clusters", None, token)
+    assert status == 200 and len(clusters) == 1
+    status, _ = _http(rest_server, "DELETE", f"/api/v1/clusters/{cluster['id']}", None, token)
+    assert status == 200
+
+
+def test_rest_requires_auth_and_rbac(rest_server):
+    status, _ = _http(rest_server, "GET", "/api/v1/clusters")
+    assert status == 401
+    # guest can read but not write
+    _http(rest_server, "POST", "/api/v1/users/signup", {"name": "bob", "password": "pw"})
+    status, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "bob", "password": "pw"})
+    guest_token = out["token"]
+    status, _ = _http(rest_server, "GET", "/api/v1/clusters", None, guest_token)
+    assert status == 200
+    status, _ = _http(rest_server, "POST", "/api/v1/clusters", {"name": "x"}, guest_token)
+    assert status == 401
+
+
+def test_rest_duplicate_is_409_and_missing_404(rest_server):
+    _, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "root", "password": "dragonfly"})
+    token = out["token"]
+    body = {"name": "app"}
+    assert _http(rest_server, "POST", "/api/v1/applications", body, token)[0] == 200
+    assert _http(rest_server, "POST", "/api/v1/applications", body, token)[0] == 409
+    assert _http(rest_server, "GET", "/api/v1/applications/999", None, token)[0] == 404
+
+
+def test_rest_pat_flow_and_oapi(rest_server):
+    _, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "root", "password": "dragonfly"})
+    token = out["token"]
+    status, pat = _http(
+        rest_server, "POST", "/api/v1/personal-access-tokens", {"name": "ci"}, token
+    )
+    assert status == 200 and pat["state"] == "active"
+    # oapi jobs with the PAT
+    status, job = _http(rest_server, "POST", "/oapi/v1/jobs", {"type": "noop"}, pat["token"])
+    assert status == 200 and job["state"] == "PENDING"
+    status, _ = _http(rest_server, "GET", "/oapi/v1/clusters", None, "bad-token")
+    assert status == 401
+
+
+def test_rest_roles_endpoints(rest_server):
+    _, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "root", "password": "dragonfly"})
+    token = out["token"]
+    status, roles = _http(rest_server, "GET", "/api/v1/roles", None, token)
+    assert status == 200 and set(roles) >= {"root", "guest"}
+    status, perms = _http(rest_server, "GET", "/api/v1/roles/guest", None, token)
+    assert status == 200 and {"object": "clusters", "action": "read"} in perms
+    # grant bob root via the user-role route
+    _http(rest_server, "POST", "/api/v1/users/signup", {"name": "bob", "password": "pw"})
+    users = _http(rest_server, "GET", "/api/v1/users", None, token)[1]
+    bob_id = next(u["id"] for u in users if u["name"] == "bob")
+    assert _http(rest_server, "PUT", f"/api/v1/users/{bob_id}/roles/root", None, token)[0] == 200
+    status, bob_roles = _http(rest_server, "GET", f"/api/v1/users/{bob_id}/roles", None, token)
+    assert "root" in bob_roles
+
+
+# ----------------------------------------------------------------------- RPC
+
+
+def _run_async(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_manager_rpc_roundtrip(tmp_path):
+    from dragonfly2_tpu.registry.registry import ModelRegistry
+    from dragonfly2_tpu.training.checkpoint import params_to_bytes
+
+    async def scenario():
+        svc = ManagerService(Database(), registry=ModelRegistry(tmp_path))
+        svc.create_cluster({"name": "c1", "scopes": {"idc": "idc-a"}})
+        server = mrpc.ManagerRPCServer(svc)
+        host, port = await server.start()
+        client = await mrpc.ManagerClient(host, port).connect()
+        try:
+            reg = await client.call(
+                mrpc.RegisterInstanceRequest(
+                    source_type="scheduler", host_name="s1", ip="10.0.0.1", port=8002, cluster_id=1
+                )
+            )
+            assert reg.id == 1
+            await client.call(
+                mrpc.KeepAliveRequest(
+                    source_type="scheduler", host_name="s1", ip="10.0.0.1", cluster_id=1
+                )
+            )
+            got = await client.call(
+                mrpc.GetSchedulersRequest(ip="1.1.1.1", hostname="h", idc="idc-a")
+            )
+            assert [s.host_name for s in got.schedulers] == ["s1"]
+            import numpy as np
+
+            blob = params_to_bytes({"dense": {"kernel": np.ones((2, 2), np.float32)}})
+            created = await client.call(
+                mrpc.CreateModelRequest(
+                    name="ranker",
+                    type="gnn",
+                    scheduler_host_id="s1-host",
+                    params_blob=blob,
+                    evaluation={"recall": 0.8},
+                )
+            )
+            assert created.version == 1
+            dyn = await client.call(mrpc.GetDynconfigRequest(scheduler_cluster_id=1))
+            assert "scheduler_cluster_config" in dyn.data
+            # error path: keepalive for unknown instance -> RuntimeError
+            with pytest.raises(RuntimeError):
+                await client.call(
+                    mrpc.KeepAliveRequest(
+                        source_type="scheduler", host_name="ghost", ip="0.0.0.0", cluster_id=1
+                    )
+                )
+        finally:
+            await client.close()
+            await server.stop()
+
+    _run_async(scenario())

@@ -140,3 +140,115 @@ def test_proxy_auth_and_whitelist(tmp_path, origin):
             await proxy.stop()
 
     asyncio.run(run())
+
+
+def test_parse_range():
+    from dragonfly2_tpu.client.transport import parse_range
+
+    assert parse_range("bytes=0-99", 1000) == (0, 99)
+    assert parse_range("bytes=500-", 1000) == (500, 999)
+    assert parse_range("bytes=-100", 1000) == (900, 999)
+    assert parse_range("bytes=0-5000", 1000) == (0, 999)  # end clamped
+    assert parse_range("bytes=2000-", 1000) is None  # unsatisfiable
+    assert parse_range(None, 1000) is None
+    assert parse_range("bytes=-", 1000) is None
+    assert parse_range("weird", 1000) is None
+
+
+def test_proxy_forwards_method_body_and_strips_hop_headers(origin):
+    """Non-GET requests keep their method and body; hop-by-hop headers and
+    the proxy's own credentials never reach the origin."""
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            seen["method"] = self.command
+            seen["body"] = self.rfile.read(length)
+            seen["proxy_auth"] = self.headers.get("Proxy-Authorization")
+            seen["custom"] = self.headers.get("X-Custom")
+            out = b"posted"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    upstream = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+    uport = upstream.server_address[1]
+
+    async def run():
+        transport = P2PTransport(daemon=None, rules=[])
+        proxy = ProxyServer(transport, basic_auth=("root", "secret"))
+        phost, pport = await proxy.start()
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{uport}/submit", data=b'{"k":1}', method="POST"
+            )
+            req.set_proxy(f"{phost}:{pport}", "http")
+            req.add_header(
+                "Proxy-Authorization",
+                "Basic " + base64.b64encode(b"root:secret").decode(),
+            )
+            req.add_header("X-Custom", "yes")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read()
+
+        try:
+            body = await asyncio.to_thread(post)
+            assert body == b"posted"
+            assert seen["method"] == "POST"
+            assert seen["body"] == b'{"k":1}'
+            assert seen["proxy_auth"] is None  # credentials not leaked
+            assert seen["custom"] == "yes"  # end-to-end headers kept
+        finally:
+            await proxy.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        upstream.shutdown()
+        upstream.server_close()
+
+
+def test_proxy_p2p_range_request(tmp_path, origin):
+    """Ranged GETs through the p2p path return the requested slice with
+    206 (a resuming registry client must not get the whole blob as 200)."""
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 16
+        sched = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        shost, sport = await sched.start()
+        daemon = Daemon(tmp_path / "d", [(shost, sport)], hostname="range-host")
+        await daemon.start()
+        transport = P2PTransport(daemon, rules=[ProxyRule(regex=r"blob\.bin")])
+        proxy = ProxyServer(transport)
+        phost, pport = await proxy.start()
+
+        def ranged(url: str, spec: str):
+            req = urllib.request.Request(url)
+            req.set_proxy(f"{phost}:{pport}", "http")
+            req.add_header("Range", spec)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read()
+
+        try:
+            status, body = await asyncio.to_thread(
+                ranged, f"http://127.0.0.1:{origin}/blob.bin", "bytes=1000-1999"
+            )
+            assert status == 206
+            assert body == PAYLOAD[1000:2000]
+        finally:
+            await proxy.stop()
+            await daemon.stop()
+            await sched.stop()
+
+    asyncio.run(run())
