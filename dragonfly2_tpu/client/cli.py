@@ -86,6 +86,8 @@ def _dfcache(args) -> int:
 
 
 def _dfstore(args) -> int:
+    if getattr(args, "endpoint", ""):
+        return _dfstore_remote(args)
     storage = StorageManager(args.data_dir)
     if args.action == "get":
         ts = storage.find_completed_task(args.task_id)
@@ -106,6 +108,31 @@ def _dfstore(args) -> int:
         with open(ts.data_path, "rb") as f:
             print(sha256_from_reader(f))
         return 0
+    raise AssertionError(args.action)
+
+
+def _dfstore_remote(args) -> int:
+    """dfstore against a daemon's object-storage HTTP API
+    (client/dfstore/dfstore.go wraps exactly this surface)."""
+    from dragonfly2_tpu.objectstorage.service import DfstoreClient
+    from dragonfly2_tpu.utils import dferrors
+
+    client = DfstoreClient(args.endpoint)
+    try:
+        if args.action == "get":
+            sys.stdout.buffer.write(client.get_object(args.bucket, args.key))
+            return 0
+        if args.action == "put":
+            client.put_object(args.bucket, args.key, pathlib.Path(args.path).read_bytes())
+            return 0
+        if args.action == "sum":
+            meta = client.object_metadatas(args.bucket, prefix=args.key)
+            for m in meta:
+                print(m["etag"] or m["content_length"], m["key"])
+            return 0
+    except dferrors.NotFound as e:
+        print(e, file=sys.stderr)
+        return 1
     raise AssertionError(args.action)
 
 
@@ -136,6 +163,9 @@ def build_parser() -> argparse.ArgumentParser:
     store.add_argument("--data-dir", default=".dfget-data")
     store.add_argument("--task-id", default="")
     store.add_argument("--path", default="")
+    store.add_argument("--endpoint", default="", help="daemon object-storage URL")
+    store.add_argument("--bucket", default="")
+    store.add_argument("--key", default="")
     return parser
 
 

@@ -41,6 +41,7 @@ class Daemon:
         total_rate_bps: float = 0.0,
         gc_interval: float = 60.0,
         probe_interval: float = 0.0,  # 0 disables the probe loop
+        object_storage: bool = False,
     ):
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
@@ -58,9 +59,25 @@ class Daemon:
                    runner=lambda: self.storage.run_gc())
         )
         self.probe_interval = probe_interval
+        self.object_storage = None
+        if object_storage:
+            # optional object-storage HTTP listener (daemon.go:525-604
+            # serves it alongside upload/proxy when configured)
+            from dragonfly2_tpu.objectstorage.backends import FilesystemBackend
+            from dragonfly2_tpu.objectstorage.service import ObjectStorageService
+
+            backend = FilesystemBackend(pathlib.Path(data_dir) / "objects")
+            self.object_storage = ObjectStorageService(backend, storage=self.storage, host=ip)
         self._probe_task: asyncio.Task | None = None
+        self._seed_tasks: list[asyncio.Task] = []
         self._running: dict[str, asyncio.Task] = {}  # task dedup
         self._announced: set[str] = set()  # scheduler addrs we announced to
+
+    @property
+    def is_seed(self) -> bool:
+        """Non-normal host types serve as seed peers (pkg/types HostType:
+        super/strong/weak vs normal; client seeder rpcserver/seeder.go)."""
+        return self.host_type != "normal"
 
     # ------------------------------------------------------------ lifecycle
 
@@ -79,17 +96,30 @@ class Daemon:
     async def start(self) -> None:
         self.upload.start()
         self.gc.start()
+        if self.object_storage is not None:
+            self.object_storage.start()
         if self.probe_interval > 0:
             self._probe_task = asyncio.create_task(self._probe_loop())
+        if self.is_seed:
+            # Seed mode: connect + announce to every scheduler up front so
+            # TriggerSeedRequests can reach this host, then serve them
+            # (ObtainSeeds, rpcserver/seeder.go:53).
+            for conn in await self.pool.connect_all():
+                await self._ensure_announced(conn)
+                self._seed_tasks.append(asyncio.create_task(self._seed_loop(conn)))
         logger.info("daemon %s up (upload :%d)", self.host_id, self.upload.port)
 
     async def stop(self, leave: bool = True) -> None:
-        if self._probe_task:
-            self._probe_task.cancel()
+        for task in (self._probe_task, *self._seed_tasks):
+            if task is None:
+                continue
+            task.cancel()
             try:
-                await self._probe_task
+                await task
             except asyncio.CancelledError:
                 pass
+        self._probe_task = None
+        self._seed_tasks.clear()
         for task in list(self._running.values()):
             task.cancel()
         if leave:
@@ -101,6 +131,8 @@ class Daemon:
                     pass
         await self.pool.close()
         self.gc.stop()
+        if self.object_storage is not None:
+            self.object_storage.stop()
         self.upload.stop()
 
     # ------------------------------------------------------------ download
@@ -168,6 +200,29 @@ class Daemon:
             return
         await conn.send(msg.AnnounceHostRequest(host=self.host_info()))
         self._announced.add(key)
+
+    # ---------------------------------------------------------- seed peer
+
+    async def _seed_loop(self, conn) -> None:
+        """Serve TriggerSeedRequests from one scheduler connection: back-
+        source the task so the cluster has a parent (ObtainSeeds)."""
+        while True:
+            trigger = await conn.seed_triggers.get()
+            asyncio.create_task(self._obtain_seed(trigger))
+
+    async def _obtain_seed(self, trigger) -> None:
+        try:
+            await self.download(
+                trigger.url,
+                tag=trigger.tag,
+                application=trigger.application,
+                piece_length=trigger.piece_length,
+                back_source_allowed=True,
+                schedule_timeout=0.5,  # seeds go straight to origin
+            )
+            logger.info("seeded task %s from %s", trigger.task_id, trigger.url)
+        except Exception:  # noqa: BLE001 - a failed seed must not kill the loop
+            logger.exception("seed download failed for %s", trigger.url)
 
     # -------------------------------------------------------------- probes
 

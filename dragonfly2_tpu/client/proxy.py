@@ -113,13 +113,15 @@ class ProxyServer:
                 self.stats["direct"] += 1
                 return
             try:
-                body, via = await self.transport.fetch(url, upstream_headers)
+                result = await self.transport.fetch(url, upstream_headers)
             except Exception as e:  # noqa: BLE001 - proxy reports, never dies
                 await self._respond(writer, 502, str(e).encode())
                 return
-            self.stats[via] += 1
-            status = 206 if "range" in headers else 200
-            await self._respond(writer, status, body, extra=f"X-Dragonfly-Via: {via}\r\n")
+            self.stats[result.via] += 1
+            extra = f"X-Dragonfly-Via: {result.via}\r\n"
+            if result.content_range:
+                extra += f"Content-Range: {result.content_range}\r\n"
+            await self._respond(writer, result.status, result.body, extra=extra)
         except (ConnectionError, asyncio.IncompleteReadError, ValueError):
             pass
         finally:

@@ -48,11 +48,11 @@ class P2PTransport:
                 return rule.rewrite(url), rule
         return url, None
 
-    async def fetch(self, url: str, headers: dict | None = None) -> tuple[bytes, str]:
-        """Returns (body, via) where via is 'p2p' or 'direct'. The p2p path
-        honors a `Range: bytes=a-b` request header by slicing the cached
-        task (the reference serves ranged requests out of the piece store,
-        transport.go + storage reuse-by-range)."""
+    async def fetch(self, url: str, headers: dict | None = None) -> "FetchResult":
+        """The p2p path honors a `Range: bytes=a-b` request header by
+        slicing the cached task (the reference serves ranged requests out
+        of the piece store, transport.go + storage reuse-by-range); the
+        direct path forwards Range and reports the origin's own status."""
         headers = headers or {}
         target, rule = self.route(url)
         if rule is not None and not rule.direct:
@@ -61,9 +61,20 @@ class P2PTransport:
             rng = parse_range(_header(headers, "range"), total)
             if rng is not None:
                 start, end = rng
-                return ts.read_range(start, end - start + 1), "p2p"
-            return ts.read_range(0, total), "p2p"
-        return await self._direct(target, headers), "direct"
+                return FetchResult(
+                    status=206,
+                    body=ts.read_range(start, end - start + 1),
+                    via="p2p",
+                    content_range=f"bytes {start}-{end}/{total}",
+                )
+            return FetchResult(status=200, body=ts.read_range(0, total), via="p2p")
+        status, resp_headers, body = await self._direct_full(target, headers)
+        return FetchResult(
+            status=status,
+            body=body,
+            via="direct",
+            content_range=resp_headers.get("Content-Range", ""),
+        )
 
     async def _direct(
         self,
@@ -72,14 +83,32 @@ class P2PTransport:
         method: str = "GET",
         body: bytes | None = None,
     ) -> bytes:
+        _, _, data = await self._direct_full(url, headers, method, body)
+        return data
+
+    async def _direct_full(
+        self,
+        url: str,
+        headers: dict | None,
+        method: str = "GET",
+        body: bytes | None = None,
+    ) -> tuple[int, dict, bytes]:
         import asyncio
 
         def run():
             req = urllib.request.Request(url, data=body, headers=headers or {}, method=method)
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
+                return resp.status, dict(resp.headers), resp.read()
 
         return await asyncio.to_thread(run)
+
+
+@dataclasses.dataclass
+class FetchResult:
+    status: int
+    body: bytes
+    via: str
+    content_range: str = ""
 
 
 def parse_range(header: str | None, total: int) -> tuple[int, int] | None:

@@ -207,6 +207,23 @@ class ProbeFinishedRequest:
     results: list[ProbeResult]
 
 
+# ------------------------------------------------------ seed-peer trigger
+
+@dataclasses.dataclass
+class TriggerSeedRequest:
+    """Scheduler -> seed daemon: download this task from origin so the
+    cluster has a parent (resource/seed_peer.go:101 TriggerTask /
+    cdnsystem ObtainSeeds, client rpcserver/seeder.go:53). Pushed over the
+    seed host's announce connection."""
+
+    host_id: str
+    task_id: str
+    url: str
+    piece_length: int = 4 << 20
+    tag: str = ""
+    application: str = ""
+
+
 # ----------------------------------------------------------------- stat
 
 @dataclasses.dataclass

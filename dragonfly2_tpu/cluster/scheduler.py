@@ -103,6 +103,12 @@ class SchedulerService:
         self._dag_slot_peer: dict[str, dict[int, str]] = {}
         self._pending: dict[str, _Pending] = {}
         self._host_info: dict[str, msg.HostInfo] = {}
+        # Seed-peer trigger path (resource/seed_peer.go TriggerTask): seed
+        # hosts announce with a non-normal type; first-seen tasks enqueue a
+        # trigger the RPC edge pushes to one of them round-robin.
+        self._seed_hosts: list[str] = []
+        self._seed_rr = 0
+        self.seed_triggers: list[msg.TriggerSeedRequest] = []
         # Serializes stream handlers vs the batched tick when the RPC edge
         # drives them from different threads (rpc/server.py). In-proc tests
         # and the simulator are single-threaded and unaffected.
@@ -131,6 +137,8 @@ class SchedulerService:
     def announce_host(self, host: msg.HostInfo) -> int:
         """AnnounceHost: upsert SoA host row (service_v2 AnnounceHost)."""
         self._host_info[host.host_id] = host
+        if host.host_type != "normal" and host.host_id not in self._seed_hosts:
+            self._seed_hosts.append(host.host_id)
         rec = HostRecord(
             id=host.host_id,
             type=host.host_type,
@@ -162,6 +170,8 @@ class SchedulerService:
                 self._leave_peer(peer_id)
         self.state.remove_host(host_id)
         self._host_info.pop(host_id, None)
+        if host_id in self._seed_hosts:
+            self._seed_hosts.remove(host_id)
 
     def register_peer(self, req: msg.RegisterPeerRequest):
         """handleRegisterPeerRequest (+ handleResource): upsert host/task/
@@ -180,6 +190,32 @@ class SchedulerService:
         )
         if self.state.task_state[task_idx] != int(TaskState.RUNNING):
             self.state.task_event(task_idx, TaskEvent.DOWNLOAD)
+
+        # First peer on a task triggers a seed download so the cluster gets
+        # a parent (service_v1.go:824 triggerTask -> seed_peer.go:101;
+        # priority 1 = back-to-source directly, skip the seed). The queue
+        # is bounded so it cannot grow without limit when no RPC edge
+        # drains it (in-proc simulator).
+        if (
+            req.url
+            and self._seed_hosts
+            and req.priority != 1
+            and len(self.seed_triggers) < 1024
+            and not self._task_peers.get(req.task_id)
+            and req.host.host_id not in self._seed_hosts
+        ):
+            seed_host = self._seed_hosts[self._seed_rr % len(self._seed_hosts)]
+            self._seed_rr += 1
+            self.seed_triggers.append(
+                msg.TriggerSeedRequest(
+                    host_id=seed_host,
+                    task_id=req.task_id,
+                    url=req.url,
+                    piece_length=req.piece_length,
+                    tag=req.tag,
+                    application=req.application,
+                )
+            )
 
         # Re-register of a known peer is load-not-create (service_v2
         # handleResource): keep its FSM/DAG state, just leave it queued.

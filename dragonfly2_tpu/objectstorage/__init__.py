@@ -1,0 +1,15 @@
+"""Object storage: backends + the daemon's S3-ish HTTP service.
+
+Capability parity with pkg/objectstorage (S3/OSS/OBS behind one interface,
+objectstorage.go:206-211) and client/daemon/objectstorage (the daemon's
+object-storage HTTP API backed by P2P, objectstorage.go:724).
+"""
+
+from dragonfly2_tpu.objectstorage.backends import (
+    FilesystemBackend,
+    ObjectMetadata,
+    new_backend,
+)
+from dragonfly2_tpu.objectstorage.service import ObjectStorageService
+
+__all__ = ["FilesystemBackend", "ObjectMetadata", "new_backend", "ObjectStorageService"]

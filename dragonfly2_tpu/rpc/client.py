@@ -34,6 +34,7 @@ class SchedulerConnection:
         self._responses: dict[str, asyncio.Queue] = {}
         self._stats: asyncio.Queue = asyncio.Queue()
         self._probe_targets: asyncio.Queue = asyncio.Queue()
+        self.seed_triggers: asyncio.Queue = asyncio.Queue()
         self._reader_task: asyncio.Task | None = None
         self._send_lock = asyncio.Lock()
 
@@ -67,6 +68,8 @@ class SchedulerConnection:
                 self._stats.put_nowait(response)
             elif isinstance(response, msg.ProbeTargetsResponse):
                 self._probe_targets.put_nowait(response)
+            elif isinstance(response, msg.TriggerSeedRequest):
+                self.seed_triggers.put_nowait(response)
             else:
                 peer_id = getattr(response, "peer_id", "")
                 q = self._responses.get(peer_id)
@@ -136,6 +139,19 @@ class SchedulerClientPool:
 
     def connections(self) -> list[SchedulerConnection]:
         return list(self._conns.values())
+
+    async def connect_all(self) -> list[SchedulerConnection]:
+        """Open a connection to every reachable scheduler (seed daemons
+        must be reachable for triggers before any task touches them). Dead
+        schedulers are skipped — the lazy per-task path retries them."""
+        async with self._lock:
+            for key, (host, port) in self._addr.items():
+                if key not in self._conns:
+                    try:
+                        self._conns[key] = await SchedulerConnection(host, port).connect()
+                    except OSError as e:
+                        logger.warning("scheduler %s unreachable: %s", key, e)
+            return list(self._conns.values())
 
     async def close(self) -> None:
         async with self._lock:

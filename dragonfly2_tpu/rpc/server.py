@@ -32,6 +32,7 @@ class SchedulerRPCServer:
         self.tick_interval = tick_interval
         self._server: asyncio.AbstractServer | None = None
         self._peer_conn: dict[str, asyncio.StreamWriter] = {}
+        self._host_conn: dict[str, asyncio.StreamWriter] = {}
         self._writers: set[asyncio.StreamWriter] = set()
         self._tick_task: asyncio.Task | None = None
         self._lock = asyncio.Lock()
@@ -72,16 +73,22 @@ class SchedulerRPCServer:
     async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._writers.add(writer)
         owned_peers: set[str] = set()
+        owned_hosts: set[str] = set()
         try:
             while True:
                 request = await wire.read_frame(reader)
                 if request is None:
                     return
                 self._m_requests.labels(type(request).__name__).inc()
+                if isinstance(request, msg.AnnounceHostRequest):
+                    async with self._lock:
+                        self._host_conn[request.host.host_id] = writer
+                        owned_hosts.add(request.host.host_id)
                 response = await self._dispatch_locked(request, writer, owned_peers)
                 if response is not None:
                     wire.write_frame(writer, response)
                     await writer.drain()
+                await self._drain_seed_triggers()
         except Exception:  # noqa: BLE001 - one bad conn must not kill the server
             logger.exception("connection handler failed")
         finally:
@@ -89,7 +96,38 @@ class SchedulerRPCServer:
             async with self._lock:
                 for peer_id in owned_peers:
                     self._peer_conn.pop(peer_id, None)
+                for host_id in owned_hosts:
+                    self._host_conn.pop(host_id, None)
             writer.close()
+
+    async def _drain_seed_triggers(self) -> None:
+        """Push queued TriggerSeedRequests to their seed hosts' announce
+        connections (the scheduler->seed-peer ObtainSeeds edge)."""
+        svc = self.service
+        if not svc.seed_triggers:
+            return
+        with svc.mu:
+            triggers, svc.seed_triggers = svc.seed_triggers, []
+        for trigger in triggers:
+            # Fall back to any connected seed host when the round-robin
+            # choice has no live connection (crashed seed without
+            # LeaveHost): a dropped trigger strands no-back-source peers.
+            async with self._lock:
+                writer = self._host_conn.get(trigger.host_id)
+                if writer is None:
+                    with svc.mu:
+                        candidates = [h for h in svc._seed_hosts if h in self._host_conn]
+                    if candidates:
+                        trigger.host_id = candidates[0]
+                        writer = self._host_conn[trigger.host_id]
+            if writer is None:
+                logger.warning("no connected seed host for task %s", trigger.task_id)
+                continue
+            try:
+                wire.write_frame(writer, trigger)
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                logger.warning("seed trigger to %s failed", trigger.host_id)
 
     async def _dispatch_locked(self, request, writer, owned_peers: set[str]):
         """Service mutations run off-loop under service.mu so they never

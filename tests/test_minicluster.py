@@ -183,3 +183,49 @@ def test_empty_task_fast_path(tmp_path):
             await server.stop()
 
     asyncio.run(run())
+
+
+def test_seed_peer_trigger(tmp_path, origin):
+    """A first-seen task triggers a seed download (seed_peer.go:101
+    TriggerTask / ObtainSeeds): a peer that may NOT back-source still gets
+    the file, because the scheduler told the seed host to fetch it."""
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        sha = hashlib.sha256(origin.payload).hexdigest()
+        try:
+            seed = Daemon(
+                tmp_path / "seed", [(host, port)], hostname="seed-1", host_type="super"
+            )
+            await seed.start()
+            assert seed.is_seed
+            # scheduler learns the seed host from its announce (async)
+            for _ in range(100):
+                if service._seed_hosts:
+                    break
+                await asyncio.sleep(0.05)
+            assert service._seed_hosts == [seed.host_id]
+
+            normal = Daemon(tmp_path / "n1", [(host, port)], hostname="normal-1")
+            await normal.start()
+            ts = await normal.download(
+                origin.url(),
+                piece_length=32 * 1024,
+                back_source_allowed=False,
+                schedule_timeout=30.0,
+            )
+            with open(ts.data_path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == sha
+            # the bytes came through the seed: origin was hit by the seed's
+            # back-source, and the seed holds the completed task locally
+            assert origin.get_count > 0
+            seed_ts = seed.storage.find_completed_task(ts.meta.task_id)
+            assert seed_ts is not None and seed_ts.meta.done
+            await normal.stop()
+            await seed.stop()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,154 @@
+"""Object storage: backends, daemon HTTP service, dfstore SDK/CLI
+(pkg/objectstorage + client/daemon/objectstorage + client/dfstore parity)."""
+
+import pytest
+
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.objectstorage.backends import (
+    FilesystemBackend,
+    new_backend,
+    object_task_id,
+)
+from dragonfly2_tpu.objectstorage.service import DfstoreClient, ObjectStorageService
+from dragonfly2_tpu.utils import dferrors
+
+
+def test_fs_backend_bucket_and_object_crud(tmp_path):
+    be = FilesystemBackend(tmp_path)
+    be.create_bucket("models")
+    assert be.is_bucket_exist("models")
+    meta = be.put_object("models", "ranker/1/model.bin", b"weights")
+    assert meta.content_length == 7 and meta.etag
+    assert be.get_object("models", "ranker/1/model.bin") == b"weights"
+    assert be.get_object("models", "ranker/1/model.bin", range_=(1, 3)) == b"eig"
+    be.copy_object("models", "ranker/1/model.bin", "ranker/2/model.bin")
+    keys = [m.key for m in be.get_object_metadatas("models", prefix="ranker/")]
+    assert keys == ["ranker/1/model.bin", "ranker/2/model.bin"]
+    with pytest.raises(dferrors.InvalidArgument):
+        be.delete_bucket("models")  # not empty
+    be.delete_object("models", "ranker/1/model.bin")
+    be.delete_object("models", "ranker/2/model.bin")
+    be.delete_bucket("models")
+    assert not be.is_bucket_exist("models")
+
+
+def test_fs_backend_rejects_escapes(tmp_path):
+    be = FilesystemBackend(tmp_path)
+    be.create_bucket("b")
+    with pytest.raises(dferrors.InvalidArgument):
+        be.put_object("b", "../escape", b"x")
+    with pytest.raises(dferrors.InvalidArgument):
+        be.create_bucket("nested/bucket")
+    with pytest.raises(dferrors.NotFound):
+        be.get_object("b", "missing")
+
+
+def test_new_backend_vendor_gating(tmp_path):
+    assert new_backend("fs", tmp_path).name == "fs"
+    for vendor in ("s3", "oss", "obs"):
+        with pytest.raises(dferrors.Unavailable):
+            new_backend(vendor)
+    with pytest.raises(dferrors.InvalidArgument):
+        new_backend("gcs")
+
+
+@pytest.fixture()
+def service(tmp_path):
+    storage = StorageManager(tmp_path / "tasks")
+    svc = ObjectStorageService(FilesystemBackend(tmp_path / "objects"), storage=storage)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def test_object_service_http_roundtrip(service):
+    client = DfstoreClient(f"http://{service.host}:{service.port}")
+    client.create_bucket("blobs")
+    assert [b["name"] for b in client.list_buckets()] == ["blobs"]
+    payload = bytes(range(256)) * 100
+    client.put_object("blobs", "dir/a.bin", payload)
+    assert client.get_object("blobs", "dir/a.bin") == payload
+    assert client.is_object_exist("blobs", "dir/a.bin")
+    assert not client.is_object_exist("blobs", "nope")
+    client.copy_object("blobs", "dir/a.bin", "dir/b.bin")
+    keys = [m["key"] for m in client.object_metadatas("blobs", prefix="dir/")]
+    assert keys == ["dir/a.bin", "dir/b.bin"]
+    client.delete_object("blobs", "dir/a.bin")
+    with pytest.raises(dferrors.NotFound):
+        client.get_object("blobs", "dir/a.bin")
+
+
+def test_put_imports_into_p2p_task_storage(service):
+    """PUT seeds the object into task storage so peers can pull pieces
+    (the reference's import-to-seed-peer modes)."""
+    client = DfstoreClient(f"http://{service.host}:{service.port}")
+    client.create_bucket("b")
+    client.put_object("b", "k.bin", b"shared-bytes")
+    ts = service.storage.find_completed_task(object_task_id("b", "k.bin"))
+    assert ts is not None and ts.meta.done
+    assert ts.read_range(0, 12) == b"shared-bytes"
+    # backend miss falls back to the P2P cache
+    service.backend.delete_object("b", "k.bin")
+    assert client.get_object("b", "k.bin") == b"shared-bytes"
+
+
+def test_dfstore_cli_remote(service, tmp_path, capsys):
+    from dragonfly2_tpu.client.cli import main
+
+    client = DfstoreClient(f"http://{service.host}:{service.port}")
+    client.create_bucket("cli")
+    src = tmp_path / "upload.bin"
+    src.write_bytes(b"cli-payload")
+    endpoint = f"http://{service.host}:{service.port}"
+    assert main(["dfstore", "put", "--endpoint", endpoint, "--bucket", "cli",
+                 "--key", "x.bin", "--path", str(src)]) == 0
+    assert main(["dfstore", "get", "--endpoint", endpoint, "--bucket", "cli",
+                 "--key", "x.bin"]) == 0
+    out = capsys.readouterr().out
+    assert "cli-payload" in out
+    assert main(["dfstore", "get", "--endpoint", endpoint, "--bucket", "cli",
+                 "--key", "missing"]) == 1
+
+
+def test_daemon_object_storage_listener(tmp_path):
+    import asyncio
+
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 8
+        cfg.scheduler.max_tasks = 8
+        server = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        host, port = await server.start()
+        daemon = Daemon(tmp_path / "d", [(host, port)], hostname="obj-host", object_storage=True)
+        await daemon.start()
+        try:
+            assert daemon.object_storage is not None
+            client = DfstoreClient(
+                f"http://{daemon.object_storage.host}:{daemon.object_storage.port}"
+            )
+            client.create_bucket("x")
+            client.put_object("x", "y", b"z")
+            assert client.get_object("x", "y") == b"z"
+        finally:
+            await daemon.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fs_backend_sibling_bucket_prefix_escape(tmp_path):
+    """Keys must not traverse into sibling buckets sharing a name prefix
+    (string-prefix path checks are not containment checks)."""
+    be = FilesystemBackend(tmp_path)
+    be.create_bucket("a")
+    be.create_bucket("ab")
+    be.put_object("ab", "secret", b"private")
+    with pytest.raises(dferrors.InvalidArgument):
+        be.get_object("a", "../ab/secret")
+    with pytest.raises(dferrors.InvalidArgument):
+        be.put_object("a", "../ab/planted", b"x")

@@ -252,3 +252,46 @@ def test_proxy_p2p_range_request(tmp_path, origin):
             await sched.stop()
 
     asyncio.run(run())
+
+
+def test_proxy_unsatisfiable_range_is_not_206(tmp_path, origin):
+    """A Range the p2p path cannot satisfy yields the full body as 200,
+    never a mislabeled 206 (which would corrupt resuming clients)."""
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 16
+        sched = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        shost, sport = await sched.start()
+        daemon = Daemon(tmp_path / "d", [(shost, sport)], hostname="unsat-host")
+        await daemon.start()
+        transport = P2PTransport(daemon, rules=[ProxyRule(regex=r"blob\.bin")])
+        proxy = ProxyServer(transport)
+        phost, pport = await proxy.start()
+
+        def ranged(url: str, spec: str):
+            req = urllib.request.Request(url)
+            req.set_proxy(f"{phost}:{pport}", "http")
+            req.add_header("Range", spec)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.headers.get("Content-Range"), resp.read()
+
+        try:
+            status, crange, body = await asyncio.to_thread(
+                ranged, f"http://127.0.0.1:{origin}/blob.bin",
+                f"bytes={len(PAYLOAD) * 2}-",
+            )
+            assert status == 200 and crange is None and body == PAYLOAD
+            # and a satisfiable one still carries Content-Range
+            status, crange, body = await asyncio.to_thread(
+                ranged, f"http://127.0.0.1:{origin}/blob.bin", "bytes=0-9"
+            )
+            assert status == 206 and body == PAYLOAD[:10]
+            assert crange == f"bytes 0-9/{len(PAYLOAD)}"
+        finally:
+            await proxy.stop()
+            await daemon.stop()
+            await sched.stop()
+
+    asyncio.run(run())
