@@ -70,6 +70,7 @@ class Daemon:
             self.object_storage = ObjectStorageService(backend, storage=self.storage, host=ip)
         self._probe_task: asyncio.Task | None = None
         self._seed_tasks: list[asyncio.Task] = []
+        self._seed_downloads: set[asyncio.Task] = set()
         self._running: dict[str, asyncio.Task] = {}  # task dedup
         self._announced: set[str] = set()  # scheduler addrs we announced to
 
@@ -94,6 +95,10 @@ class Daemon:
         )
 
     async def start(self) -> None:
+        # pay the one-time native build here, never on a request path
+        from dragonfly2_tpu import native
+
+        await asyncio.to_thread(native.ensure_built)
         self.upload.start()
         self.gc.start()
         if self.object_storage is not None:
@@ -110,7 +115,7 @@ class Daemon:
         logger.info("daemon %s up (upload :%d)", self.host_id, self.upload.port)
 
     async def stop(self, leave: bool = True) -> None:
-        for task in (self._probe_task, *self._seed_tasks):
+        for task in (self._probe_task, *self._seed_tasks, *self._seed_downloads):
             if task is None:
                 continue
             task.cancel()
@@ -120,6 +125,7 @@ class Daemon:
                 pass
         self._probe_task = None
         self._seed_tasks.clear()
+        self._seed_downloads.clear()
         for task in list(self._running.values()):
             task.cancel()
         if leave:
@@ -147,13 +153,16 @@ class Daemon:
         workers: int = 4,
         back_source_allowed: bool = True,
         schedule_timeout: float = 10.0,
+        task_id: str | None = None,
     ) -> TaskStorage:
         """StartFileTask: dedup on task id — concurrent requests for the
-        same task await one conductor."""
-        task_id = idgen.task_id_v1(
-            url, tag=tag, application=application,
-            filtered_query_params=filtered_query_params,
-        )
+        same task await one conductor. `task_id` overrides derivation when
+        the caller already holds the authoritative id (seed triggers)."""
+        if task_id is None:
+            task_id = idgen.task_id_v1(
+                url, tag=tag, application=application,
+                filtered_query_params=filtered_query_params,
+            )
         existing = self.storage.find_completed_task(task_id)
         if existing is not None:
             return existing
@@ -205,13 +214,20 @@ class Daemon:
 
     async def _seed_loop(self, conn) -> None:
         """Serve TriggerSeedRequests from one scheduler connection: back-
-        source the task so the cluster has a parent (ObtainSeeds)."""
+        source the task so the cluster has a parent (ObtainSeeds). Spawned
+        downloads are strongly referenced (the loop holds only weak refs)
+        and cancelled on stop."""
         while True:
             trigger = await conn.seed_triggers.get()
-            asyncio.create_task(self._obtain_seed(trigger))
+            task = asyncio.create_task(self._obtain_seed(trigger))
+            self._seed_downloads.add(task)
+            task.add_done_callback(self._seed_downloads.discard)
 
     async def _obtain_seed(self, trigger) -> None:
         try:
+            # the trigger's task id is authoritative: the requesting peer
+            # may have derived it with filtered query params the raw URL
+            # alone would not reproduce
             await self.download(
                 trigger.url,
                 tag=trigger.tag,
@@ -219,6 +235,7 @@ class Daemon:
                 piece_length=trigger.piece_length,
                 back_source_allowed=True,
                 schedule_timeout=0.5,  # seeds go straight to origin
+                task_id=trigger.task_id,
             )
             logger.info("seeded task %s from %s", trigger.task_id, trigger.url)
         except Exception:  # noqa: BLE001 - a failed seed must not kill the loop

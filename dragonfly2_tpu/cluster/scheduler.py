@@ -84,6 +84,9 @@ class SchedulerService:
         ml_evaluator=None,
         seed: int = 0,
     ):
+        from dragonfly2_tpu import native
+
+        native.ensure_built()  # one-time; cycle checks ride the native path
         self.config = config or Config()
         sched = self.config.scheduler
         self.state = ClusterState(

@@ -91,9 +91,17 @@ class TaskDAG:
         return bool(self.adj[u, word] & (np.uint64(1) << np.uint64(bit)))
 
     def reachable(self, src: int, dst: int) -> bool:
-        """BFS over bitset rows: can src reach dst? (dag.go DFS :84-86)"""
+        """BFS over bitset rows: can src reach dst? (dag.go DFS :84-86).
+        Runs in native code when dfnative is built (cycle checks sit on
+        the DAG-mutation hot path); the Python loop below is the
+        fallback and the parity oracle for its tests."""
         if src == dst:
             return True
+        from dragonfly2_tpu import native
+
+        result = native.dag_reachable(self.adj, src, dst)
+        if result is not None:
+            return result
         frontier = np.zeros(self.words, np.uint64)
         word, bit = divmod(src, 64)
         frontier[word] = np.uint64(1) << np.uint64(bit)

@@ -1,0 +1,242 @@
+"""Native runtime bindings: build-on-first-use C++ kernels via ctypes.
+
+The reference's runtime is compiled Go end to end; here the host-side hot
+paths (ring lookups, DAG cycle checks, trace CSV parsing — see
+native/dfnative.cpp for the reference citations) are C++ with Python
+fallbacks. The shared library is compiled once with g++ into
+``native/_build/`` and loaded with ctypes (no pybind11 in the image);
+``DF_NATIVE=0`` disables it, and every consumer degrades to the pure
+Python implementation when the toolchain or build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+_SRC = pathlib.Path(__file__).resolve().parents[2] / "native" / "dfnative.cpp"
+_BUILD_DIR = _SRC.parent / "_build"
+_LIB_PATH = _BUILD_DIR / "libdfnative.so"
+
+_lock = threading.Lock()
+_build_lock = threading.Lock()  # serializes g++ invocations
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    with _build_lock:
+        if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= _SRC.stat().st_mtime:
+            return True  # another thread built it while we waited
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        tmp = _LIB_PATH.with_suffix(".tmp.so")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(tmp), str(_SRC)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            logger.warning("dfnative build failed to run: %s", e)
+            return False
+        if proc.returncode != 0:
+            logger.warning("dfnative build failed:\n%s", proc.stderr)
+            return False
+        tmp.replace(_LIB_PATH)
+        return True
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+
+    lib.df_fnv1a64.argtypes = [u8p, ctypes.c_int64]
+    lib.df_fnv1a64.restype = ctypes.c_uint64
+    lib.df_fnv1a64_batch.argtypes = [u8p, i64p, ctypes.c_int64, u64p]
+    lib.df_fnv1a64_batch.restype = None
+    lib.df_ring_pick_batch.argtypes = [u64p, ctypes.c_int64, u64p, ctypes.c_int64, i64p]
+    lib.df_ring_pick_batch.restype = None
+    lib.df_dag_reachable.argtypes = [u64p] + [ctypes.c_int64] * 4
+    lib.df_dag_reachable.restype = ctypes.c_int32
+    lib.df_dag_reachable_batch.argtypes = [u64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, ctypes.c_int64, i32p]
+    lib.df_dag_reachable_batch.restype = None
+    lib.df_csv_parse_numeric.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, f64p, ctypes.c_int64,
+    ]
+    lib.df_csv_parse_numeric.restype = ctypes.c_int64
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded library, or None (callers fall back to Python).
+
+    Never blocks a hot path on compilation: a fresh .so loads inline
+    (milliseconds); a missing/stale one kicks a background build and this
+    returns None until it lands. `ensure_built()` blocks for callers that
+    want the native path up front (process start, tests)."""
+    global _lib, _tried
+    if os.environ.get("DF_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        try:
+            stale = (
+                not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime
+            )
+        except OSError:
+            _tried = True
+            return None
+        if not stale:
+            _tried = True
+            try:
+                _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
+            except OSError as e:
+                logger.warning("dfnative unavailable: %s", e)
+                _lib = None
+            return _lib
+        # stale: build off the caller's thread; fall back meanwhile
+        threading.Thread(target=_background_build, daemon=True).start()
+        _tried = True
+        return None
+
+
+def _background_build() -> None:
+    global _lib
+    ok = _build()
+    with _lock:
+        if ok:
+            try:
+                _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
+            except OSError as e:
+                logger.warning("dfnative unavailable after build: %s", e)
+                _lib = None
+
+
+def ensure_built() -> bool:
+    """Blocking: build+load now if needed. For process start and tests."""
+    global _lib, _tried
+    if os.environ.get("DF_NATIVE", "1") == "0":
+        return False
+    with _lock:
+        if _lib is not None:
+            return True
+        try:
+            stale = (
+                not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime
+            )
+            if stale and not _build():
+                _tried = True
+                return False
+            _lib = _declare(ctypes.CDLL(str(_LIB_PATH)))
+            _tried = True
+            return True
+        except OSError as e:
+            logger.warning("dfnative unavailable: %s", e)
+            _tried = True
+            return False
+
+
+def available() -> bool:
+    return ensure_built()
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def fnv1a64(data: bytes) -> int:
+    """FNV-1a 64 of `data` — native when available, else pure Python.
+    Both paths are the exact same function, so ring placements agree
+    across mixed fleets."""
+    lib = get_lib()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
+        return int(lib.df_fnv1a64(buf, len(data)))
+    h = 0xCBF29CE484222325
+    for byte in data:
+        h ^= byte
+        h = (h * 0x100000001B3) & _MASK64
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _MASK64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _MASK64
+    h ^= h >> 33
+    return h
+
+
+def fnv1a64_batch(keys: list[bytes]) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        return np.asarray([fnv1a64(k) for k in keys], np.uint64)
+    buf = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    out = np.zeros(len(keys), np.uint64)
+    cbuf = (ctypes.c_uint8 * max(len(buf), 1)).from_buffer_copy(buf or b"\0")
+    lib.df_fnv1a64_batch(cbuf, _as_ptr(offsets, ctypes.c_int64), len(keys), _as_ptr(out, ctypes.c_uint64))
+    return out
+
+
+def ring_pick_batch(ring_hashes: np.ndarray, key_hashes: np.ndarray) -> np.ndarray:
+    """For each key hash, index into the sorted ring (bisect semantics)."""
+    ring_hashes = np.ascontiguousarray(ring_hashes, np.uint64)
+    key_hashes = np.ascontiguousarray(key_hashes, np.uint64)
+    out = np.zeros(key_hashes.shape[0], np.int64)
+    lib = get_lib()
+    if lib is None:
+        idx = np.searchsorted(ring_hashes, key_hashes, side="right")
+        return idx % len(ring_hashes)
+    lib.df_ring_pick_batch(
+        _as_ptr(ring_hashes, ctypes.c_uint64), len(ring_hashes),
+        _as_ptr(key_hashes, ctypes.c_uint64), len(key_hashes),
+        _as_ptr(out, ctypes.c_int64),
+    )
+    return out
+
+
+def dag_reachable(adj: np.ndarray, src: int, dst: int) -> bool | None:
+    """Native BFS over the TaskDAG bitmatrix; None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    adj = np.ascontiguousarray(adj, np.uint64)
+    capacity, words = adj.shape
+    result = lib.df_dag_reachable(_as_ptr(adj, ctypes.c_uint64), capacity, words, src, dst)
+    if result < 0:
+        return None
+    return bool(result)
+
+
+def csv_parse_numeric(data: bytes, n_cols: int, skip_header: bool = True,
+                      max_rows: int | None = None) -> np.ndarray | None:
+    """Parse CSV bytes into an (rows, n_cols) float64 matrix; non-numeric
+    fields become NaN. None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if max_rows is None:
+        max_rows = data.count(b"\n") + 1
+    out = np.empty((max(max_rows, 1), n_cols), np.float64)
+    rows = lib.df_csv_parse_numeric(
+        data, len(data), n_cols, 1 if skip_header else 0,
+        _as_ptr(out, ctypes.c_double), max_rows,
+    )
+    if rows < 0:
+        return None
+    return out[:rows]

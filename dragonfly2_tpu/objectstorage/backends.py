@@ -78,6 +78,8 @@ class FilesystemBackend:
         tmp = path.with_name(path.name + ".tmp")
         tmp.write_bytes(data)
         tmp.replace(path)
+        # etag sidecar: listings must not re-hash every object's bytes
+        _etag_path(path).write_text(hashlib.md5(data).hexdigest())
         return self.get_object_metadata(bucket, key)
 
     def get_object(self, bucket: str, key: str, range_: tuple[int, int] | None = None) -> bytes:
@@ -94,11 +96,10 @@ class FilesystemBackend:
         path = self._object_path(bucket, key)
         if not path.is_file():
             raise dferrors.NotFound(f"object {bucket}/{key} not found")
-        data = path.read_bytes()
         return ObjectMetadata(
             key=key,
-            content_length=len(data),
-            etag=hashlib.md5(data).hexdigest(),
+            content_length=path.stat().st_size,
+            etag=_etag_of(path),
             last_modified_at=path.stat().st_mtime,
         )
 
@@ -108,7 +109,7 @@ class FilesystemBackend:
             raise dferrors.NotFound(f"bucket {bucket} not found")
         out = []
         for path in sorted(bucket_dir.rglob("*")):
-            if not path.is_file() or path.name.endswith(".tmp"):
+            if not path.is_file() or path.name.endswith((".tmp", ".etag")):
                 continue
             key = path.relative_to(bucket_dir).as_posix()
             if not key.startswith(prefix):
@@ -117,7 +118,7 @@ class FilesystemBackend:
                 ObjectMetadata(
                     key=key,
                     content_length=path.stat().st_size,
-                    etag=hashlib.md5(path.read_bytes()).hexdigest(),
+                    etag=_etag_of(path),
                     last_modified_at=path.stat().st_mtime,
                 )
             )
@@ -136,6 +137,7 @@ class FilesystemBackend:
         path = self._object_path(bucket, key)
         if path.is_file():
             path.unlink()
+        _etag_path(path).unlink(missing_ok=True)
 
     def get_sign_url(self, bucket: str, key: str, method: str = "GET", expire: float = 300.0) -> str:
         """Filesystem 'signed URL': a file:// URL (callers only need a
@@ -155,6 +157,27 @@ class FilesystemBackend:
         if not path.is_relative_to(bucket_dir.resolve()):
             raise dferrors.InvalidArgument(f"key escapes bucket: {key!r}")
         return path
+
+
+def _etag_path(path: pathlib.Path) -> pathlib.Path:
+    return path.with_name(path.name + ".etag")
+
+
+def _etag_of(path: pathlib.Path) -> str:
+    """Sidecar-cached md5; recomputed (and re-persisted) only when the
+    sidecar is missing or older than the object."""
+    side = _etag_path(path)
+    try:
+        if side.stat().st_mtime >= path.stat().st_mtime:
+            return side.read_text().strip()
+    except OSError:
+        pass
+    etag = hashlib.md5(path.read_bytes()).hexdigest()
+    try:
+        side.write_text(etag)
+    except OSError:
+        pass
+    return etag
 
 
 _VENDORS = ("s3", "oss", "obs")

@@ -140,9 +140,10 @@ class ObjectStorageService:
         from dragonfly2_tpu.client.storage import TaskMetadata
 
         task_id = object_task_id(bucket, key)
+        # Overwrite semantics: a re-PUT must replace the P2P copy, never
+        # leave peers pulling the previous object's bytes.
+        self.storage.delete_task(task_id)
         ts = self.storage.register_task(TaskMetadata(task_id=task_id, peer_id="objstore"))
-        if ts.meta.done:
-            return
         layout = piece_layout(len(data), ts.meta.piece_length)
         for n, off, length in layout:
             ts.write_piece(n, off, data[off : off + length])

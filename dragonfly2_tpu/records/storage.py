@@ -91,6 +91,40 @@ class _RotatingCSV:
     def count(self) -> int:
         return self._count
 
+    def numeric_matrix(self, columns: list[str] | None = None):
+        """All rotations parsed into a float64 matrix (NaN where a field
+        is non-numeric) — the trainer's columnar fast path over the
+        100+-column schema. Parses in native code when dfnative is built;
+        the csv-module fallback produces identical output."""
+        import numpy as np
+
+        from dragonfly2_tpu import native
+
+        n_cols = len(self.header)
+        col_idx = (
+            np.arange(n_cols)
+            if columns is None
+            else np.asarray([self.header.index(c) for c in columns])
+        )
+        mats = []
+        for path in self.all_paths():
+            data = path.read_bytes()
+            mat = native.csv_parse_numeric(data, n_cols, skip_header=True)
+            if mat is None:  # pure-Python fallback
+                rows = []
+                with path.open(newline="") as f:
+                    reader = csv.reader(f)
+                    next(reader, None)
+                    for row in reader:
+                        if len(row) != n_cols:
+                            continue
+                        rows.append([_to_float(v) for v in row])
+                mat = np.asarray(rows, np.float64).reshape(len(rows), n_cols)
+            mats.append(mat[:, col_idx])
+        if not mats:
+            return np.zeros((0, len(col_idx)), np.float64)
+        return np.concatenate(mats, axis=0)
+
     def open_bytes(self) -> bytes:
         """Concatenated raw bytes of all rotations (announcer upload path)."""
         buf = io.BytesIO()
@@ -103,6 +137,13 @@ class _RotatingCSV:
             for path in self.all_paths():
                 path.unlink(missing_ok=True)
             self._count = 0
+
+
+def _to_float(value: str) -> float:
+    try:
+        return float(value)
+    except ValueError:
+        return float("nan")
 
 
 def _csv_line(header: list[str], row: dict) -> str:
@@ -131,6 +172,13 @@ class TraceStorage:
 
     def list_network_topologies(self) -> list[NetworkTopologyRecord]:
         return list(self.topologies.iter_records())
+
+    def download_matrix(self, columns: list[str] | None = None):
+        """Columnar numeric view of the download traces (native parse)."""
+        return self.downloads.numeric_matrix(columns)
+
+    def topology_matrix(self, columns: list[str] | None = None):
+        return self.topologies.numeric_matrix(columns)
 
     def open_download(self) -> bytes:
         return self.downloads.open_bytes()

@@ -106,8 +106,15 @@ class SchedulerRPCServer:
         svc = self.service
         if not svc.seed_triggers:
             return
-        with svc.mu:
-            triggers, svc.seed_triggers = svc.seed_triggers, []
+
+        def pop_triggers():
+            # svc.mu may be held by the tick thread through a device call;
+            # never block the event loop on it.
+            with svc.mu:
+                triggers, svc.seed_triggers = svc.seed_triggers, []
+                return triggers, list(svc._seed_hosts)
+
+        triggers, seed_hosts = await asyncio.to_thread(pop_triggers)
         for trigger in triggers:
             # Fall back to any connected seed host when the round-robin
             # choice has no live connection (crashed seed without
@@ -115,8 +122,7 @@ class SchedulerRPCServer:
             async with self._lock:
                 writer = self._host_conn.get(trigger.host_id)
                 if writer is None:
-                    with svc.mu:
-                        candidates = [h for h in svc._seed_hosts if h in self._host_conn]
+                    candidates = [h for h in seed_hosts if h in self._host_conn]
                     if candidates:
                         trigger.host_id = candidates[0]
                         writer = self._host_conn[trigger.host_id]

@@ -3,17 +3,20 @@
 Capability parity with pkg/balancer/consistent_hashing.go:40-57 + the
 dynconfig-fed resolver (pkg/resolver/): every request for a given task id
 must land on the same scheduler so its in-memory DAG/state is authoritative.
-Implemented as a sorted ring of virtual-node hashes.
+Implemented as a sorted ring of virtual-node hashes over FNV-1a 64 — the
+same function in the native (dfnative.cpp) and Python paths, so mixed
+fleets agree on placement.
 """
 
 from __future__ import annotations
 
 import bisect
-import hashlib
+
+from dragonfly2_tpu import native
 
 
 def _hash(key: str) -> int:
-    return int.from_bytes(hashlib.sha256(key.encode("utf-8")).digest()[:8], "big")
+    return native.fnv1a64(key.encode("utf-8"))
 
 
 class HashRing:
@@ -53,6 +56,18 @@ class HashRing:
         h = _hash(key)
         idx = bisect.bisect(self._ring, h) % len(self._ring)
         return self._members[self._ring[idx]]
+
+    def pick_many(self, keys: list[str]) -> list[str | None]:
+        """Batch pick (native ring lookup when available) — the trace
+        replay / preheat fan-out path."""
+        if not self._ring:
+            return [None] * len(keys)
+        import numpy as np
+
+        ring = np.asarray(self._ring, np.uint64)
+        hashes = native.fnv1a64_batch([k.encode("utf-8") for k in keys])
+        idx = native.ring_pick_batch(ring, hashes)
+        return [self._members[self._ring[int(i)]] for i in idx]
 
     def nodes(self) -> set[str]:
         return set(self._nodes)

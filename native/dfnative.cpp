@@ -1,0 +1,190 @@
+// dfnative: native runtime kernels for the host-side hot paths.
+//
+// The reference keeps its whole runtime in compiled Go (SURVEY.md §2 —
+// scheduler DAG pkg/graph/dag, balancer pkg/balancer, CSV trace storage
+// scheduler/storage); the TPU build keeps XLA for tensor math and this
+// C++ layer for the host-side data structures on the request path:
+//   - FNV-1a hashing + consistent-hash ring lookups (task -> scheduler
+//     affinity, pkg/balancer/consistent_hashing.go:40-57)
+//   - DAG reachability over uint64 bitset rows (cycle checks at DAG
+//     mutation rate, pkg/graph/dag/dag.go:84-86)
+//   - columnar numeric CSV parsing (the trainer's trace reader,
+//     scheduler/storage/storage.go + trainer/storage)
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hashing
+
+// FNV-1a 64-bit with a murmur3 fmix64 finalizer (raw FNV clusters badly
+// on structured keys like "node#3", skewing ring balance). Both the
+// Python and native implementations use this exact function so mixed
+// fleets agree on task->scheduler affinity.
+uint64_t df_fnv1a64(const uint8_t* data, int64_t len) {
+    uint64_t h = 14695981039346656037ULL;
+    for (int64_t i = 0; i < len; i++) {
+        h ^= (uint64_t)data[i];
+        h *= 1099511628211ULL;
+    }
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdULL;
+    h ^= h >> 33;
+    h *= 0xc4ceb9fe1a85ec53ULL;
+    h ^= h >> 33;
+    return h;
+}
+
+// Hash n strings packed back to back; offsets has n+1 entries.
+void df_fnv1a64_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                      uint64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = df_fnv1a64(buf + offsets[i], offsets[i + 1] - offsets[i]);
+    }
+}
+
+// ------------------------------------------------------------------- ring
+
+// ring: sorted vnode hashes. For each key hash, find the first vnode
+// strictly greater (wrapping), i.e. Python bisect.bisect semantics.
+void df_ring_pick_batch(const uint64_t* ring, int64_t n_ring,
+                        const uint64_t* keys, int64_t n_keys, int64_t* out) {
+    for (int64_t i = 0; i < n_keys; i++) {
+        uint64_t k = keys[i];
+        int64_t lo = 0, hi = n_ring;
+        while (lo < hi) {
+            int64_t mid = (lo + hi) / 2;
+            if (ring[mid] <= k) lo = mid + 1; else hi = mid;
+        }
+        out[i] = lo % n_ring;
+    }
+}
+
+// -------------------------------------------------------------------- DAG
+
+// adj: capacity x words uint64 bitmatrix, adj[u] = children bitset of u.
+// Returns 1 when src reaches dst (BFS over bitset rows).
+int32_t df_dag_reachable(const uint64_t* adj, int64_t capacity, int64_t words,
+                         int64_t src, int64_t dst) {
+    if (src == dst) return 1;
+    uint64_t* frontier = (uint64_t*)calloc((size_t)words, 8);
+    uint64_t* visited = (uint64_t*)calloc((size_t)words, 8);
+    uint64_t* next = (uint64_t*)calloc((size_t)words, 8);
+    if (!frontier || !visited || !next) {
+        free(frontier); free(visited); free(next);
+        return -1;
+    }
+    frontier[src / 64] = 1ULL << (src % 64);
+    visited[src / 64] = frontier[src / 64];
+    int32_t found = 0;
+    for (;;) {
+        int any = 0;
+        memset(next, 0, (size_t)words * 8);
+        for (int64_t w = 0; w < words; w++) {
+            uint64_t bits = frontier[w];
+            while (bits) {
+                int64_t b = __builtin_ctzll(bits);
+                bits &= bits - 1;
+                const uint64_t* row = adj + (w * 64 + b) * words;
+                for (int64_t j = 0; j < words; j++) next[j] |= row[j];
+            }
+        }
+        for (int64_t j = 0; j < words; j++) {
+            next[j] &= ~visited[j];
+            if (next[j]) any = 1;
+        }
+        if (next[dst / 64] & (1ULL << (dst % 64))) { found = 1; break; }
+        if (!any) break;
+        for (int64_t j = 0; j < words; j++) visited[j] |= next[j];
+        uint64_t* tmp = frontier; frontier = next; next = tmp;
+    }
+    free(frontier); free(visited); free(next);
+    return found;
+}
+
+void df_dag_reachable_batch(const uint64_t* adj, int64_t capacity, int64_t words,
+                            const int64_t* srcs, const int64_t* dsts, int64_t n,
+                            int32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = df_dag_reachable(adj, capacity, words, srcs[i], dsts[i]);
+    }
+}
+
+// -------------------------------------------------------------------- CSV
+
+// Parse a CSV buffer into a dense row-major double matrix of n_cols
+// columns. Handles quoted fields (commas/newlines inside quotes, doubled
+// quotes); non-numeric/empty fields become NaN. Rows with a different
+// column count are skipped. Returns rows written (<= max_rows), or -1 on
+// malformed input that prevents forward progress.
+int64_t df_csv_parse_numeric(const char* buf, int64_t len, int64_t n_cols,
+                             int32_t skip_header, double* out, int64_t max_rows) {
+    int64_t pos = 0, rows = 0;
+    double* row_vals = (double*)malloc((size_t)n_cols * 8);
+    if (!row_vals) return -1;
+    if (skip_header) {
+        // header fields may be quoted but never contain newlines here
+        while (pos < len && buf[pos] != '\n') pos++;
+        if (pos < len) pos++;
+    }
+    while (pos < len && rows < max_rows) {
+        // skip blank lines
+        if (buf[pos] == '\n' || buf[pos] == '\r') { pos++; continue; }
+        int64_t col = 0;
+        for (;;) {
+            double value = NAN;
+            char tmp[64]; int64_t ti = 0;
+            if (pos < len && buf[pos] == '"') {
+                pos++;  // opening quote
+                int64_t flen = 0;
+                while (pos < len) {
+                    if (buf[pos] == '"') {
+                        if (pos + 1 < len && buf[pos + 1] == '"') {
+                            if (ti < 63) tmp[ti++] = '"';
+                            flen++; pos += 2;
+                        } else { pos++; break; }
+                    } else {
+                        if (ti < 63) tmp[ti++] = buf[pos];
+                        flen++; pos++;
+                    }
+                }
+                if (flen > 63) ti = 0;  // too long to be numeric
+            } else {
+                int64_t start = pos;
+                while (pos < len && buf[pos] != ',' && buf[pos] != '\n' &&
+                       buf[pos] != '\r') pos++;
+                int64_t flen = pos - start;
+                if (flen > 0 && flen < 64) {
+                    memcpy(tmp, buf + start, (size_t)flen);
+                    ti = flen;
+                }
+            }
+            if (ti > 0) {
+                tmp[ti] = 0;
+                char* end = nullptr;
+                double d = strtod(tmp, &end);
+                if (end && *end == 0) value = d;
+            }
+            if (col < n_cols) row_vals[col] = value;
+            col++;
+            if (pos >= len) break;
+            if (buf[pos] == ',') { pos++; continue; }
+            if (buf[pos] == '\r') { pos++; if (pos < len && buf[pos] == '\n') pos++; break; }
+            pos++;  // '\n'
+            break;
+        }
+        if (col == n_cols) {
+            memcpy(out + rows * n_cols, row_vals, (size_t)n_cols * 8);
+            rows++;
+        }
+    }
+    free(row_vals);
+    return rows;
+}
+
+}  // extern "C"
