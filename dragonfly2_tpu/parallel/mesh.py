@@ -19,27 +19,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
 GRAPH_AXIS = "graph"
+SP_AXIS = "sp"  # sequence/context parallelism (ring attention, parallel/ring.py)
 
 
 def make_mesh(
     n_devices: int | None = None,
     dp: int | None = None,
     graph: int = 1,
+    sp: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a (dp, graph) mesh. Defaults: all devices on the dp axis."""
+    """Build a (dp, graph, sp) mesh. Defaults: all devices on the dp axis.
+    Unused axes have size 1 — specs that don't name them are unaffected."""
     devices = devices if devices is not None else jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
+    model = graph * sp
     if dp is None:
-        if n % graph != 0:
-            raise ValueError(f"{n} devices not divisible by graph={graph}")
-        dp = n // graph
-    if dp * graph != n:
-        raise ValueError(f"mesh {dp}x{graph} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, graph)
-    return Mesh(arr, (DP_AXIS, GRAPH_AXIS))
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by graph*sp={model}")
+        dp = n // model
+    if dp * model != n:
+        raise ValueError(f"mesh {dp}x{graph}x{sp} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, graph, sp)
+    return Mesh(arr, (DP_AXIS, GRAPH_AXIS, SP_AXIS))
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:

@@ -1,0 +1,92 @@
+"""Ring attention: sequence/context parallelism over the mesh `sp` axis.
+
+The reference has no sequence models (SURVEY.md §5 "long-context:
+absent") — this is new TPU-first capability: attention over sequences too
+long for one chip's HBM, computed blockwise with the KV shards rotating
+around the ICI ring (`lax.ppermute`) while each device keeps only its
+query shard — the Ring Attention construction (see PAPERS.md), with
+flash-style online-softmax accumulation so nothing materializes the full
+[L, L] score matrix.
+
+Layouts: q/k/v are [B, H, L, D] (L = per-device shard inside shard_map),
+kv_mask is [B, L] key validity. `dense_attention` is the single-device
+reference implementation and the parity oracle in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.parallel.mesh import DP_AXIS, SP_AXIS
+
+_NEG = jnp.float32(-1e30)
+
+
+def dense_attention(q, k, v, kv_mask) -> jax.Array:
+    """Reference softmax attention. [B,H,L,D] x [B,L] -> [B,H,L,D]."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(kv_mask[:, None, None, :], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with no valid key softmax over the -1e30 floor uniformly; zero
+    # them so fully-masked rows produce 0 like the ring path
+    probs = probs * kv_mask[:, None, None, :]
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
+    """Blockwise attention inside shard_map: every step attends the local
+    queries to the current KV block, then rotates KV one hop around the
+    `axis_name` ring. Online softmax keeps running (max, sum, acc) in
+    float32."""
+    n = jax.lax.psum(1, axis_name)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    batch, heads, q_len, dim = qf.shape
+
+    acc = jnp.zeros((batch, heads, q_len, dim), jnp.float32)
+    row_max = jnp.full((batch, heads, q_len), _NEG, jnp.float32)
+    row_sum = jnp.zeros((batch, heads, q_len), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(_, carry):
+        acc, row_max, row_sum, kb, vb, mb = carry
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        key_valid = mb[:, None, None, :]
+        scores = jnp.where(key_valid, scores, _NEG)
+        block_max = jnp.max(scores, axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None]) * key_valid
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32)
+        )
+        row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+        kb, vb, mb = jax.lax.ppermute((kb, vb, mb), axis_name, perm)
+        return acc, new_max, row_sum, kb, vb, mb
+
+    acc, row_max, row_sum, *_ = jax.lax.fori_loop(
+        0, n, body, (acc, row_max, row_sum, k, v, kv_mask)
+    )
+    out = acc / jnp.maximum(row_sum, 1e-9)[..., None]
+    return out.astype(q.dtype)
+
+
+def sharded_ring_attention(mesh, q, k, v, kv_mask) -> jax.Array:
+    """shard_map wrapper: batch over `dp`, sequence over `sp`. Global
+    shapes in, global shapes out; each device holds L/sp of the sequence
+    and the KV shards ride the ICI ring."""
+    qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
+    mask_spec = P(DP_AXIS, SP_AXIS)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=SP_AXIS),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_mask)

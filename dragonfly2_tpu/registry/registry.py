@@ -29,6 +29,9 @@ from dragonfly2_tpu.utils.idgen import model_id as make_model_id
 
 MODEL_TYPE_GNN = "gnn"
 MODEL_TYPE_MLP = "mlp"
+# beyond the reference's gnn|mlp enum (manager/models/model.go:19-46): the
+# set-transformer ranker family (models/attention.py)
+MODEL_TYPE_ATTENTION = "attention"
 
 STATE_INACTIVE = "inactive"
 STATE_ACTIVE = "active"
@@ -80,7 +83,7 @@ class ModelRegistry:
     ) -> ModelVersion:
         """CreateModel semantics (manager_server_v1.go:802-952): next version
         number, artifacts + evaluation stored, version starts inactive."""
-        if model_type not in (MODEL_TYPE_GNN, MODEL_TYPE_MLP):
+        if model_type not in (MODEL_TYPE_GNN, MODEL_TYPE_MLP, MODEL_TYPE_ATTENTION):
             raise ValueError(f"unknown model type {model_type!r}")
         mid = make_model_id(name, scheduler_host_id)
         versions = self.list_versions(mid)

@@ -186,6 +186,98 @@ def train_gnn(
     )
 
 
+def train_attention(
+    ds: RankingDataset,
+    config: TrainerConfig | None = None,
+    mesh=None,
+    seed: int = 0,
+    eval_fraction: float = 0.2,
+) -> TrainResult:
+    """Train the set-transformer parent ranker (models/attention.py) on
+    the same RankingDataset the GNN consumes — candidates attend to each
+    other, no graph needed. With a mesh, batches shard over dp and the
+    attention inner product can run as ring attention over sp."""
+    import functools
+
+    from dragonfly2_tpu.models.attention import AttentionRanker
+    from dragonfly2_tpu.parallel.ring import sharded_ring_attention
+    from dragonfly2_tpu.parallel.mesh import SP_AXIS
+
+    config = config or TrainerConfig()
+    rng = np.random.default_rng(seed)
+    n = ds.child.shape[0]
+    perm = rng.permutation(n)
+    n_eval = max(1, int(n * eval_fraction))
+    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+
+    model = AttentionRanker(hidden_dim=config.hidden_dim)
+    attention_fn = None
+    if mesh is not None and mesh.shape.get(SP_AXIS, 1) > 1:
+        attention_fn = functools.partial(sharded_ring_attention, mesh)
+
+    def apply(params, child, parents, pair, mask):
+        if attention_fn is not None:
+            return model.apply(params, child, parents, pair, mask, attention_fn=attention_fn)
+        return model.apply(params, child, parents, pair, mask)
+
+    def take(idx):
+        pair = np.concatenate(
+            [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
+        ).astype(np.float32)
+        return {
+            "child": ds.child[idx],
+            "parents": ds.parents[idx],
+            "pair": pair,
+            "mask": ds.mask[idx],
+            "throughput": ds.throughput[idx],
+        }
+
+    sample = take(train_idx[: min(2, len(train_idx))])
+    params = model.init(
+        jax.random.key(seed), sample["child"], sample["parents"], sample["pair"], sample["mask"]
+    )
+    optimizer = optax.adamw(config.learning_rate)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, batch):
+        scores = apply(params, batch["child"], batch["parents"], batch["pair"], batch["mask"])
+        return listwise_rank_loss(scores, batch["throughput"], batch["mask"])
+
+    if mesh is not None:
+        params = jax.device_put(params, replicated(mesh))
+        opt_state = jax.device_put(opt_state, replicated(mesh))
+
+    step = _make_step(loss_fn, optimizer)
+    losses = []
+    t0 = time.perf_counter()
+    n_samples = 0
+    batch_size = min(config.batch_size, len(train_idx))
+    for _ in range(config.epochs):
+        order = rng.permutation(len(train_idx))
+        for start in range(0, len(order) - batch_size + 1, batch_size):
+            batch = take(train_idx[order[start : start + batch_size]])
+            batch = shard_batch(mesh, batch) if mesh is not None else jax.device_put(batch)
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+            n_samples += batch_size
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    eb = take(eval_idx)
+    scores = apply(
+        jax.device_put(params) if mesh is None else params,
+        eb["child"], eb["parents"], eb["pair"], eb["mask"],
+    )
+    stats = M.top1_selection_stats(np.asarray(scores), eb["throughput"], eb["mask"])
+    return TrainResult(
+        params=params,
+        losses=losses,
+        eval_metrics={k: float(v) for k, v in stats.items()},
+        samples_per_sec=n_samples / max(dt, 1e-9),
+        steps=len(losses),
+    )
+
+
 def _take_rank_batch(ds: RankingDataset, idx: np.ndarray) -> RankBatch:
     pair_feats = np.concatenate(
         [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
