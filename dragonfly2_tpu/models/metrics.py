@@ -33,6 +33,12 @@ def top1_selection_stats(scores: jax.Array, throughput: jax.Array, mask: jax.Arr
     pick is a true positive when it selects a relevant candidate. With one
     pick per row, precision = fraction of rows whose pick was relevant;
     recall = TP / total relevant; F1 combines them.
+
+    Also reports `regret`: the top-1 pick's position in the row's observed
+    throughput range, (best - picked) / (best - worst), averaged over valid
+    rows — 0 means always picking the best candidate, ~0.5 is a uniform
+    random picker, 1 means always picking the worst. Scale-invariant, so it
+    is meaningful whether `throughput` is raw or log-domain.
     """
     neg = jnp.float32(-1e30)
     valid_rows = mask.sum(-1) >= 2
@@ -49,7 +55,14 @@ def top1_selection_stats(scores: jax.Array, throughput: jax.Array, mask: jax.Arr
     precision = tp / n_rows
     recall = tp / n_relevant
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-9)
-    return {"precision": precision, "recall": recall, "f1": f1}
+
+    picked_tp = jnp.take_along_axis(masked_tp, pick[..., None], axis=-1)[..., 0]
+    best = masked_tp.max(-1)
+    worst = jnp.where(mask, throughput, jnp.float32(1e30)).min(-1)
+    span = jnp.maximum(best - worst, 1e-9)
+    per_row_regret = jnp.clip((best - picked_tp) / span, 0.0, 1.0)
+    regret = (per_row_regret * valid_rows).sum() / n_rows
+    return {"precision": precision, "recall": recall, "f1": f1, "regret": regret}
 
 
 def regression_report(pred, target, mask=None) -> dict:

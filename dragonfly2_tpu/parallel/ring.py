@@ -27,9 +27,15 @@ _NEG = jnp.float32(-1e30)
 
 
 def dense_attention(q, k, v, kv_mask) -> jax.Array:
-    """Reference softmax attention. [B,H,L,D] x [B,L] -> [B,H,L,D]."""
+    """Reference softmax attention. [B,H,L,D] x [B,L] -> [B,H,L,D].
+
+    The q.k matmul keeps the input dtype (bf16 on the MXU) but accumulates
+    in float32 — the same contract as the ring path, so the single-chip and
+    sp>1 implementations are numerically interchangeable."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    )
     scores = jnp.where(kv_mask[:, None, None, :], scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     # rows with no valid key softmax over the -1e30 floor uniformly; zero
@@ -45,17 +51,18 @@ def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
     float32."""
     n = jax.lax.psum(1, axis_name)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    qf = q.astype(jnp.float32)
-    batch, heads, q_len, dim = qf.shape
+    batch, heads, q_len, dim = q.shape
 
     acc = jnp.zeros((batch, heads, q_len, dim), jnp.float32)
     row_max = jnp.full((batch, heads, q_len), _NEG, jnp.float32)
     row_sum = jnp.zeros((batch, heads, q_len), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def body(_, carry):
-        acc, row_max, row_sum, kb, vb, mb = carry
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
+    def attend_block(acc, row_max, row_sum, kb, vb, mb):
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
+            * scale
+        )
         key_valid = mb[:, None, None, :]
         scores = jnp.where(key_valid, scores, _NEG)
         block_max = jnp.max(scores, axis=-1)
@@ -66,12 +73,21 @@ def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
             "bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32)
         )
         row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-        kb, vb, mb = jax.lax.ppermute((kb, vb, mb), axis_name, perm)
-        return acc, new_max, row_sum, kb, vb, mb
+        return acc, new_max, row_sum
 
-    acc, row_max, row_sum, *_ = jax.lax.fori_loop(
-        0, n, body, (acc, row_max, row_sum, k, v, kv_mask)
+    def body(_, carry):
+        acc, row_max, row_sum, kb, vb, mb = carry
+        acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb)
+        kb, vb, mb = jax.lax.ppermute((kb, vb, mb), axis_name, perm)
+        return acc, row_max, row_sum, kb, vb, mb
+
+    # n-1 attend+rotate steps, then the final block attends WITHOUT the
+    # trailing rotation — its output would be discarded, and each skipped
+    # ppermute saves a full K+V+mask shard crossing the ICI ring.
+    acc, row_max, row_sum, kb, vb, mb = jax.lax.fori_loop(
+        0, n - 1, body, (acc, row_max, row_sum, k, v, kv_mask)
     )
+    acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb)
     out = acc / jnp.maximum(row_sum, 1e-9)[..., None]
     return out.astype(q.dtype)
 

@@ -28,6 +28,7 @@ from dragonfly2_tpu.models.graphsage import GraphSAGERanker
 from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
 from dragonfly2_tpu.ops import evaluator as ev
 from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_ATTENTION,
     MODEL_TYPE_GNN,
     MODEL_TYPE_MLP,
     ModelRegistry,
@@ -60,6 +61,10 @@ class ModelServer:
             self.model = GraphSAGERanker()
         elif model_type == MODEL_TYPE_MLP:
             self.model = ProbeRTTRegressor()
+        elif model_type == MODEL_TYPE_ATTENTION:
+            from dragonfly2_tpu.models.attention import AttentionRanker
+
+            self.model = AttentionRanker()
         else:
             raise ValueError(model_type)
 
@@ -98,6 +103,14 @@ class ModelServer:
         """(B, K) candidate scores from cached host-slot embeddings."""
         return _gnn_score(self.model, self.params, host_emb, child_host, cand_host, pair_feats)
 
+    def score_set(self, child_feats, parent_feats, pair_feats, mask) -> jax.Array:
+        """(B, P) candidate scores from the set-transformer ranker
+        (models/attention.py) — candidates attend to each other, no
+        embedding cache needed."""
+        return _attention_score(
+            self.model, self.params, child_feats, parent_feats, pair_feats, mask
+        )
+
 
 @functools.partial(jax.jit, static_argnames=("model",))
 def _mlp_apply(model, params, x):
@@ -114,6 +127,11 @@ def _gnn_embed(model, params, graph_arrays):
         graph_arrays["edge_feats"],
         method="embed",
     )
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _attention_score(model, params, child_feats, parent_feats, pair_feats, mask):
+    return model.apply(params, child_feats, parent_feats, pair_feats, mask)
 
 
 @functools.partial(jax.jit, static_argnames=("model",))

@@ -221,13 +221,10 @@ def train_attention(
         return model.apply(params, child, parents, pair, mask)
 
     def take(idx):
-        pair = np.concatenate(
-            [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
-        ).astype(np.float32)
         return {
             "child": ds.child[idx],
             "parents": ds.parents[idx],
-            "pair": pair,
+            "pair": _pair_feats(ds, idx),
             "mask": ds.mask[idx],
             "throughput": ds.throughput[idx],
         }
@@ -264,11 +261,24 @@ def train_attention(
     dt = time.perf_counter() - t0
 
     eb = take(eval_idx)
+    n_real = eb["mask"].shape[0]
+    if mesh is not None:
+        # The sharded attention path requires the batch dim to divide dp;
+        # pad with masked-out rows and slice the scores back.
+        dp = mesh.shape.get(DP_AXIS, 1)
+        pad = (-n_real) % dp
+        if pad:
+            eb = {
+                k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                for k, v in eb.items()
+            }
     scores = apply(
         jax.device_put(params) if mesh is None else params,
         eb["child"], eb["parents"], eb["pair"], eb["mask"],
     )
-    stats = M.top1_selection_stats(np.asarray(scores), eb["throughput"], eb["mask"])
+    stats = M.top1_selection_stats(
+        np.asarray(scores)[:n_real], eb["throughput"][:n_real], eb["mask"][:n_real]
+    )
     return TrainResult(
         params=params,
         losses=losses,
@@ -278,14 +288,19 @@ def train_attention(
     )
 
 
-def _take_rank_batch(ds: RankingDataset, idx: np.ndarray) -> RankBatch:
-    pair_feats = np.concatenate(
+def _pair_feats(ds: RankingDataset, idx: np.ndarray) -> np.ndarray:
+    """(B, P, 2) pair features — the single definition both the GNN and
+    attention trainers consume, so the families can never drift apart."""
+    return np.concatenate(
         [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
     ).astype(np.float32)
+
+
+def _take_rank_batch(ds: RankingDataset, idx: np.ndarray) -> RankBatch:
     return RankBatch(
         child_idx=ds.child_host_idx[idx],
         parent_idx=ds.parent_host_idx[idx],
-        pair_feats=pair_feats,
+        pair_feats=_pair_feats(ds, idx),
         throughput=ds.throughput[idx],
         mask=ds.mask[idx],
     )

@@ -96,7 +96,12 @@ def test_attention_ranker_learns_planted_signal():
     result = train_attention(
         ds, TrainerConfig(hidden_dim=32, batch_size=32, epochs=8), seed=0
     )
-    assert result.losses[-1] < result.losses[0]
+    # Single-batch losses are noisy; compare epoch means. The listwise CE
+    # is lower-bounded by the target distribution's entropy (~1.43 on this
+    # trace), so "learned" = last epoch mean strictly below first.
+    spe = result.steps // 8
+    losses = np.asarray(result.losses)
+    assert losses[-spe:].mean() < losses[:spe].mean()
     assert result.eval_metrics["regret"] < 0.35, result.eval_metrics
 
 

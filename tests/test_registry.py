@@ -133,3 +133,34 @@ def test_ml_evaluator_fallback_and_served(tmp_path):
     assert np.asarray(out_ml["selected_valid"]).any()
     # ml scores come from the net, not the rule blend
     assert not np.allclose(np.asarray(out_ml["scores"]), np.asarray(out_fallback["scores"]))
+
+
+def test_attention_model_servable(tmp_path):
+    """The third model family (set-transformer ranker) must round-trip
+    through the registry AND be constructible/servable by ModelServer —
+    registrable-but-unservable is the reference's Triton gap all over."""
+    from dragonfly2_tpu.models.attention import AttentionRanker
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_ATTENTION
+
+    n, p, f = 4, 6, 12
+    rng = np.random.default_rng(0)
+    child = rng.normal(size=(n, f)).astype(np.float32)
+    parents = rng.normal(size=(n, p, f)).astype(np.float32)
+    pair = rng.normal(size=(n, p, 2)).astype(np.float32)
+    mask = np.ones((n, p), bool)
+    model = AttentionRanker(hidden_dim=32)
+    params = model.init(jax.random.key(0), child, parents, pair, mask)
+
+    reg = ModelRegistry(tmp_path)
+    mv = reg.create_model_version(
+        "set-ranker", MODEL_TYPE_ATTENTION, "h", params,
+        ModelEvaluation(precision=0.9), metadata={"hidden_dim": 32},
+    )
+    # no explicit model=: the server must construct the right family itself
+    server = ModelServer(reg, "set-ranker", "h", MODEL_TYPE_ATTENTION, template_params=params)
+    assert not server.ready
+    reg.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    scores = np.asarray(server.score_set(child, parents, pair, mask))
+    assert scores.shape == (n, p)
+    assert np.isfinite(scores).all()
