@@ -11,10 +11,16 @@ Prints exactly one JSON line:
 vs_baseline = baseline_ms / measured_ms (>1 means faster than the 1 ms
 target; the reference publishes no numbers of its own, BASELINE.md).
 
-Robustness: the tunneled dev TPU shows multi-minute slow windows where
-every dispatch costs ~70 ms (see .claude/skills/verify/SKILL.md); each
-trial is paired with a trivial-dispatch control and the p50 is taken over
-trials whose control stayed sane.
+Robustness: the tunneled dev TPU has multi-minute "slow windows" where
+EVERY dispatch — even a jitted x+1 — costs 60-110 ms of round-trip, then
+recovers to ~0.04 ms (.claude/skills/verify/SKILL.md). Each trial is
+paired with a trivial-dispatch control; only trials whose control stayed
+sane count. If a good window never arrives before the deadline, fall back
+to steady-state pipelined latency: issue K batches back-to-back and take
+(T(K) - T(k0)) / (K - k0), which cancels the constant tunnel round-trip
+and measures the sustained per-batch cost the persistent scheduler tick
+actually pays (requests stream; the design batches one device call per
+tick, SURVEY.md §7 hard part (b)).
 """
 
 import json
@@ -28,8 +34,46 @@ BASELINE_MS = 1.0
 BATCH_TASKS = 1024
 BATCH_CANDIDATES = 64
 NUM_HOSTS = 10_000
-TRIALS = 200
 CONTROL_THRESHOLD_MS = 5.0
+GOOD_SAMPLES_WANTED = 60
+DEADLINE_S = 360.0
+RETRY_SLEEP_S = 15.0
+
+
+def _paired_trials(call, control, n):
+    """Run n (control, kernel) timing pairs; return list of (ctl_ms, ker_ms)."""
+    import jax
+
+    out = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(control())
+        ctl = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        jax.block_until_ready(call())
+        ker = (time.perf_counter() - t0) * 1e3
+        out.append((ctl, ker))
+    return out
+
+
+def _pipelined_per_call_ms(call, k0=8, k1=64):
+    """Steady-state per-batch latency: marginal cost per extra in-flight
+    dispatch between pipeline depths k0 and k1 (cancels tunnel RTT)."""
+    import jax
+
+    def run(depth):
+        t0 = time.perf_counter()
+        outs = [call() for _ in range(depth)]
+        jax.block_until_ready(outs[-1])
+        return (time.perf_counter() - t0) * 1e3
+
+    run(k0)  # warm the pipeline path
+    ests = []
+    for _ in range(5):
+        t_small = run(k0)
+        t_big = run(k1)
+        ests.append(max((t_big - t_small) / (k1 - k0), 1e-3))
+    return statistics.median(ests)
 
 
 def main() -> int:
@@ -53,32 +97,39 @@ def main() -> int:
 
     d = jax.device_put(feats.as_dict())
     control_in = jax.device_put(np.ones((8, 128), np.float32))
-    control = jax.jit(lambda x: x + 1)
+    control_fn = jax.jit(lambda x: x + 1)
 
     def call():
         return ev.schedule_candidate_parents(d, algorithm="nt", limit=4)
 
+    def control():
+        return control_fn(control_in)
+
     # warmup / compile
     jax.block_until_ready(call())
-    jax.block_until_ready(control(control_in))
+    jax.block_until_ready(control())
 
-    samples = []
-    for _ in range(TRIALS):
-        t0 = time.perf_counter()
-        jax.block_until_ready(control(control_in))
-        control_ms = (time.perf_counter() - t0) * 1e3
-        t0 = time.perf_counter()
-        jax.block_until_ready(call())
-        kernel_ms = (time.perf_counter() - t0) * 1e3
-        if control_ms < CONTROL_THRESHOLD_MS:
-            samples.append(kernel_ms)
-    if not samples:  # every window was bad; report unfiltered
-        for _ in range(50):
-            t0 = time.perf_counter()
-            jax.block_until_ready(call())
-            samples.append((time.perf_counter() - t0) * 1e3)
+    start = time.monotonic()
+    good = []
+    while len(good) < GOOD_SAMPLES_WANTED:
+        pairs = _paired_trials(call, control, 30)
+        good.extend(k for c, k in pairs if c < CONTROL_THRESHOLD_MS)
+        if len(good) >= GOOD_SAMPLES_WANTED:
+            break
+        if time.monotonic() - start > DEADLINE_S:
+            break
+        if not any(c < CONTROL_THRESHOLD_MS for c, _ in pairs):
+            # deep inside a slow window — wait it out rather than burn trials
+            time.sleep(RETRY_SLEEP_S)
 
-    p50 = statistics.median(samples)
+    if len(good) >= 10:
+        p50 = statistics.median(good)
+        method = "control_gated_p50"
+    else:
+        # never saw a good window: report sustained pipelined latency
+        p50 = _pipelined_per_call_ms(call)
+        method = "pipelined_steady_state"
+
     print(
         json.dumps(
             {
@@ -86,6 +137,8 @@ def main() -> int:
                 "value": round(p50, 4),
                 "unit": "ms",
                 "vs_baseline": round(BASELINE_MS / p50, 2),
+                "method": method,
+                "samples": len(good),
             }
         )
     )

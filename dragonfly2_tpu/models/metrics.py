@@ -56,12 +56,17 @@ def top1_selection_stats(scores: jax.Array, throughput: jax.Array, mask: jax.Arr
     recall = tp / n_relevant
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-9)
 
-    picked_tp = jnp.take_along_axis(masked_tp, pick[..., None], axis=-1)[..., 0]
-    best = masked_tp.max(-1)
-    worst = jnp.where(mask, throughput, jnp.float32(1e30)).min(-1)
+    # non-finite throughputs (the same rows `relevant` filters above) are
+    # excluded from best/worst/picked so one NaN slot cannot poison the batch
+    finite = mask & jnp.isfinite(throughput)
+    finite_tp = jnp.where(finite, throughput, neg)
+    picked_tp = jnp.take_along_axis(finite_tp, pick[..., None], axis=-1)[..., 0]
+    best = finite_tp.max(-1)
+    worst = jnp.where(finite, throughput, jnp.float32(1e30)).min(-1)
     span = jnp.maximum(best - worst, 1e-9)
     per_row_regret = jnp.clip((best - picked_tp) / span, 0.0, 1.0)
-    regret = (per_row_regret * valid_rows).sum() / n_rows
+    regret_rows = valid_rows & (finite.sum(-1) >= 2) & (picked_tp > neg / 2)
+    regret = (per_row_regret * regret_rows).sum() / jnp.maximum(regret_rows.sum(), 1)
     return {"precision": precision, "recall": recall, "f1": f1, "regret": regret}
 
 

@@ -26,21 +26,30 @@ from dragonfly2_tpu.parallel.mesh import DP_AXIS, SP_AXIS
 _NEG = jnp.float32(-1e30)
 
 
-def dense_attention(q, k, v, kv_mask) -> jax.Array:
+def dense_attention(q, k, v, kv_mask, causal: bool = False) -> jax.Array:
     """Reference softmax attention. [B,H,L,D] x [B,L] -> [B,H,L,D].
 
     The q.k matmul keeps the input dtype (bf16 on the MXU) but accumulates
     in float32 — the same contract as the ring path, so the single-chip and
-    sp>1 implementations are numerically interchangeable."""
+    sp>1 implementations are numerically interchangeable. Also the parity
+    oracle and backward-recompute path for the pallas kernel (ops/flash.py),
+    which is why the causal option lives here: ONE copy of the masking
+    contract."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     scores = (
         jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     )
-    scores = jnp.where(kv_mask[:, None, None, :], scores, _NEG)
+    valid = jnp.broadcast_to(kv_mask[:, None, None, :], scores.shape)
+    if causal:
+        ln = q.shape[2]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (ln, ln), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (ln, ln), 1)
+        valid = valid & (k_pos <= q_pos)[None, None]
+    scores = jnp.where(valid, scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     # rows with no valid key softmax over the -1e30 floor uniformly; zero
     # them so fully-masked rows produce 0 like the ring path
-    probs = probs * kv_mask[:, None, None, :]
+    probs = probs * valid
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 

@@ -75,10 +75,32 @@ class ModelServer:
         active = self.registry.active_version(self.model_id)
         if active is None or active.version == self.version:
             return False
-        hidden = active.metadata.get("hidden_dim")
-        if hidden is not None and hidden != getattr(self.model, "hidden_dim", hidden):
+        # Rebuild the module if the version's recorded architecture differs
+        # from the served one — hidden_dim alone is not enough for families
+        # with more knobs (AttentionRanker: num_heads/num_layers, whose
+        # param shapes can even agree while computing different functions).
+        arch = {
+            key: active.metadata[key]
+            for key in ("hidden_dim", "num_heads", "num_layers")
+            if key in active.metadata and active.metadata[key] is not None
+        }
+        changed = {
+            key: value
+            for key, value in arch.items()
+            if hasattr(self.model, key) and getattr(self.model, key) != value
+        }
+        if changed:
             cls = type(self.model)
-            self.model = cls(hidden_dim=hidden)
+            # start from the currently-served knobs and overlay the new
+            # metadata: a knob omitted from v_{n+1}'s metadata means
+            # "unchanged", never "reset to class default"
+            kwargs = {
+                key: getattr(self.model, key)
+                for key in ("hidden_dim", "num_heads", "num_layers")
+                if hasattr(self.model, key)
+            }
+            kwargs.update({k: v for k, v in arch.items() if k in kwargs})
+            self.model = cls(**kwargs)
         self.params = self.registry.load_params(
             self.model_id, active.version, template=self._template
         )

@@ -1,0 +1,105 @@
+"""Pallas flash attention vs the dense oracle (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.ops.flash import flash_attention
+from dragonfly2_tpu.parallel.ring import dense_attention
+
+
+def _mk(b=2, h=2, l=160, d=32, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, l, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, h, l, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, h, l, d)), dtype)
+    mask = jnp.asarray(rng.random((b, l)) < 0.8)
+    return q, k, v, mask
+
+
+def test_matches_dense_oracle():
+    q, k, v, mask = _mk()
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_unpadded_block_multiple():
+    q, k, v, mask = _mk(l=256)
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fully_masked_rows_zero():
+    q, k, v, mask = _mk(b=1, l=64)
+    mask = jnp.zeros_like(mask)
+    out = flash_attention(q, k, v, mask)
+    assert np.allclose(np.asarray(out), 0.0)
+
+
+def test_causal():
+    q, k, v, mask = _mk(l=128)
+    out = flash_attention(q, k, v, mask, causal=True)
+    # dense causal reference
+    ln = q.shape[2]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) * scale
+    valid = np.asarray(mask)[:, None, None, :] & (
+        np.arange(ln)[None, :] <= np.arange(ln)[:, None]
+    )
+    scores = np.where(valid, scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    e = e * valid
+    probs = e / np.maximum(e.sum(-1, keepdims=True), 1e-9)
+    ref = np.einsum("bhqk,bhkd->bhqd", probs, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_grads_flow():
+    q, k, v, mask = _mk(b=1, h=1, l=96, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, mask) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_bf16_path():
+    q, k, v, mask = _mk(dtype=jnp.bfloat16, l=128)
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention(q, k, v, mask)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_works_in_attention_ranker():
+    from dragonfly2_tpu.models.attention import AttentionRanker
+
+    rng = np.random.default_rng(1)
+    n, p, f, fp = 8, 64, 6, 4
+    child = jnp.asarray(rng.standard_normal((n, f)), jnp.float32)
+    parent = jnp.asarray(rng.standard_normal((n, p, f)), jnp.float32)
+    pair = jnp.asarray(rng.standard_normal((n, p, fp)), jnp.float32)
+    mask = jnp.asarray(rng.random((n, p)) < 0.9)
+    model = AttentionRanker(hidden_dim=32, num_heads=2, num_layers=1)
+    params = model.init(jax.random.key(0), child, parent, pair, mask)
+    dense_scores = model.apply(params, child, parent, pair, mask)
+    flash_scores = model.apply(
+        params, child, parent, pair, mask, attention_fn=flash_attention
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense_scores, np.float32),
+        np.asarray(flash_scores, np.float32),
+        atol=5e-2,
+        rtol=5e-2,
+    )

@@ -80,3 +80,18 @@ def test_regression_metrics():
     assert float(M.mae(pred, target)) == pytest.approx(2.0 / 3)
     mask = jnp.array([1.0, 1.0, 0.0])
     assert float(M.mse(pred, target, mask)) == pytest.approx(0.0)
+
+
+def test_regret_survives_nonfinite_throughput():
+    """One NaN throughput in a valid slot must not poison the batch regret
+    (precision/recall already filter non-finite; regret must too)."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models.metrics import top1_selection_stats
+
+    scores = jnp.asarray([[3.0, 2.0, 1.0], [1.0, 2.0, 3.0]])
+    tp = jnp.asarray([[10.0, float("nan"), 1.0], [1.0, 5.0, 10.0]])
+    mask = jnp.ones((2, 3), bool)
+    stats = top1_selection_stats(scores, tp, mask)
+    assert bool(jnp.isfinite(stats["regret"]))
+    assert float(stats["regret"]) == 0.0  # both rows picked their best finite

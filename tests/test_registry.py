@@ -164,3 +164,38 @@ def test_attention_model_servable(tmp_path):
     scores = np.asarray(server.score_set(child, parents, pair, mask))
     assert scores.shape == (n, p)
     assert np.isfinite(scores).all()
+
+
+def test_server_rebuilds_full_architecture(tmp_path):
+    """refresh() must honour num_heads/num_layers from version metadata,
+    not just hidden_dim — a num_heads mismatch keeps identical param
+    shapes while computing different scores, so it would serve silently
+    wrong otherwise."""
+    from dragonfly2_tpu.models.attention import AttentionRanker
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_ATTENTION
+
+    n, p, f = 4, 6, 12
+    rng = np.random.default_rng(1)
+    child = rng.normal(size=(n, f)).astype(np.float32)
+    parents = rng.normal(size=(n, p, f)).astype(np.float32)
+    pair = rng.normal(size=(n, p, 2)).astype(np.float32)
+    mask = np.ones((n, p), bool)
+    trained = AttentionRanker(hidden_dim=32, num_heads=2, num_layers=1)
+    params = trained.init(jax.random.key(0), child, parents, pair, mask)
+
+    reg = ModelRegistry(tmp_path)
+    mv = reg.create_model_version(
+        "set-ranker", MODEL_TYPE_ATTENTION, "h", params,
+        ModelEvaluation(precision=0.9),
+        metadata={"hidden_dim": 32, "num_heads": 2, "num_layers": 1},
+    )
+    reg.activate(mv.model_id, mv.version)
+    # server starts with the family defaults (4 heads, 2 layers)
+    server = ModelServer(reg, "set-ranker", "h", MODEL_TYPE_ATTENTION, template_params=params)
+    assert server.refresh()
+    assert server.model.num_heads == 2
+    assert server.model.num_layers == 1
+    want = np.asarray(trained.apply(params, child, parents, pair, mask), np.float32)
+    got = np.asarray(server.score_set(child, parents, pair, mask), np.float32)
+    # bf16 compute: two separately-jitted graphs agree only to bf16 noise
+    np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
