@@ -1,0 +1,155 @@
+"""Trainer-throughput benchmark: JAX/TPU GraphSAGE vs a torch-CPU
+reference implementation of the SAME architecture and workload.
+
+North star (BASELINE.md): trainer GNN throughput >= 50x a CPU reference,
+in samples/sec/chip, converging on a 10k-peer trace. The reference repo
+has no trainer at all (trainer/training/training.go:82-98 is a TODO
+stub), so the CPU baseline is what the stub would most plausibly have
+been: the same 2-layer mean-aggregation GraphSAGE ranker in torch on the
+host CPU, full-precision, batch 1024.
+
+Prints one JSON line:
+  {"metric": "trainer_gnn_samples_per_sec", "value": <tpu>, "unit":
+   "samples/s", "vs_baseline": <tpu / cpu_torch>}
+
+(bench.py remains the driver's headline metric; this script documents the
+second north star and is run manually / by CI.)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+HIDDEN = 128
+BATCH = 1024
+EPOCHS = 4
+NUM_HOSTS = 10_000
+NUM_RECORDS = 20_000
+
+
+def _dataset():
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.features import downloads_to_ranking_dataset
+
+    cluster = synth.make_cluster(NUM_HOSTS, seed=0)
+    records = synth.gen_download_records(
+        cluster, NUM_RECORDS, num_tasks=512, max_parents=20
+    )
+    return downloads_to_ranking_dataset(records)
+
+
+def tpu_samples_per_sec(ds, graph) -> float:
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.training.train import train_gnn
+
+    cfg = TrainerConfig(hidden_dim=HIDDEN, batch_size=BATCH, epochs=EPOCHS)
+    return train_gnn(ds, graph, cfg).samples_per_sec
+
+
+def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8) -> float:
+    """Same model family in torch on CPU: 2 SAGE layers (self + neighbor
+    mean + edge mean), listwise softmax rank loss, AdamW."""
+    import torch
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+
+    node_feats = torch.tensor(graph.node_feats, dtype=torch.float32)
+    edge_src = torch.tensor(graph.edge_src, dtype=torch.long)
+    edge_dst = torch.tensor(graph.edge_dst, dtype=torch.long)
+    edge_feats = torch.tensor(graph.edge_feats, dtype=torch.float32)
+    n_nodes = node_feats.shape[0]
+    f_node, f_edge = node_feats.shape[1], edge_feats.shape[1]
+
+    class Sage(torch.nn.Module):
+        def __init__(self, f_in, f_edge, hidden):
+            super().__init__()
+            self.self0 = torch.nn.Linear(f_in, hidden)
+            self.neigh0 = torch.nn.Linear(f_in, hidden, bias=False)
+            self.edge0 = torch.nn.Linear(f_edge, hidden, bias=False)
+            self.self1 = torch.nn.Linear(hidden, hidden)
+            self.neigh1 = torch.nn.Linear(hidden, hidden, bias=False)
+            self.edge1 = torch.nn.Linear(f_edge, hidden, bias=False)
+            self.score = torch.nn.Sequential(
+                torch.nn.Linear(2 * hidden + 2, hidden),
+                torch.nn.GELU(),
+                torch.nn.Linear(hidden, 1),
+            )
+
+        def embed(self):
+            h = node_feats
+            cnt = torch.zeros(n_nodes, 1).index_add_(
+                0, edge_src, torch.ones(edge_src.shape[0], 1)
+            ).clamp(min=1.0)
+            for self_l, neigh_l, edge_l in (
+                (self.self0, self.neigh0, self.edge0),
+                (self.self1, self.neigh1, self.edge1),
+            ):
+                agg = torch.zeros(n_nodes, h.shape[1]).index_add_(0, edge_src, h[edge_dst])
+                eag = torch.zeros(n_nodes, f_edge).index_add_(0, edge_src, edge_feats)
+                h = torch.nn.functional.gelu(
+                    self_l(h) + neigh_l(agg / cnt) + edge_l(eag / cnt)
+                )
+            return h
+
+        def forward(self, child_idx, parent_idx, pair_feats):
+            h = self.embed()
+            child = h[child_idx][:, None, :].expand(-1, parent_idx.shape[1], -1)
+            parent = h[parent_idx]
+            x = torch.cat([child, parent, pair_feats], dim=-1)
+            return self.score(x)[..., 0]
+
+    model = Sage(f_node, f_edge, HIDDEN)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    rng = np.random.default_rng(0)
+    n = ds.child.shape[0]
+    pair = np.concatenate(
+        [ds.same_idc[..., None], ds.loc_match[..., None]], axis=-1
+    ).astype(np.float32)
+
+    steps = 0
+    t0 = time.perf_counter()
+    while steps < max_steps:
+        idx = rng.choice(n, BATCH, replace=False)
+        child_idx = torch.tensor(ds.child_host_idx[idx], dtype=torch.long)
+        parent_idx = torch.tensor(ds.parent_host_idx[idx], dtype=torch.long)
+        pf = torch.tensor(pair[idx])
+        tp = torch.tensor(ds.throughput[idx])
+        mask = torch.tensor(ds.mask[idx])
+        scores = model(child_idx, parent_idx, pf)
+        scores = scores.masked_fill(~mask, -1e30)
+        target = torch.softmax(tp.masked_fill(~mask, -1e30), dim=-1)
+        logp = torch.log_softmax(scores, dim=-1)
+        loss = -(target * logp.masked_fill(~mask, 0.0)).sum(-1).mean()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        steps += 1
+    dt = time.perf_counter() - t0
+    return steps * BATCH / dt
+
+
+def main() -> int:
+    ds, graph = _dataset()
+    cpu = torch_cpu_samples_per_sec(ds, graph)
+    tpu = tpu_samples_per_sec(ds, graph)
+    print(
+        json.dumps(
+            {
+                "metric": "trainer_gnn_samples_per_sec",
+                "value": round(tpu, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(tpu / cpu, 2),
+                "cpu_torch_baseline": round(cpu, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

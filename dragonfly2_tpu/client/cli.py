@@ -36,6 +36,8 @@ async def _dfget(args) -> int:
     )
     await daemon.start()
     try:
+        if args.recursive:
+            return await _recursive_download(daemon, args)
         ts = await daemon.download(
             args.url,
             tag=args.tag,
@@ -48,6 +50,84 @@ async def _dfget(args) -> int:
         return 0
     finally:
         await daemon.stop()
+
+
+def _accept(url: str, accept_regex: str, reject_regex: str) -> bool:
+    """Reject wins; then the accept filter must match if set
+    (dfget.go accept()/reject(), :296-314)."""
+    import re
+
+    if reject_regex and re.search(reject_regex, url):
+        return False
+    if accept_regex and not re.search(accept_regex, url):
+        return False
+    return True
+
+
+async def _recursive_download(daemon, args) -> int:
+    """Breadth-first directory download (recursiveDownload,
+    client/dfget/dfget.go:316-387): pop a directory, list its children via
+    the source registry, enqueue subdirectories (bounded by --level, 0 =
+    unlimited), filter files by --accept-regex/--reject-regex, download
+    each to output joined with its name. --list prints instead of
+    downloading. Re-listing an already-seen URL is deduped; cycle safety
+    for file:// trees comes from FileSource.list_entries refusing to
+    descend into directory symlinks (each hop through a link cycle would
+    mint a new, longer URL the dedup set can never catch), and --level
+    bounds pathological ever-deepening http autoindexes."""
+    from collections import deque
+
+    from dragonfly2_tpu.client import source as source_mod
+
+    root_out = pathlib.Path(args.output)
+    queue = deque([(args.url, root_out, args.level)])
+    visited: set[str] = set()
+    failures = 0
+    while queue:
+        url, out_dir, level = queue.popleft()
+        if args.level and level == 0:
+            print(f"{url}: recursion level reached, skip", file=sys.stderr)
+            continue
+        if url in visited:
+            continue
+        visited.add(url)
+        try:
+            entries = source_mod.list_entries(url)
+        except Exception as e:  # noqa: BLE001 - keep walking other subtrees
+            print(f"list {url}: {e}", file=sys.stderr)
+            failures += 1
+            continue
+        for entry in entries:
+            if "/" in entry.name or entry.name in ("", ".", ".."):
+                # defense against hostile autoindexes: an entry name that
+                # is a path (or '..') could escape the --output root
+                print(f"skip suspicious entry {entry.url!r}", file=sys.stderr)
+                continue
+            child_out = out_dir / entry.name
+            if entry.is_dir:
+                # accept/reject filter files only — pruning directories here
+                # would silently drop matching files deeper in the tree
+                queue.append((entry.url, child_out, level - 1))
+                continue
+            if not _accept(entry.url, args.accept_regex, args.reject_regex):
+                continue
+            print(str(child_out.relative_to(root_out)))
+            if args.list:
+                continue
+            try:
+                ts = await daemon.download(
+                    entry.url,
+                    tag=args.tag,
+                    application=args.application,
+                    piece_length=args.piece_length,
+                    back_source_allowed=not args.no_back_source,
+                )
+                child_out.parent.mkdir(parents=True, exist_ok=True)
+                await daemon.export_file(ts, str(child_out))
+            except Exception as e:  # noqa: BLE001
+                print(f"download {entry.url}: {e}", file=sys.stderr)
+                failures += 1
+    return 0 if failures == 0 else 1
 
 
 def _dfcache(args) -> int:
@@ -150,6 +230,20 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument("--application", default="")
     get.add_argument("--piece-length", type=int, default=4 << 20)
     get.add_argument("--no-back-source", action="store_true")
+    get.add_argument(
+        "-r", "--recursive", action="store_true",
+        help="treat URL as a directory and download it breadth-first",
+    )
+    get.add_argument(
+        "--level", type=int, default=0,
+        help="max directory depth to recurse into (0 = unlimited)",
+    )
+    get.add_argument("--accept-regex", default="", help="only fetch matching URLs")
+    get.add_argument("--reject-regex", default="", help="skip matching URLs")
+    get.add_argument(
+        "--list", action="store_true",
+        help="with --recursive: print the would-be downloads, fetch nothing",
+    )
 
     cache = sub.add_parser("dfcache", help="local task cache ops")
     cache.add_argument("action", choices=("stat", "import", "export", "delete"))

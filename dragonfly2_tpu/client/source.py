@@ -11,6 +11,8 @@ with a pointer, since this image has no credentials or SDKs wired.
 
 from __future__ import annotations
 
+import dataclasses
+import html.parser
 import pathlib
 import urllib.error
 import urllib.parse
@@ -22,12 +24,26 @@ from dragonfly2_tpu.utils import dferrors
 _CHUNK = 1 << 20
 
 
+@dataclasses.dataclass(frozen=True)
+class URLEntry:
+    """One child of a directory-ish URL (pkg/source URLEntry: URL, Name,
+    IsDir — consumed by dfget's recursive BFS, client/dfget/dfget.go:352)."""
+
+    url: str
+    name: str
+    is_dir: bool
+
+
 class SourceClient(Protocol):
     def content_length(self, url: str, headers: dict | None = None) -> int: ...
 
     def download(
         self, url: str, headers: dict | None = None, offset: int = 0, length: int = -1
     ) -> Iterator[bytes]: ...
+
+    def list_entries(
+        self, url: str, headers: dict | None = None
+    ) -> list[URLEntry]: ...
 
 
 _REGISTRY: dict[str, SourceClient] = {}
@@ -55,6 +71,11 @@ def download(
     url: str, headers: dict | None = None, offset: int = 0, length: int = -1
 ) -> Iterator[bytes]:
     return client_for(url).download(url, headers, offset, length)
+
+
+def list_entries(url: str, headers: dict | None = None) -> list[URLEntry]:
+    """Children of a directory URL (source.List, source_client.go:376)."""
+    return client_for(url).list_entries(url, headers)
 
 
 # ---------------------------------------------------------------- http(s)
@@ -102,6 +123,55 @@ class HTTPSource:
                         return
 
 
+    def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        """Parse an HTML directory index (nginx/apache autoindex, python
+        http.server): every <a href> resolving to a strict child of the
+        directory URL becomes an entry; a trailing slash marks a dir."""
+        base = url if url.endswith("/") else url + "/"
+        req = urllib.request.Request(base, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read(4 << 20).decode("utf-8", "replace")
+        except urllib.error.URLError as e:
+            raise dferrors.Unavailable(f"LIST {base}: {e}") from e
+
+        parser = _HrefParser()
+        parser.feed(body)
+        entries: list[URLEntry] = []
+        seen: set[str] = set()
+        for href in parser.hrefs:
+            if href.startswith(("?", "#")):
+                continue
+            resolved = urllib.parse.urljoin(base, href)
+            resolved, _frag = urllib.parse.urldefrag(resolved)
+            if not resolved.startswith(base) or resolved == base:
+                continue  # parent links, absolute escapes, self
+            rel = resolved[len(base):]
+            is_dir = rel.endswith("/")
+            name = urllib.parse.unquote(rel.rstrip("/"))
+            if "/" in name or name in ("", ".", ".."):
+                # only direct children; a percent-encoded '..' or '/' in the
+                # decoded name would let a hostile index escape the tree
+                continue
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            entries.append(URLEntry(url=resolved, name=name, is_dir=is_dir))
+        return entries
+
+
+class _HrefParser(html.parser.HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.hrefs: list[str] = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag == "a":
+            for key, value in attrs:
+                if key == "href" and value:
+                    self.hrefs.append(value)
+
+
 # ------------------------------------------------------------------ file
 
 
@@ -135,6 +205,29 @@ class FileSource:
                     if remaining <= 0:
                         return
 
+    def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        path = self._path(url)
+        if not path.is_dir():
+            raise dferrors.NotFound(f"{path} is not a directory")
+        base = url if url.endswith("/") else url + "/"
+        entries = []
+        for child in sorted(path.iterdir()):
+            is_dir = child.is_dir()
+            if is_dir and child.is_symlink():
+                # Never descend into directory symlinks (same stance as Go's
+                # filepath.Walk): a link to an ancestor makes every BFS hop a
+                # new, strictly longer URL, so visited-dedup alone can't
+                # terminate the walk.
+                continue
+            entries.append(
+                URLEntry(
+                    url=base + urllib.parse.quote(child.name) + ("/" if is_dir else ""),
+                    name=child.name,
+                    is_dir=is_dir,
+                )
+            )
+        return entries
+
 
 # ------------------------------------------------------------------ stubs
 
@@ -156,6 +249,9 @@ class _StubSource:
         self._raise()
 
     def download(self, url: str, headers: dict | None = None, offset: int = 0, length: int = -1):
+        self._raise()
+
+    def list_entries(self, url: str, headers: dict | None = None):
         self._raise()
 
 

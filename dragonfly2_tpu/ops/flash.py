@@ -44,7 +44,6 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-_NEG = jnp.float32(-1e30)
 _NEG_F = -1e30  # python literal: jnp constants may not be captured inside pallas kernels
 BLOCK_Q = 128
 BLOCK_K = 128

@@ -19,7 +19,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP_AXIS = "dp"
 GRAPH_AXIS = "graph"
-SP_AXIS = "sp"  # sequence/context parallelism (ring attention, parallel/ring.py)
+SP_AXIS = "sp"  # sequence/context parallelism (ring/ulysses attention)
+TP_AXIS = "tp"  # tensor parallelism (parallel/tensor.py)
+PP_AXIS = "pp"  # pipeline parallelism (parallel/pipeline.py)
+EP_AXIS = "ep"  # expert parallelism (parallel/moe.py)
 
 
 def make_mesh(
@@ -27,23 +30,27 @@ def make_mesh(
     dp: int | None = None,
     graph: int = 1,
     sp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a (dp, graph, sp) mesh. Defaults: all devices on the dp axis.
-    Unused axes have size 1 — specs that don't name them are unaffected."""
+    """Build a (dp, graph, sp, tp, pp, ep) mesh. Defaults: all devices on
+    the dp axis. Unused axes have size 1 — specs that don't name them are
+    unaffected, so existing dp/graph/sp code is oblivious to the new axes."""
     devices = devices if devices is not None else jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
-    model = graph * sp
+    model = graph * sp * tp * pp * ep
     if dp is None:
         if n % model != 0:
-            raise ValueError(f"{n} devices not divisible by graph*sp={model}")
+            raise ValueError(f"{n} devices not divisible by model axes={model}")
         dp = n // model
     if dp * model != n:
-        raise ValueError(f"mesh {dp}x{graph}x{sp} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, graph, sp)
-    return Mesh(arr, (DP_AXIS, GRAPH_AXIS, SP_AXIS))
+        raise ValueError(f"mesh {dp}x{graph}x{sp}x{tp}x{pp}x{ep} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, graph, sp, tp, pp, ep)
+    return Mesh(arr, (DP_AXIS, GRAPH_AXIS, SP_AXIS, TP_AXIS, PP_AXIS, EP_AXIS))
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
@@ -76,5 +83,28 @@ def shard_batch(mesh: Mesh, tree):
                 fill = np.repeat(x[-1:], pad, axis=0)
             x = np.concatenate([x, fill], axis=0)
         return jax.device_put(x, batch_sharding(mesh, x.ndim))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def shard_stacked_batches(mesh, tree):
+    """device_put a stack of batches [S, B, ...] with the BATCH dim (dim 1)
+    sharded over dp — the layout `lax.scan`-based epoch loops consume (one
+    device call per epoch instead of one per step). Dim-1 padding follows
+    shard_batch's rules: False for masks, repeat-last otherwise."""
+    dp = mesh.shape[DP_AXIS]
+
+    def put(x):
+        x = np.asarray(x)
+        b = x.shape[1]
+        if b % dp:
+            pad = dp - (b % dp)
+            if x.dtype == np.bool_:
+                fill = np.zeros((x.shape[0], pad) + x.shape[2:], x.dtype)
+            else:
+                fill = np.repeat(x[:, -1:], pad, axis=1)
+            x = np.concatenate([x, fill], axis=1)
+        spec = P(None, DP_AXIS, *([None] * (x.ndim - 2)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(put, tree)

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the `pp` axis.
+
+No analogue in the reference (SURVEY.md §2.6); TPU-native depth scaling:
+each device owns ONE stage's params (the stage pytree is sharded on its
+leading dim), activations hop stage-to-stage with `lax.ppermute` around
+the ICI ring, and M microbatches fill the pipe so steady-state keeps all
+pp devices busy (bubble = (pp-1)/(M+pp-1)).
+
+Homogeneous stages (same fn/shape per stage) — the layer-stack case, e.g.
+the AttentionRanker's SelfAttentionBlocks. The last stage's outputs are
+broadcast back to every device with a psum so the wrapper returns
+replicated global-shape outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.parallel.mesh import PP_AXIS
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    axis_name: str = PP_AXIS,
+):
+    """Inside shard_map: run M microbatches through pp chained stages.
+
+    stage_params: pytree whose leaves have a leading local dim of 1 (this
+    device's stage, from a [pp, ...]-sharded tree); stage_fn(params, a)
+    must preserve a's shape. x: [M, ...microbatch...] replicated on every
+    device. Returns [M, ...] outputs, replicated."""
+    pp = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    num_micro = x.shape[0]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(t, carry):
+        outputs, state = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
+        )
+        inp = jnp.where(idx == 0, feed, state)
+        y = stage_fn(my_params, inp)
+        out_t = t - (pp - 1)
+        collected = jax.lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_t, 0, num_micro - 1), 0
+        )
+        take = (idx == pp - 1) & (out_t >= 0) & (out_t < num_micro)
+        outputs = jnp.where(take, collected, outputs)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return outputs, state
+
+    outputs0 = jnp.zeros_like(x)
+    state0 = jnp.zeros_like(x[0])
+    outputs, _ = jax.lax.fori_loop(0, num_micro + pp - 1, tick, (outputs0, state0))
+    # only the last stage holds real outputs; broadcast to all devices
+    outputs = jnp.where(idx == pp - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis_name)
+
+
+def sharded_pipeline_apply(mesh, stage_fn, stage_params, x):
+    """shard_map wrapper: stage_params leaves are [pp, ...] (stage i's
+    params at index i), x is [M, ...] microbatched input; both global.
+    Returns [M, ...] outputs equal to applying the stages sequentially."""
+    fn = jax.shard_map(
+        functools.partial(pipeline_apply, stage_fn, axis_name=PP_AXIS),
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(PP_AXIS), stage_params),
+            P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stage_params, x)

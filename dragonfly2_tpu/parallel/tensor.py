@@ -1,0 +1,56 @@
+"""Tensor parallelism: Megatron-style column/row-parallel feed-forward.
+
+No analogue in the reference (it has no tensor compute, SURVEY.md §2.6);
+this is the TPU-native scaling axis for wide model layers. The classic
+two-matmul block needs exactly ONE collective:
+
+    y = gelu(x @ W1 + b1) @ W2 + b2
+        W1 [F, H] column-sharded over `tp` -> each device owns H/tp of the
+        hidden; gelu is elementwise so it needs no exchange.
+        W2 [H, F] row-sharded over `tp` -> partial [.., F] products,
+        summed with one psum over the ICI ring.
+
+Used standalone via `sharded_tp_ffn` (global shapes in/out) or composed
+inside a larger shard_map with `tp_ffn`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+
+def tp_ffn(x, w1, b1, w2, b2, axis_name: str = TP_AXIS) -> jax.Array:
+    """Inside shard_map: x [..., F] replicated over tp; w1 [F, H/tp],
+    b1 [H/tp], w2 [H/tp, F] are the local shards; b2 [F] replicated.
+    Returns the full [..., F] output on every device (one psum)."""
+    h = jnp.dot(x, w1, preferred_element_type=jnp.float32) + b1
+    h = jax.nn.gelu(h).astype(x.dtype)
+    partial = jnp.dot(h, w2, preferred_element_type=jnp.float32)
+    out = jax.lax.psum(partial, axis_name)
+    return (out + b2).astype(x.dtype)
+
+
+def sharded_tp_ffn(mesh, x, w1, b1, w2, b2) -> jax.Array:
+    """shard_map wrapper: batch over dp, hidden over tp. Weights come in
+    at global shape (W1 [F, H], W2 [H, F]) and are sharded on their
+    hidden dim; x/output are batch-sharded and tp-replicated."""
+    fn = jax.shard_map(
+        functools.partial(tp_ffn, axis_name=TP_AXIS),
+        mesh=mesh,
+        in_specs=(
+            P(DP_AXIS),  # x: batch rows over dp, features replicated
+            P(None, TP_AXIS),  # w1 columns over tp
+            P(TP_AXIS),  # b1 follows w1's hidden shard
+            P(TP_AXIS, None),  # w2 rows over tp
+            P(),  # b2 replicated
+        ),
+        out_specs=P(DP_AXIS),
+        check_vma=False,
+    )
+    return fn(x, w1, b1, w2, b2)

@@ -1,0 +1,83 @@
+"""Ulysses (all-to-all) sequence parallelism — the second long-context
+strategy, alongside ring attention (parallel/ring.py).
+
+The reference has no sequence models (SURVEY.md §5); this is new TPU-first
+capability. Where ring attention keeps queries resident and rotates KV
+shards hop-by-hop around the ICI ring (sp all-reduce-ish traffic, best
+when L is huge and heads are few), Ulysses re-shards with two
+`lax.all_to_all`s: heads scatter across the `sp` axis while the sequence
+gathers, every device runs *exact* full-sequence attention over H/sp
+heads, then the inverse all_to_all restores the sequence sharding. Two
+collective hops total, best when H >= sp and the per-device full sequence
+fits HBM — and the local attend is free to use the fused pallas kernel
+(ops/flash.py).
+
+Layouts match ring.py: q/k/v [B, H, L, D] with L sharded over `sp` inside
+shard_map, kv_mask [B, L] key validity. dense_attention is the parity
+oracle; both strategies are numerically interchangeable with it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.parallel.mesh import DP_AXIS, SP_AXIS
+from dragonfly2_tpu.parallel.ring import dense_attention
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    kv_mask,
+    axis_name: str = SP_AXIS,
+    inner: Callable = dense_attention,
+    causal: bool = False,
+) -> jax.Array:
+    """Inside shard_map: [B, H, L/sp, D] shards -> exact attention.
+
+    all_to_all #1: scatter heads (axis 1), gather sequence (axis 2) ->
+    each device holds [B, H/sp, L, D]. Local `inner` attends the full
+    sequence for its head group. all_to_all #2 inverts the exchange.
+    Requires H % sp == 0."""
+    sp = jax.lax.psum(1, axis_name)
+    heads = q.shape[1]
+    if heads % sp:
+        raise ValueError(f"num_heads={heads} must be divisible by sp={sp}")
+
+    def scatter_heads(t):  # [B, H, Ls, D] -> [B, H/sp, L, D]
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def gather_heads(t):  # [B, H/sp, L, D] -> [B, H, Ls, D]
+        return jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # every device needs the full-sequence key mask for its head group
+    mask_full = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+    out = inner(qg, kg, vg, mask_full, causal=causal)
+    return gather_heads(out)
+
+
+def sharded_ulysses_attention(
+    mesh, q, k, v, kv_mask, inner: Callable = dense_attention, causal: bool = False
+) -> jax.Array:
+    """shard_map wrapper: batch over `dp`, sequence over `sp` — the same
+    global-shapes-in/out contract as ring.sharded_ring_attention, so the
+    two strategies are drop-in swaps for each other."""
+    qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
+    mask_spec = P(DP_AXIS, SP_AXIS)
+    fn = jax.shard_map(
+        functools.partial(
+            ulysses_attention, axis_name=SP_AXIS, inner=inner, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_mask)

@@ -81,3 +81,40 @@ def graph_arrays(graph: HostGraph, pad_edges_to: int | None = None) -> dict:
 def edge_bucket(e: int, granularity: int = 4096) -> int:
     """Round edge count up to a bucket so graph growth rarely recompiles."""
     return max(granularity, ((e + granularity - 1) // granularity) * granularity)
+
+
+# Above this node count the [N, N] bf16 adjacency would cross ~2 GB of HBM
+# and the edge-sharded segment path (train.embed_graph_sharded) wins.
+DENSE_ADJ_MAX_NODES = 16_384
+
+
+def dense_graph_arrays(graph: HostGraph) -> dict:
+    """HostGraph -> arrays for the MXU dense-aggregation path
+    (models/graphsage.SAGELayer adj= branch): `adj` is the row-normalized
+    neighbor matrix (adj @ h == mean over N(v)), `edge_mean` the static
+    per-node mean of incident edge features. Same math as the segment
+    path — one matmul instead of gather + scatter-add per layer."""
+    n = graph.node_feats.shape[0]
+    if n > DENSE_ADJ_MAX_NODES:
+        raise ValueError(
+            f"{n} nodes > DENSE_ADJ_MAX_NODES={DENSE_ADJ_MAX_NODES}; "
+            "use graph_arrays + embed_graph_sharded instead"
+        )
+    adj = np.zeros((n, n), np.float32)
+    np.add.at(adj, (graph.edge_src, graph.edge_dst), 1.0)
+    cnt = np.maximum(adj.sum(axis=1, keepdims=True), 1.0)
+    adj /= cnt
+    edge_sum = np.zeros((n, graph.edge_feats.shape[1]), np.float32)
+    np.add.at(edge_sum, graph.edge_src, graph.edge_feats.astype(np.float32))
+    edge_mean = edge_sum / cnt
+    return {
+        "node_feats": graph.node_feats.astype(np.float32),
+        # segment inputs kept for API compatibility; unused on this path
+        "edge_src": graph.edge_src.astype(np.int32),
+        "edge_dst": graph.edge_dst.astype(np.int32),
+        "edge_feats": graph.edge_feats.astype(np.float32),
+        # f16 on the host: halves the one-time transfer; the model
+        # casts to its compute dtype (bf16) before the matmul
+        "adj": adj.astype(np.float16),
+        "edge_mean": edge_mean,
+    }

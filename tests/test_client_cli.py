@@ -102,3 +102,214 @@ def test_dfget_end_to_end(tmp_path, capsys):
     finally:
         origin.shutdown()
         origin.server_close()
+
+
+def test_source_list_entries_file_and_http(tmp_path):
+    """Directory listing through the source registry: file:// scandir and
+    an HTML autoindex over HTTP (pkg/source List, source_client.go:376)."""
+    import functools
+    import http.server
+
+    from dragonfly2_tpu.client import source
+
+    root = tmp_path / "tree"
+    (root / "sub").mkdir(parents=True)
+    (root / "a.txt").write_bytes(b"a")
+    (root / "b.bin").write_bytes(b"bb")
+    (root / "sub" / "c.txt").write_bytes(b"ccc")
+
+    entries = source.list_entries(f"file://{root}")
+    names = {(e.name, e.is_dir) for e in entries}
+    assert names == {("a.txt", False), ("b.bin", False), ("sub", True)}
+
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(root)
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        entries = source.list_entries(f"http://127.0.0.1:{port}/")
+        names = {(e.name, e.is_dir) for e in entries}
+        assert names == {("a.txt", False), ("b.bin", False), ("sub", True)}
+        sub = next(e for e in entries if e.is_dir)
+        kids = source.list_entries(sub.url)
+        assert {(e.name, e.is_dir) for e in kids} == {("c.txt", False)}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_dfget_recursive(tmp_path, capsys):
+    """Recursive dfget over an HTTP autoindex tree: BFS, level limit,
+    accept/reject regex, --list (recursiveDownload, dfget.go:316-387)."""
+    import functools
+    import http.server
+
+    root = tmp_path / "tree"
+    (root / "sub" / "deep").mkdir(parents=True)
+    (root / "a.txt").write_bytes(b"alpha" * 1000)
+    (root / "b.log").write_bytes(b"log" * 100)
+    (root / "sub" / "c.txt").write_bytes(b"gamma" * 2000)
+    (root / "sub" / "deep" / "d.txt").write_bytes(b"delta" * 300)
+
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(root)
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def run(extra, out_name):
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 32
+        server = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        host, sport = await server.start()
+        out = tmp_path / out_name
+        rc = await cli._dfget(
+            cli.build_parser().parse_args(
+                [
+                    "dfget", f"http://127.0.0.1:{port}/",
+                    "-o", str(out), "--recursive",
+                    "--scheduler", f"{host}:{sport}",
+                    "--data-dir", str(tmp_path / f"data-{out_name}"),
+                    "--piece-length", str(16 * 1024),
+                ]
+                + extra
+            )
+        )
+        await server.stop()
+        return rc, out
+
+    try:
+        # full recursive fetch, rejecting logs
+        rc, out = asyncio.run(run(["--reject-regex", r"\.log$"], "full"))
+        assert rc == 0
+        assert (out / "a.txt").read_bytes() == b"alpha" * 1000
+        assert (out / "sub" / "c.txt").read_bytes() == b"gamma" * 2000
+        assert (out / "sub" / "deep" / "d.txt").read_bytes() == b"delta" * 300
+        assert not (out / "b.log").exists()
+        capsys.readouterr()
+
+        # --list prints relative paths, downloads nothing
+        rc, out = asyncio.run(run(["--list"], "listed"))
+        assert rc == 0
+        printed = capsys.readouterr().out.strip().splitlines()
+        assert "a.txt" in printed and "b.log" in printed
+        assert not (out / "a.txt").exists()
+
+        # level=1: root listed, subdirectories skipped
+        rc, out = asyncio.run(run(["--level", "1"], "shallow"))
+        assert rc == 0
+        assert (out / "a.txt").exists()
+        assert not (out / "sub").exists()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_file_list_entries_skips_dir_symlinks(tmp_path):
+    """A directory symlink to an ancestor must not be listed as a dir:
+    every BFS hop through the cycle would mint a new, longer URL, so the
+    recursive walk would never terminate. File symlinks still resolve."""
+    from dragonfly2_tpu.client import source
+
+    root = tmp_path / "tree"
+    (root / "sub").mkdir(parents=True)
+    (root / "a.txt").write_bytes(b"a")
+    (root / "sub" / "loop").symlink_to(root, target_is_directory=True)
+    (root / "sub" / "f.txt").symlink_to(root / "a.txt")
+
+    names = {(e.name, e.is_dir) for e in source.list_entries(f"file://{root}/sub")}
+    assert names == {("f.txt", False)}
+
+
+def test_list_entries_rejects_encoded_traversal():
+    """A hostile autoindex with %2E%2E/ (encoded '..') must not produce an
+    entry that escapes the tree."""
+    import http.server
+
+    from dragonfly2_tpu.client import source
+
+    page = b'<html><a href="%2E%2E/">up</a><a href="ok.txt">ok</a>' \
+           b'<a href="a%2Fb">slash</a><a href=".">self</a></html>'
+
+    class Evil(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Evil)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        entries = source.list_entries(f"http://127.0.0.1:{port}/dir/")
+        assert [e.name for e in entries] == ["ok.txt"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_dfget_recursive_accept_regex_keeps_subdirs(tmp_path, capsys):
+    """--accept-regex filters files only: a subdirectory that does not
+    match must still be descended into (matching files live below it)."""
+    import functools
+    import http.server
+
+    root = tmp_path / "tree"
+    (root / "sub").mkdir(parents=True)
+    (root / "top.txt").write_bytes(b"top")
+    (root / "sub" / "inner.txt").write_bytes(b"inner")
+    (root / "sub" / "skip.bin").write_bytes(b"no")
+
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(root)
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 32
+        server = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+        host, sport = await server.start()
+        out = tmp_path / "out"
+        rc = await cli._dfget(
+            cli.build_parser().parse_args(
+                [
+                    "dfget", f"http://127.0.0.1:{port}/",
+                    "-o", str(out), "--recursive",
+                    "--accept-regex", r"\.txt$",
+                    "--scheduler", f"{host}:{sport}",
+                    "--data-dir", str(tmp_path / "data"),
+                ]
+            )
+        )
+        await server.stop()
+        return rc, out
+
+    try:
+        rc, out = asyncio.run(run())
+        assert rc == 0
+        assert (out / "top.txt").exists()
+        assert (out / "sub" / "inner.txt").exists()  # dir didn't match but was walked
+        assert not (out / "sub" / "skip.bin").exists()
+    finally:
+        srv.shutdown()
+        srv.server_close()

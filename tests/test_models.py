@@ -95,3 +95,36 @@ def test_regret_survives_nonfinite_throughput():
     stats = top1_selection_stats(scores, tp, mask)
     assert bool(jnp.isfinite(stats["regret"]))
     assert float(stats["regret"]) == 0.0  # both rows picked their best finite
+
+
+def test_dense_adjacency_matches_segment_path():
+    """The MXU dense-adjacency aggregation must equal the segment_sum path
+    (same params, same scores) — it is an execution strategy, not a model."""
+    import jax
+    import numpy as np
+
+    from dragonfly2_tpu.models.graphsage import GraphSAGERanker
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.features import downloads_to_ranking_dataset
+    from dragonfly2_tpu.training import data as D
+
+    cluster = synth.make_cluster(64, seed=5)
+    records = synth.gen_download_records(cluster, 128, num_tasks=16, max_parents=8)
+    ds, graph = downloads_to_ranking_dataset(records, max_parents=8)
+    seg = D.graph_arrays(graph)
+    dense = D.dense_graph_arrays(graph)
+
+    model = GraphSAGERanker(hidden_dim=32)
+    idx = np.arange(16)
+    pair = np.concatenate(
+        [ds.same_idc[idx, :, None], ds.loc_match[idx, :, None]], axis=-1
+    ).astype(np.float32)
+    params = model.init(
+        jax.random.key(0), seg, ds.child_host_idx[idx], ds.parent_host_idx[idx], pair
+    )
+    s_seg = model.apply(params, seg, ds.child_host_idx[idx], ds.parent_host_idx[idx], pair)
+    s_dense = model.apply(params, dense, ds.child_host_idx[idx], ds.parent_host_idx[idx], pair)
+    np.testing.assert_allclose(
+        np.asarray(s_seg, np.float32), np.asarray(s_dense, np.float32),
+        atol=5e-2, rtol=5e-2,  # bf16 compute; aggregation order differs
+    )
