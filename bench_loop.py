@@ -1,0 +1,210 @@
+"""Full-loop scale replay — SURVEY.md §7 stage 8 at its target size.
+
+Drives the whole framework end to end at the BASELINE.json configs[3]
+scale: a 10k-host cluster replays ~1M piece downloads through the real
+SchedulerService (batched device evaluator, DAGs, probe EWMA store,
+CSV trace storage), the announcer streams the traces to the trainer,
+the trainer fits the GraphSAGE ranker + MLP regressor and publishes to
+the model registry, and a second replay phase serves the trained model
+back into the scheduler's `ml` evaluator — the loop the reference never
+closed (trainer/training/training.go:82-98 TODO stubs).
+
+Prints one JSON line per phase plus a final summary line:
+  {"metric": "full_loop_pieces_per_sec", ...}
+  {"metric": "full_loop_tick_p50_ms", ...}
+  {"metric": "full_loop_trainer_samples_per_sec", ...}
+  {"metric": "full_loop_ml_tick_p50_ms", ...}
+
+Usage: python bench_loop.py [--hosts 10000] [--pieces 1000000]
+       [--tasks 512] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import statistics
+import tempfile
+import time
+
+import numpy as np
+
+
+def replay(svc, sim, target_pieces: int, new_downloads: int, probe_every: int = 50):
+    """Run rounds until `target_pieces` pieces have flowed; GC completed
+    peers above a high-water mark the way the reference's TTL GC reclaims
+    dead resource entries (pkg/gc + resource managers)."""
+    tick_ms: list[float] = []
+    completed_order: collections.deque[str] = collections.deque()
+    max_peers = svc.state.max_peers
+    high, low = int(max_peers * 0.75), int(max_peers * 0.6)
+    rounds = 0
+    t0 = time.perf_counter()
+    while sim.stats.pieces < target_pieces:
+        for _ in range(new_downloads):
+            sim.start_download()
+        t1 = time.perf_counter()
+        responses = svc.tick()
+        tick_ms.append((time.perf_counter() - t1) * 1e3)
+        for resp in responses:
+            sim._act(resp)
+            pid = getattr(resp, "peer_id", None)
+            if pid is not None:
+                completed_order.append(pid)
+        rounds += 1
+        if rounds % probe_every == 0:
+            sim.run_probe_round(sources=8)
+        used = svc.state.counts().get("peers", 0)
+        if used > high:
+            while used > low and completed_order:
+                pid = completed_order.popleft()
+                if pid in svc._peer_meta:
+                    svc.leave_peer(pid)
+                    used -= 1
+    wall = time.perf_counter() - t0
+    return wall, tick_ms, rounds
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=10_000)
+    ap.add_argument("--pieces", type=int, default=1_000_000)
+    ap.add_argument("--tasks", type=int, default=512)
+    ap.add_argument("--downloads-per-round", type=int, default=64)
+    ap.add_argument("--quick", action="store_true",
+                    help="1k hosts / 20k pieces smoke configuration")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+    if args.quick:
+        args.hosts, args.pieces, args.tasks = 1000, 20_000, 64
+
+    from dragonfly2_tpu.cluster.announcer import Announcer
+    from dragonfly2_tpu.cluster.probes import ProbeStore
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+    from dragonfly2_tpu.cluster.trainer_service import GNN_MODEL_NAME, TrainerService
+    from dragonfly2_tpu.config.config import Config, TrainerConfig
+    from dragonfly2_tpu.models import GraphSAGERanker
+    from dragonfly2_tpu.records.storage import HostTraceStorage, TraceStorage
+    from dragonfly2_tpu.registry import MLEvaluator, ModelRegistry, ModelServer
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_GNN
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="bench-loop-")
+    results = []
+
+    # ---------------- phase 1: 10k-host replay producing real traces
+    cfg = Config()
+    cfg.scheduler.max_hosts = max(16384, 1 << (args.hosts - 1).bit_length())
+    cfg.scheduler.max_tasks = max(4096, 2 * args.tasks)
+    storage = TraceStorage(f"{workdir}/sched-data")
+    probes = ProbeStore(max_pairs=1 << 17, max_hosts=cfg.scheduler.max_hosts)
+    svc = SchedulerService(config=cfg, storage=storage, probes=probes)
+    sim = ClusterSimulator(svc, num_hosts=args.hosts, num_tasks=args.tasks, seed=0)
+
+    wall, tick_ms, rounds = replay(
+        svc, sim, args.pieces, args.downloads_per_round
+    )
+    pieces_per_sec = sim.stats.pieces / max(wall, 1e-9)
+    results.append({
+        "metric": "full_loop_pieces_per_sec",
+        "value": round(pieces_per_sec, 1),
+        "unit": "pieces/s",
+        "pieces": sim.stats.pieces,
+        "completed": sim.stats.completed,
+        "back_to_source": sim.stats.back_to_source,
+        "rounds": rounds,
+        "hosts": args.hosts,
+        "wall_s": round(wall, 2),
+    })
+    results.append({
+        "metric": "full_loop_tick_p50_ms",
+        "value": round(statistics.median(tick_ms), 3),
+        "unit": "ms",
+        "p95": round(sorted(tick_ms)[int(0.95 * len(tick_ms))], 3),
+        "ticks": len(tick_ms),
+    })
+
+    # topology snapshot feeding the GNN dataset
+    host_info = {
+        svc.state.host_index(h.id): {
+            "id": h.id, "hostname": h.hostname, "ip": h.ip, "port": 8002,
+            "type": "super" if h.is_seed else "normal",
+        }
+        for h in sim.cluster.hosts
+        if svc.state.host_index(h.id) is not None
+    }
+    for rec in probes.snapshot(host_info, now_ns=1):
+        storage.create_network_topology(rec)
+
+    # ---------------- phase 2: announcer -> trainer -> registry
+    registry = ModelRegistry(f"{workdir}/registry")
+    tcfg = TrainerConfig(epochs=4, batch_size=1024, hidden_dim=64)
+    trainer = TrainerService(HostTraceStorage(f"{workdir}/trainer-data"), registry, tcfg)
+    announcer = Announcer("sched-host-1", storage, trainer, interval_seconds=0)
+    t0 = time.perf_counter()
+    assert announcer.maybe_announce(), "announce+train failed"
+    train_wall = time.perf_counter() - t0
+    gnn_id = registry.model_id(GNN_MODEL_NAME, "sched-host-1")
+    active = registry.active_version(gnn_id)
+    assert active is not None, "no active GNN version after training"
+    results.append({
+        "metric": "full_loop_trainer_wall_s",
+        "value": round(train_wall, 2),
+        "unit": "s",
+        "precision": round(active.evaluation.precision, 4),
+        "recall": round(active.evaluation.recall, 4),
+        "f1": round(active.evaluation.f1_score, 4),
+    })
+
+    # ---------------- phase 3: serve the model on the ml path at scale
+    import jax
+
+    hidden = tcfg.hidden_dim
+    template_graph = {
+        "node_feats": np.zeros((4, svc.state.host_numeric.shape[1]), np.float32),
+        "edge_src": np.zeros(2, np.int32),
+        "edge_dst": np.zeros(2, np.int32),
+        "edge_feats": np.zeros((2, 2), np.float32),
+    }
+    model = GraphSAGERanker(hidden_dim=hidden)
+    template = model.init(
+        jax.random.key(0), template_graph, np.zeros(1, np.int32),
+        np.zeros((1, 2), np.int32), np.zeros((1, 2, 2), np.float32),
+    )
+    server = ModelServer(registry, GNN_MODEL_NAME, "sched-host-1", MODEL_TYPE_GNN, template)
+    assert server.refresh(), "model server refresh failed"
+    ml = MLEvaluator(server)
+    used = max(host_info) + 1
+    ml.refresh_embeddings({
+        "node_feats": svc.state.host_numeric[:used].astype(np.float32),
+        "edge_src": np.zeros(2, np.int32),
+        "edge_dst": np.zeros(2, np.int32),
+        "edge_feats": np.zeros((2, 2), np.float32),
+    })
+
+    cfg_ml = Config()
+    cfg_ml.evaluator.algorithm = "ml"
+    cfg_ml.scheduler.max_hosts = cfg.scheduler.max_hosts
+    cfg_ml.scheduler.max_tasks = cfg.scheduler.max_tasks
+    svc_ml = SchedulerService(config=cfg_ml, ml_evaluator=ml)
+    sim_ml = ClusterSimulator(svc_ml, num_hosts=args.hosts, num_tasks=args.tasks, seed=1)
+    ml_target = max(args.pieces // 50, 2000)
+    wall_ml, tick_ml, _ = replay(svc_ml, sim_ml, ml_target, args.downloads_per_round)
+    results.append({
+        "metric": "full_loop_ml_tick_p50_ms",
+        "value": round(statistics.median(tick_ml), 3),
+        "unit": "ms",
+        "pieces_per_sec": round(sim_ml.stats.pieces / max(wall_ml, 1e-9), 1),
+        "pieces": sim_ml.stats.pieces,
+    })
+
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

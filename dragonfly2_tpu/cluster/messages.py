@@ -259,6 +259,16 @@ class TrainRequest:
 
 
 @dataclasses.dataclass
+class TrainEndRequest:
+    """Explicit end-of-upload commit marker. A torn connection shows up as
+    bare EOF, which the trainer treats as an abort; only this frame starts
+    training — the role CloseSend/io.EOF separation plays in the reference
+    (trainer/service/service_v1.go stream handling)."""
+
+    host_id: str = ""
+
+
+@dataclasses.dataclass
 class TrainResponse:
     ok: bool
     description: str = ""

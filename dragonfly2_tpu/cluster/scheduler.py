@@ -99,6 +99,18 @@ class SchedulerService:
         self.ml_evaluator = ml_evaluator
         self.rng = np.random.default_rng(seed)
         self.algorithm = self.config.evaluator.algorithm
+        # "plugin": an externally supplied scorer replaces the linear blend
+        # while every filter rule still applies (evaluator plugin.go; loader
+        # contract: utils/plugins). The object must expose
+        # `evaluate(feats: dict) -> (B, K) scores`.
+        self.plugin_evaluator = None
+        if self.algorithm == "plugin":
+            from dragonfly2_tpu.utils import plugins
+
+            evcfg = self.config.evaluator
+            self.plugin_evaluator = plugins.load(
+                evcfg.plugin_dir, "evaluator", evcfg.plugin_name
+            )
         self._dags: dict[str, TaskDAG] = {}
         self._dag_capacity = _round_up_64(sched.max_peers_per_task)
         self._peer_meta: dict[str, _PeerMeta] = {}
@@ -438,22 +450,53 @@ class SchedulerService:
         feats = self.state.gather_candidates(
             child_peer_idx, cand_peer_idx, cand_valid, avg_rtt, has_rtt
         )
+        fd = feats.as_dict()
 
+        # The jitted kernels specialize on (B, K). A raw B = len(pending)
+        # would recompile on nearly every tick (SURVEY.md §7 hard part (a)),
+        # so the batch is cut into chunks padded to one of three fixed
+        # buckets — at most three compiled shapes per algorithm, with the
+        # biggest chunk at the BASELINE eval shape (1024 tasks/call).
+        # Padding rows are valid=False everywhere and fall out of selection.
         limit = self.config.scheduler.candidate_parent_limit
-        if self.ml_evaluator is not None and self.algorithm == "ml":
-            out = self.ml_evaluator.schedule(
-                feats.as_dict(), child_host_slots, cand_host_slots,
-                blocklist, in_degree, can_add_edge, limit=limit,
-            )
-        else:
-            algorithm = self.algorithm if self.algorithm in ("default", "nt") else "default"
-            out = ev.schedule_candidate_parents(
-                feats.as_dict(), blocklist, in_degree, can_add_edge,
-                algorithm=algorithm, limit=limit,
-            )
-        selected = np.asarray(out["selected"])
-        selected_valid = np.asarray(out["selected_valid"])
-        selected_scores = np.asarray(out["selected_scores"])
+        sel_parts, val_parts, score_parts = [], [], []
+        for s in range(0, b, _EVAL_BUCKETS[-1]):
+            e = min(s + _EVAL_BUCKETS[-1], b)
+            bsz = _bucket_rows(e - s)
+            fd_c = {name: _pad_rows(v[s:e], bsz) for name, v in fd.items()}
+            bl = _pad_rows(blocklist[s:e], bsz)
+            ind = _pad_rows(in_degree[s:e], bsz)
+            cae = _pad_rows(can_add_edge[s:e], bsz)
+            if self.ml_evaluator is not None and self.algorithm == "ml":
+                out = self.ml_evaluator.schedule(
+                    fd_c,
+                    _pad_rows(child_host_slots[s:e], bsz),
+                    _pad_rows(cand_host_slots[s:e], bsz),
+                    bl, ind, cae, limit=limit,
+                )
+            elif self.plugin_evaluator is not None:
+                scores = np.asarray(self.plugin_evaluator.evaluate(fd_c), np.float32)
+                out = ev.select_with_scores(
+                    fd_c, scores, bl, ind, cae, limit=limit
+                )
+            else:
+                algorithm = self.algorithm if self.algorithm in ("default", "nt") else "default"
+                out = ev.schedule_candidate_parents(
+                    fd_c, bl, ind, cae, algorithm=algorithm, limit=limit
+                )
+            # One round trip, not three: start async D2H copies for every
+            # output before the first blocking read — over a tunneled device
+            # each blocking np.asarray pays the full link RTT serially.
+            for key in ("selected", "selected_valid", "selected_scores"):
+                arr = out[key]
+                if hasattr(arr, "copy_to_host_async"):
+                    arr.copy_to_host_async()
+            sel_parts.append(np.asarray(out["selected"])[: e - s])
+            val_parts.append(np.asarray(out["selected_valid"])[: e - s])
+            score_parts.append(np.asarray(out["selected_scores"])[: e - s])
+        selected = np.concatenate(sel_parts)
+        selected_valid = np.concatenate(val_parts)
+        selected_scores = np.concatenate(score_parts)
 
         for i, pending in enumerate(work):
             meta = self._peer_meta[pending.peer_id]
@@ -704,3 +747,22 @@ class SchedulerService:
 
 def _round_up_64(n: int) -> int:
     return ((n + 63) // 64) * 64
+
+
+# Fixed (B, K) batch buckets for the jitted scheduling kernels; the largest
+# is the BASELINE.json eval shape (1k concurrent tasks per device call).
+_EVAL_BUCKETS = (64, 256, 1024)
+
+
+def _bucket_rows(n: int) -> int:
+    for cap in _EVAL_BUCKETS:
+        if n <= cap:
+            return cap
+    return _EVAL_BUCKETS[-1]
+
+
+def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    if a.shape[0] == rows:
+        return a
+    pad = np.zeros((rows - a.shape[0],) + a.shape[1:], a.dtype)
+    return np.concatenate([a, pad])

@@ -28,12 +28,15 @@ ENV_PREFIX = "DRAGONFLY_"
 
 @dataclasses.dataclass
 class EvaluatorConfig:
-    # "default" | "nt" | "ml" — unlike the reference (evaluator.go:84-86,
-    # where "ml" silently falls back to base), "ml" here is actually wired to
-    # a served model (registry/serving.py).
+    # "default" | "nt" | "ml" | "plugin" — unlike the reference
+    # (evaluator.go:84-86, where "ml" silently falls back to base), "ml" here
+    # is actually wired to a served model (registry/serving.py), and "plugin"
+    # loads a scorer via utils/plugins (plugin.go + dfplugin.go:43-81).
     algorithm: str = "default"
     batch_tasks: int = CONSTANTS.EVAL_BATCH_TASKS
     batch_candidates: int = CONSTANTS.EVAL_BATCH_CANDIDATES
+    plugin_dir: str = ""
+    plugin_name: str = ""
 
 
 @dataclasses.dataclass

@@ -53,6 +53,62 @@ def make_mesh(
     return Mesh(arr, (DP_AXIS, GRAPH_AXIS, SP_AXIS, TP_AXIS, PP_AXIS, EP_AXIS))
 
 
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bootstrap: `jax.distributed.initialize` with the standard
+    env-var fallbacks. After this, `jax.devices()` spans every host and
+    `make_mesh`/`make_hybrid_mesh` build global meshes whose collectives
+    ride ICI within a slice and DCN across slices — the role the
+    reference's NCCL-free gRPC/Redis backend plays for its cluster
+    (SURVEY.md §2.6), minus the hand-written transport."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(
+    dcn_dp: int,
+    dp: int = 1,
+    graph: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Mesh for multi-slice / multi-host topologies: `dcn_dp` data-parallel
+    replicas over DCN (one per slice), every other axis within a slice over
+    ICI. Gradient all-reduce then decomposes into a fast intra-slice
+    reduce-scatter/all-gather plus a small cross-slice all-reduce — the
+    layout the scaling playbook prescribes, with only the dp axis allowed
+    to cross the slow network. Falls back to `make_mesh` ordering when the
+    platform exposes no slice topology (CPU test meshes)."""
+    from jax.experimental import mesh_utils
+
+    axis_names = (DP_AXIS, GRAPH_AXIS, SP_AXIS, TP_AXIS, PP_AXIS, EP_AXIS)
+    ici_shape = (dp, graph, sp, tp, pp, ep)
+    dcn_shape = (dcn_dp, 1, 1, 1, 1, 1)
+    devices = devices if devices is not None else jax.devices()
+    slices = {getattr(d, "slice_index", None) for d in devices}
+    if len(slices) <= 1 or None in slices:
+        # Single slice or no slice topology (CPU test meshes): a hybrid
+        # layout is meaningless, fold the dcn replicas into dp so specs
+        # keep working unchanged. Real multi-slice errors must NOT take
+        # this path — a flat mesh would let model axes span DCN.
+        return make_mesh(
+            dcn_dp * dp * graph * sp * tp * pp * ep,
+            dp=dcn_dp * dp, graph=graph, sp=sp, tp=tp, pp=pp, ep=ep,
+            devices=devices,
+        )
+    arr = mesh_utils.create_hybrid_device_mesh(ici_shape, dcn_shape, devices=devices)
+    return Mesh(arr, axis_names)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard the leading (batch) dim over dp, replicate the rest."""
     return NamedSharding(mesh, P(DP_AXIS, *([None] * (ndim - 1))))

@@ -173,17 +173,26 @@ class TrainerClient:
     ) -> msg.TrainResponse:
         reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
-            for dataset, blob in datasets.items():
-                for off in range(0, max(len(blob), 1), chunk_size):
-                    wire.write_frame(
-                        writer,
-                        msg.TrainRequest(
-                            host_id=host_id, ip=ip, hostname=hostname,
-                            dataset=dataset, chunk=blob[off : off + chunk_size],
-                        ),
-                    )
-                    await writer.drain()
-            writer.write_eof()
+            try:
+                for dataset, blob in datasets.items():
+                    for off in range(0, max(len(blob), 1), chunk_size):
+                        wire.write_frame(
+                            writer,
+                            msg.TrainRequest(
+                                host_id=host_id, ip=ip, hostname=hostname,
+                                dataset=dataset, chunk=blob[off : off + chunk_size],
+                            ),
+                        )
+                        await writer.drain()
+                # explicit commit marker: bare EOF means "torn", not "done"
+                wire.write_frame(writer, msg.TrainEndRequest(host_id=host_id))
+                await writer.drain()
+                writer.write_eof()
+            except (ConnectionError, RuntimeError):
+                # The server may have replied with an error and closed its
+                # read side mid-upload; fall through and try to collect that
+                # response rather than losing it to the broken pipe.
+                pass
             response = await wire.read_frame(reader)
             if not isinstance(response, msg.TrainResponse):
                 return msg.TrainResponse(ok=False, description="bad trainer reply")

@@ -292,3 +292,121 @@ class SchedulerRPCServer:
             except (ConnectionError, RuntimeError):
                 async with self._lock:
                     self._peer_conn.pop(peer_id, None)
+
+
+class TrainerRPCServer:
+    """Trainer service edge: the Train client-stream as a socket server.
+
+    Capability parity with trainer/rpcserver/trainer_server_v1.go +
+    trainer/service/service_v1.go:59-162: a connection streams TrainRequest
+    frames ('download' chunks -> the MLP dataset, 'networktopology' -> the
+    GNN dataset, per-host files keyed by host_id), EOF kicks training off
+    the event loop, errors clear only that host's partial files, and the
+    single TrainResponse reports the outcome."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self.service = service  # TrainerService (cluster/trainer_service.py)
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        reg = default_registry()
+        self._m_chunks = reg.counter(
+            "dragonfly_trainer_train_chunks_total", "dataset chunks", ("dataset",)
+        )
+        self._m_trains = reg.counter(
+            "dragonfly_trainer_train_total", "train runs", ("state",)
+        )
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        logger.info("trainer rpc listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self._writers):
+            w.close()
+
+    async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._writers.add(writer)
+        host_id = None
+        try:
+            committed = False
+            while True:
+                request = await wire.read_frame(reader)
+                if request is None:
+                    # Bare EOF before the TrainEndRequest commit marker: the
+                    # connection tore (read_frame folds ConnectionError into
+                    # None) — never train on a possibly-truncated dataset.
+                    break
+                if isinstance(request, msg.TrainEndRequest):
+                    host_id = request.host_id or host_id
+                    committed = True
+                    break
+                if not isinstance(request, msg.TrainRequest):
+                    await self._abort_reply(
+                        reader, writer, host_id, "expected TrainRequest"
+                    )
+                    return
+                host_id = request.host_id
+                self._m_chunks.labels(request.dataset).inc()
+                try:
+                    if request.dataset == "download":
+                        self.service.train_mlp_chunk(host_id, request.chunk)
+                    elif request.dataset == "networktopology":
+                        self.service.train_gnn_chunk(host_id, request.chunk)
+                    else:
+                        raise ValueError(f"unknown dataset {request.dataset!r}")
+                except Exception as e:  # noqa: BLE001 - reply, don't kill server
+                    await self._abort_reply(reader, writer, host_id, str(e))
+                    return
+            if not committed:
+                if host_id is not None:
+                    self.service.train_abort(host_id)
+                    self._m_trains.labels("aborted").inc()
+                return  # torn connection: nobody is listening for a reply
+            if host_id is None:
+                wire.write_frame(writer, msg.TrainResponse(ok=False, description="empty stream"))
+                await writer.drain()
+                return
+            # commit -> train both models off-loop (service_v1.go:155 goroutine)
+            try:
+                outcome = await asyncio.to_thread(self.service.train_finish, host_id)
+                self._m_trains.labels("succeeded").inc()
+                parts = []
+                if outcome.gnn is not None:
+                    parts.append(f"gnn v{outcome.gnn.version}")
+                if outcome.mlp is not None:
+                    parts.append(f"mlp v{outcome.mlp.version}")
+                wire.write_frame(
+                    writer, msg.TrainResponse(ok=True, description=", ".join(parts))
+                )
+            except Exception as e:  # noqa: BLE001
+                self.service.train_abort(host_id)
+                self._m_trains.labels("failed").inc()
+                wire.write_frame(writer, msg.TrainResponse(ok=False, description=str(e)))
+            await writer.drain()
+        except Exception:  # noqa: BLE001 - one bad conn must not kill the server
+            logger.exception("trainer connection handler failed")
+            if host_id is not None:
+                self.service.train_abort(host_id)
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _abort_reply(self, reader, writer, host_id, description: str) -> None:
+        """Mid-stream error: clear the host's partial files, reply, then
+        drain the client's remaining frames so the error response isn't
+        lost to a connection reset while the client is still writing."""
+        if host_id is not None:
+            self.service.train_abort(host_id)
+        self._m_trains.labels("aborted").inc()
+        wire.write_frame(writer, msg.TrainResponse(ok=False, description=description))
+        await writer.drain()
+        while await wire.read_frame(reader) is not None:
+            pass

@@ -120,3 +120,29 @@ def test_attention_ranker_trains_on_dp_sp_mesh():
         ds, TrainerConfig(hidden_dim=32, batch_size=16, epochs=2), mesh=mesh, seed=0
     )
     assert result.steps > 0 and np.isfinite(result.losses).all()
+
+
+def test_ranker_with_flash_attention_matches_dense():
+    """The Pallas flash kernel is a drop-in attention_fn for the ranker:
+    same scores as the dense path (interpret mode on CPU)."""
+    from dragonfly2_tpu.ops.flash import flash_attention
+
+    rng = np.random.default_rng(3)
+    n, p, f = 4, 16, 6
+    child = rng.standard_normal((n, f)).astype(np.float32)
+    parents = rng.standard_normal((n, p, f)).astype(np.float32)
+    pair = rng.standard_normal((n, p, 2)).astype(np.float32)
+    mask = rng.random((n, p)) < 0.8
+    mask[:, 0] = True
+
+    model = AttentionRanker(hidden_dim=16, num_heads=2, num_layers=2)
+    params = model.init(jax.random.key(0), child, parents, pair, mask)
+    dense_scores = model.apply(params, child, parents, pair, mask)
+    flash_scores = model.apply(
+        params, child, parents, pair, mask, attention_fn=flash_attention
+    )
+    # bf16 matmul accumulation inside the kernel: parity at half precision,
+    # not f32 (same tolerance family as tests/test_flash.py)
+    np.testing.assert_allclose(
+        np.asarray(flash_scores), np.asarray(dense_scores), atol=5e-2, rtol=5e-2
+    )

@@ -130,3 +130,43 @@ def test_moe_capacity_drops_zero_out_tokens():
         ), i
     # at least one token per expert survived
     assert (np.abs(got).sum(-1) > 1e-6).sum() >= 2
+
+
+def test_hybrid_mesh_cpu_fallback_trains():
+    """make_hybrid_mesh on a platform with no slice topology folds the DCN
+    replicas into dp; the resulting mesh drives a sharded train step."""
+    import numpy as np
+    import optax
+
+    from dragonfly2_tpu.parallel.mesh import (
+        make_hybrid_mesh, replicated, shard_batch, DP_AXIS,
+    )
+
+    mesh = make_hybrid_mesh(dcn_dp=2, dp=2, tp=2)
+    assert mesh.shape[DP_AXIS] == 4 and mesh.shape["tp"] == 2
+    assert mesh.size == 8
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((4, 1)) * 0.1, jnp.float32)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = rng.standard_normal((16,)).astype(np.float32)
+    opt = optax.sgd(0.1)
+
+    def loss_fn(w, batch):
+        return jnp.mean((batch["x"] @ w1(w) - batch["y"]) ** 2)
+
+    def w1(w):
+        return w
+
+    @jax.jit
+    def step(w, opt_state, batch):
+        loss, g = jax.value_and_grad(loss_fn)(w, batch)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    w_dev = jax.device_put(w, replicated(mesh))
+    opt_state = opt.init(w_dev)
+    batch = shard_batch(mesh, {"x": x, "y": y})
+    w2, _, loss0 = step(w_dev, opt_state, batch)
+    _, _, loss1 = step(w2, opt_state, batch)
+    assert float(loss1) < float(loss0)

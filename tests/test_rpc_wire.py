@@ -69,3 +69,139 @@ def test_stream_framing():
 
     messages, got = asyncio.run(run())
     assert got == messages
+
+
+def test_trainer_rpc_stream_trains_and_publishes(tmp_path):
+    """Socket Train stream end to end (trainer_server_v1.go + announcer
+    upload): chunked download/networktopology uploads over a real socket,
+    EOF triggers training, the registry gets the published versions."""
+    from dragonfly2_tpu.cluster.probes import ProbeStore
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+    from dragonfly2_tpu.cluster.trainer_service import GNN_MODEL_NAME, TrainerService
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.records.storage import HostTraceStorage, TraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.rpc.client import TrainerClient
+    from dragonfly2_tpu.rpc.server import TrainerRPCServer
+
+    storage = TraceStorage(tmp_path / "sched-data")
+    svc = SchedulerService(storage=storage, probes=ProbeStore(max_pairs=1024, max_hosts=128))
+    sim = ClusterSimulator(svc, num_hosts=24, num_tasks=4, seed=11)
+    for _ in range(8):
+        sim.run_round(new_downloads=6)
+        sim.run_probe_round(sources=4)
+    host_info = {
+        svc.state.host_index(h.id): {
+            "id": h.id, "hostname": h.hostname, "ip": h.ip, "port": 8002,
+            "type": "super" if h.is_seed else "normal",
+        }
+        for h in sim.cluster.hosts
+        if svc.state.host_index(h.id) is not None
+    }
+    for rec in svc.probes.snapshot(host_info, now_ns=1):
+        storage.create_network_topology(rec)
+    assert storage.list_downloads()
+
+    registry = ModelRegistry(tmp_path / "registry")
+    trainer = TrainerService(
+        HostTraceStorage(tmp_path / "trainer-data"), registry,
+        TrainerConfig(epochs=2, batch_size=32, hidden_dim=16),
+    )
+
+    async def run():
+        server = TrainerRPCServer(trainer)
+        host, port = await server.start()
+        try:
+            client = TrainerClient(host, port)
+            return await client.train(
+                "sched-1", "10.0.0.1", "sched-node",
+                datasets={
+                    "download": storage.open_download(),
+                    "networktopology": storage.open_network_topology(),
+                },
+                chunk_size=4096,  # force multi-chunk framing
+            )
+        finally:
+            await server.stop()
+
+    response = asyncio.run(run())
+    assert response.ok, response.description
+    assert "gnn" in response.description
+    models = registry.list_models()
+    assert any(m["type"] == "gnn" for m in models)
+    gnn_id = registry.model_id(GNN_MODEL_NAME, "sched-1")
+    assert registry.active_version(gnn_id) is not None
+
+
+def test_trainer_rpc_bad_dataset_aborts(tmp_path):
+    from dragonfly2_tpu.cluster.trainer_service import TrainerService
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.records.storage import HostTraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.rpc.client import TrainerClient
+    from dragonfly2_tpu.rpc.server import TrainerRPCServer
+
+    trainer = TrainerService(
+        HostTraceStorage(tmp_path / "trainer-data"),
+        ModelRegistry(tmp_path / "registry"),
+        TrainerConfig(epochs=1, batch_size=8, hidden_dim=8),
+    )
+
+    async def run():
+        server = TrainerRPCServer(trainer)
+        host, port = await server.start()
+        try:
+            client = TrainerClient(host, port)
+            return await client.train(
+                "sched-1", "10.0.0.1", "sched-node",
+                datasets={"bogus": b"xyz"},
+            )
+        finally:
+            await server.stop()
+
+    response = asyncio.run(run())
+    assert not response.ok
+    assert "bogus" in response.description
+    # the failing host's partial files were cleared
+    assert not trainer.storage.list_downloads()
+
+
+def test_trainer_rpc_torn_connection_aborts(tmp_path):
+    """Dropping the connection before the TrainEndRequest commit marker
+    must abort the upload — no training on truncated datasets, and the
+    host's partial files are cleared."""
+    from dragonfly2_tpu.cluster.trainer_service import TrainerService
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.records.storage import HostTraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.rpc.server import TrainerRPCServer
+
+    registry = ModelRegistry(tmp_path / "registry")
+    trainer = TrainerService(
+        HostTraceStorage(tmp_path / "trainer-data"), registry,
+        TrainerConfig(epochs=1, batch_size=8, hidden_dim=8),
+    )
+
+    async def run():
+        server = TrainerRPCServer(trainer)
+        host, port = await server.start()
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            wire.write_frame(
+                writer,
+                msg.TrainRequest(
+                    host_id="sched-torn", ip="1.2.3.4", hostname="n",
+                    dataset="download", chunk=b"id,tag\n",
+                ),
+            )
+            await writer.drain()
+            writer.close()  # die mid-upload: no TrainEndRequest
+            await writer.wait_closed()
+            await asyncio.sleep(0.2)  # let the server observe EOF
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+    assert not trainer.storage.list_downloads()
+    assert not registry.list_models()
