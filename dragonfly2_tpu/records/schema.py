@@ -18,6 +18,7 @@ zero-filled columns + count fields, which become masks on device.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import typing
 from typing import get_args, get_origin, get_type_hints
 
@@ -204,9 +205,13 @@ def _list_width(cls: type, field: str) -> int:
         raise TypeError(f"no fixed width declared for list field {cls.__name__}.{field}")
 
 
+@functools.lru_cache(maxsize=None)
+def _class_hints(cls: type) -> dict:
+    return get_type_hints(cls)
+
+
 def _element_type(cls: type, field_name: str) -> type:
-    hints = get_type_hints(cls)
-    tp = hints[field_name]
+    tp = _class_hints(cls)[field_name]
     if get_origin(tp) in (list, typing.List):
         return get_args(tp)[0]
     raise TypeError(f"{cls.__name__}.{field_name} is not a list field")
@@ -278,3 +283,137 @@ def _unflatten_into(obj, prefix: str, row: dict) -> None:
                 setattr(obj, f.name, float(raw) if raw != "" else 0.0)
             else:
                 setattr(obj, f.name, str(raw))
+
+
+# --------------------------------------------------------------------------
+# Compiled positional codecs — the CSV hot path.
+#
+# A DownloadRecord spans 1,745 columns (20 parents x 10 pieces x nested host
+# stats), so per-row reflection (get_type_hints + fields walks) and DictReader
+# dicts dominate trace loading at the 1M-piece scale. These compile, once per
+# record class, closures that read/write a positional value list aligned with
+# `header(cls)` — the exact order `flatten` emits, i.e. the on-disk layout.
+
+
+def _to_int(raw: str) -> int:
+    if not raw:
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        return int(float(raw))
+
+
+def _compile_reader(cls: type, prefix: str, index: dict[str, int]):
+    template = cls()
+    hints = _class_hints(cls)
+    steps = []
+    for f in dataclasses.fields(cls):
+        key = f"{prefix}{f.name}"
+        current = getattr(template, f.name)
+        if dataclasses.is_dataclass(current):
+            steps.append((f.name, _compile_reader(type(current), key + ".", index)))
+        elif isinstance(current, list):
+            width = _list_width(cls, f.name)
+            elem_cls = _element_type(cls, f.name)
+            subs = tuple(
+                _compile_reader(elem_cls, f"{key}.{i}.", index) for i in range(width)
+            )
+            ci = index[key + ".count"]
+
+            def read_list(vals, subs=subs, ci=ci, width=width):
+                n = min(_to_int(vals[ci]), width)
+                return [subs[i](vals) for i in range(n)]
+
+            steps.append((f.name, read_list))
+        else:
+            i = index[key]
+            tp = hints[f.name]
+            if tp is int:
+                steps.append((f.name, lambda vals, i=i: _to_int(vals[i])))
+            elif tp is float:
+                steps.append(
+                    (f.name, lambda vals, i=i: float(vals[i]) if vals[i] else 0.0)
+                )
+            else:
+                steps.append((f.name, lambda vals, i=i: vals[i]))
+    steps = tuple(steps)
+
+    def build(vals, cls=cls, steps=steps):
+        obj = cls.__new__(cls)  # every field is assigned below
+        for name, fn in steps:
+            setattr(obj, name, fn(vals))
+        return obj
+
+    return build
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_reader(cls: type):
+    index = {k: i for i, k in enumerate(header(cls))}
+    reader = _compile_reader(cls, "", index)
+    return reader, len(index)
+
+
+def from_row(cls: type, values: list[str]):
+    """Rebuild a record from a positional CSV row in `header(cls)` order."""
+    reader, n = _compiled_reader(cls)
+    if len(values) != n:
+        raise ValueError(f"{cls.__name__} row has {len(values)} columns, want {n}")
+    return reader(values)
+
+
+def _compile_writer(cls: type):
+    # Position-only: the writer emits values in field-walk order (the same
+    # order `header` derives), so no column keys are needed anywhere.
+    template = cls()
+    steps = []
+    for f in dataclasses.fields(cls):
+        current = getattr(template, f.name)
+        if dataclasses.is_dataclass(current):
+            sub = _compile_writer(type(current))
+            steps.append(lambda obj, out, n=f.name, sub=sub: sub(getattr(obj, n), out))
+        elif isinstance(current, list):
+            width = _list_width(cls, f.name)
+            elem_cls = _element_type(cls, f.name)
+            sub = _compile_writer(elem_cls)
+            pad = tuple(flatten(elem_cls()).values())
+
+            def write_list(
+                obj, out, n=f.name, sub=sub, width=width, pad=pad,
+                cls_name=cls.__name__,
+            ):
+                items = getattr(obj, n)
+                if len(items) > width:
+                    raise ValueError(
+                        f"{cls_name}.{n} has {len(items)} items, max {width}"
+                    )
+                out.append(len(items))
+                for elem in items:
+                    sub(elem, out)
+                for _ in range(width - len(items)):
+                    out.extend(pad)
+
+            steps.append(write_list)
+        else:
+            steps.append(lambda obj, out, n=f.name: out.append(getattr(obj, n)))
+    steps = tuple(steps)
+
+    def write(obj, out, steps=steps):
+        for fn in steps:
+            fn(obj, out)
+
+    return write
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_writer(cls: type):
+    return _compile_writer(cls)
+
+
+def to_row(record) -> list:
+    """Record -> positional scalar list in `header(type(record))` order
+    (the inverse of `from_row`; same values `flatten` would emit)."""
+    out: list = []
+    _compiled_writer(type(record))(record, out)
+    return out

@@ -59,8 +59,7 @@ class _RotatingCSV:
         return paths
 
     def create(self, record) -> None:
-        row = _schema.flatten(record)
-        line = _csv_line(self.header, row)
+        line = _csv_values_line(_schema.to_row(record))
         with self._lock:
             path = self.active_path
             new_file = not path.exists() or path.stat().st_size == 0
@@ -69,7 +68,7 @@ class _RotatingCSV:
                 new_file = True
             with path.open("a", newline="") as f:
                 if new_file:
-                    f.write(_csv_line(self.header, dict(zip(self.header, self.header))))
+                    f.write(_csv_values_line(self.header))
                 f.write(line)
             self._count += 1
 
@@ -83,10 +82,22 @@ class _RotatingCSV:
             backups.pop(0).unlink()
 
     def iter_records(self) -> Iterator:
+        # Positional fast path: rows are read as plain lists and decoded by
+        # the compiled per-class codec (schema.from_row) — building a
+        # 1,745-key dict per row (DictReader + unflatten) costs ~5 ms/row
+        # and dominated trainer dataset loading at the 1M-piece scale.
+        n_cols = len(self.header)
         for path in self.all_paths():
             with path.open(newline="") as f:
-                for row in csv.DictReader(f):
-                    yield _schema.unflatten(self.record_cls, row)
+                for row in csv.reader(f):
+                    if len(row) != n_cols or row == self.header:
+                        continue  # torn write, or a (possibly repeated —
+                        # open_bytes() concatenates rotations) header row
+                    try:
+                        yield _schema.from_row(self.record_cls, row)
+                    except ValueError:
+                        continue  # foreign/renamed-schema row: skip, keep
+                        # listing the healthy files (old DictReader behavior)
 
     def count(self) -> int:
         return self._count
@@ -146,9 +157,9 @@ def _to_float(value: str) -> float:
         return float("nan")
 
 
-def _csv_line(header: list[str], row: dict) -> str:
+def _csv_values_line(values: list) -> str:
     out = io.StringIO()
-    csv.writer(out, lineterminator="\n").writerow([row.get(h, "") for h in header])
+    csv.writer(out, lineterminator="\n").writerow(values)
     return out.getvalue()
 
 

@@ -114,20 +114,23 @@ def _make_epoch_indexed(loss_fn: Callable, optimizer: optax.GradientTransformati
 
 
 def _index_epochs(
-    loss_fn, optimizer, data_full, n_rows, batch_size, epochs, rng, static_data=None
+    loss_fn, optimizer, data_full, n_rows, batch_size, epochs, rng,
+    static_data=None, start_epoch=0, on_epoch=None,
 ):
     """Run `epochs` scanned epochs over device-resident `data_full`
     (single-chip path). `static_data` (e.g. graph arrays) rides along as a
     runtime argument rather than a closure capture — captured arrays bake
     into the compiled program as constants, which a 400 MB adjacency must
-    not. loss_fn(params, batch, static_data)."""
+    not. loss_fn(params, batch, static_data). `start_epoch`/`on_epoch`
+    support checkpoint resume (losses cover only the epochs actually run;
+    the minibatch permutation stream restarts on resume)."""
     epoch_fn = _make_epoch_indexed(loss_fn, optimizer)
     data_dev = jax.device_put(data_full)
     static_dev = jax.device_put(static_data) if static_data is not None else None
 
     def run(params, opt_state):
         losses, epoch_samples, epoch_secs = [], [], []
-        for _ in range(epochs):
+        for e in range(start_epoch, epochs):
             idx = np.stack(list(D.minibatches(n_rows, batch_size, rng))).astype(np.int32)
             t0 = time.perf_counter()
             params, opt_state, ep_losses = epoch_fn(
@@ -137,6 +140,8 @@ def _index_epochs(
             epoch_secs.append(time.perf_counter() - t0)
             epoch_samples.append(idx.shape[0] * batch_size)
             losses.append(ep_losses)
+            if on_epoch is not None:
+                on_epoch(e, params, opt_state)
         flat = [float(v) for ep in losses for v in np.asarray(ep, np.float64)]
         n_samples, dt = _steady_state_throughput(epoch_samples, epoch_secs)
         return params, opt_state, flat, n_samples, dt
@@ -145,7 +150,8 @@ def _index_epochs(
 
 
 def _stacked_epochs(
-    loss_fn, optimizer, mesh, epochs, batch_size, make_epoch_batches: Callable
+    loss_fn, optimizer, mesh, epochs, batch_size, make_epoch_batches: Callable,
+    start_epoch=0, on_epoch=None,
 ):
     """Mesh-path counterpart of `_index_epochs`: per epoch, build host
     batches via `make_epoch_batches()`, stack + shard them over dp, and run
@@ -155,7 +161,7 @@ def _stacked_epochs(
 
     def run(params, opt_state):
         losses, epoch_samples, epoch_secs = [], [], []
-        for _ in range(epochs):
+        for e in range(start_epoch, epochs):
             batches = make_epoch_batches()
             if not batches:
                 continue
@@ -166,10 +172,34 @@ def _stacked_epochs(
             epoch_secs.append(time.perf_counter() - t0)
             epoch_samples.append(len(batches) * batch_size)
             losses.extend(np.asarray(ep_losses, np.float64).tolist())
+            if on_epoch is not None:
+                on_epoch(e, params, opt_state)
         n_samples, dt = _steady_state_throughput(epoch_samples, epoch_secs)
         return params, opt_state, losses, n_samples, dt
 
     return run
+
+
+def _resume_hooks(checkpointer, params, opt_state):
+    """(params, opt_state, start_epoch, on_epoch) for optional
+    checkpoint/resume (training/checkpoint.py): restore the newest epoch if
+    one exists, and save after every epoch. The data-plane analogue is the
+    daemon's persistent-task reload + piece-bitset resume
+    (storage_manager.go:545,674); the reference has no ML equivalent."""
+    if checkpointer is None:
+        return params, opt_state, 0, None
+    saved = checkpointer.restore(
+        template={"params": params, "opt_state": opt_state, "epoch": 0}
+    )
+    start_epoch = 0
+    if saved is not None:
+        params, opt_state = saved["params"], saved["opt_state"]
+        start_epoch = int(np.asarray(saved["epoch"])) + 1
+
+    def on_epoch(e, p, o):
+        checkpointer.save(e, {"params": p, "opt_state": o, "epoch": e})
+
+    return params, opt_state, start_epoch, on_epoch
 
 
 def _steady_state_throughput(epoch_samples: list, epoch_secs: list) -> tuple:
@@ -190,6 +220,7 @@ def train_mlp(
     mesh=None,
     seed: int = 0,
     eval_fraction: float = 0.2,
+    checkpointer=None,
 ) -> TrainResult:
     """Train the probe-RTT regressor; returns params + MSE/MAE on held-out
     pairs (the registry's evaluation fields)."""
@@ -204,6 +235,9 @@ def train_mlp(
     params = model.init(jax.random.key(seed), jnp.zeros((1, x.shape[1]), jnp.float32))
     optimizer = optax.adamw(config.learning_rate)
     opt_state = optimizer.init(params)
+    params, opt_state, start_epoch, on_epoch = _resume_hooks(
+        checkpointer, params, opt_state
+    )
 
     def loss_fn(params, batch):
         pred = model.apply(params, batch["x"])
@@ -219,6 +253,7 @@ def train_mlp(
         run = _index_epochs(
             lambda p, b, _s: loss_fn(p, b),
             optimizer, data_full, len(train_idx), batch_size, config.epochs, rng,
+            start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
     else:
@@ -236,7 +271,8 @@ def train_mlp(
             ]
 
         run = _stacked_epochs(
-            loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches
+            loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches,
+            start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
 
@@ -258,6 +294,7 @@ def train_gnn(
     mesh=None,
     seed: int = 0,
     eval_fraction: float = 0.2,
+    checkpointer=None,
 ) -> TrainResult:
     """Train the GraphSAGE parent ranker; eval = precision/recall/F1 of its
     top-1 parent picks on held-out downloads (manager/types/model.go:58-64)."""
@@ -283,6 +320,9 @@ def train_gnn(
     )
     optimizer = optax.adamw(config.learning_rate)
     opt_state = optimizer.init(params)
+    params, opt_state, start_epoch, on_epoch = _resume_hooks(
+        checkpointer, params, opt_state
+    )
 
     def loss_fn(params, batch: RankBatch, graph_static=None):
         g = graph_static if graph_static is not None else garrs_dev
@@ -301,7 +341,7 @@ def train_gnn(
         data_full = _take_rank_batch(ds, train_idx)
         run = _index_epochs(
             loss_fn, optimizer, data_full, len(train_idx), batch_size, config.epochs,
-            rng, static_data=garrs_dev,
+            rng, static_data=garrs_dev, start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
     else:
@@ -309,6 +349,7 @@ def train_gnn(
         run = _stacked_epochs(
             loss_fn, optimizer, mesh, config.epochs, batch_size,
             lambda: list(D.rank_batches(sub, batch_size, rng)),
+            start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
 
@@ -335,6 +376,7 @@ def train_attention(
     mesh=None,
     seed: int = 0,
     eval_fraction: float = 0.2,
+    checkpointer=None,
 ) -> TrainResult:
     """Train the set-transformer parent ranker (models/attention.py) on
     the same RankingDataset the GNN consumes — candidates attend to each
@@ -378,6 +420,9 @@ def train_attention(
     )
     optimizer = optax.adamw(config.learning_rate)
     opt_state = optimizer.init(params)
+    params, opt_state, start_epoch, on_epoch = _resume_hooks(
+        checkpointer, params, opt_state
+    )
 
     def loss_fn(params, batch):
         scores = apply(params, batch["child"], batch["parents"], batch["pair"], batch["mask"])
@@ -393,6 +438,7 @@ def train_attention(
         run = _index_epochs(
             lambda p, b, _s: loss_fn(p, b),
             optimizer, data_full, len(train_idx), batch_size, config.epochs, rng,
+            start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
     else:
@@ -404,7 +450,8 @@ def train_attention(
             ]
 
         run = _stacked_epochs(
-            loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches
+            loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches,
+            start_epoch=start_epoch, on_epoch=on_epoch,
         )
         params, opt_state, losses, n_samples, dt = run(params, opt_state)
 

@@ -118,3 +118,23 @@ def test_host_trace_storage_clear_host_scoped(tmp_path):
     store.append_download_bytes("hostB", blob)
     store.clear_host("hostA")
     assert len(store.list_downloads()) == len(downloads)  # hostB intact
+
+
+def test_iter_records_skips_foreign_rows(tmp_path):
+    """A foreign file with the right column count but a renamed column must
+    not abort listing — healthy files keep loading (graceful degradation)."""
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.storage import TraceStorage
+
+    cluster = synth.make_cluster(8, seed=0)
+    recs = synth.gen_download_records(cluster, 3, num_tasks=1, max_parents=2)
+    store = TraceStorage(tmp_path)
+    for r in recs:
+        store.create_download(r)
+
+    # inject a backup file whose header renames a column (schema drift)
+    good_header = store.downloads.header
+    bad_header = ["cost_ns" if h == "cost" else h for h in good_header]
+    (tmp_path / "download-1.csv").write_text(",".join(bad_header) + "\n")
+
+    assert store.list_downloads() == recs

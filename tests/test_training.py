@@ -1,6 +1,7 @@
 """Training-loop tests: convergence on planted signal, DP sharding
 equivalence, checkpoint roundtrip (SURVEY.md §4 numeric tier)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -97,3 +98,32 @@ def test_checkpoint_roundtrip(tmp_path, mlp_data):
     for a, b in zip(leaves_a, leaves_b):
         np.testing.assert_array_equal(a, b)
     ckpt.close()
+
+
+def test_train_resumes_from_checkpoint(tmp_path, mlp_data):
+    """Kill-and-restart resume: a second train call with the same
+    checkpointer picks up at the next epoch instead of restarting, and a
+    fully-trained checkpoint yields no further epochs."""
+    from dragonfly2_tpu.training.checkpoint import TrainCheckpointer
+
+    x, y = mlp_data
+    cfg = TrainerConfig(epochs=2, batch_size=64, hidden_dim=16, learning_rate=3e-3)
+
+    ck = TrainCheckpointer(tmp_path / "ck")
+    first = train_mlp(x, y, cfg, seed=0, checkpointer=ck)
+    assert ck.latest_step() == 1  # saved after epochs 0 and 1
+    steps_per_epoch = first.steps // 2
+
+    # "crash" after epoch 1 of a 4-epoch run: resume trains only 2 more
+    cfg4 = TrainerConfig(epochs=4, batch_size=64, hidden_dim=16, learning_rate=3e-3)
+    resumed = train_mlp(x, y, cfg4, seed=0, checkpointer=ck)
+    assert resumed.steps == 2 * steps_per_epoch
+    assert ck.latest_step() == 3
+
+    # already complete: nothing to train, params come from the checkpoint
+    again = train_mlp(x, y, cfg4, seed=0, checkpointer=ck)
+    assert again.steps == 0
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(again.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(resumed.params)[0]),
+    )
