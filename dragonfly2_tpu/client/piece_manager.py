@@ -69,8 +69,15 @@ class PieceManager:
         piece-group fetches; unknown-length streams sequentially."""
         content_length = source_pkg.content_length(url, headers)
         piece_length = ts.meta.piece_length
-        if content_length >= 0:
+        use_ranges = content_length >= 0
+        if use_ranges:
             layout = piece_layout(content_length, piece_length)
+            if len(layout) > 1 and not source_pkg.supports_range(url, headers):
+                # Server ignores Range (python -m http.server, some CDNs):
+                # concurrent ranged workers would each re-download and
+                # discard the file head — O(N^2) transfer. Stream once.
+                use_ranges = False
+        if use_ranges:
             with concurrent.futures.ThreadPoolExecutor(self.concurrency) as pool:
                 futures = {
                     pool.submit(self._fetch_range, url, headers, off, length): (n, off, length)

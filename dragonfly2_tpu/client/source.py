@@ -78,6 +78,13 @@ def list_entries(url: str, headers: dict | None = None) -> list[URLEntry]:
     return client_for(url).list_entries(url, headers)
 
 
+def supports_range(url: str, headers: dict | None = None) -> bool:
+    """Whether ranged reads are honored for this URL. Clients without a
+    probe are assumed range-capable (file://, object stores)."""
+    probe = getattr(client_for(url), "supports_range", None)
+    return True if probe is None else probe(url, headers)
+
+
 # ---------------------------------------------------------------- http(s)
 
 
@@ -111,6 +118,18 @@ class HTTPSource:
         except urllib.error.URLError as e:
             raise dferrors.Unavailable(f"GET {url}: {e}") from e
         with resp:
+            if "Range" in h and getattr(resp, "status", 200) == 200:
+                # The server ignored the Range header and returned the whole
+                # entity (python -m http.server, some CDNs): emulate the
+                # range by discarding `offset` bytes before yielding.
+                # Returning the body as-is would write piece N's buffer
+                # starting with the FILE's first bytes — silent corruption.
+                to_skip = offset
+                while to_skip > 0:
+                    skipped = resp.read(min(_CHUNK, to_skip))
+                    if not skipped:
+                        return
+                    to_skip -= len(skipped)
             remaining = length if length > 0 else -1
             while True:
                 chunk = resp.read(_CHUNK if remaining < 0 else min(_CHUNK, remaining))
@@ -122,6 +141,25 @@ class HTTPSource:
                     if remaining <= 0:
                         return
 
+
+    def supports_range(self, url: str, headers: dict | None = None) -> bool:
+        """Probe with `Range: bytes=0-0`: a range-capable server answers
+        206, one that ignores Range answers 200 with the full entity (the
+        connection is dropped after the status line, so the probe costs a
+        round trip, not a download). Lets the piece manager pick parallel
+        ranged fetches vs sequential streaming up front — emulating ranges
+        per concurrent worker would re-download the file head once per
+        piece."""
+        h = dict(headers or {})
+        h["Range"] = "bytes=0-0"
+        req = urllib.request.Request(url, headers=h)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return getattr(resp, "status", 200) == 206
+        except urllib.error.HTTPError as e:
+            return e.code == 206
+        except urllib.error.URLError as e:
+            raise dferrors.Unavailable(f"GET {url}: {e}") from e
 
     def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
         """Parse an HTML directory index (nginx/apache autoindex, python

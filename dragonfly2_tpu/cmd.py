@@ -1,0 +1,178 @@
+"""Service launchers — the `cmd/{scheduler,trainer,manager,dfdaemon}` tier.
+
+Capability parity with the reference's per-service binaries
+(cmd/scheduler, cmd/trainer, cmd/manager, cmd/dfdaemon wired through
+cmd/dependency/dependency.go:61 InitCommandAndConfig): one module, one
+subcommand per service, YAML config via --config plus flag overrides,
+graceful SIGINT/SIGTERM shutdown. Each service prints exactly one
+`READY <host> <port>` line once its listener is bound, so a parent
+process (or the multi-process e2e) can wait on startup without polling.
+
+    python -m dragonfly2_tpu.cmd scheduler --port 8002 --data-dir /var/df
+    python -m dragonfly2_tpu.cmd trainer   --port 8004 --data-dir ... --registry-dir ...
+    python -m dragonfly2_tpu.cmd manager   --port 8080 --db manager.db
+    python -m dragonfly2_tpu.cmd dfdaemon  --data-dir ... --scheduler host:8002
+
+The file/cache/object CLIs (dfget/dfcache/dfstore) live in client/cli.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+
+def _parse_addr(value: str) -> tuple[str, int]:
+    host, _, port = value.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def _run_until_signalled(ready_line: str) -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    print(ready_line, flush=True)
+    await stop.wait()
+
+
+async def _serve_scheduler(args) -> int:
+    from dragonfly2_tpu.cluster.probes import ProbeStore
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.records.storage import TraceStorage
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    config = Config.load(args.config) if args.config else Config()
+    if args.algorithm:
+        config.evaluator.algorithm = args.algorithm
+    storage = TraceStorage(args.data_dir) if args.data_dir else None
+    probes = ProbeStore(max_hosts=config.scheduler.max_hosts)
+    service = SchedulerService(config=config, storage=storage, probes=probes)
+    server = SchedulerRPCServer(service, host=args.host, port=args.port)
+    host, port = await server.start()
+    try:
+        await _run_until_signalled(f"READY {host} {port}")
+    finally:
+        await server.stop()
+    return 0
+
+
+async def _serve_trainer(args) -> int:
+    from dragonfly2_tpu.cluster.trainer_service import TrainerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.records.storage import HostTraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.rpc.server import TrainerRPCServer
+
+    config = Config.load(args.config) if args.config else Config()
+    if args.epochs:
+        config.trainer.epochs = args.epochs
+    service = TrainerService(
+        HostTraceStorage(args.data_dir),
+        ModelRegistry(args.registry_dir),
+        config.trainer,
+    )
+    server = TrainerRPCServer(service, host=args.host, port=args.port)
+    host, port = await server.start()
+    try:
+        await _run_until_signalled(f"READY {host} {port}")
+    finally:
+        await server.stop()
+    return 0
+
+
+async def _serve_manager(args) -> int:
+    from dragonfly2_tpu.manager.models import Database
+    from dragonfly2_tpu.manager.rest import ManagerREST
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.registry import ModelRegistry
+
+    registry = ModelRegistry(args.registry_dir) if args.registry_dir else None
+    service = ManagerService(db=Database(args.db), registry=registry)
+    rest = ManagerREST(service, host=args.host, port=args.port)
+    host, port = rest.start()
+    try:
+        await _run_until_signalled(f"READY {host} {port}")
+    finally:
+        rest.stop()
+    return 0
+
+
+async def _serve_dfdaemon(args) -> int:
+    from dragonfly2_tpu.client.daemon import Daemon
+
+    daemon = Daemon(
+        data_dir=args.data_dir,
+        scheduler_addresses=[_parse_addr(s) for s in args.scheduler],
+        ip=args.ip,
+        host_type=args.host_type,
+        idc=args.idc,
+        location=args.location,
+        probe_interval=args.probe_interval,
+        object_storage=args.object_storage,
+    )
+    await daemon.start()
+    try:
+        await _run_until_signalled(
+            f"READY {daemon.ip} {daemon.upload.port}"
+        )
+    finally:
+        await daemon.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dragonfly2-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("scheduler", help="peer-scheduling control plane")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--config", default=None, help="YAML config path")
+    s.add_argument("--data-dir", default=None, help="trace CSV directory")
+    s.add_argument("--algorithm", default=None,
+                   help="evaluator override: default|nt|ml|plugin")
+
+    t = sub.add_parser("trainer", help="model training service")
+    t.add_argument("--host", default="127.0.0.1")
+    t.add_argument("--port", type=int, default=0)
+    t.add_argument("--config", default=None)
+    t.add_argument("--data-dir", required=True, help="per-host dataset dir")
+    t.add_argument("--registry-dir", required=True, help="model registry dir")
+    t.add_argument("--epochs", type=int, default=0)
+
+    m = sub.add_parser("manager", help="REST control plane")
+    m.add_argument("--host", default="127.0.0.1")
+    m.add_argument("--port", type=int, default=0)
+    m.add_argument("--db", default=":memory:", help="sqlite path")
+    m.add_argument("--registry-dir", default=None)
+
+    d = sub.add_parser("dfdaemon", help="peer data-plane daemon")
+    d.add_argument("--data-dir", required=True)
+    d.add_argument("--scheduler", action="append", required=True,
+                   help="host:port (repeatable)")
+    d.add_argument("--ip", default="127.0.0.1")
+    d.add_argument("--host-type", default="normal", choices=("normal", "super"))
+    d.add_argument("--idc", default="")
+    d.add_argument("--location", default="")
+    d.add_argument("--probe-interval", type=float, default=0.0)
+    d.add_argument("--object-storage", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    runner = {
+        "scheduler": _serve_scheduler,
+        "trainer": _serve_trainer,
+        "manager": _serve_manager,
+        "dfdaemon": _serve_dfdaemon,
+    }[args.cmd]
+    return asyncio.run(runner(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -313,3 +313,77 @@ def test_dfget_recursive_accept_regex_keeps_subdirs(tmp_path, capsys):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_http_source_range_ignored_by_server(tmp_path):
+    """A server that ignores Range (python -m http.server, some CDNs)
+    returns 200 + the full entity; the client must emulate the range by
+    skipping `offset` bytes — not hand back the file head as piece N."""
+    import functools
+    import http.server
+
+    from dragonfly2_tpu.client import source
+
+    payload = bytes(range(256)) * 1024  # 256 KiB, position-identifiable
+    (tmp_path / "blob.bin").write_bytes(payload)
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(tmp_path)
+    )  # SimpleHTTPRequestHandler has no Range support at all
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{port}/blob.bin"
+        got = b"".join(source.download(url, offset=100_000, length=50_000))
+        assert got == payload[100_000:150_000]
+        # unbounded tail read past an ignored range
+        got = b"".join(source.download(url, offset=len(payload) - 777))
+        assert got == payload[-777:]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_backsource_rangeless_server_streams_once(tmp_path):
+    """Against a range-less origin the piece manager must stream the entity
+    once (sequential cut-into-pieces), not emulate ranges per concurrent
+    worker — that would re-download the file head once per piece."""
+    import functools
+    import http.server
+
+    from dragonfly2_tpu.client.piece_manager import PieceManager
+    from dragonfly2_tpu.client.storage import StorageManager
+
+    payload = bytes(range(256)) * 2048  # 512 KiB
+    (tmp_path / "blob.bin").write_bytes(payload)
+    gets = []
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            gets.append(self.headers.get("Range"))
+            super().do_GET()  # SimpleHTTPRequestHandler ignores Range
+
+    handler = functools.partial(Handler, directory=str(tmp_path))
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from dragonfly2_tpu.client.storage import TaskMetadata
+
+        sm = StorageManager(tmp_path / "store")
+        ts = sm.register_task(
+            TaskMetadata(task_id="t-rangeless", peer_id="p", piece_length=64 * 1024)
+        )
+        pm = PieceManager()
+        length, pieces = pm.download_source(ts, f"http://127.0.0.1:{port}/blob.bin")
+        assert length == len(payload) and pieces == 8
+        with open(ts.data_path, "rb") as f:
+            assert f.read() == payload
+        # probe + one streaming GET — not one GET per piece
+        assert len(gets) <= 2, gets
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,209 @@
+"""Multi-process e2e — the reference's kind-cluster tier with real OS
+processes: `python -m dragonfly2_tpu.cmd` launches scheduler + trainer as
+separate processes, dfget-style downloads run against them from this
+process, traces stream to the trainer over its socket, and the registry
+fills with trained models. (SURVEY.md §4: e2e tests exec dfget in pods
+against a live cluster; here pods are subprocesses.)"""
+
+import asyncio
+import hashlib
+import http.server
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _spawn(args: list[str], tmp_path) -> tuple[subprocess.Popen, str, int]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.cmd", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=tmp_path,
+        env=env,
+    )
+    line = proc.stdout.readline().strip()
+    if not line.startswith("READY "):
+        proc.kill()
+        raise RuntimeError(f"service failed to start: {line!r}")
+    _, host, port = line.split()
+    return proc, host, int(port)
+
+
+def _stop(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+class _Origin:
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.gets = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(outer.payload)))
+                self.end_headers()
+
+            def do_GET(self):
+                outer.gets += 1
+                body = outer.payload
+                rng = self.headers.get("Range")
+                status = 200
+                if rng and rng.startswith("bytes="):
+                    lo, _, hi = rng[6:].partition("-")
+                    lo = int(lo or 0)
+                    hi = int(hi) if hi else len(body) - 1
+                    body = body[lo : hi + 1]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+@pytest.mark.slow
+def test_processes_schedule_download_train(tmp_path):
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.records.storage import TraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.rpc.client import TrainerClient
+
+    payload = os.urandom(3 * (1 << 20) + 12345)
+    digest = hashlib.sha256(payload).hexdigest()
+    origin = _Origin(payload)
+
+    sched_dir = tmp_path / "sched-data"
+    sched, s_host, s_port = _spawn(
+        ["scheduler", "--data-dir", str(sched_dir)], tmp_path
+    )
+    trainer, t_host, t_port = _spawn(
+        [
+            "trainer",
+            "--data-dir", str(tmp_path / "trainer-data"),
+            "--registry-dir", str(tmp_path / "registry"),
+            "--epochs", "2",
+        ],
+        tmp_path,
+    )
+    try:
+        async def drive():
+            url = f"http://127.0.0.1:{origin.port}/blob.bin"
+            # first peer back-sources, second pulls from it over P2P
+            d1 = Daemon(
+                tmp_path / "peer1", [(s_host, s_port)],
+                ip="127.0.0.1", hostname="proc-peer-1",
+            )
+            await d1.start()
+            ts1 = await d1.download(url, piece_length=1 << 20)
+            await d1.export_file(ts1, str(tmp_path / "out1.bin"))
+            gets_after_first = origin.gets
+
+            d2 = Daemon(
+                tmp_path / "peer2", [(s_host, s_port)],
+                ip="127.0.0.1", hostname="proc-peer-2",
+            )
+            await d2.start()
+            ts2 = await d2.download(
+                url, piece_length=1 << 20, back_source_allowed=False
+            )
+            await d2.export_file(ts2, str(tmp_path / "out2.bin"))
+            await d2.stop()
+            await d1.stop()
+            return gets_after_first
+
+        gets_after_first = asyncio.run(drive())
+        for name in ("out1.bin", "out2.bin"):
+            got = hashlib.sha256((tmp_path / name).read_bytes()).hexdigest()
+            assert got == digest, f"{name} corrupt"
+        assert origin.gets == gets_after_first, "second peer hit the origin"
+
+        # the scheduler process recorded download traces on disk
+        storage = TraceStorage(sched_dir)
+        assert storage.list_downloads(), "no traces written by scheduler proc"
+
+        # stream them to the trainer process; registry fills with models
+        async def train():
+            client = TrainerClient(t_host, t_port)
+            return await client.train(
+                "sched-proc", "127.0.0.1", "sched-node",
+                datasets={"download": storage.open_download()},
+                chunk_size=1 << 20,
+            )
+
+        response = asyncio.run(train())
+        assert response.ok, response.description
+        registry = ModelRegistry(tmp_path / "registry")
+        assert any(m["type"] == "gnn" for m in registry.list_models())
+    finally:
+        _stop(sched)
+        _stop(trainer)
+        origin.close()
+
+
+@pytest.mark.slow
+def test_manager_and_dfdaemon_launchers(tmp_path):
+    import json
+    import urllib.request
+
+    manager, m_host, m_port = _spawn(
+        ["manager", "--db", str(tmp_path / "manager.db")], tmp_path
+    )
+    sched, s_host, s_port = _spawn(["scheduler"], tmp_path)
+    daemon, d_host, d_port = _spawn(
+        [
+            "dfdaemon",
+            "--data-dir", str(tmp_path / "daemon-data"),
+            "--scheduler", f"{s_host}:{s_port}",
+        ],
+        tmp_path,
+    )
+    try:
+        # sign in as the default root user, then hit an RBAC-guarded route
+        signin = urllib.request.Request(
+            f"http://{m_host}:{m_port}/api/v1/users/signin",
+            data=json.dumps({"name": "root", "password": "dragonfly"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(signin, timeout=5) as resp:
+            token = json.loads(resp.read())["token"]
+        schedulers = urllib.request.Request(
+            f"http://{m_host}:{m_port}/api/v1/schedulers",
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(schedulers, timeout=5) as resp:
+            assert resp.status == 200
+            json.loads(resp.read())
+        assert d_port > 0  # daemon bound its upload listener
+    finally:
+        _stop(daemon)
+        _stop(sched)
+        _stop(manager)
