@@ -1,0 +1,87 @@
+"""Long-context attention benchmark: Pallas flash kernel vs dense XLA.
+
+The reference has no sequence models at all (SURVEY.md §5); long-context
+support is new TPU-native territory: ops/flash.py (fused single-chip
+kernel, O(L) memory), parallel/ring.py (sp-sharded ring attention), and
+parallel/ulysses.py (all-to-all head parallelism). This script measures
+the single-chip kernel against the dense reference at growing sequence
+lengths on the real chip — dense attention materializes the [L, L] score
+matrix, so it falls off a memory cliff where flash keeps scaling.
+
+Prints one JSON line per (length, impl): median ms over trials, plus a
+final summary line with the speedup at the largest length both complete.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+BATCH, HEADS, DIM = 4, 8, 128
+LENGTHS = (2048, 4096, 8192, 16384, 32768)
+TRIALS = 20
+
+
+def _bench(fn, *args) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile
+    times = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.ops.flash import flash_attention
+    from dragonfly2_tpu.parallel.ring import dense_attention
+
+    rng = np.random.default_rng(0)
+    results = {}
+    for length in LENGTHS:
+        shape = (BATCH, HEADS, length, DIM)
+        q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        mask = jnp.ones((BATCH, length), bool)
+        for name, fn in (("flash", flash_attention), ("dense", dense_attention)):
+            jfn = jax.jit(fn)
+            try:
+                ms = _bench(jfn, q, k, v, mask)
+            except Exception as e:  # noqa: BLE001 - dense OOMs eventually
+                print(json.dumps({
+                    "metric": f"attention_{name}_ms", "length": length,
+                    "value": None, "error": type(e).__name__,
+                }))
+                continue
+            results[(name, length)] = ms
+            tflops = 4 * BATCH * HEADS * length * length * DIM / (ms / 1e3) / 1e12
+            print(json.dumps({
+                "metric": f"attention_{name}_ms", "length": length,
+                "value": round(ms, 3), "unit": "ms", "tflops": round(tflops, 1),
+            }))
+
+    common = [l for l in LENGTHS if ("flash", l) in results and ("dense", l) in results]
+    if common:
+        l = common[-1]
+        print(json.dumps({
+            "metric": "attention_flash_speedup_vs_dense",
+            "length": l,
+            "value": round(results[("dense", l)] / results[("flash", l)], 2),
+            "unit": "x",
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -48,6 +48,8 @@ class ProbeStore:
     ):
         self.max_pairs = max_pairs
         self.queue_length = queue_length
+        # collision-free packing base for (src, dst) -> int64 keys
+        self.max_pairs_key = max_hosts + 1
         self.ring = jnp.zeros((max_pairs, queue_length), jnp.float32)
         self.cursor = jnp.zeros(max_pairs, jnp.int32)
         self.count = jnp.zeros(max_pairs, jnp.int32)
@@ -57,6 +59,11 @@ class ProbeStore:
         self._pairs_by_src: dict[int, list[int]] = {}
         self._pair_dst: list[int] = []
         self._next = 0
+        # Sorted-key mirror of _pair_index for batched (B, K) lookups in
+        # gather_candidate_rtt; rebuilt lazily when pairs were added.
+        self._sorted_keys = np.zeros(0, np.int64)
+        self._sorted_idx = np.zeros(0, np.int32)
+        self._sorted_dirty = False
 
     # ------------------------------------------------------------ indexing
 
@@ -71,6 +78,7 @@ class ProbeStore:
             self._pair_index[key] = idx
             self._pairs_by_src.setdefault(src_slot, []).append(idx)
             self._pair_dst.append(dst_slot)
+            self._sorted_dirty = True
         return idx
 
     # ------------------------------------------------------------- updates
@@ -106,15 +114,31 @@ class ProbeStore:
         the parent being scored, src the child (evaluator_network_topology
         .go:217-224 scores parent->child RTT)."""
         b, k = cand_host_slots.shape
-        avg = np.zeros((b, k), np.float32)
-        has = np.zeros((b, k), bool)
-        for i in range(b):
-            child = int(child_host_slots[i])
-            for j in range(k):
-                idx = self._pair_index.get((int(cand_host_slots[i, j]), child))
-                if idx is not None and self.average[idx] > 0:
-                    avg[i, j] = self.average[idx]
-                    has[i, j] = True
+        if self._sorted_dirty:
+            keys = np.fromiter(
+                (s * self.max_pairs_key + d for (s, d) in self._pair_index),
+                np.int64, count=self._next,
+            )
+            order = np.argsort(keys, kind="stable")
+            self._sorted_keys = keys[order]
+            self._sorted_idx = np.fromiter(
+                self._pair_index.values(), np.int32, count=self._next
+            )[order]
+            self._sorted_dirty = False
+        if self._sorted_keys.size == 0:
+            return np.zeros((b, k), np.float32), np.zeros((b, k), bool)
+        # one vectorized searchsorted instead of B*K dict lookups (this runs
+        # inside every nt-mode scheduler tick at up to 1024x15 queries)
+        want = (
+            cand_host_slots.astype(np.int64) * self.max_pairs_key
+            + child_host_slots.astype(np.int64)[:, None]
+        )
+        pos = np.searchsorted(self._sorted_keys, want)
+        pos_c = np.minimum(pos, self._sorted_keys.size - 1)
+        found = self._sorted_keys[pos_c] == want
+        idx = self._sorted_idx[pos_c]
+        avg = np.where(found, self.average[idx], 0.0).astype(np.float32)
+        has = found & (avg > 0)
         return avg, has
 
     def find_probed_hosts(

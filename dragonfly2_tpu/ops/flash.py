@@ -8,10 +8,16 @@ long-context capability: the AttentionRanker's set attention
 layout with a [B, L] key-validity mask.
 
 Design (pallas_guide.md patterns):
-- grid = (B, H, L/BLOCK_Q): one program attends BLOCK_Q queries against
-  the full local KV, streaming it in BLOCK_K tiles from VMEM with a
-  fori_loop carrying flash-style online-softmax state (acc, row-max,
-  row-sum) in f32 registers — the [L, L] score matrix never exists.
+- grid = (B, H, L/BLOCK_Q, L/BLOCK_K) with the key-block sweep as the
+  innermost "arbitrary" dimension: each step holds ONE [BLOCK_K, D] K/V
+  tile in VMEM, and flash-style online-softmax state (acc, row-max,
+  row-sum) lives in VMEM scratch that persists across the sweep — the
+  [L, L] score matrix never exists and the VMEM footprint is constant in
+  L (a whole-KV block spec hits the scoped-vmem ceiling near L=12k).
+- causal: above-diagonal steps skip their math under pl.when, and their
+  BlockSpec index maps clamp to the last live key block, so the
+  would-be dead K/V DMAs collapse into "same index as previous step"
+  no-op copies.
 - QK^T and PV ride the MXU via dot_general with
   preferred_element_type=f32; everything else is VPU elementwise.
 - Masking (key validity + optional causal) is applied as -1e30 adds
@@ -53,40 +59,52 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int, causal: bool):
-    """One (b, h, iq) program: BLOCK_Q queries vs the full [L, D] KV."""
+def _flash_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, block_k: int, causal: bool, num_kb: int,
+):
+    """One (b, h, iq, jk) program: BLOCK_Q queries vs ONE [BK, D] key block.
+
+    The key-block sweep is the innermost ("arbitrary") grid dimension, so
+    only one K/V tile is resident in VMEM at a time and the footprint is
+    constant in L — a whole-KV block spec runs out of scoped vmem around
+    L=12k. Online-softmax state (acc, row-max, row-sum) lives in VMEM
+    scratch, which persists across the inner grid steps; the output tile
+    is written once on the last key block."""
     iq = pl.program_id(2)
+    jk = pl.program_id(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_F)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
     q = q_ref[0, 0]  # [BQ, D], input dtype (bf16 on the fast path)
-    scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    seq_len = k_ref.shape[2]
-    num_kb = seq_len // block_k
-
     block_q = q.shape[0]
-    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_F, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    start = jk * block_k
 
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(kb_idx, carry):
-        acc, m, l = carry
-        start = kb_idx * block_k
-        kb = k_ref[0, 0, pl.ds(start, block_k), :]  # [BK, D], input dtype
-        vb = v_ref[0, 0, pl.ds(start, block_k), :]
-        mb = mask_ref[0, 0, pl.ds(start, block_k)] > 0  # [BK] f32 -> bool
+    def update():
+        kb = k_ref[0, 0]  # [BK, D]
+        vb = v_ref[0, 0]
+        mb = mask_ref[0, 0] > 0  # [BK] f32 -> bool
+        m = m_ref[:, :1]  # lanes hold copies; column 0 is the value
+        l = l_ref[:, :1]
 
         # MXU matmul in the input dtype (bf16), f32 accumulation
         scores = (
             jax.lax.dot_general(
-                q,
-                kb,
-                (((1,), (1,)), ((), ())),
+                q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             * scale
         )  # [BQ, BK] f32
         valid = jnp.broadcast_to(mb[None, :], scores.shape)
         if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
@@ -97,27 +115,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int, causal:
         new_m = jnp.maximum(m, block_max)
         correction = jnp.exp(m - new_m)
         probs = jnp.exp(scores - new_m) * valid.astype(jnp.float32)
-        acc = acc * correction + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
             probs.astype(vb.dtype),  # PV matmul also in bf16, f32 accum
-            vb,
-            (((1,), (0,)), ((), ())),
+            vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        l = l * correction + jnp.sum(probs, axis=-1, keepdims=True)
-        return acc, new_m, l
+        new_l = l * correction + jnp.sum(probs, axis=-1, keepdims=True)
+        m_ref[...] = jnp.broadcast_to(new_m, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(new_l, l_ref.shape)
 
     if causal:
-        # blocks entirely above the diagonal contribute nothing; bound the
-        # loop at the last block that can intersect this query tile
-        num_live = jnp.minimum(
-            num_kb, pl.cdiv((iq + 1) * block_q, block_k)
-        )
-        acc, m, l = jax.lax.fori_loop(0, num_live, body, (acc0, m0, l0))
+        # blocks entirely above the diagonal contribute nothing
+        @pl.when(start < (iq + 1) * block_q)
+        def _():
+            update()
     else:
-        acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+        update()
 
-    out = acc / jnp.maximum(l, 1e-9)
-    o_ref[0, 0] = out.astype(o_ref.dtype)
+    @pl.when(jk == num_kb - 1)
+    def _write():
+        out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-9)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _pick_blocks(l: int) -> tuple[int, int]:
@@ -156,24 +174,65 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, block_q: int = None, block_k:
     # dims, satisfying the TPU (8, 128) tiling rule; bool sublane=1 does not
     mp = mp.astype(jnp.float32)[:, None, :]
 
-    grid = (b, h, lp // block_q)
-    kernel = functools.partial(_flash_kernel, block_k=min(block_k, lp), causal=causal)
+    block_k = min(block_k, lp)
+    num_kb = lp // block_k
+    grid = (b, h, lp // block_q, num_kb)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, num_kb=num_kb
+    )
+    if causal:
+        # Above-diagonal key blocks are skipped by pl.when in the kernel;
+        # clamping their index to the last live block makes consecutive
+        # steps request the SAME tile, which pallas recognizes and elides
+        # the K/V/mask DMAs — without this, causal pays ~2x the HBM reads.
+        def kv_index(b_, h_, i, j):
+            live = jnp.minimum(j, ((i + 1) * block_q + block_k - 1) // block_k - 1)
+            return (b_, h_, live, 0)
+
+        def mask_index(b_, h_, i, j):
+            live = jnp.minimum(j, ((i + 1) * block_q + block_k - 1) // block_k - 1)
+            return (b_, 0, live)
+    else:
+        def kv_index(b_, h_, i, j):
+            return (b_, h_, j, 0)
+
+        def mask_index(b_, h_, i, j):
+            return (b_, 0, j)
+
     kwargs = {}
     if _HAS_PLTPU and not _use_interpret():
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         )
+    # pltpu.VMEM pins scratch to on-chip memory on real TPUs; plain
+    # ShapeDtypeStruct keeps interpret mode working on builds without the
+    # pallas tpu module (the _HAS_PLTPU fallback this file promises).
+    if _HAS_PLTPU:
+        scratch = [
+            pltpu.VMEM((block_q, d), jnp.float32),    # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # row-max (lane copies)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # row-sum (lane copies)
+        ]
+    else:
+        scratch = [
+            jax.ShapeDtypeStruct((block_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+        ]
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, h, lp, d), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, lp, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, lp, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, lp), lambda b_, h_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k), mask_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
+        ),
+        scratch_shapes=scratch,
         interpret=_use_interpret(),
         **kwargs,
     )(qp, kp, vp, mp)

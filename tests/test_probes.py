@@ -73,3 +73,28 @@ def test_snapshot_records():
     assert {d.id for d in h0.dest_hosts} == {"h1", "h2"}
     assert all(d.probes.average_rtt > 0 for d in h0.dest_hosts)
     assert h0.created_at == 123
+
+
+def test_gather_candidate_rtt_batch_matches_scalar():
+    """The vectorized searchsorted lookup must agree with per-pair
+    average_rtt across hits, misses, and unprobed pairs."""
+    import numpy as np
+
+    store = ProbeStore(max_pairs=256, max_hosts=64)
+    rng = np.random.default_rng(0)
+    srcs = rng.integers(0, 40, 60)
+    dsts = rng.integers(0, 40, 60)
+    keep = srcs != dsts
+    srcs, dsts = srcs[keep], dsts[keep]
+    store.enqueue(srcs, dsts, rng.random(srcs.size).astype(np.float32) * 1e7 + 1)
+
+    child = rng.integers(0, 48, 16).astype(np.int32)
+    cand = rng.integers(0, 48, (16, 7)).astype(np.int32)
+    avg, has = store.gather_candidate_rtt(child, cand)
+    for i in range(16):
+        for j in range(7):
+            want = store.average_rtt(int(cand[i, j]), int(child[i]))
+            if want is None:
+                assert not has[i, j]
+            else:
+                assert has[i, j] and abs(avg[i, j] - want) < 1e-3
