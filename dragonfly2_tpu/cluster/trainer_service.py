@@ -21,18 +21,35 @@ from dragonfly2_tpu.records.features import (
 )
 from dragonfly2_tpu.records.storage import HostTraceStorage
 from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_ATTENTION,
     MODEL_TYPE_GNN,
     MODEL_TYPE_MLP,
     ModelEvaluation,
     ModelRegistry,
     ModelVersion,
 )
-from dragonfly2_tpu.training.train import TrainResult, train_gnn, train_mlp
+from dragonfly2_tpu.training.train import (
+    TrainResult,
+    train_attention,
+    train_gnn,
+    train_mlp,
+)
 
 logger = logging.getLogger(__name__)
 
+
+def _ranker_evaluation(result: "TrainResult") -> "ModelEvaluation":
+    """Registry evaluation fields for the parent-ranker families (GNN and
+    attention share the top-1 selection metrics)."""
+    return ModelEvaluation(
+        recall=result.eval_metrics.get("recall", 0.0),
+        precision=result.eval_metrics.get("precision", 0.0),
+        f1_score=result.eval_metrics.get("f1", 0.0),
+    )
+
 GNN_MODEL_NAME = "parent-ranker"
 MLP_MODEL_NAME = "rtt-regressor"
+ATTENTION_MODEL_NAME = "parent-ranker-attention"
 
 
 @dataclasses.dataclass
@@ -42,6 +59,8 @@ class TrainOutcome:
     mlp: ModelVersion | None
     gnn_result: TrainResult | None
     mlp_result: TrainResult | None
+    attention: ModelVersion | None = None
+    attention_result: TrainResult | None = None
 
 
 class TrainerService:
@@ -77,8 +96,8 @@ class TrainerService:
         self.storage.clear_host(host_id)
 
     def train_finish(self, host_id: str) -> TrainOutcome:
-        """Stream end: train GNN ∥ MLP, publish versions, clear datasets
-        (training.go:60-98's errgroup, realized)."""
+        """Stream end: train GNN ∥ MLP (∥ attention when enabled), publish
+        versions, clear datasets (training.go:60-98's errgroup, realized)."""
         outcome = TrainOutcome(host_id, None, None, None, None)
         try:
             downloads = self.storage.list_downloads()
@@ -89,13 +108,17 @@ class TrainerService:
                 outcome.gnn_result = result
                 outcome.gnn = self._publish(
                     GNN_MODEL_NAME, MODEL_TYPE_GNN, host_id, result,
-                    ModelEvaluation(
-                        recall=result.eval_metrics.get("recall", 0.0),
-                        precision=result.eval_metrics.get("precision", 0.0),
-                        f1_score=result.eval_metrics.get("f1", 0.0),
-                    ),
+                    _ranker_evaluation(result),
                     extra={"num_downloads": len(downloads), "num_hosts": len(graph.host_ids)},
                 )
+                if self.config.train_attention:
+                    result = train_attention(ds, self.config, mesh=self.mesh)
+                    outcome.attention_result = result
+                    outcome.attention = self._publish(
+                        ATTENTION_MODEL_NAME, MODEL_TYPE_ATTENTION, host_id, result,
+                        _ranker_evaluation(result),
+                        extra={"num_downloads": len(downloads)},
+                    )
             if topologies:
                 x, y = topology_to_pairs(topologies)
                 if x.shape[0] >= 8:

@@ -78,6 +78,9 @@ class TrainerConfig:
     epochs: int = 10
     hidden_dim: int = 128
     checkpoint_dir: str = "checkpoints"
+    # Also train/publish the attention parent ranker (third model family;
+    # the reference's registry only knows gnn|mlp, models/model.go:19-46).
+    train_attention: bool = False
 
 
 @dataclasses.dataclass

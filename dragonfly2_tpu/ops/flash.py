@@ -60,8 +60,8 @@ def _use_interpret() -> bool:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, block_k: int, causal: bool, num_kb: int,
+    q_ref, k_ref, v_ref, mask_ref, *refs,
+    block_k: int, causal: bool, num_kb: int, partial: bool = False,
 ):
     """One (b, h, iq, jk) program: BLOCK_Q queries vs ONE [BK, D] key block.
 
@@ -70,7 +70,17 @@ def _flash_kernel(
     constant in L — a whole-KV block spec runs out of scoped vmem around
     L=12k. Online-softmax state (acc, row-max, row-sum) lives in VMEM
     scratch, which persists across the inner grid steps; the output tile
-    is written once on the last key block."""
+    is written once on the last key block.
+
+    With `partial=True` the kernel emits UNNORMALIZED online-softmax
+    partials — (acc f32, row-max, row-sum) — instead of the finished
+    output, so callers can merge blocks computed elsewhere (the ring
+    attention steps in parallel/ring.py compose one partial per KV
+    rotation)."""
+    if partial:
+        o_ref, om_ref, ol_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(2)
     jk = pl.program_id(3)
 
@@ -134,8 +144,16 @@ def _flash_kernel(
 
     @pl.when(jk == num_kb - 1)
     def _write():
-        out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-9)
-        o_ref[0, 0] = out.astype(o_ref.dtype)
+        if partial:
+            o_ref[0, 0] = acc_ref[...].astype(o_ref.dtype)
+            # row stats as [BQ, 8] lane copies: a [b,h,lp]-shaped output
+            # block (1,1,BQ) violates the TPU (8,128) tiling rule, while a
+            # trailing dim equal to the array's passes it
+            om_ref[0, 0] = m_ref[:, :8]
+            ol_ref[0, 0] = l_ref[:, :8]
+        else:
+            out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-9)
+            o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _pick_blocks(l: int) -> tuple[int, int]:
@@ -150,7 +168,18 @@ def _pick_blocks(l: int) -> tuple[int, int]:
     return block_q, lp
 
 
-def _flash_forward(q, k, v, kv_mask, causal: bool, block_q: int = None, block_k: int = None):
+def _flash_forward(
+    q, k, v, kv_mask, causal: bool, block_q: int = None, block_k: int = None,
+    partial: bool = False,
+):
+    if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
+        # padding/grid/index maps all derive from q's length; a shorter KV
+        # would be read out of bounds. Ring attention always passes
+        # equal-length shards; cross-length callers must pad KV themselves.
+        raise ValueError(
+            f"flash attention requires equal q/kv lengths, got q={q.shape[2]} "
+            f"kv={k.shape[2]}/{v.shape[2]}"
+        )
     if block_q is None or block_k is None:
         auto_q, auto_k = _pick_blocks(q.shape[2])
         block_q = block_q or auto_q
@@ -178,7 +207,8 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, block_q: int = None, block_k:
     num_kb = lp // block_k
     grid = (b, h, lp // block_q, num_kb)
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, num_kb=num_kb
+        _flash_kernel, block_k=block_k, causal=causal, num_kb=num_kb,
+        partial=partial,
     )
     if causal:
         # Above-diagonal key blocks are skipped by pl.when in the kernel;
@@ -219,9 +249,21 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, block_q: int = None, block_k:
             jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
             jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
         ]
+    out_block = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    row_block = pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, i, j: (b_, h_, i, 0))
+    if partial:
+        out_shape = (
+            jax.ShapeDtypeStruct((b, h, lp, d), jnp.float32),  # unnormalized acc
+            jax.ShapeDtypeStruct((b, h, lp, 8), jnp.float32),  # row-max (lane copies)
+            jax.ShapeDtypeStruct((b, h, lp, 8), jnp.float32),  # row-sum (lane copies)
+        )
+        out_specs = (out_block, row_block, row_block)
+    else:
+        out_shape = jax.ShapeDtypeStruct((b, h, lp, d), q.dtype)
+        out_specs = out_block
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, lp, d), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -229,13 +271,14 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, block_q: int = None, block_k:
             pl.BlockSpec((1, 1, block_k, d), kv_index),
             pl.BlockSpec((1, 1, block_k), mask_index),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
-        ),
+        out_specs=out_specs,
         scratch_shapes=scratch,
         interpret=_use_interpret(),
         **kwargs,
     )(qp, kp, vp, mp)
+    if partial:
+        acc, row_max, row_sum = out
+        return acc[:, :, :l, :], row_max[:, :, :l, 0], row_sum[:, :, :l, 0]
     return out[:, :, :l, :]
 
 
@@ -275,3 +318,16 @@ def flash_attention(q, k, v, kv_mask, causal: bool = False) -> jax.Array:
     invalid keys contribute nothing; fully-masked rows return 0) and for
     models/attention.py's injectable attention_fn."""
     return _flash(q, k, v, kv_mask, causal)
+
+
+def flash_attention_partials(q, k, v, kv_mask):
+    """Unnormalized flash partials for cross-block composition.
+
+    Returns (acc, row_max, row_sum) in f32: `acc / max(row_sum, eps)` is
+    the attention output over exactly this KV block. Ring attention
+    (parallel/ring.py) computes one partial per KV rotation and merges
+    them with the standard online-softmax combine — giving the ring's
+    per-device step the kernel's O(block) VMEM footprint instead of an
+    [Lq, Lk] score matrix. Forward-only: differentiate the ring through
+    its dense path (the kernel has no VJP in partials mode)."""
+    return _flash_forward(q, k, v, kv_mask, causal=False, partial=True)

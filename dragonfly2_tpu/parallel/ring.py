@@ -53,11 +53,19 @@ def dense_attention(q, k, v, kv_mask, causal: bool = False) -> jax.Array:
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
+def ring_attention(
+    q, k, v, kv_mask, axis_name: str = SP_AXIS, use_flash: bool = False
+) -> jax.Array:
     """Blockwise attention inside shard_map: every step attends the local
     queries to the current KV block, then rotates KV one hop around the
     `axis_name` ring. Online softmax keeps running (max, sum, acc) in
-    float32."""
+    float32.
+
+    use_flash=True computes each per-device block with the pallas kernel's
+    partials mode (ops/flash.py) and merges them with the same combine —
+    the [Lq, Lk] block score matrix never materializes, so long local
+    shards fit where the einsum path would blow HBM. Forward-only (the
+    partials kernel has no VJP); training keeps the einsum path."""
     n = jax.lax.psum(1, axis_name)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     batch, heads, q_len, dim = q.shape
@@ -67,22 +75,34 @@ def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
     row_sum = jnp.zeros((batch, heads, q_len), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def attend_block(acc, row_max, row_sum, kb, vb, mb):
-        scores = (
-            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
-            * scale
-        )
-        key_valid = mb[:, None, None, :]
-        scores = jnp.where(key_valid, scores, _NEG)
-        block_max = jnp.max(scores, axis=-1)
-        new_max = jnp.maximum(row_max, block_max)
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[..., None]) * key_valid
-        acc = acc * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32)
-        )
-        row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-        return acc, new_max, row_sum
+    if use_flash:
+        from dragonfly2_tpu.ops.flash import flash_attention_partials
+
+        def attend_block(acc, row_max, row_sum, kb, vb, mb):
+            acc_b, m_b, l_b = flash_attention_partials(q, kb, vb, mb)
+            new_max = jnp.maximum(row_max, m_b)
+            c_old = jnp.exp(row_max - new_max)
+            c_new = jnp.exp(m_b - new_max)
+            acc = acc * c_old[..., None] + acc_b * c_new[..., None]
+            row_sum = row_sum * c_old + l_b * c_new
+            return acc, new_max, row_sum
+    else:
+        def attend_block(acc, row_max, row_sum, kb, vb, mb):
+            scores = (
+                jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
+                * scale
+            )
+            key_valid = mb[:, None, None, :]
+            scores = jnp.where(key_valid, scores, _NEG)
+            block_max = jnp.max(scores, axis=-1)
+            new_max = jnp.maximum(row_max, block_max)
+            correction = jnp.exp(row_max - new_max)
+            probs = jnp.exp(scores - new_max[..., None]) * key_valid
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", probs, vb.astype(jnp.float32)
+            )
+            row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+            return acc, new_max, row_sum
 
     def body(_, carry):
         acc, row_max, row_sum, kb, vb, mb = carry
@@ -101,14 +121,15 @@ def ring_attention(q, k, v, kv_mask, axis_name: str = SP_AXIS) -> jax.Array:
     return out.astype(q.dtype)
 
 
-def sharded_ring_attention(mesh, q, k, v, kv_mask) -> jax.Array:
+def sharded_ring_attention(mesh, q, k, v, kv_mask, use_flash: bool = False) -> jax.Array:
     """shard_map wrapper: batch over `dp`, sequence over `sp`. Global
     shapes in, global shapes out; each device holds L/sp of the sequence
-    and the KV shards ride the ICI ring."""
+    and the KV shards ride the ICI ring. `use_flash` swaps the per-device
+    block computation for the pallas partials kernel (forward-only)."""
     qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
     mask_spec = P(DP_AXIS, SP_AXIS)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=SP_AXIS),
+        functools.partial(ring_attention, axis_name=SP_AXIS, use_flash=use_flash),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,

@@ -199,3 +199,41 @@ def test_server_rebuilds_full_architecture(tmp_path):
     got = np.asarray(server.score_set(child, parents, pair, mask), np.float32)
     # bf16 compute: two separately-jitted graphs agree only to bf16 noise
     np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+
+
+def test_trainer_service_publishes_attention_family(tmp_path):
+    """With train_attention on, the trainer publishes all three families
+    and the attention version serves through the registry's scorer."""
+    import numpy as np
+
+    from dragonfly2_tpu.cluster.trainer_service import (
+        ATTENTION_MODEL_NAME,
+        TrainerService,
+    )
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.schema import flatten  # noqa: F401 (api sanity)
+    from dragonfly2_tpu.records.storage import HostTraceStorage, TraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_ATTENTION
+
+    cluster = synth.make_cluster(24, seed=1)
+    records = synth.gen_download_records(cluster, 120, num_tasks=8)
+    store = TraceStorage(tmp_path / "traces")
+    for r in records:
+        store.create_download(r)
+
+    registry = ModelRegistry(tmp_path / "registry")
+    svc = TrainerService(
+        HostTraceStorage(tmp_path / "trainer"),
+        registry,
+        TrainerConfig(epochs=2, batch_size=32, hidden_dim=16, train_attention=True),
+    )
+    svc.train_mlp_chunk("h1", store.open_download())
+    outcome = svc.train_finish("h1")
+    assert outcome.gnn is not None and outcome.attention is not None
+    types = {m["type"] for m in registry.list_models()}
+    assert MODEL_TYPE_ATTENTION in types
+    att_id = registry.model_id(ATTENTION_MODEL_NAME, "h1")
+    active = registry.active_version(att_id)
+    assert active is not None and active.evaluation.precision >= 0.0
