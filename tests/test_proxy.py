@@ -295,3 +295,22 @@ def test_proxy_unsatisfiable_range_is_not_206(tmp_path, origin):
             await sched.stop()
 
     asyncio.run(run())
+
+
+def test_stress_driver_smoke(capsys):
+    """tools/stress.py (the reference's test/tools/stress parity): the
+    in-proc rig must sustain error-free proxied fetches and report QPS."""
+    import importlib.util
+    import json
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "tools" / "stress.py"
+    spec = importlib.util.spec_from_file_location("dragonfly2_tpu_stress_tool", path)
+    stress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(stress)
+
+    rc = stress.main(["--connections", "4", "--duration", "2", "--size", "262144"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "proxy_qps"
+    assert out["requests"] > 0 and out["errors"] == 0

@@ -1,0 +1,168 @@
+"""Daemon stress driver — parity with test/tools/stress in the reference.
+
+Stands up a full in-proc slice (origin file server -> scheduler ->
+daemon -> P2P proxy) and fires `--connections` concurrent HTTP clients
+through the daemon's proxy for `--duration` seconds, reporting QPS and
+latency percentiles exactly like the reference's custom stress tool does
+for dfdaemon's proxy (test/tools/stress/main.go). Against an external
+proxy, pass --proxy host:port --url http://... to skip the in-proc rig.
+
+Prints one JSON line:
+  {"metric": "proxy_qps", "value": ..., "p50_ms": ..., "p95_ms": ...,
+   "p99_ms": ..., "requests": N, "errors": E}
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import http.server
+import json
+import os
+import pathlib
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+if __name__ == "__main__":  # library imports (tests) already have the repo on sys.path
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def _origin(payload: bytes):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+
+        def do_GET(self):
+            data = payload
+            r = self.headers.get("Range")
+            status = 200
+            if r and r.startswith("bytes="):
+                lo, _, hi = r[6:].partition("-")
+                lo = int(lo or 0)
+                hi = int(hi) if hi else len(data) - 1
+                data, status = data[lo : hi + 1], 206
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _fetch_once(proxy_addr: str, url: str) -> float:
+    req = urllib.request.Request(url)
+    req.set_proxy(proxy_addr, "http")
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        resp.read()
+    return (time.perf_counter() - t0) * 1e3
+
+
+def _worker(proxy_addr: str, url: str, deadline: float, out: list, errors: list):
+    while time.monotonic() < deadline:
+        try:
+            out.append(_fetch_once(proxy_addr, url))
+        except Exception:  # noqa: BLE001 - count, back off, continue
+            errors.append(1)
+            # an unreachable proxy fails instantly: without a pause this
+            # loop would spin the CPU and grow `errors` unboundedly
+            time.sleep(0.2)
+
+
+async def _run_inproc(args):
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.client.proxy import ProxyRule, ProxyServer
+    from dragonfly2_tpu.client.transport import P2PTransport
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    payload = os.urandom(args.size)
+    origin_srv, origin_port = _origin(payload)
+    workdir = tempfile.mkdtemp(prefix="stress-")
+    cfg = Config()
+    sched = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.01)
+    shost, sport = await sched.start()
+    daemon = Daemon(pathlib.Path(workdir) / "d", [(shost, sport)], hostname="stress-host")
+    await daemon.start()
+    transport = P2PTransport(daemon, rules=[ProxyRule(regex=r".*")])
+    proxy = ProxyServer(transport)
+    phost, pport = await proxy.start()
+    url = f"http://127.0.0.1:{origin_port}/blob.bin"
+    # warm the task into the mesh once so the stress loop measures reuse
+    await asyncio.to_thread(_fetch_once, f"{phost}:{pport}", url)
+    try:
+        return await _drive(f"{phost}:{pport}", url, args)
+    finally:
+        await proxy.stop()
+        await daemon.stop()
+        await sched.stop()
+        origin_srv.shutdown()
+        origin_srv.server_close()
+
+
+async def _drive(proxy_addr: str, url: str, args):
+    latencies: list = []
+    errors: list = []
+    deadline = time.monotonic() + args.duration
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(
+            target=_worker, args=(proxy_addr, url, deadline, latencies, errors)
+        )
+        for _ in range(args.connections)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        await asyncio.to_thread(t.join)
+    wall = time.monotonic() - t0
+    lat = sorted(latencies)
+    out = {
+        "metric": "proxy_qps",
+        "value": round(len(lat) / max(wall, 1e-9), 1),
+        "unit": "req/s",
+        "p50_ms": round(statistics.median(lat), 2) if lat else None,
+        "p95_ms": round(lat[int(0.95 * len(lat))], 2) if lat else None,
+        "p99_ms": round(lat[int(0.99 * len(lat))], 2) if lat else None,
+        "requests": len(lat),
+        "errors": len(errors),
+        "connections": args.connections,
+        "duration_s": args.duration,
+    }
+    print(json.dumps(out))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--connections", type=int, default=16)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--size", type=int, default=4 << 20, help="in-proc blob size")
+    ap.add_argument("--proxy", default=None, help="external proxy host:port")
+    ap.add_argument("--url", default=None, help="URL to fetch via --proxy")
+    args = ap.parse_args(argv)
+    if args.proxy:
+        if not args.url:
+            ap.error("--url is required with --proxy")
+        result = asyncio.run(_drive(args.proxy, args.url, args))
+    else:
+        result = asyncio.run(_run_inproc(args))
+    return 0 if result["requests"] and not result["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
