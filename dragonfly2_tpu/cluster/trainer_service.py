@@ -11,8 +11,10 @@ registry.create_model_version + evaluation metrics).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
+import pathlib
 
 from dragonfly2_tpu.config.config import TrainerConfig
 from dragonfly2_tpu.records.features import (
@@ -104,7 +106,10 @@ class TrainerService:
             topologies = self.storage.list_network_topologies()
             if downloads:
                 ds, graph = downloads_to_ranking_dataset(downloads)
-                result = train_gnn(ds, graph, self.config, mesh=self.mesh)
+                with self._checkpoint(GNN_MODEL_NAME) as ck:
+                    result = train_gnn(
+                        ds, graph, self.config, mesh=self.mesh, checkpointer=ck
+                    )
                 outcome.gnn_result = result
                 outcome.gnn = self._publish(
                     GNN_MODEL_NAME, MODEL_TYPE_GNN, host_id, result,
@@ -112,7 +117,10 @@ class TrainerService:
                     extra={"num_downloads": len(downloads), "num_hosts": len(graph.host_ids)},
                 )
                 if self.config.train_attention:
-                    result = train_attention(ds, self.config, mesh=self.mesh)
+                    with self._checkpoint(ATTENTION_MODEL_NAME) as ck:
+                        result = train_attention(
+                            ds, self.config, mesh=self.mesh, checkpointer=ck
+                        )
                     outcome.attention_result = result
                     outcome.attention = self._publish(
                         ATTENTION_MODEL_NAME, MODEL_TYPE_ATTENTION, host_id, result,
@@ -122,7 +130,10 @@ class TrainerService:
             if topologies:
                 x, y = topology_to_pairs(topologies)
                 if x.shape[0] >= 8:
-                    result = train_mlp(x, y, self.config, mesh=self.mesh)
+                    with self._checkpoint(MLP_MODEL_NAME) as ck:
+                        result = train_mlp(
+                            x, y, self.config, mesh=self.mesh, checkpointer=ck
+                        )
                     outcome.mlp_result = result
                     outcome.mlp = self._publish(
                         MLP_MODEL_NAME, MODEL_TYPE_MLP, host_id, result,
@@ -136,6 +147,27 @@ class TrainerService:
             self.storage.clear_downloads()
             self.storage.clear_network_topologies()
         return outcome
+
+    @contextlib.contextmanager
+    def _checkpoint(self, model_name: str):
+        """Per-model train-state checkpointer when checkpoint_dir is set:
+        a trainer killed mid-run resumes at the next epoch on restart.
+        Cleared on successful completion — otherwise the NEXT train_finish
+        would "resume" past its final epoch, run zero steps on the fresh
+        traces, and publish the stale params. Closed either way (orbax
+        managers hold background threads; a long-lived service would leak
+        them per upload cycle)."""
+        if not self.config.checkpoint_dir:
+            yield None
+            return
+        from dragonfly2_tpu.training.checkpoint import TrainCheckpointer
+
+        ck = TrainCheckpointer(pathlib.Path(self.config.checkpoint_dir) / model_name)
+        try:
+            yield ck
+            ck.clear()  # success: next run starts fresh
+        finally:
+            ck.close()
 
     def _publish(self, name, model_type, host_id, result: TrainResult,
                  evaluation: ModelEvaluation, extra: dict) -> ModelVersion:

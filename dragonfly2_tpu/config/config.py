@@ -77,7 +77,10 @@ class TrainerConfig:
     learning_rate: float = 1e-3
     epochs: int = 10
     hidden_dim: int = 128
-    checkpoint_dir: str = "checkpoints"
+    # non-empty -> per-model orbax checkpoints under this dir; a rerun of
+    # an interrupted training resumes at the next epoch (train-state
+    # resume the reference has no analogue for, SURVEY.md §5)
+    checkpoint_dir: str = ""
     # Also train/publish the attention parent ranker (third model family;
     # the reference's registry only knows gnn|mlp, models/model.go:19-46).
     train_attention: bool = False

@@ -7,6 +7,7 @@ artifacts in the registry (registry/).
 from __future__ import annotations
 
 import pathlib
+import shutil
 from typing import Any
 
 import orbax.checkpoint as ocp
@@ -22,6 +23,7 @@ class TrainCheckpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
+        self._closed = False
 
     def save(self, step: int, state: Any) -> None:
         self._mngr.save(step, args=ocp.args.StandardSave(state))
@@ -39,7 +41,16 @@ class TrainCheckpointer:
         return self._mngr.restore(step)
 
     def close(self) -> None:
-        self._mngr.close()
+        if not self._closed:
+            self._closed = True
+            self._mngr.close()
+
+    def clear(self) -> None:
+        """Completed-run cleanup: close the manager and delete the saved
+        state, so the NEXT training run starts from scratch instead of
+        'resuming' past its final epoch and publishing stale params."""
+        self.close()
+        shutil.rmtree(self.directory, ignore_errors=True)
 
 
 def params_to_bytes(params: Any) -> bytes:

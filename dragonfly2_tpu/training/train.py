@@ -377,6 +377,7 @@ def train_attention(
     seed: int = 0,
     eval_fraction: float = 0.2,
     checkpointer=None,
+    sp_strategy: str = "ring",
 ) -> TrainResult:
     """Train the set-transformer parent ranker (models/attention.py) on
     the same RankingDataset the GNN consumes — candidates attend to each
@@ -386,6 +387,7 @@ def train_attention(
 
     from dragonfly2_tpu.models.attention import AttentionRanker
     from dragonfly2_tpu.parallel.ring import sharded_ring_attention
+    from dragonfly2_tpu.parallel.ulysses import sharded_ulysses_attention
     from dragonfly2_tpu.parallel.mesh import SP_AXIS
 
     config = config or TrainerConfig()
@@ -396,9 +398,19 @@ def train_attention(
     eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
 
     model = AttentionRanker(hidden_dim=config.hidden_dim)
+    # ring and ulysses are drop-in swaps (same global-shape contract); ring
+    # moves KV around the ICI ring, ulysses all-to-alls heads — pick per
+    # workload (ulysses needs heads % sp == 0). Validated regardless of
+    # mesh so a typo fails on single-chip runs too, not only at sp>1.
+    strategies = {
+        "ring": sharded_ring_attention,
+        "ulysses": sharded_ulysses_attention,
+    }
+    if sp_strategy not in strategies:
+        raise ValueError(f"unknown sp_strategy {sp_strategy!r}")
     attention_fn = None
     if mesh is not None and mesh.shape.get(SP_AXIS, 1) > 1:
-        attention_fn = functools.partial(sharded_ring_attention, mesh)
+        attention_fn = functools.partial(strategies[sp_strategy], mesh)
 
     def apply(params, child, parents, pair, mask):
         if attention_fn is not None:

@@ -19,6 +19,7 @@ from dragonfly2_tpu.training import (
     train_gnn,
     train_mlp,
 )
+from dragonfly2_tpu.training.train import train_attention
 from dragonfly2_tpu.training.data import edge_bucket, graph_arrays
 
 
@@ -127,3 +128,51 @@ def test_train_resumes_from_checkpoint(tmp_path, mlp_data):
         np.asarray(jax.tree_util.tree_leaves(again.params)[0]),
         np.asarray(jax.tree_util.tree_leaves(resumed.params)[0]),
     )
+
+
+def test_train_attention_ulysses_strategy(rank_data):
+    """sp_strategy='ulysses' swaps ring for all-to-all attention in the
+    trainer; loss must stay finite on a dp x sp mesh."""
+    ds, _ = rank_data
+    mesh = make_mesh(8, dp=4, sp=2)
+    cfg = TrainerConfig(epochs=1, batch_size=16, hidden_dim=32)
+    res = train_attention(ds, cfg, mesh=mesh, seed=0, sp_strategy="ulysses")
+    assert res.steps > 0 and np.isfinite(res.losses).all()
+    with pytest.raises(ValueError):
+        train_attention(ds, cfg, mesh=mesh, sp_strategy="bogus")
+
+
+def test_trainer_service_checkpoint_lifecycle(tmp_path):
+    """checkpoint_dir set -> checkpoints are written during training but
+    CLEARED on success, so a later train_finish on fresh traces trains
+    from scratch instead of "resuming" past its final epoch and
+    republishing stale params with zero steps."""
+    from dragonfly2_tpu.cluster.trainer_service import GNN_MODEL_NAME, TrainerService
+    from dragonfly2_tpu.records.storage import HostTraceStorage, TraceStorage
+    from dragonfly2_tpu.registry import ModelRegistry
+
+    cluster = synth.make_cluster(16, seed=3)
+    records = synth.gen_download_records(cluster, 60, num_tasks=4)
+    store = TraceStorage(tmp_path / "traces")
+    for r in records:
+        store.create_download(r)
+
+    svc = TrainerService(
+        HostTraceStorage(tmp_path / "trainer"),
+        ModelRegistry(tmp_path / "registry"),
+        TrainerConfig(
+            epochs=2, batch_size=16, hidden_dim=16,
+            checkpoint_dir=str(tmp_path / "ck"),
+        ),
+    )
+    svc.train_mlp_chunk("h1", store.open_download())
+    outcome = svc.train_finish("h1")
+    assert outcome.gnn is not None and outcome.gnn_result.steps > 0
+    # success cleared the checkpoint state
+    assert not (tmp_path / "ck" / GNN_MODEL_NAME).exists()
+
+    # a second upload cycle must actually train on the new data
+    svc.train_mlp_chunk("h1", store.open_download())
+    outcome2 = svc.train_finish("h1")
+    assert outcome2.gnn is not None and outcome2.gnn_result.steps > 0
+    assert outcome2.gnn.version == outcome.gnn.version + 1
