@@ -5,8 +5,10 @@ CreatePreheat resolves content into tasks and fans group jobs out to
 scheduler queues (manager/job/preheat.go:73-286); scheduler-side workers
 consume `preheat` (seed-peer TriggerDownloadTask, scheduler/job/job.go:152)
 and `sync_peers` (:224). Here the queue is in-proc (the gRPC/Redis edge can
-wrap it); preheat triggers registration of a seed peer on the scheduler the
-hash ring assigns.
+wrap it); preheat enqueues a seed-download trigger (TriggerSeedRequest)
+on the scheduler the hash ring assigns, which the RPC edge pushes to the
+seed daemon's announce connection — the ObtainSeeds path, with the task
+id derived exactly as the daemons derive it (idgen.task_id_v1).
 """
 
 from __future__ import annotations
@@ -63,12 +65,17 @@ class JobManager:
         task_ids = []
         failures = {}
         for url in req.urls:
-            task_id = idgen.task_id_v2(
+            # v1 derivation, matching the daemons' dfget path
+            # (client/daemon.py download -> idgen.task_id_v1): a preheat
+            # that hashes differently from the peers seeds a task nobody
+            # ever asks for.
+            task_id = idgen.task_id_v1(
                 url,
                 tag=req.tag,
                 application=req.application,
-                piece_length=req.piece_length,
-                filtered_query_params=req.filtered_query_params,
+                filtered_query_params=idgen.FILTERED_QUERY_PARAMS_SEPARATOR.join(
+                    req.filtered_query_params or []
+                ),
             )
             task_ids.append(task_id)
             scheduler_name = self.ring.pick(task_id)
@@ -77,19 +84,20 @@ class JobManager:
                 continue
             seed = self.seed_hosts[next(self._seed_rr) % len(self.seed_hosts)]
             scheduler = self.schedulers[scheduler_name]
-            scheduler.register_peer(
-                msg.RegisterPeerRequest(
-                    peer_id=f"{seed.host_id[:16]}-{uuid.uuid4()}",
-                    task_id=task_id,
-                    host=seed,
-                    url=url,
-                    content_length=-1,
-                    piece_length=req.piece_length,
-                    tag=req.tag,
-                    application=req.application,
-                    priority=1,
-                )
+            # TriggerDownloadTask to the seed daemon (preheat.go:90-286 ->
+            # scheduler job.go:152 -> seed ObtainSeeds) — NOT a proxy peer
+            # registration: a peer registered on the seed's behalf has no
+            # connection to receive responses, so nothing would download.
+            ok = scheduler.trigger_seed_download(
+                task_id=task_id,
+                url=url,
+                piece_length=req.piece_length,
+                tag=req.tag,
+                application=req.application,
+                host_id=seed.host_id,
             )
+            if not ok:
+                failures[task_id] = "seed trigger queue full"
         state = JobState.FAILURE if failures else JobState.SUCCESS
         result = JobResult(job_id, state, task_ids, {"failures": failures})
         self.jobs[job_id] = result

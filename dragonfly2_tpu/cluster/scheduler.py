@@ -385,6 +385,37 @@ class SchedulerService:
 
     # ============================================================== tick
 
+    def trigger_seed_download(
+        self, task_id: str, url: str, piece_length: int = 4 << 20,
+        tag: str = "", application: str = "", host_id: str = "",
+    ) -> bool:
+        """Enqueue a seed-peer download trigger directly (the preheat job
+        edge: manager/job/preheat.go fans TriggerDownloadTask out to seed
+        daemons; scheduler/job.go:152 consumes). The RPC edge pushes it
+        over the chosen seed host's announce connection."""
+        with self.mu:
+            if host_id and host_id not in self._seed_hosts:
+                # preheat may name a seed the manager knows about before it
+                # has announced here; accept it so the trigger can be
+                # delivered once the daemon connects
+                self._seed_hosts.append(host_id)
+            if not self._seed_hosts or len(self.seed_triggers) >= 1024:
+                return False
+            if not host_id:
+                host_id = self._seed_hosts[self._seed_rr % len(self._seed_hosts)]
+                self._seed_rr += 1
+            self.seed_triggers.append(
+                msg.TriggerSeedRequest(
+                    host_id=host_id,
+                    task_id=task_id,
+                    url=url,
+                    piece_length=piece_length,
+                    tag=tag,
+                    application=application,
+                )
+            )
+            return True
+
     def tick(self) -> list:
         """Run ONE batched scheduling round over every pending peer.
 

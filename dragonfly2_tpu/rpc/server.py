@@ -259,6 +259,11 @@ class SchedulerRPCServer:
             await asyncio.sleep(self.tick_interval)
             try:
                 await self._tick_once()
+                # Seed triggers can be enqueued OUT of band (a manager
+                # preheat job calls the service directly); per-connection
+                # draining alone would leave them stuck until some peer
+                # happens to send a message.
+                await self._drain_seed_triggers()
             except Exception:  # noqa: BLE001 - keep ticking
                 logger.exception("schedule tick failed")
 

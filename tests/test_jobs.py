@@ -18,13 +18,14 @@ def test_preheat_fans_out_by_hash_ring():
     result = jm.create_preheat(PreheatRequest(urls=urls, tag="preheat"))
     assert result.state == JobState.SUCCESS
     assert len(result.task_ids) == 12
-    counts = jm.sync_peers()
-    total_tasks = sum(c["tasks"] for c in counts.values())
-    total_peers = sum(c["peers"] for c in counts.values())
-    assert total_tasks == 12
-    assert total_peers == 12  # one seed registration per task
-    # consistent hashing actually split the work
-    assert counts["s1"]["tasks"] > 0 and counts["s2"]["tasks"] > 0
+    # one TriggerSeedRequest per task, split across schedulers by the ring
+    total_triggers = sum(len(s.seed_triggers) for s in schedulers.values())
+    assert total_triggers == 12
+    assert schedulers["s1"].seed_triggers and schedulers["s2"].seed_triggers
+    trigger_tasks = {
+        t.task_id for s in schedulers.values() for t in s.seed_triggers
+    }
+    assert trigger_tasks == set(result.task_ids)
     # same urls preheat to the same schedulers (stable affinity)
     jm2 = JobManager({"s1": SchedulerService(), "s2": SchedulerService()}, [seed_host(0)])
     result2 = jm2.create_preheat(PreheatRequest(urls=urls, tag="preheat"))
@@ -36,3 +37,20 @@ def test_preheat_without_seeds_fails():
     result = jm.create_preheat(PreheatRequest(urls=["https://e.com/x"]))
     assert result.state == JobState.FAILURE
     assert jm.get(result.job_id) is result
+
+
+def test_preheat_task_id_matches_daemon_derivation():
+    """Multi-param filtered_query_params must hash identically to the
+    daemons' dfget derivation (join with the idgen separator, not ','):
+    a preheat that hashes differently seeds a task nobody requests."""
+    from dragonfly2_tpu.utils import idgen
+
+    url = "https://cdn.example.com/blob?v=1&token=abc&x=2"
+    svc = SchedulerService()
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(
+        PreheatRequest(urls=[url], tag="t", filtered_query_params=["v", "token"])
+    )
+    want = idgen.task_id_v1(url, tag="t", filtered_query_params="v&token")
+    assert result.task_ids == [want]
+    assert svc.seed_triggers[0].task_id == want

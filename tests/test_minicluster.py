@@ -229,3 +229,81 @@ def test_seed_peer_trigger(tmp_path, origin):
             await server.stop()
 
     asyncio.run(run())
+
+
+def test_preheat_via_manager_rest(tmp_path, origin):
+    """Full preheat path (SURVEY.md §3.4): POST /api/v1/jobs on the manager
+    -> JobManager fan-out by hash ring -> scheduler seed trigger -> seed
+    daemon back-sources (ObtainSeeds) -> later peers download P2P without
+    touching the origin again."""
+    import json
+    import urllib.request
+
+    from dragonfly2_tpu.cluster import messages as msg
+    from dragonfly2_tpu.cluster.jobs import JobManager
+    from dragonfly2_tpu.manager.rest import ManagerREST
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        seed = Daemon(
+            tmp_path / "seed", [(host, port)], hostname="seed-1", host_type="super"
+        )
+        await seed.start()
+
+        jm = JobManager(
+            {"s1": service},
+            [msg.HostInfo(
+                host_id=seed.host_id, hostname="seed-1", ip=seed.ip,
+                host_type="super",
+            )],
+        )
+        manager = ManagerService(jobs=jm)
+        rest = ManagerREST(manager)
+        mhost, mport = rest.start()
+
+        peer = None
+        try:
+            req = urllib.request.Request(
+                f"http://{mhost}:{mport}/api/v1/jobs",
+                data=json.dumps(
+                    {"type": "preheat", "args": {"urls": [origin.url()],
+                     "piece_length": 32 * 1024}}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            body = await asyncio.to_thread(
+                lambda: json.loads(urllib.request.urlopen(req, timeout=10).read())
+            )
+            assert body.get("state") in ("SUCCESS", "PENDING"), body
+
+            # the seed daemon consumes the trigger and back-sources
+            for _ in range(100):
+                if origin.get_count > 0 and not service.seed_triggers:
+                    break
+                await asyncio.sleep(0.1)
+            assert origin.get_count > 0, "seed never back-sourced"
+            await asyncio.sleep(0.3)  # let the seed report completion
+            warm_gets = origin.get_count
+
+            # a normal peer now gets the bytes purely over P2P
+            peer = Daemon(tmp_path / "p1", [(host, port)], hostname="peer-1")
+            await peer.start()
+            ts = await peer.download(
+                origin.url(), piece_length=32 * 1024, back_source_allowed=False
+            )
+            with open(ts.data_path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == hashlib.sha256(
+                    origin.payload
+                ).hexdigest()
+            assert origin.get_count == warm_gets, "peer hit the origin"
+        finally:
+            if peer is not None:
+                await peer.stop()
+            await seed.stop()
+            await server.stop()
+            rest.stop()
+
+    asyncio.run(run())
