@@ -3,10 +3,10 @@
 Capability parity with pkg/source (source_client.go:267 `Register` +
 per-scheme clients in pkg/source/clients/: http, s3, oss, hdfs, oras):
 a scheme->client registry behind one interface (content_length, download,
-download_range). Shipped clients: http/https (urllib, Range requests) and
-file:// (local paths — what the e2e harness and dfcache import/export
-use). s3/oss/hdfs/oras register as explicit stubs that raise Unavailable
-with a pointer, since this image has no credentials or SDKs wired.
+list_entries, supports_range). Shipped clients: http/https (urllib, Range
+requests) and file:// in this module; s3/oss/obs (signed vendor HTTP),
+hdfs (WebHDFS), and oras (OCI pull) in `object_sources.py`, registered
+lazily on first lookup.
 """
 
 from __future__ import annotations
@@ -47,6 +47,7 @@ class SourceClient(Protocol):
 
 
 _REGISTRY: dict[str, SourceClient] = {}
+_defaults_registered = False
 
 
 def register(scheme: str, client: SourceClient, force: bool = False) -> None:
@@ -56,6 +57,7 @@ def register(scheme: str, client: SourceClient, force: bool = False) -> None:
 
 
 def client_for(url: str) -> SourceClient:
+    _register_defaults()
     scheme = urllib.parse.urlsplit(url).scheme.lower()
     client = _REGISTRY.get(scheme)
     if client is None:
@@ -267,41 +269,27 @@ class FileSource:
         return entries
 
 
-# ------------------------------------------------------------------ stubs
-
-
-class _StubSource:
-    """Placeholder for object-store schemes this image can't reach
-    (pkg/source/clients/{s3,oss,hdfs,oras}clients in the reference)."""
-
-    def __init__(self, scheme: str):
-        self.scheme = scheme
-
-    def _raise(self):
-        raise dferrors.Unavailable(
-            f"{self.scheme}:// back-source requires external credentials/SDKs; "
-            "register a real client via client.source.register()"
-        )
-
-    def content_length(self, url: str, headers: dict | None = None) -> int:
-        self._raise()
-
-    def download(self, url: str, headers: dict | None = None, offset: int = 0, length: int = -1):
-        self._raise()
-
-    def list_entries(self, url: str, headers: dict | None = None):
-        self._raise()
-
-
 def _register_defaults() -> None:
+    """Populate the registry on first lookup, not at import time: the
+    object-store / hdfs / oras clients in object_sources.py import THIS
+    module for URLEntry, so an import-time registration would touch
+    object_sources while it is still half-initialized whenever a user
+    imports object_sources first (circular-import crash)."""
+    global _defaults_registered
+    if _defaults_registered:
+        return
+    _defaults_registered = True
+    from dragonfly2_tpu.client import object_sources
+
     for scheme in ("http", "https"):
         if scheme not in _REGISTRY:
             register(scheme, HTTPSource())
     if "file" not in _REGISTRY:
         register("file", FileSource())
-    for scheme in ("s3", "oss", "obs", "hdfs", "oras"):
+    for scheme in ("s3", "oss", "obs"):
         if scheme not in _REGISTRY:
-            register(scheme, _StubSource(scheme))
-
-
-_register_defaults()
+            register(scheme, object_sources.ObjectStoreSource(scheme))
+    if "hdfs" not in _REGISTRY:
+        register("hdfs", object_sources.HdfsSource())
+    if "oras" not in _REGISTRY:
+        register("oras", object_sources.OrasSource())

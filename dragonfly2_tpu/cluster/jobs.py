@@ -59,8 +59,12 @@ class JobManager:
         self.jobs: dict[str, JobResult] = {}
 
     def create_preheat(self, req: PreheatRequest) -> JobResult:
-        """Resolve urls -> task ids, register a seed peer per task on the
-        owning scheduler (preheat.go:90-286 + scheduler job.go:152-221)."""
+        """Resolve urls -> task ids and enqueue a TriggerSeedRequest per
+        task on the owning scheduler, to be pushed to the chosen seed
+        daemon's announce connection (preheat.go:90-286 + scheduler
+        job.go:152-221). No peer is registered here — a peer registered
+        on the seed's behalf would have no connection to receive
+        responses, so nothing would download."""
         job_id = str(uuid.uuid4())
         task_ids = []
         failures = {}

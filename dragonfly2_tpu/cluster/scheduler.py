@@ -394,16 +394,19 @@ class SchedulerService:
         daemons; scheduler/job.go:152 consumes). The RPC edge pushes it
         over the chosen seed host's announce connection."""
         with self.mu:
-            if host_id and host_id not in self._seed_hosts:
-                # preheat may name a seed the manager knows about before it
-                # has announced here; accept it so the trigger can be
-                # delivered once the daemon connects
-                self._seed_hosts.append(host_id)
-            if not self._seed_hosts or len(self.seed_triggers) >= 1024:
+            if len(self.seed_triggers) >= 1024:
                 return False
             if not host_id:
+                if not self._seed_hosts:
+                    return False
                 host_id = self._seed_hosts[self._seed_rr % len(self._seed_hosts)]
                 self._seed_rr += 1
+            # An explicitly named seed may not have announced yet (preheat
+            # right after a seed restart): the trigger is queued anyway —
+            # the RPC drain re-routes to any connected seed or keeps
+            # requeueing until the delivery deadline. The unannounced host
+            # is deliberately NOT added to _seed_hosts, so round-robin for
+            # other tasks never lands on a host that may not exist.
             self.seed_triggers.append(
                 msg.TriggerSeedRequest(
                     host_id=host_id,

@@ -184,18 +184,23 @@ _VENDORS = ("s3", "oss", "obs")
 
 
 def new_backend(name: str, base_dir: str | pathlib.Path | None = None, **options):
-    """pkg/objectstorage New(): vendor dispatch. `fs` is real; the cloud
-    vendors need SDKs not present in this image and raise Unavailable
-    (callers degrade exactly as when a vendor endpoint is down)."""
+    """pkg/objectstorage New(): vendor dispatch (objectstorage.go:205-212).
+    `fs` is the local store; `s3`/`oss`/`obs` speak the vendor HTTP dialect
+    directly (signed with stdlib hmac — no SDKs in this image) and need
+    endpoint + access_key + secret_key options."""
     if name == "fs":
         if base_dir is None:
             raise dferrors.InvalidArgument("fs backend needs base_dir")
         return FilesystemBackend(base_dir)
     if name in _VENDORS:
-        raise dferrors.Unavailable(
-            f"object-storage vendor {name!r} requires its SDK, which is not "
-            "available in this environment; use the 'fs' backend"
-        )
+        if not options.get("endpoint"):
+            raise dferrors.Unavailable(
+                f"object-storage vendor {name!r} needs endpoint/access_key/"
+                "secret_key options (no ambient cloud credentials here)"
+            )
+        from dragonfly2_tpu.objectstorage.remote import new_remote_backend
+
+        return new_remote_backend(name, **options)
     raise dferrors.InvalidArgument(f"unknown object storage name {name!r}")
 
 

@@ -23,6 +23,10 @@ wire.register_module(msg)
 
 logger = logging.getLogger(__name__)
 
+# How long a seed trigger waits for *any* seed daemon to connect before it
+# is declared undeliverable (preheat racing the seed's announce).
+SEED_TRIGGER_TTL_S = 60.0
+
 
 class SchedulerRPCServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0, tick_interval: float = 0.005):
@@ -35,6 +39,8 @@ class SchedulerRPCServer:
         self._host_conn: dict[str, asyncio.StreamWriter] = {}
         self._writers: set[asyncio.StreamWriter] = set()
         self._tick_task: asyncio.Task | None = None
+        self._trigger_deadline: dict[str, float] = {}
+        self._pending_triggers: list = []
         self._lock = asyncio.Lock()
         reg = default_registry()
         self._m_requests = reg.counter(
@@ -102,9 +108,31 @@ class SchedulerRPCServer:
 
     async def _drain_seed_triggers(self) -> None:
         """Push queued TriggerSeedRequests to their seed hosts' announce
-        connections (the scheduler->seed-peer ObtainSeeds edge)."""
+        connections (the scheduler->seed-peer ObtainSeeds edge).
+
+        Triggers that cannot be delivered yet — no seed connected (preheat
+        racing the seed's announce), or the write failed mid-flight — are
+        held in a server-side pending list and retried on later drains
+        until SEED_TRIGGER_TTL_S, NOT silently dropped. The pending list
+        lives here (not back in svc.seed_triggers) so the 5ms tick doesn't
+        pay two thread hops per tick just to shuttle the same trigger."""
         svc = self.service
-        if not svc.seed_triggers:
+        if not svc.seed_triggers and not self._pending_triggers:
+            return
+        if not self._host_conn and not svc.seed_triggers:
+            # nothing can be delivered; just expire long-waiting triggers
+            now = time.monotonic()
+            still = []
+            for trigger in self._pending_triggers:
+                if now < self._trigger_deadline.get(trigger.task_id, now + 1):
+                    still.append(trigger)
+                else:
+                    self._trigger_deadline.pop(trigger.task_id, None)
+                    logger.warning(
+                        "seed trigger for task %s expired after %.0fs with no "
+                        "connected seed host", trigger.task_id, SEED_TRIGGER_TTL_S,
+                    )
+            self._pending_triggers = still
             return
 
         def pop_triggers():
@@ -114,11 +142,18 @@ class SchedulerRPCServer:
                 triggers, svc.seed_triggers = svc.seed_triggers, []
                 return triggers, list(svc._seed_hosts)
 
-        triggers, seed_hosts = await asyncio.to_thread(pop_triggers)
+        if svc.seed_triggers:
+            triggers, seed_hosts = await asyncio.to_thread(pop_triggers)
+        else:
+            triggers, seed_hosts = [], list(svc._seed_hosts)
+        triggers = self._pending_triggers + triggers
+        self._pending_triggers = []
+        undeliverable: list = []
+        now = time.monotonic()
         for trigger in triggers:
-            # Fall back to any connected seed host when the round-robin
-            # choice has no live connection (crashed seed without
-            # LeaveHost): a dropped trigger strands no-back-source peers.
+            # Fall back to any connected seed host when the chosen host
+            # has no live connection (crashed seed without LeaveHost): a
+            # dropped trigger strands no-back-source peers.
             async with self._lock:
                 writer = self._host_conn.get(trigger.host_id)
                 if writer is None:
@@ -126,14 +161,31 @@ class SchedulerRPCServer:
                     if candidates:
                         trigger.host_id = candidates[0]
                         writer = self._host_conn[trigger.host_id]
-            if writer is None:
-                logger.warning("no connected seed host for task %s", trigger.task_id)
+            delivered = False
+            if writer is not None:
+                try:
+                    wire.write_frame(writer, trigger)
+                    await writer.drain()
+                    delivered = True
+                except (ConnectionError, RuntimeError):
+                    logger.warning(
+                        "seed trigger to %s failed, will retry", trigger.host_id
+                    )
+            if delivered:
+                self._trigger_deadline.pop(trigger.task_id, None)
                 continue
-            try:
-                wire.write_frame(writer, trigger)
-                await writer.drain()
-            except (ConnectionError, RuntimeError):
-                logger.warning("seed trigger to %s failed", trigger.host_id)
+            deadline = self._trigger_deadline.setdefault(
+                trigger.task_id, now + SEED_TRIGGER_TTL_S
+            )
+            if now < deadline:
+                undeliverable.append(trigger)
+            else:
+                self._trigger_deadline.pop(trigger.task_id, None)
+                logger.warning(
+                    "seed trigger for task %s expired undelivered after %.0fs",
+                    trigger.task_id, SEED_TRIGGER_TTL_S,
+                )
+        self._pending_triggers = undeliverable
 
     async def _dispatch_locked(self, request, writer, owned_peers: set[str]):
         """Service mutations run off-loop under service.mu so they never

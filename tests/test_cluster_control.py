@@ -332,3 +332,20 @@ def test_tick_bucketing_schedules_all_pending():
         if len(scheduled) == n:
             break
     assert scheduled == {f"child-{i}" for i in range(n)}
+
+
+def test_trigger_seed_download_named_vs_roundrobin():
+    """A preheat may name a seed before that daemon has announced: the
+    trigger is queued for later delivery, and the unannounced host must
+    NOT leak into the round-robin seed set used for other tasks."""
+    svc = SchedulerService()
+    # no seeds at all: unnamed trigger is refused, named trigger is queued
+    assert not svc.trigger_seed_download("t-a", "http://o/f")
+    assert svc.trigger_seed_download("t-b", "http://o/f", host_id="seed-not-yet")
+    assert [t.host_id for t in svc.seed_triggers] == ["seed-not-yet"]
+    assert svc._seed_hosts == []
+
+    # once a real seed announces, round-robin only ever picks it
+    register(svc, "seed-peer", "task-1", host(0, seed=True))
+    assert svc.trigger_seed_download("t-c", "http://o/f")
+    assert svc.seed_triggers[-1].host_id == host(0, seed=True).host_id
