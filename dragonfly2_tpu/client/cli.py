@@ -28,6 +28,16 @@ def _parse_scheduler(value: str) -> tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+def _parse_headers(pairs: list[str]) -> dict[str, str] | None:
+    headers = {}
+    for pair in pairs:
+        key, sep, value = pair.partition(":")
+        if not sep or not key.strip():
+            raise SystemExit(f"--header needs 'Key: Value', got {pair!r}")
+        headers[key.strip()] = value.strip()
+    return headers or None
+
+
 async def _dfget(args) -> int:
     daemon = Daemon(
         data_dir=args.data_dir,
@@ -36,14 +46,16 @@ async def _dfget(args) -> int:
     )
     await daemon.start()
     try:
+        headers = _parse_headers(args.header)
         if args.recursive:
-            return await _recursive_download(daemon, args)
+            return await _recursive_download(daemon, args, headers)
         ts = await daemon.download(
             args.url,
             tag=args.tag,
             application=args.application,
             piece_length=args.piece_length,
             back_source_allowed=not args.no_back_source,
+            headers=headers,
         )
         await daemon.export_file(ts, args.output)
         print(f"downloaded {ts.meta.content_length} bytes -> {args.output}")
@@ -64,7 +76,7 @@ def _accept(url: str, accept_regex: str, reject_regex: str) -> bool:
     return True
 
 
-async def _recursive_download(daemon, args) -> int:
+async def _recursive_download(daemon, args, headers: dict | None = None) -> int:
     """Breadth-first directory download (recursiveDownload,
     client/dfget/dfget.go:316-387): pop a directory, list its children via
     the source registry, enqueue subdirectories (bounded by --level, 0 =
@@ -92,7 +104,7 @@ async def _recursive_download(daemon, args) -> int:
             continue
         visited.add(url)
         try:
-            entries = source_mod.list_entries(url)
+            entries = source_mod.list_entries(url, headers)
         except Exception as e:  # noqa: BLE001 - keep walking other subtrees
             print(f"list {url}: {e}", file=sys.stderr)
             failures += 1
@@ -121,6 +133,7 @@ async def _recursive_download(daemon, args) -> int:
                     application=args.application,
                     piece_length=args.piece_length,
                     back_source_allowed=not args.no_back_source,
+                    headers=headers,
                 )
                 child_out.parent.mkdir(parents=True, exist_ok=True)
                 await daemon.export_file(ts, str(child_out))
@@ -230,6 +243,12 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument("--application", default="")
     get.add_argument("--piece-length", type=int, default=4 << 20)
     get.add_argument("--no-back-source", action="store_true")
+    get.add_argument(
+        "-H", "--header", action="append", default=[], metavar="'Key: Value'",
+        help="request header forwarded to the back-source client "
+        "(repeatable; dfget --header / urlMeta.Header in the reference — "
+        "auth tokens, x-df-* object-store credentials)",
+    )
     get.add_argument(
         "-r", "--recursive", action="store_true",
         help="treat URL as a directory and download it breadth-first",

@@ -44,6 +44,7 @@ class PeerTaskConductor:
         schedule_timeout: float = 10.0,
         shaper: TrafficShaper | None = None,
         back_source_allowed: bool = True,
+        headers: dict[str, str] | None = None,
     ):
         self.conn = conn
         self.storage = storage
@@ -56,6 +57,10 @@ class PeerTaskConductor:
         self.schedule_timeout = schedule_timeout
         self.shaper = shaper
         self.back_source_allowed = back_source_allowed
+        # request headers forwarded to the back-source client (dfget
+        # --header / urlMeta.Header in the reference): auth tokens,
+        # x-df-* object-store credentials, etc.
+        self.headers = dict(headers) if headers else None
         self.piece_manager = PieceManager()
         self.dispatcher = PieceDispatcher()
         self._parents: dict[str, msg.CandidateParent] = {}
@@ -110,7 +115,7 @@ class PeerTaskConductor:
         from dragonfly2_tpu.client import source as source_pkg
 
         try:
-            return source_pkg.content_length(self.url)
+            return source_pkg.content_length(self.url, self.headers)
         except dferrors.DFError:
             return -1
 
@@ -291,7 +296,7 @@ class PeerTaskConductor:
 
         try:
             content_length, pieces = await asyncio.to_thread(
-                self.piece_manager.download_source, ts, self.url, None, on_piece
+                self.piece_manager.download_source, ts, self.url, self.headers, on_piece
             )
         except dferrors.DFError as e:
             self._error = e

@@ -154,6 +154,7 @@ class Daemon:
         back_source_allowed: bool = True,
         schedule_timeout: float = 10.0,
         task_id: str | None = None,
+        headers: dict[str, str] | None = None,
     ) -> TaskStorage:
         """StartFileTask: dedup on task id — concurrent requests for the
         same task await one conductor. `task_id` overrides derivation when
@@ -171,7 +172,7 @@ class Daemon:
             running = asyncio.create_task(
                 self._run_conductor(
                     task_id, url, piece_length, workers, back_source_allowed,
-                    schedule_timeout,
+                    schedule_timeout, headers,
                 )
             )
             self._running[task_id] = running
@@ -181,6 +182,7 @@ class Daemon:
     async def _run_conductor(
         self, task_id: str, url: str, piece_length: int, workers: int,
         back_source_allowed: bool, schedule_timeout: float,
+        headers: dict[str, str] | None = None,
     ) -> TaskStorage:
         conn = await self.pool.for_task(task_id)
         await self._ensure_announced(conn)
@@ -196,6 +198,7 @@ class Daemon:
             shaper=self.shaper,
             back_source_allowed=back_source_allowed,
             schedule_timeout=schedule_timeout,
+            headers=headers,
         )
         return await conductor.run()
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import html.parser
 import pathlib
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -48,6 +49,7 @@ class SourceClient(Protocol):
 
 _REGISTRY: dict[str, SourceClient] = {}
 _defaults_registered = False
+_register_lock = threading.Lock()
 
 
 def register(scheme: str, client: SourceClient, force: bool = False) -> None:
@@ -274,11 +276,21 @@ def _register_defaults() -> None:
     object-store / hdfs / oras clients in object_sources.py import THIS
     module for URLEntry, so an import-time registration would touch
     object_sources while it is still half-initialized whenever a user
-    imports object_sources first (circular-import crash)."""
+    imports object_sources first (circular-import crash). Guarded by a
+    lock with the flag set LAST: concurrent first lookups (two conductors
+    probing content-length on to_thread workers) must not observe a
+    half-populated registry."""
     global _defaults_registered
     if _defaults_registered:
         return
-    _defaults_registered = True
+    with _register_lock:
+        if _defaults_registered:
+            return
+        _do_register_defaults()
+        _defaults_registered = True
+
+
+def _do_register_defaults() -> None:
     from dragonfly2_tpu.client import object_sources
 
     for scheme in ("http", "https"):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import signal
 import sys
 
@@ -38,6 +39,27 @@ async def _run_until_signalled(ready_line: str) -> None:
     await stop.wait()
 
 
+
+@contextlib.asynccontextmanager
+async def _monitored(args, ready: str):
+    """Start the per-service observability HTTP when --metrics-port is
+    set (`/metrics`, `/debug/stacks`, `/debug/profile` — the reference's
+    per-service Prometheus server + InitMonitor pprof,
+    cmd/dependency/dependency.go:95-138), append its port to the READY
+    line, and shut it down on exit."""
+    monitor = None
+    if getattr(args, "metrics_port", None) is not None:
+        from dragonfly2_tpu.telemetry import serve_metrics
+
+        monitor = serve_metrics(port=args.metrics_port)
+        ready += f" METRICS {monitor.server_address[1]}"
+    try:
+        yield ready
+    finally:
+        if monitor is not None:
+            monitor.shutdown()
+
+
 async def _serve_scheduler(args) -> int:
     from dragonfly2_tpu.cluster.probes import ProbeStore
     from dragonfly2_tpu.cluster.scheduler import SchedulerService
@@ -53,9 +75,43 @@ async def _serve_scheduler(args) -> int:
     service = SchedulerService(config=config, storage=storage, probes=probes)
     server = SchedulerRPCServer(service, host=args.host, port=args.port)
     host, port = await server.start()
+    infer_server = None
+    if args.registry_dir:
+        # Serve the registry's trained models over the KServe-v2-shaped
+        # inference RPC (the reference points its ml evaluator at an
+        # external Triton sidecar; here the scheduler process itself is
+        # the inference endpoint). Built after start() so the default
+        # registry host id uses the *bound* port, not a pre-bind 0.
+        from dragonfly2_tpu.cluster.trainer_service import (
+            ATTENTION_MODEL_NAME, GNN_MODEL_NAME, MLP_MODEL_NAME,
+        )
+        from dragonfly2_tpu.registry import ModelRegistry, ModelServer
+        from dragonfly2_tpu.registry.registry import (
+            MODEL_TYPE_ATTENTION, MODEL_TYPE_GNN, MODEL_TYPE_MLP,
+        )
+        from dragonfly2_tpu.rpc.inference import InferenceRPCServer
+
+        registry = ModelRegistry(args.registry_dir)
+        sched_host_id = args.scheduler_host_id or f"{host}:{port}"
+        servers = {
+            name: ModelServer(registry, name, sched_host_id, mtype, template_params=None)
+            for name, mtype in (
+                (GNN_MODEL_NAME, MODEL_TYPE_GNN),
+                (MLP_MODEL_NAME, MODEL_TYPE_MLP),
+                (ATTENTION_MODEL_NAME, MODEL_TYPE_ATTENTION),
+            )
+        }
+        infer_server = InferenceRPCServer(servers, host=args.host, port=args.infer_port)
+        await infer_server.start()
+    ready = f"READY {host} {port}"
+    if infer_server is not None:
+        ready += f" INFER {infer_server.host} {infer_server.port}"
     try:
-        await _run_until_signalled(f"READY {host} {port}")
+        async with _monitored(args, ready) as line:
+            await _run_until_signalled(line)
     finally:
+        if infer_server is not None:
+            await infer_server.stop()
         await server.stop()
     return 0
 
@@ -78,7 +134,8 @@ async def _serve_trainer(args) -> int:
     server = TrainerRPCServer(service, host=args.host, port=args.port)
     host, port = await server.start()
     try:
-        await _run_until_signalled(f"READY {host} {port}")
+        async with _monitored(args, f"READY {host} {port}") as line:
+            await _run_until_signalled(line)
     finally:
         await server.stop()
     return 0
@@ -95,7 +152,8 @@ async def _serve_manager(args) -> int:
     rest = ManagerREST(service, host=args.host, port=args.port)
     host, port = rest.start()
     try:
-        await _run_until_signalled(f"READY {host} {port}")
+        async with _monitored(args, f"READY {host} {port}") as line:
+            await _run_until_signalled(line)
     finally:
         rest.stop()
     return 0
@@ -116,9 +174,8 @@ async def _serve_dfdaemon(args) -> int:
     )
     await daemon.start()
     try:
-        await _run_until_signalled(
-            f"READY {daemon.ip} {daemon.upload.port}"
-        )
+        async with _monitored(args, f"READY {daemon.ip} {daemon.upload.port}") as line:
+            await _run_until_signalled(line)
     finally:
         await daemon.stop()
     return 0
@@ -135,6 +192,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--data-dir", default=None, help="trace CSV directory")
     s.add_argument("--algorithm", default=None,
                    help="evaluator override: default|nt|ml|plugin")
+    s.add_argument("--registry-dir", default=None,
+                   help="model registry dir; serves trained models over "
+                   "the inference RPC when set")
+    s.add_argument("--infer-port", type=int, default=0)
+    s.add_argument("--scheduler-host-id", default=None,
+                   help="registry host id the trainer published under "
+                   "(default host:port)")
+    s.add_argument("--metrics-port", type=int, default=None,
+                   help="observability HTTP: /metrics /debug/stacks /debug/profile")
 
     t = sub.add_parser("trainer", help="model training service")
     t.add_argument("--host", default="127.0.0.1")
@@ -143,12 +209,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--data-dir", required=True, help="per-host dataset dir")
     t.add_argument("--registry-dir", required=True, help="model registry dir")
     t.add_argument("--epochs", type=int, default=0)
+    t.add_argument("--metrics-port", type=int, default=None)
 
     m = sub.add_parser("manager", help="REST control plane")
     m.add_argument("--host", default="127.0.0.1")
     m.add_argument("--port", type=int, default=0)
     m.add_argument("--db", default=":memory:", help="sqlite path")
     m.add_argument("--registry-dir", default=None)
+    m.add_argument("--metrics-port", type=int, default=None)
 
     d = sub.add_parser("dfdaemon", help="peer data-plane daemon")
     d.add_argument("--data-dir", required=True)
@@ -160,6 +228,7 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--location", default="")
     d.add_argument("--probe-interval", type=float, default=0.0)
     d.add_argument("--object-storage", action="store_true")
+    d.add_argument("--metrics-port", type=int, default=None)
     return p
 
 

@@ -39,13 +39,18 @@ def _strip_ns(tag: str) -> str:
 
 
 def _parse_time(text: str) -> float:
-    """ISO-8601 (list responses) or RFC-1123 (Last-Modified) → epoch."""
+    """ISO-8601 (list responses) or RFC-1123 (Last-Modified) → epoch;
+    0.0 for anything unparseable (a vendor-mangled date must not escape
+    the module's dferrors contract and crash the caller)."""
     text = text.strip()
     try:
         return datetime.datetime.fromisoformat(text.replace("Z", "+00:00")).timestamp()
     except ValueError:
-        dt = email.utils.parsedate_to_datetime(text)
-        return dt.timestamp()
+        pass
+    try:
+        return email.utils.parsedate_to_datetime(text).timestamp()
+    except (ValueError, TypeError):
+        return 0.0
 
 
 class _RemoteBackend:
@@ -233,11 +238,12 @@ class _RemoteBackend:
             return False
 
     def copy_object(self, bucket: str, src_key: str, dst_key: str) -> ObjectMetadata:
+        # servers URL-decode the copy-source header, so the source key
+        # must be percent-encoded like the request path ('a+b.txt' sent
+        # raw would be decoded to 'a b.txt' -> NoSuchKey)
+        src = f"/{bucket}/" + urllib.parse.quote(src_key)
         self._request(
-            "PUT",
-            bucket,
-            dst_key,
-            headers={self._copy_source_header(): f"/{bucket}/{src_key}"},
+            "PUT", bucket, dst_key, headers={self._copy_source_header(): src}
         )
         return self.get_object_metadata(bucket, dst_key)
 
@@ -313,9 +319,11 @@ class _HeaderStyleBackend(_RemoteBackend):
                 self.secret_key.encode(), string_to_sign.encode(), hashlib.sha1
             ).digest()
         ).decode()
-        prefix = self._scheme
+        # Aliyun names the query param OSSAccessKeyId; Huawei OBS keeps
+        # plain AccessKeyId for its temporary-URL auth.
+        ak_param = "OSSAccessKeyId" if self._scheme == "OSS" else "AccessKeyId"
         query = urllib.parse.urlencode(
-            {f"{prefix}AccessKeyId": self.access_key, "Expires": expires, "Signature": sig}
+            {ak_param: self.access_key, "Expires": expires, "Signature": sig}
         )
         return self._url(bucket, key) + "?" + query
 

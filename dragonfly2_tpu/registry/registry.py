@@ -23,6 +23,8 @@ import pathlib
 import time
 from typing import Any
 
+import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from dragonfly2_tpu.utils.idgen import model_id as make_model_id
@@ -170,10 +172,21 @@ class ModelRegistry:
         return _version_from_json(json.loads(vjson.read_text()))
 
     def load_params(self, model_id: str, version: int, template: Any = None) -> Any:
+        """Restore a version's params. Template-less restores must work
+        across device topologies — the trainer saves on TPU, a scheduler
+        may restore on CPU (or another slice), and orbax would otherwise
+        replay the *saved* shardings and fail with "Device ... was not
+        found". Restoring as numpy leaves placement to the first jit call."""
         path = self.base / model_id / str(version) / "params"
         if template is not None:
             return self._ckpt.restore(path, target=template)
-        return self._ckpt.restore(path)
+        with ocp.PyTreeCheckpointer() as ckpt:
+            meta = ckpt.metadata(path).item_metadata
+            tree = meta.tree if hasattr(meta, "tree") else meta
+            restore_args = jax.tree_util.tree_map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+            )
+            return ckpt.restore(path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
 
     def model_id(self, name: str, scheduler_host_id: str) -> str:
         return make_model_id(name, scheduler_host_id)

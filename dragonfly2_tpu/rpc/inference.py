@@ -1,0 +1,337 @@
+"""Over-the-wire model inference — the KServe-v2 surface, served natively.
+
+Capability parity with pkg/rpc/inference (client_v1.go:83-123 wraps
+Triton's `GRPCInferenceService` ModelInfer/ModelReady/ServerLive against
+an *external* Triton sidecar). Here the same RPC surface is served by the
+framework itself: an `InferenceRPCServer` fronts `registry.serving
+.ModelServer`s (jit-compiled apply fns hot-swapped on activation flips),
+so anything that could talk to the reference's Triton endpoint — a remote
+scheduler, a debugging CLI, an evaluation harness — can call this instead,
+and the compute runs on the TPU this process owns.
+
+Tensors travel as raw little-endian bytes + dtype + shape (KServe v2's
+`raw_input_contents` convention) over the same length-prefixed msgpack
+framing as every other cluster edge.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import threading
+import time
+
+import numpy as np
+
+from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.utils import dferrors
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ messages
+
+
+@dataclasses.dataclass
+class InferTensor:
+    """KServe-v2 tensor: name + datatype (numpy dtype string) + shape +
+    raw little-endian contents."""
+
+    name: str
+    datatype: str
+    shape: list[int]
+    contents: bytes
+
+    @staticmethod
+    def from_numpy(name: str, array: np.ndarray) -> "InferTensor":
+        array = np.ascontiguousarray(array)
+        return InferTensor(
+            name=name,
+            datatype=array.dtype.str.lstrip("<>|="),
+            shape=list(array.shape),
+            contents=array.astype(array.dtype.newbyteorder("<"), copy=False).tobytes(),
+        )
+
+    def to_numpy(self) -> np.ndarray:
+        dtype = np.dtype(self.datatype).newbyteorder("<")
+        return np.frombuffer(self.contents, dtype=dtype).reshape(self.shape)
+
+
+@dataclasses.dataclass
+class ServerLiveRequest:
+    pass
+
+
+@dataclasses.dataclass
+class ServerLiveResponse:
+    live: bool
+
+
+@dataclasses.dataclass
+class ModelReadyRequest:
+    name: str
+    version: str = ""
+
+
+@dataclasses.dataclass
+class ModelReadyResponse:
+    ready: bool
+
+
+@dataclasses.dataclass
+class ModelMetadataRequest:
+    name: str
+    version: str = ""
+
+
+@dataclasses.dataclass
+class ModelMetadataResponse:
+    name: str
+    versions: list[str]
+    platform: str
+    inputs: list[str]
+    outputs: list[str]
+
+
+@dataclasses.dataclass
+class ModelInferRequest:
+    model_name: str
+    inputs: list[InferTensor]
+    model_version: str = ""
+    id: str = ""
+
+
+@dataclasses.dataclass
+class ModelInferResponse:
+    model_name: str
+    model_version: str
+    outputs: list[InferTensor]
+    id: str = ""
+    error: str = ""
+
+
+wire.register_messages(
+    InferTensor,
+    ServerLiveRequest,
+    ServerLiveResponse,
+    ModelReadyRequest,
+    ModelReadyResponse,
+    ModelMetadataRequest,
+    ModelMetadataResponse,
+    ModelInferRequest,
+    ModelInferResponse,
+)
+
+
+# The per-model-type IO contracts (what the reference would have encoded
+# in each model's Triton config.pbtxt, manager/types/model.go:23-37).
+_CONTRACTS = {
+    "mlp": (["features"], ["rtt"]),
+    "attention": (["child_feats", "parent_feats", "pair_feats", "mask"], ["scores"]),
+    "gnn": (["host_emb", "child_host", "cand_host", "pair_feats"], ["scores"]),
+}
+
+
+# -------------------------------------------------------------------- server
+
+
+class InferenceRPCServer:
+    """Serves ModelInfer/ModelReady/ServerLive for a set of ModelServers
+    keyed by model name (the scheduler registers its gnn/mlp/attention
+    servers; remote callers score through them)."""
+
+    def __init__(
+        self,
+        servers: dict[str, object],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        refresh_ttl_s: float = 0.5,
+    ):
+        self.servers = servers
+        self.host = host
+        self.port = port
+        self.refresh_ttl_s = refresh_ttl_s
+        self._server: asyncio.AbstractServer | None = None
+        # refresh() swaps .model and .params non-atomically and infer
+        # reads them; dispatches run on to_thread workers, so each model
+        # gets a lock serializing refresh+infer (a reader between the two
+        # writes would apply new-module params... to the old module).
+        self._model_locks = {name: threading.Lock() for name in servers}
+        self._last_refresh = {name: float("-inf") for name in servers}
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        logger.info("inference rpc listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve_conn(self, reader, writer):
+        try:
+            while True:
+                request = await wire.read_frame(reader)
+                if request is None:
+                    return
+                # jit apply fns release the GIL during device execution;
+                # off-loop keeps one slow infer from stalling other conns
+                response = await asyncio.to_thread(self._dispatch, request)
+                if response is not None:
+                    wire.write_frame(writer, response)
+                    await writer.drain()
+        except Exception:  # noqa: BLE001 - one bad conn must not kill the server
+            logger.exception("inference connection handler failed")
+        finally:
+            writer.close()
+
+    def _refresh(self, name: str, server) -> None:
+        """refresh() re-reads version manifests from disk; bound it to
+        once per refresh_ttl_s so the per-request hot path doesn't pay
+        two file reads per call (the active pointer flips rarely)."""
+        now = time.monotonic()
+        if now - self._last_refresh[name] < self.refresh_ttl_s:
+            return
+        self._last_refresh[name] = now
+        server.refresh()
+
+    def _dispatch(self, request):
+        if isinstance(request, ServerLiveRequest):
+            return ServerLiveResponse(live=True)
+        if isinstance(request, ModelReadyRequest):
+            server = self.servers.get(request.name)
+            if server is not None:
+                with self._model_locks[request.name]:
+                    self._refresh(request.name, server)
+            return ModelReadyResponse(ready=bool(server is not None and server.ready))
+        if isinstance(request, ModelMetadataRequest):
+            server = self.servers.get(request.name)
+            if server is None:
+                return ModelMetadataResponse(
+                    name=request.name, versions=[], platform="", inputs=[], outputs=[]
+                )
+            inputs, outputs = _CONTRACTS[server.model_type]
+            with self._model_locks[request.name]:
+                self._refresh(request.name, server)
+            return ModelMetadataResponse(
+                name=request.name,
+                versions=[str(server.version)] if server.version is not None else [],
+                platform=f"jax-{server.model_type}",
+                inputs=inputs,
+                outputs=outputs,
+            )
+        if isinstance(request, ModelInferRequest):
+            try:
+                return self._infer(request)
+            except Exception as e:  # noqa: BLE001 - a bad infer (shape
+                # mismatch, flax scope error, stale checkpoint) must come
+                # back as an error *response*; killing the connection would
+                # take down every other in-flight caller on it
+                return ModelInferResponse(
+                    model_name=request.model_name, model_version="",
+                    outputs=[], id=request.id, error=f"{type(e).__name__}: {e}",
+                )
+        # An unhandled-but-decodable type (version skew, wrong port): fail
+        # the connection loudly — returning None would write no response
+        # frame and leave the peer awaiting one forever.
+        raise dferrors.InvalidArgument(
+            f"inference server cannot handle {type(request).__name__}"
+        )
+
+    def _infer(self, request: ModelInferRequest) -> ModelInferResponse:
+        server = self.servers.get(request.model_name)
+        if server is None:
+            raise dferrors.NotFound(f"no model {request.model_name!r}")
+        lock = self._model_locks[request.model_name]
+        with lock:
+            self._refresh(request.model_name, server)
+            return self._infer_locked(request, server)
+
+    def _infer_locked(self, request: ModelInferRequest, server) -> ModelInferResponse:
+        if not server.ready:
+            raise dferrors.FailedPrecondition(
+                f"model {request.model_name!r} has no active version"
+            )
+        tensors = {t.name: t.to_numpy() for t in request.inputs}
+        want, out_names = _CONTRACTS[server.model_type]
+        missing = [n for n in want if n not in tensors]
+        if missing:
+            raise dferrors.InvalidArgument(
+                f"model {request.model_name!r} needs inputs {want}, missing {missing}"
+            )
+        if server.model_type == "mlp":
+            out = server.infer_mlp(tensors["features"])
+        elif server.model_type == "attention":
+            out = server.score_set(
+                tensors["child_feats"], tensors["parent_feats"],
+                tensors["pair_feats"], tensors["mask"],
+            )
+        else:  # gnn candidate scoring against caller-supplied embeddings
+            out = server.score_candidates(
+                tensors["host_emb"], tensors["child_host"],
+                tensors["cand_host"], tensors["pair_feats"],
+            )
+        return ModelInferResponse(
+            model_name=request.model_name,
+            model_version=str(server.version),
+            outputs=[InferTensor.from_numpy(out_names[0], np.asarray(out))],
+            id=request.id,
+        )
+
+
+# -------------------------------------------------------------------- client
+
+
+class InferenceClient:
+    """Typed client mirroring pkg/rpc/inference/client/client_v1.go's
+    surface (ModelInfer / ModelReady / ServerLive) over one connection."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader = None
+        self._writer = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self) -> "InferenceClient":
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+
+    async def _call(self, request):
+        async with self._lock:  # one in-flight request per connection
+            wire.write_frame(self._writer, request)
+            await self._writer.drain()
+            response = await wire.read_frame(self._reader)
+        if response is None:
+            raise dferrors.Unavailable("inference server closed the connection")
+        return response
+
+    async def server_live(self) -> bool:
+        return (await self._call(ServerLiveRequest())).live
+
+    async def model_ready(self, name: str) -> bool:
+        return (await self._call(ModelReadyRequest(name=name))).ready
+
+    async def model_metadata(self, name: str) -> ModelMetadataResponse:
+        return await self._call(ModelMetadataRequest(name=name))
+
+    async def model_infer(
+        self, name: str, inputs: dict[str, np.ndarray], request_id: str = ""
+    ) -> dict[str, np.ndarray]:
+        request = ModelInferRequest(
+            model_name=name,
+            inputs=[InferTensor.from_numpy(k, v) for k, v in inputs.items()],
+            id=request_id,
+        )
+        response = await self._call(request)
+        if response.error:
+            raise dferrors.Unavailable(f"ModelInfer {name}: {response.error}")
+        return {t.name: t.to_numpy() for t in response.outputs}

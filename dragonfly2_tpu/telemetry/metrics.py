@@ -241,19 +241,84 @@ def default_registry() -> Registry:
     return _DEFAULT
 
 
+def _sample_stacks(seconds: float, interval_s: float = 0.01) -> str:
+    """Poor-man's py-spy: aggregate `sys._current_frames()` samples into
+    per-frame inclusive counts across all threads."""
+    import collections
+    import sys
+    import time as _time
+
+    counts: collections.Counter[str] = collections.Counter()
+    me = threading.get_ident()
+    samples = 0
+    deadline = _time.monotonic() + seconds
+    while _time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            while frame is not None:
+                code = frame.f_code
+                counts[f"{code.co_filename}:{frame.f_lineno} {code.co_qualname}"] += 1
+                frame = frame.f_back
+        samples += 1
+        _time.sleep(interval_s)
+    lines = [f"# {samples} samples over {seconds:.1f}s (10ms interval), inclusive counts"]
+    for frame_id, n in counts.most_common(80):
+        lines.append(f"{n:8d} {frame_id}")
+    return "\n".join(lines) + "\n"
+
+
 def serve_metrics(registry: Registry | None = None, port: int = 0) -> http.server.ThreadingHTTPServer:
-    """Serve `/metrics` on a background thread; returns the server (use
-    .server_address for the bound port, .shutdown() to stop)."""
+    """Serve the per-service observability HTTP endpoint on a background
+    thread (the reference starts a Prometheus `/metrics` server per
+    service plus pprof/statsview via InitMonitor,
+    cmd/dependency/dependency.go:95-138):
+
+    - `/metrics` — Prometheus text exposition
+    - `/debug/stacks` — current stack of every thread (pprof goroutine
+      profile equivalent; faulthandler)
+    - `/debug/profile?seconds=N` — sampling profiler: sample every
+      thread's stack every 10 ms for N seconds (default 2, max 30) and
+      return frames ranked by inclusive sample count (cProfile only sees
+      the calling thread; sampling `sys._current_frames()` sees the whole
+      process, like the pprof CPU profile does)
+
+    Returns the server (.server_address for the bound port, .shutdown()
+    to stop)."""
     reg = registry or _DEFAULT
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path.rstrip("/") not in ("", "/metrics"):
-                self.send_error(404)
-                return
-            body = reg.expose().encode()
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/")
+            if path in ("", "/metrics"):
+                return self._send(reg.expose().encode(), "text/plain; version=0.0.4")
+            if path == "/debug/stacks":
+                import sys
+                import traceback
+
+                names = {t.ident: t.name for t in threading.enumerate()}
+                parts = []
+                for tid, frame in sys._current_frames().items():
+                    parts.append(f"Thread {names.get(tid, '?')} (id {tid}):")
+                    parts.append("".join(traceback.format_stack(frame)))
+                return self._send("\n".join(parts).encode())
+            if path == "/debug/profile":
+                import urllib.parse as _up
+
+                params = dict(_up.parse_qsl(query))
+                try:
+                    seconds = float(params.get("seconds", 2) or 2)
+                except ValueError:
+                    self.send_error(400, "seconds must be a number")
+                    return
+                seconds = min(max(seconds, 0.1), 30.0)
+                return self._send(_sample_stacks(seconds).encode())
+            self.send_error(404)
+
+        def _send(self, body: bytes, ctype: str = "text/plain"):
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -1,0 +1,129 @@
+"""Over-the-wire ModelInfer (rpc/inference.py) against live ModelServers.
+
+The reference's pkg/rpc/inference client can only talk to an external
+Triton sidecar; here the same KServe-v2-shaped surface (ServerLive /
+ModelReady / ModelMetadata / ModelInfer) is served natively and must
+return bit-identical scores to in-process serving."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models.attention import AttentionRanker
+from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
+from dragonfly2_tpu.registry import ModelEvaluation, ModelRegistry, ModelServer
+from dragonfly2_tpu.registry.registry import MODEL_TYPE_ATTENTION, MODEL_TYPE_MLP
+from dragonfly2_tpu.rpc.inference import InferenceClient, InferenceRPCServer
+from dragonfly2_tpu.utils import dferrors
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    reg = ModelRegistry(tmp_path)
+
+    mlp = ProbeRTTRegressor(hidden_dim=8)
+    x = jnp.ones((2, 8))
+    mlp_params = mlp.init(jax.random.key(0), x)
+    mlp_server = ModelServer(
+        reg, "rtt", "sched-h", MODEL_TYPE_MLP, template_params=mlp_params, model=mlp
+    )
+
+    n, p, f = 3, 5, 12
+    rng = np.random.default_rng(1)
+    child = rng.normal(size=(n, f)).astype(np.float32)
+    parents = rng.normal(size=(n, p, f)).astype(np.float32)
+    pair = rng.normal(size=(n, p, 2)).astype(np.float32)
+    mask = np.ones((n, p), bool)
+    att = AttentionRanker(hidden_dim=32)
+    att_params = att.init(jax.random.key(1), child, parents, pair, mask)
+    att_server = ModelServer(
+        reg, "set-ranker", "sched-h", MODEL_TYPE_ATTENTION,
+        template_params=att_params, model=att,
+    )
+
+    servers = {"rtt": mlp_server, "set-ranker": att_server}
+    return reg, servers, {
+        "mlp": (mlp_params, np.asarray(x, np.float32)),
+        "att": (att_params, (child, parents, pair, mask)),
+    }
+
+
+def test_infer_rpc_end_to_end(rig):
+    reg, servers, data = rig
+
+    async def run():
+        # ttl=0: the test flips activation and expects the very next
+        # request to observe it
+        server = InferenceRPCServer(servers, refresh_ttl_s=0.0)
+        host, port = await server.start()
+        client = await InferenceClient(host, port).connect()
+        try:
+            assert await client.server_live()
+            # nothing active yet
+            assert not await client.model_ready("rtt")
+            with pytest.raises(dferrors.Unavailable, match="no active version"):
+                await client.model_infer("rtt", {"features": data["mlp"][1]})
+
+            # publish + activate both models
+            mlp_params, x = data["mlp"]
+            mv = reg.create_model_version(
+                "rtt", MODEL_TYPE_MLP, "sched-h", mlp_params, ModelEvaluation()
+            )
+            reg.activate(mv.model_id, mv.version)
+            att_params, (child, parents, pair, mask) = data["att"]
+            av = reg.create_model_version(
+                "set-ranker", MODEL_TYPE_ATTENTION, "sched-h", att_params,
+                ModelEvaluation(),
+            )
+            reg.activate(av.model_id, av.version)
+
+            assert await client.model_ready("rtt")
+            meta = await client.model_metadata("rtt")
+            assert meta.platform == "jax-mlp" and meta.versions == ["1"]
+            assert meta.inputs == ["features"] and meta.outputs == ["rtt"]
+
+            # scores over the wire == scores in-process
+            out = await client.model_infer("rtt", {"features": x})
+            direct = np.asarray(servers["rtt"].infer_mlp(x))
+            np.testing.assert_array_equal(out["rtt"], direct)
+
+            out = await client.model_infer(
+                "set-ranker",
+                {"child_feats": child, "parent_feats": parents,
+                 "pair_feats": pair, "mask": mask},
+            )
+            direct = np.asarray(
+                servers["set-ranker"].score_set(child, parents, pair, mask)
+            )
+            np.testing.assert_array_equal(out["scores"], direct)
+            assert out["scores"].shape == (3, 5)
+
+            # error surfaces, connection stays usable afterwards
+            with pytest.raises(dferrors.Unavailable, match="missing"):
+                await client.model_infer("set-ranker", {"child_feats": child})
+            with pytest.raises(dferrors.Unavailable, match="no model"):
+                await client.model_infer("nope", {"features": x})
+            assert await client.server_live()
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_infer_tensor_roundtrip():
+    from dragonfly2_tpu.rpc.inference import InferTensor
+    from dragonfly2_tpu.rpc import wire
+
+    for arr in (
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array([[True, False], [False, True]]),
+        np.arange(6, dtype=np.int32).reshape(2, 3),
+    ):
+        t = InferTensor.from_numpy("t", arr)
+        decoded = wire.decode(wire.encode(t)[4:])
+        np.testing.assert_array_equal(decoded.to_numpy(), arr)
+        assert decoded.to_numpy().dtype == arr.dtype

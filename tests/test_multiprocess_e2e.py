@@ -37,7 +37,9 @@ def _spawn(args: list[str], tmp_path) -> tuple[subprocess.Popen, str, int]:
     if not line.startswith("READY "):
         proc.kill()
         raise RuntimeError(f"service failed to start: {line!r}")
-    _, host, port = line.split()
+    parts = line.split()  # "READY h p [INFER h p]"
+    host, port = parts[1], int(parts[2])
+    proc.ready_line = line
     return proc, host, int(port)
 
 
@@ -207,3 +209,77 @@ def test_manager_and_dfdaemon_launchers(tmp_path):
         _stop(daemon)
         _stop(sched)
         _stop(manager)
+
+
+def test_scheduler_serves_inference_rpc(tmp_path):
+    """`cmd scheduler --registry-dir` exposes trained models over the
+    KServe-v2-shaped inference RPC: publish+activate an MLP into the
+    registry, boot the scheduler process, score through the wire."""
+    import jax
+    import numpy as np
+
+    from dragonfly2_tpu.cluster.trainer_service import MLP_MODEL_NAME
+    from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
+    from dragonfly2_tpu.registry import ModelEvaluation, ModelRegistry
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_MLP
+    from dragonfly2_tpu.rpc.inference import InferenceClient
+
+    registry_dir = tmp_path / "registry"
+    reg = ModelRegistry(registry_dir)
+    model = ProbeRTTRegressor(hidden_dim=8)
+    x = np.ones((4, 8), np.float32)
+    params = model.init(jax.random.key(0), x)
+    mv = reg.create_model_version(
+        MLP_MODEL_NAME, MODEL_TYPE_MLP, "sched-1", params, ModelEvaluation(),
+        metadata={"hidden_dim": 8},  # the trainer always records this —
+        # refresh() rebuilds the served module from it
+    )
+    reg.activate(mv.model_id, mv.version)
+
+    proc, _, _ = _spawn(
+        ["scheduler", "--registry-dir", str(registry_dir),
+         "--scheduler-host-id", "sched-1"],
+        tmp_path,
+    )
+    try:
+        parts = proc.ready_line.split()
+        assert "INFER" in parts, proc.ready_line
+        ih, ip = parts[parts.index("INFER") + 1], int(parts[parts.index("INFER") + 2])
+
+        async def run():
+            client = await InferenceClient(ih, ip).connect()
+            try:
+                assert await client.server_live()
+                assert await client.model_ready(MLP_MODEL_NAME)
+                out = await client.model_infer(MLP_MODEL_NAME, {"features": x})
+                expected = np.asarray(model.apply(params, x))
+                np.testing.assert_allclose(out["rtt"], expected, rtol=1e-5)
+            finally:
+                await client.close()
+
+        asyncio.run(run())
+    finally:
+        _stop(proc)
+
+
+def test_metrics_and_debug_endpoints(tmp_path):
+    """--metrics-port serves /metrics, /debug/stacks, /debug/profile
+    (InitMonitor + per-service Prometheus server parity)."""
+    import urllib.request
+
+    proc, _, _ = _spawn(["scheduler", "--metrics-port", "0"], tmp_path)
+    try:
+        parts = proc.ready_line.split()
+        mport = int(parts[parts.index("METRICS") + 1])
+        base = f"http://127.0.0.1:{mport}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        with urllib.request.urlopen(f"{base}/debug/stacks", timeout=5) as resp:
+            stacks = resp.read().decode()
+            assert "Thread" in stacks or "File" in stacks
+        with urllib.request.urlopen(f"{base}/debug/profile?seconds=0.3", timeout=10) as resp:
+            prof = resp.read().decode()
+            assert "samples over" in prof
+    finally:
+        _stop(proc)
