@@ -105,11 +105,12 @@ class ModelRegistry:
             created_at=time.time(),
             metadata=metadata or {},
         )
-        (vdir / "version.json").write_text(json.dumps(dataclasses.asdict(mv), indent=2))
+        _atomic_write_json(vdir / "version.json", dataclasses.asdict(mv))
         model_manifest = self.base / mid / "model.json"
         if not model_manifest.exists():
-            model_manifest.write_text(
-                json.dumps({"model_id": mid, "name": name, "type": model_type, "active_version": None})
+            _atomic_write_json(
+                model_manifest,
+                {"model_id": mid, "name": name, "type": model_type, "active_version": None},
             )
         return mv
 
@@ -123,7 +124,7 @@ class ModelRegistry:
         for v in self.list_versions(model_id):
             self._set_state(model_id, v.version, STATE_ACTIVE if v.version == version else STATE_INACTIVE)
         manifest["active_version"] = version
-        manifest_path.write_text(json.dumps(manifest))
+        _atomic_write_json(manifest_path, manifest)
 
     def delete_version(self, model_id: str, version: int) -> None:
         vdir = self.base / model_id / str(version)
@@ -142,7 +143,7 @@ class ModelRegistry:
         path = self.base / model_id / str(version) / "version.json"
         data = json.loads(path.read_text())
         data["state"] = state
-        path.write_text(json.dumps(data, indent=2))
+        _atomic_write_json(path, data)
 
     # --------------------------------------------------------------- read
 
@@ -190,6 +191,16 @@ class ModelRegistry:
 
     def model_id(self, name: str, scheduler_host_id: str) -> str:
         return make_model_id(name, scheduler_host_id)
+
+
+
+def _atomic_write_json(path: pathlib.Path, data: dict) -> None:
+    """write_text truncates in place — a concurrent reader (a scheduler's
+    ModelServer.refresh mid-activation) could see a half-written manifest.
+    Write to a sibling temp file and rename (atomic on POSIX)."""
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(data, indent=2))
+    tmp.replace(path)
 
 
 def _version_from_json(data: dict) -> ModelVersion:

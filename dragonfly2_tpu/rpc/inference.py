@@ -192,12 +192,20 @@ class InferenceRPCServer:
     def _refresh(self, name: str, server) -> None:
         """refresh() re-reads version manifests from disk; bound it to
         once per refresh_ttl_s so the per-request hot path doesn't pay
-        two file reads per call (the active pointer flips rarely)."""
+        two file reads per call (the active pointer flips rarely). The
+        timestamp is only advanced on success — a transient read failure
+        (registry being rewritten) must not suppress retries for a full
+        TTL — and a raise degrades to serving the current state rather
+        than propagating (which would close the caller's connection)."""
         now = time.monotonic()
         if now - self._last_refresh[name] < self.refresh_ttl_s:
             return
+        try:
+            server.refresh()
+        except Exception:  # noqa: BLE001
+            logger.exception("refresh of model %s failed; serving previous state", name)
+            return
         self._last_refresh[name] = now
-        server.refresh()
 
     def _dispatch(self, request):
         if isinstance(request, ServerLiveRequest):
@@ -246,13 +254,15 @@ class InferenceRPCServer:
         server = self.servers.get(request.model_name)
         if server is None:
             raise dferrors.NotFound(f"no model {request.model_name!r}")
-        lock = self._model_locks[request.model_name]
-        with lock:
+        # Snapshot (model, params, version) under the lock so a concurrent
+        # refresh can't swap the module between reads — but run the pure
+        # apply OUTSIDE it, otherwise concurrent inference for one model
+        # serializes on the device call and the to_thread offload buys
+        # nothing.
+        with self._model_locks[request.model_name]:
             self._refresh(request.model_name, server)
-            return self._infer_locked(request, server)
-
-    def _infer_locked(self, request: ModelInferRequest, server) -> ModelInferResponse:
-        if not server.ready:
+            model, params, version = server.model, server.params, server.version
+        if params is None:
             raise dferrors.FailedPrecondition(
                 f"model {request.model_name!r} has no active version"
             )
@@ -263,21 +273,23 @@ class InferenceRPCServer:
             raise dferrors.InvalidArgument(
                 f"model {request.model_name!r} needs inputs {want}, missing {missing}"
             )
+        from dragonfly2_tpu.registry import serving
+
         if server.model_type == "mlp":
-            out = server.infer_mlp(tensors["features"])
+            out = serving._mlp_apply(model, params, tensors["features"])
         elif server.model_type == "attention":
-            out = server.score_set(
-                tensors["child_feats"], tensors["parent_feats"],
+            out = serving._attention_score(
+                model, params, tensors["child_feats"], tensors["parent_feats"],
                 tensors["pair_feats"], tensors["mask"],
             )
         else:  # gnn candidate scoring against caller-supplied embeddings
-            out = server.score_candidates(
-                tensors["host_emb"], tensors["child_host"],
+            out = serving._gnn_score(
+                model, params, tensors["host_emb"], tensors["child_host"],
                 tensors["cand_host"], tensors["pair_feats"],
             )
         return ModelInferResponse(
             model_name=request.model_name,
-            model_version=str(server.version),
+            model_version=str(version),
             outputs=[InferTensor.from_numpy(out_names[0], np.asarray(out))],
             id=request.id,
         )
