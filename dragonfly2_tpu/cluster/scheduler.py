@@ -98,6 +98,7 @@ class SchedulerService:
         self.probes = probes
         self.ml_evaluator = ml_evaluator
         self.rng = np.random.default_rng(seed)
+        self._last_storage_flush = 0.0
         self.algorithm = self.config.evaluator.algorithm
         # "plugin": an externally supplied scorer replaces the linear blend
         # while every filter rule still applies (evaluator plugin.go; loader
@@ -435,6 +436,14 @@ class SchedulerService:
                 self._pending.pop(pending.peer_id, None)
             else:
                 work.append(pending)
+        if self.storage is not None:
+            # push buffered trace rows to disk on the tick cadence so
+            # external readers (e2e harness, tail -f) never lag by more
+            # than a tick interval past the writer's own 1s flush
+            now = time.monotonic()
+            if now - self._last_storage_flush > 1.0:
+                self._last_storage_flush = now
+                self.storage.flush()
         if not work:
             return responses
 

@@ -110,6 +110,9 @@ async def _serve_scheduler(args) -> int:
         async with _monitored(args, ready) as line:
             await _run_until_signalled(line)
     finally:
+        if storage is not None:
+            storage.close()  # flush buffered trace rows FIRST — an RPC
+            # stop() that raises must not take the buffered rows with it
         if infer_server is not None:
             await infer_server.stop()
         await server.stop()

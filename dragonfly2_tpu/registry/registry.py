@@ -17,9 +17,12 @@ filesystem dir standing in for the object-store bucket.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
+import os
 import pathlib
+import tempfile
 import time
 from typing import Any
 
@@ -197,10 +200,25 @@ class ModelRegistry:
 def _atomic_write_json(path: pathlib.Path, data: dict) -> None:
     """write_text truncates in place — a concurrent reader (a scheduler's
     ModelServer.refresh mid-activation) could see a half-written manifest.
-    Write to a sibling temp file and rename (atomic on POSIX)."""
-    tmp = path.with_name(path.name + ".tmp")
-    tmp.write_text(json.dumps(data, indent=2))
-    tmp.replace(path)
+    Write to a UNIQUE temp file (two concurrent writers must not rename
+    each other's tmp away), fsync, and rename (atomic on POSIX)."""
+    fd, tmp_name = tempfile.mkstemp(
+        prefix=path.name + ".", suffix=".tmp", dir=path.parent
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            # mkstemp creates 0600; manifests must stay readable by other
+            # users (trainer/operator processes) like write_text's
+            # umask-default files were
+            os.fchmod(f.fileno(), 0o644)
+            f.write(json.dumps(data, indent=2))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_name, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp_name)
+        raise
 
 
 def _version_from_json(data: dict) -> ModelVersion:

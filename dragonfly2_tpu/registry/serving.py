@@ -89,6 +89,7 @@ class ModelServer:
             for key, value in arch.items()
             if hasattr(self.model, key) and getattr(self.model, key) != value
         }
+        new_model = self.model
         if changed:
             cls = type(self.model)
             # start from the currently-served knobs and overlay the new
@@ -100,10 +101,16 @@ class ModelServer:
                 if hasattr(self.model, key)
             }
             kwargs.update({k: v for k, v in arch.items() if k in kwargs})
-            self.model = cls(**kwargs)
-        self.params = self.registry.load_params(
+            new_model = cls(**kwargs)
+        # Load BEFORE assigning anything: a failed params read must leave
+        # the served (model, params, version) triple untouched — swapping
+        # the module first and then raising would leave a mismatched pair
+        # behind for callers that catch the error and keep serving.
+        new_params = self.registry.load_params(
             self.model_id, active.version, template=self._template
         )
+        self.model = new_model
+        self.params = new_params
         self.version = active.version
         return True
 
@@ -115,27 +122,29 @@ class ModelServer:
 
     def infer_mlp(self, x: jax.Array) -> jax.Array:
         """Predicted log1p(rtt_ms) for (N, F) pair features."""
-        return _mlp_apply(self.model, self.params, x)
+        return mlp_apply(self.model, self.params, x)
 
     def embed_hosts(self, graph_arrays: dict) -> jax.Array:
         """(H, D) host embeddings for the current params."""
         return _gnn_embed(self.model, self.params, graph_arrays)
 
-    def score_candidates(self, host_emb, child_host, cand_host, pair_feats) -> jax.Array:
-        """(B, K) candidate scores from cached host-slot embeddings."""
-        return _gnn_score(self.model, self.params, host_emb, child_host, cand_host, pair_feats)
+    def snapshot(self) -> tuple[Any, Any, int | None]:
+        """(model, params, version) read together — callers that must not
+        see a concurrent refresh() swap half-applied (the inference RPC)
+        take this under their lock and run the pure apply fns on it."""
+        return self.model, self.params, self.version
 
     def score_set(self, child_feats, parent_feats, pair_feats, mask) -> jax.Array:
         """(B, P) candidate scores from the set-transformer ranker
         (models/attention.py) — candidates attend to each other, no
         embedding cache needed."""
-        return _attention_score(
+        return attention_score(
             self.model, self.params, child_feats, parent_feats, pair_feats, mask
         )
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
-def _mlp_apply(model, params, x):
+def mlp_apply(model, params, x):
     return model.apply(params, x)
 
 
@@ -152,12 +161,12 @@ def _gnn_embed(model, params, graph_arrays):
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
-def _attention_score(model, params, child_feats, parent_feats, pair_feats, mask):
+def attention_score(model, params, child_feats, parent_feats, pair_feats, mask):
     return model.apply(params, child_feats, parent_feats, pair_feats, mask)
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
-def _gnn_score(model, params, host_emb, child_host, cand_host, pair_feats):
+def gnn_score(model, params, host_emb, child_host, cand_host, pair_feats):
     child_emb = host_emb[child_host]
     parent_emb = host_emb[cand_host]
     return model.apply(params, child_emb, parent_emb, pair_feats, method="score")
@@ -238,9 +247,7 @@ def _ml_schedule(
         ],
         axis=-1,
     )
-    child_emb = host_emb[child_host]
-    parent_emb = host_emb[cand_host]
-    scores = model.apply(params, child_emb, parent_emb, pair_feats, method="score")
+    scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
     return ev.select_with_scores(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
     )

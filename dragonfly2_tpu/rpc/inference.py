@@ -29,6 +29,9 @@ from dragonfly2_tpu.utils import dferrors
 
 logger = logging.getLogger(__name__)
 
+# retry cadence after a failed registry refresh (seconds)
+FAILURE_BACKOFF_S = 0.1
+
 
 # ------------------------------------------------------------------ messages
 
@@ -202,8 +205,16 @@ class InferenceRPCServer:
             return
         try:
             server.refresh()
-        except Exception:  # noqa: BLE001
-            logger.exception("refresh of model %s failed; serving previous state", name)
+        except Exception as e:  # noqa: BLE001
+            # Short backoff instead of the full TTL (a transient mid-write
+            # read should retry soon) but NOT per-request (a persistently
+            # dead registry must not cost every request a failed disk read
+            # and a log line).
+            self._last_refresh[name] = now - self.refresh_ttl_s + FAILURE_BACKOFF_S
+            logger.warning(
+                "refresh of model %s failed (%s: %s); serving previous state",
+                name, type(e).__name__, e,
+            )
             return
         self._last_refresh[name] = now
 
@@ -261,7 +272,7 @@ class InferenceRPCServer:
         # nothing.
         with self._model_locks[request.model_name]:
             self._refresh(request.model_name, server)
-            model, params, version = server.model, server.params, server.version
+            model, params, version = server.snapshot()
         if params is None:
             raise dferrors.FailedPrecondition(
                 f"model {request.model_name!r} has no active version"
@@ -276,14 +287,14 @@ class InferenceRPCServer:
         from dragonfly2_tpu.registry import serving
 
         if server.model_type == "mlp":
-            out = serving._mlp_apply(model, params, tensors["features"])
+            out = serving.mlp_apply(model, params, tensors["features"])
         elif server.model_type == "attention":
-            out = serving._attention_score(
+            out = serving.attention_score(
                 model, params, tensors["child_feats"], tensors["parent_feats"],
                 tensors["pair_feats"], tensors["mask"],
             )
         else:  # gnn candidate scoring against caller-supplied embeddings
-            out = serving._gnn_score(
+            out = serving.gnn_score(
                 model, params, tensors["host_emb"], tensors["child_host"],
                 tensors["cand_host"], tensors["pair_feats"],
             )

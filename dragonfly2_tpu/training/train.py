@@ -213,6 +213,22 @@ def _steady_state_throughput(epoch_samples: list, epoch_secs: list) -> tuple:
     return sum(epoch_samples), max(sum(epoch_secs), 1e-9)
 
 
+
+def _train_eval_split(perm, eval_fraction: float):
+    """Shuffled (eval_idx, train_idx). Degenerate datasets (a couple of
+    rows from a smoke run) must still train: the eval split is capped so
+    at least one training sample remains — an empty train_idx would make
+    the batch step zero and crash; n == 1 trains and evals on the row."""
+    n = len(perm)
+    if n == 0:
+        raise ValueError("cannot train on an empty dataset")
+    n_eval = min(max(1, int(n * eval_fraction)), max(n - 1, 1))
+    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    if len(train_idx) == 0:
+        train_idx = eval_idx
+    return eval_idx, train_idx
+
+
 def train_mlp(
     x: np.ndarray,
     y: np.ndarray,
@@ -228,8 +244,7 @@ def train_mlp(
     rng = np.random.default_rng(seed)
     n = x.shape[0]
     perm = rng.permutation(n)
-    n_eval = max(1, int(n * eval_fraction))
-    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    eval_idx, train_idx = _train_eval_split(perm, eval_fraction)
 
     model = ProbeRTTRegressor(hidden_dim=config.hidden_dim)
     params = model.init(jax.random.key(seed), jnp.zeros((1, x.shape[1]), jnp.float32))
@@ -302,8 +317,7 @@ def train_gnn(
     rng = np.random.default_rng(seed)
     n = ds.child.shape[0]
     perm = rng.permutation(n)
-    n_eval = max(1, int(n * eval_fraction))
-    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    eval_idx, train_idx = _train_eval_split(perm, eval_fraction)
 
     # Single-chip with a graph that fits: dense row-normalized adjacency
     # puts neighbor aggregation on the MXU (one matmul per layer) instead
@@ -394,8 +408,7 @@ def train_attention(
     rng = np.random.default_rng(seed)
     n = ds.child.shape[0]
     perm = rng.permutation(n)
-    n_eval = max(1, int(n * eval_fraction))
-    eval_idx, train_idx = perm[:n_eval], perm[n_eval:]
+    eval_idx, train_idx = _train_eval_split(perm, eval_fraction)
 
     model = AttentionRanker(hidden_dim=config.hidden_dim)
     # ring and ulysses are drop-in swaps (same global-shape contract); ring
