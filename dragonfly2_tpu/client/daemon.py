@@ -42,6 +42,11 @@ class Daemon:
         gc_interval: float = 60.0,
         probe_interval: float = 0.0,  # 0 disables the probe loop
         object_storage: bool = False,
+        proxy: bool = False,
+        proxy_rules: list | None = None,
+        registry_mirror: str = "",
+        sni_proxy: bool = False,
+        sni_allowed_hosts: list[str] | None = None,
     ):
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
@@ -68,6 +73,21 @@ class Daemon:
 
             backend = FilesystemBackend(pathlib.Path(data_dir) / "objects")
             self.object_storage = ObjectStorageService(backend, storage=self.storage, host=ip)
+        self.proxy = None
+        self.sni_proxy = None
+        if proxy:
+            # HTTP(S) forward proxy with per-rule P2P hijack — one of the
+            # reference daemon's listeners (daemon.go:525-604)
+            from dragonfly2_tpu.client.proxy import ProxyServer
+            from dragonfly2_tpu.client.transport import P2PTransport
+
+            transport = P2PTransport(self, rules=list(proxy_rules or []))
+            self.proxy = ProxyServer(transport, host=ip, registry_mirror=registry_mirror)
+        if sni_proxy:
+            from dragonfly2_tpu.client.proxy import SNIProxy
+
+            # deny-by-default: with no allowlist the listener refuses all
+            self.sni_proxy = SNIProxy(host=ip, allowed_hosts=sni_allowed_hosts)
         self._probe_task: asyncio.Task | None = None
         self._seed_tasks: list[asyncio.Task] = []
         self._seed_downloads: set[asyncio.Task] = set()
@@ -103,6 +123,10 @@ class Daemon:
         self.gc.start()
         if self.object_storage is not None:
             self.object_storage.start()
+        if self.proxy is not None:
+            await self.proxy.start()
+        if self.sni_proxy is not None:
+            await self.sni_proxy.start()
         if self.probe_interval > 0:
             self._probe_task = asyncio.create_task(self._probe_loop())
         if self.is_seed:
@@ -125,6 +149,10 @@ class Daemon:
                 pass
         self._probe_task = None
         self._seed_tasks.clear()
+        if self.proxy is not None:
+            await self.proxy.stop()
+        if self.sni_proxy is not None:
+            await self.sni_proxy.stop()
         self._seed_downloads.clear()
         for task in list(self._running.values()):
             task.cancel()

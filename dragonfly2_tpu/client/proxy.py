@@ -33,6 +33,27 @@ def _forwardable(headers: dict) -> dict:
     return {k: v for k, v in headers.items() if k.lower() not in _HOP_BY_HOP}
 
 
+
+async def _pump(src: asyncio.StreamReader, dst: asyncio.StreamWriter) -> None:
+    """One direction of a byte shovel. EOF is PROPAGATED with write_eof()
+    rather than closing dst — a client that half-closes after sending its
+    request must still receive the rest of the response; the caller closes
+    both writers after BOTH directions finish."""
+    try:
+        while True:
+            data = await src.read(64 * 1024)
+            if not data:
+                break
+            dst.write(data)
+            await dst.drain()
+    except (ConnectionError, RuntimeError, asyncio.TimeoutError):
+        pass
+    try:
+        dst.write_eof()
+    except (OSError, RuntimeError):
+        pass
+
+
 class ProxyServer:
     def __init__(
         self,
@@ -50,17 +71,33 @@ class ProxyServer:
         self.whitelist_hosts = whitelist_hosts
         self.basic_auth = basic_auth
         self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.Task] = set()
         self.stats = {"p2p": 0, "direct": 0, "tunnel": 0, "denied": 0}
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self._server = await asyncio.start_server(self._track, self.host, self.port)
         self.host, self.port = self._server.sockets[0].getsockname()[:2]
         return self.host, self.port
 
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # 3.12's wait_closed() waits on in-flight handlers; a client
+            # holding a CONNECT tunnel open would hang shutdown — cancel.
+            for task in list(self._conns):
+                task.cancel()
+            await asyncio.gather(*self._conns, return_exceptions=True)
             await self._server.wait_closed()
+
+    async def _track(self, reader, writer):
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await self._handle(reader, writer)
+        except asyncio.CancelledError:
+            writer.close()
+        finally:
+            self._conns.discard(task)
 
     # ------------------------------------------------------------- handler
 
@@ -138,24 +175,12 @@ class ProxyServer:
         writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
         await writer.drain()
         self.stats["tunnel"] += 1
-
-        async def pump(src, dst):
-            try:
-                while True:
-                    data = await src.read(64 * 1024)
-                    if not data:
-                        break
-                    dst.write(data)
-                    await dst.drain()
-            except (ConnectionError, RuntimeError):
-                pass
-            finally:
-                try:
-                    dst.close()
-                except RuntimeError:
-                    pass
-
-        await asyncio.gather(pump(reader, upstream_w), pump(upstream_r, writer))
+        try:
+            await asyncio.gather(
+                _pump(reader, upstream_w), _pump(upstream_r, writer)
+            )
+        finally:
+            upstream_w.close()
 
     # ------------------------------------------------------------- helpers
 
@@ -185,3 +210,161 @@ class ProxyServer:
         )
         writer.write(head.encode() + body)
         await writer.drain()
+
+
+# ------------------------------------------------------------------ SNI
+
+
+def parse_client_hello_sni(data: bytes) -> str | None:
+    """Extract the server_name from a TLS ClientHello, or None.
+
+    The reference's SNI proxy (client/daemon/proxy/proxy_sni.go:140)
+    routes raw TLS connections by the SNI extension without terminating
+    TLS; this is the same parse: TLS record header -> handshake header ->
+    skip version/random/session/ciphers/compression -> walk extensions
+    for type 0 (server_name)."""
+    try:
+        if len(data) < 5 or data[0] != 0x16:  # handshake record
+            return None
+        record_len = int.from_bytes(data[3:5], "big")
+        body = data[5 : 5 + record_len]
+        if len(body) < 4 or body[0] != 0x01:  # ClientHello
+            return None
+        hs_len = int.from_bytes(body[1:4], "big")
+        hello = body[4 : 4 + hs_len]
+        pos = 2 + 32  # client_version + random
+        sid_len = hello[pos]
+        pos += 1 + sid_len
+        cs_len = int.from_bytes(hello[pos : pos + 2], "big")
+        pos += 2 + cs_len
+        comp_len = hello[pos]
+        pos += 1 + comp_len
+        if pos + 2 > len(hello):
+            return None  # no extensions
+        ext_total = int.from_bytes(hello[pos : pos + 2], "big")
+        pos += 2
+        end = min(pos + ext_total, len(hello))
+        while pos + 4 <= end:
+            ext_type = int.from_bytes(hello[pos : pos + 2], "big")
+            ext_len = int.from_bytes(hello[pos + 2 : pos + 4], "big")
+            pos += 4
+            if ext_type == 0x0000:  # server_name
+                lst = hello[pos : pos + ext_len]
+                if len(lst) < 5 or lst[2] != 0x00:  # host_name entry
+                    return None
+                name_len = int.from_bytes(lst[3:5], "big")
+                raw = lst[5 : 5 + name_len]
+                try:
+                    return raw.decode("idna")  # strict-only codec
+                except UnicodeError:
+                    return raw.decode("ascii", "replace")
+            pos += ext_len
+        return None
+    except (IndexError, UnicodeError):
+        return None
+
+
+class SNIProxy:
+    """Raw-TLS passthrough router (proxy_sni.go): accept a TCP
+    connection, peek the ClientHello, resolve the SNI hostname to an
+    upstream, replay the peeked bytes, and shovel bytes both ways — TLS
+    is never terminated, so no cert minting is involved.
+
+    `resolver(host) -> (addr, port)` decides the upstream (the reference
+    maps SNI proxies onto registry-mirror-style host rules). Without a
+    resolver, `allowed_hosts` gates which SNI names may be dialed on 443
+    — and with NEITHER configured every connection is refused: a
+    relay-anywhere default would make the listener an unauthenticated
+    SSRF hop to any host an attacker names in the ClientHello."""
+
+    def __init__(self, resolver=None, allowed_hosts: list[str] | None = None,
+                 host: str = "127.0.0.1", port: int = 0, timeout: float = 30.0):
+        self.resolver = resolver
+        self.allowed_hosts = allowed_hosts
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.Task] = set()
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._track, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # Python 3.12's wait_closed() waits for every in-flight
+            # handler; a held-open tunnel would hang shutdown forever, so
+            # cancel the pumps first.
+            for task in list(self._conns):
+                task.cancel()
+            await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._server.wait_closed()
+
+    async def _track(self, reader, writer):
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await self._handle(reader, writer)
+        except asyncio.CancelledError:
+            writer.close()
+        finally:
+            self._conns.discard(task)
+
+    def _resolve(self, name: str) -> tuple[str, int] | None:
+        if self.resolver is not None:
+            try:
+                return self.resolver(name)
+            except Exception as e:  # noqa: BLE001 - a table-miss KeyError
+                # must be a clean refusal, not an unhandled-task traceback
+                logger.warning("sni proxy: resolver refused %r (%s)", name, e)
+                return None
+        if self.allowed_hosts is not None and any(
+            name == h or name.endswith("." + h) for h in self.allowed_hosts
+        ):
+            return name, 443
+        logger.warning("sni proxy: %r not in allowed hosts; refusing", name)
+        return None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            # Read until the full first record is in hand (ClientHello can
+            # arrive across several TCP segments).
+            buf = b""
+            while len(buf) < 5:
+                chunk = await asyncio.wait_for(reader.read(4096), self.timeout)
+                if not chunk:
+                    return
+                buf += chunk
+            need = 5 + int.from_bytes(buf[3:5], "big")
+            while len(buf) < need:
+                chunk = await asyncio.wait_for(reader.read(4096), self.timeout)
+                if not chunk:
+                    break
+                buf += chunk
+            name = parse_client_hello_sni(buf)
+            if not name:
+                logger.warning("sni proxy: no server_name in ClientHello")
+                return
+            upstream = self._resolve(name)
+            if upstream is None:
+                return
+            up_reader, up_writer = await asyncio.wait_for(
+                asyncio.open_connection(*upstream), self.timeout
+            )
+            try:
+                up_writer.write(buf)  # replay the peeked ClientHello
+                await up_writer.drain()
+                await asyncio.gather(
+                    _pump(reader, up_writer), _pump(up_reader, writer),
+                    return_exceptions=True,
+                )
+            finally:
+                up_writer.close()
+        except (ConnectionError, asyncio.TimeoutError, OSError) as e:
+            logger.warning("sni proxy connection failed: %s", e)
+        finally:
+            writer.close()

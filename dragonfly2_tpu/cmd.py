@@ -164,7 +164,16 @@ async def _serve_manager(args) -> int:
 
 async def _serve_dfdaemon(args) -> int:
     from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.client.transport import ProxyRule
 
+    rules = []
+    for spec in args.proxy_rule or []:
+        # REGEX[=REDIRECT_HOST]; prefix with 'direct:' to bypass P2P
+        direct = spec.startswith("direct:")
+        if direct:
+            spec = spec[len("direct:"):]
+        regex, _, redirect = spec.partition("=")
+        rules.append(ProxyRule(regex=regex, direct=direct, redirect=redirect))
     daemon = Daemon(
         data_dir=args.data_dir,
         scheduler_addresses=[_parse_addr(s) for s in args.scheduler],
@@ -174,10 +183,20 @@ async def _serve_dfdaemon(args) -> int:
         location=args.location,
         probe_interval=args.probe_interval,
         object_storage=args.object_storage,
+        proxy=args.proxy,
+        proxy_rules=rules,
+        registry_mirror=args.registry_mirror,
+        sni_proxy=args.sni_proxy,
+        sni_allowed_hosts=args.sni_allow or None,
     )
     await daemon.start()
+    ready = f"READY {daemon.ip} {daemon.upload.port}"
+    if daemon.proxy is not None:
+        ready += f" PROXY {daemon.proxy.port}"
+    if daemon.sni_proxy is not None:
+        ready += f" SNI {daemon.sni_proxy.port}"
     try:
-        async with _monitored(args, f"READY {daemon.ip} {daemon.upload.port}") as line:
+        async with _monitored(args, ready) as line:
             await _run_until_signalled(line)
     finally:
         await daemon.stop()
@@ -231,6 +250,18 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--location", default="")
     d.add_argument("--probe-interval", type=float, default=0.0)
     d.add_argument("--object-storage", action="store_true")
+    d.add_argument("--proxy", action="store_true",
+                   help="serve the HTTP(S) forward proxy listener")
+    d.add_argument("--registry-mirror", default="",
+                   help="reverse-proxy base URL for relative requests")
+    d.add_argument("--sni-proxy", action="store_true",
+                   help="serve the raw-TLS SNI passthrough listener "
+                   "(refuses every host unless --sni-allow is given)")
+    d.add_argument("--sni-allow", action="append", default=[],
+                   help="hostname (or suffix) the SNI proxy may dial (repeatable)")
+    d.add_argument("--proxy-rule", action="append", default=[],
+                   help="P2P hijack rule REGEX[=REDIRECT_HOST]; prefix "
+                   "'direct:' to match-but-bypass (repeatable)")
     d.add_argument("--metrics-port", type=int, default=None)
     return p
 

@@ -283,3 +283,42 @@ def test_metrics_and_debug_endpoints(tmp_path):
             assert "samples over" in prof
     finally:
         _stop(proc)
+
+
+def test_dfdaemon_proxy_listeners(tmp_path):
+    """--proxy/--sni-proxy serve the daemon's proxy listeners (the
+    reference daemon's proxy + SNI servers, daemon.go:525-604)."""
+    import urllib.request
+
+    sched, s_host, s_port = _spawn(["scheduler"], tmp_path)
+    origin = _Origin(b"layer-bytes" * 1000)
+    daemon, _, _ = _spawn(
+        ["dfdaemon", "--data-dir", str(tmp_path / "d"),
+         "--scheduler", f"{s_host}:{s_port}",
+         "--proxy", "--sni-proxy",
+         "--proxy-rule", r"127\.0\.0\.1.*\.bin",
+         "--registry-mirror", f"http://127.0.0.1:{origin.port}"],
+        tmp_path,
+    )
+    try:
+        parts = daemon.ready_line.split()
+        pport = int(parts[parts.index("PROXY") + 1])
+        assert "SNI" in parts
+        # reverse-proxy mode: a relative request is mirrored to the origin
+        req = urllib.request.Request(f"http://127.0.0.1:{pport}/v2/some/blob")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.read() == origin.payload
+        # --proxy-rule hijack: an absolute-URI GET matching the rule is
+        # served out of the P2P mesh (daemon downloads the task), marked
+        # by the via header
+        proxied = urllib.request.Request(
+            f"http://127.0.0.1:{origin.port}/layer.bin",
+        )
+        proxied.set_proxy(f"127.0.0.1:{pport}", "http")
+        with urllib.request.urlopen(proxied, timeout=30) as resp:
+            assert resp.read() == origin.payload
+            assert resp.headers.get("X-Dragonfly-Via") == "p2p"
+    finally:
+        _stop(daemon)
+        _stop(sched)
+        origin.close()

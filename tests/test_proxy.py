@@ -314,3 +314,74 @@ def test_stress_driver_smoke(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["metric"] == "proxy_qps"
     assert out["requests"] > 0 and out["errors"] == 0
+
+
+def test_sni_proxy_routes_by_client_hello():
+    """A real ssl-module ClientHello is parsed for its server_name and
+    the connection (including the peeked bytes) is replayed to the
+    resolved upstream — TLS untouched (proxy_sni.go parity)."""
+    import asyncio
+    import ssl
+    import threading
+
+    from dragonfly2_tpu.client.proxy import SNIProxy, parse_client_hello_sni
+
+    received: dict[str, bytes] = {}
+
+    async def run():
+        # two fake upstreams record whatever bytes arrive
+        async def make_backend(name):
+            got = asyncio.Event()
+
+            async def handle(reader, writer):
+                received[name] = await reader.read(1 << 16)
+                got.set()
+                writer.close()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            return server, server.sockets[0].getsockname()[1], got
+
+        b1, p1, got1 = await make_backend("registry.internal")
+        b2, p2, got2 = await make_backend("other.internal")
+        table = {"registry.internal": ("127.0.0.1", p1), "other.internal": ("127.0.0.1", p2)}
+        proxy = SNIProxy(resolver=lambda n: table[n])
+        host, port = await proxy.start()
+
+        def tls_connect(sni):
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            import socket
+
+            try:
+                with socket.create_connection((host, port), timeout=5) as sock:
+                    with ctx.wrap_socket(sock, server_hostname=sni):
+                        pass  # handshake cannot complete: backend is not TLS
+            except (ssl.SSLError, OSError):
+                pass
+
+        for sni, got in (("registry.internal", got1), ("other.internal", got2)):
+            await asyncio.get_running_loop().run_in_executor(
+                None, tls_connect, sni
+            )
+            await asyncio.wait_for(got.wait(), 10)
+
+        # each backend saw a ClientHello carrying ITS hostname
+        for name in ("registry.internal", "other.internal"):
+            assert received[name][0] == 0x16, "not a TLS handshake record"
+            assert parse_client_hello_sni(received[name]) == name
+
+        await proxy.stop()
+        for b in (b1, b2):
+            b.close()
+            await b.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_parse_client_hello_sni_rejects_garbage():
+    from dragonfly2_tpu.client.proxy import parse_client_hello_sni
+
+    assert parse_client_hello_sni(b"") is None
+    assert parse_client_hello_sni(b"GET / HTTP/1.1\r\n\r\n") is None
+    assert parse_client_hello_sni(b"\x16\x03\x01\x00\x05tiny!") is None
