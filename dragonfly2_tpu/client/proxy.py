@@ -17,6 +17,7 @@ import base64
 import logging
 
 from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 logger = logging.getLogger(__name__)
 
@@ -71,33 +72,21 @@ class ProxyServer:
         self.whitelist_hosts = whitelist_hosts
         self.basic_auth = basic_auth
         self._server: asyncio.AbstractServer | None = None
-        self._conns: set[asyncio.Task] = set()
+        self._tracker = ConnTracker()
         self.stats = {"p2p": 0, "direct": 0, "tunnel": 0, "denied": 0}
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._track, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._handle), self.host, self.port
+        )
         self.host, self.port = self._server.sockets[0].getsockname()[:2]
         return self.host, self.port
 
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            # 3.12's wait_closed() waits on in-flight handlers; a client
-            # holding a CONNECT tunnel open would hang shutdown — cancel.
-            for task in list(self._conns):
-                task.cancel()
-            await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
-
-    async def _track(self, reader, writer):
-        task = asyncio.current_task()
-        self._conns.add(task)
-        try:
-            await self._handle(reader, writer)
-        except asyncio.CancelledError:
-            writer.close()
-        finally:
-            self._conns.discard(task)
 
     # ------------------------------------------------------------- handler
 
@@ -121,6 +110,13 @@ class ProxyServer:
                                     extra="Proxy-Authenticate: Basic realm=dragonfly\r\n")
                 return
             if method == "CONNECT":
+                # the whitelist must gate tunnels too, or a configured
+                # whitelist only protects plain HTTP while CONNECT relays
+                # to any host:port
+                if not self._host_allowed("https://" + target):
+                    self.stats["denied"] += 1
+                    await self._respond(writer, 403, b"host not in white list")
+                    return
                 await self._tunnel(target, reader, writer)
                 return
             url = target
@@ -285,10 +281,12 @@ class SNIProxy:
         self.port = port
         self.timeout = timeout
         self._server: asyncio.AbstractServer | None = None
-        self._conns: set[asyncio.Task] = set()
+        self._tracker = ConnTracker()
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._track, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._handle), self.host, self.port
+        )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
         return self.host, self.port
@@ -296,23 +294,8 @@ class SNIProxy:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
-            # Python 3.12's wait_closed() waits for every in-flight
-            # handler; a held-open tunnel would hang shutdown forever, so
-            # cancel the pumps first.
-            for task in list(self._conns):
-                task.cancel()
-            await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
-
-    async def _track(self, reader, writer):
-        task = asyncio.current_task()
-        self._conns.add(task)
-        try:
-            await self._handle(reader, writer)
-        except asyncio.CancelledError:
-            writer.close()
-        finally:
-            self._conns.discard(task)
 
     def _resolve(self, name: str) -> tuple[str, int] | None:
         if self.resolver is not None:

@@ -172,7 +172,9 @@ async def _serve_dfdaemon(args) -> int:
         direct = spec.startswith("direct:")
         if direct:
             spec = spec[len("direct:"):]
-        regex, _, redirect = spec.partition("=")
+        # '=>' separates regex from redirect host: a bare '=' is common
+        # inside URL-query regexes and must stay part of the pattern
+        regex, _, redirect = spec.partition("=>")
         rules.append(ProxyRule(regex=regex, direct=direct, redirect=redirect))
     daemon = Daemon(
         data_dir=args.data_dir,
@@ -260,7 +262,7 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--sni-allow", action="append", default=[],
                    help="hostname (or suffix) the SNI proxy may dial (repeatable)")
     d.add_argument("--proxy-rule", action="append", default=[],
-                   help="P2P hijack rule REGEX[=REDIRECT_HOST]; prefix "
+                   help="P2P hijack rule REGEX[=>REDIRECT_HOST]; prefix "
                    "'direct:' to match-but-bypass (repeatable)")
     d.add_argument("--metrics-port", type=int, default=None)
     return p

@@ -17,7 +17,7 @@ import asyncio
 import dataclasses
 import logging
 
-from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc import mux, wire
 
 logger = logging.getLogger(__name__)
 
@@ -157,6 +157,9 @@ class ManagerRPCServer:
             writer.close()
 
     def _dispatch(self, request):
+        health = mux.handle_health_request(request)
+        if health is not None:
+            return health
         svc = self.service
         try:
             if isinstance(request, GetSchedulersRequest):

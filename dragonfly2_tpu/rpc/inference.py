@@ -24,7 +24,7 @@ import time
 
 import numpy as np
 
-from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc import mux, wire
 from dragonfly2_tpu.utils import dferrors
 
 logger = logging.getLogger(__name__)
@@ -219,6 +219,9 @@ class InferenceRPCServer:
         self._last_refresh[name] = now
 
     def _dispatch(self, request):
+        health = mux.handle_health_request(request)
+        if health is not None:
+            return health
         if isinstance(request, ServerLiveRequest):
             return ServerLiveResponse(live=True)
         if isinstance(request, ModelReadyRequest):

@@ -1,0 +1,160 @@
+"""Single-port multiplexing of the wire protocol and plain HTTP.
+
+Capability parity with pkg/rpc's mux listener (mux.go — one TCP port
+serving both gRPC and HTTP health/debug traffic) and pkg/rpc/health (the
+grpc health-checking protocol every service registers): the first bytes
+of a connection decide the protocol. HTTP methods are ASCII ("GET ",
+"POST"...), while a wire frame starts with a 4-byte big-endian length
+whose first byte is 0x00 for any frame under 16 MiB — the two are
+disjoint, so a 4-byte peek routes with no ambiguity (frames ≥16 MiB only
+occur on the trainer upload path, which never fronts a mux).
+
+HTTP side serves `/healthz` (liveness — the health RPC's HTTP twin) and
+`/metrics` (Prometheus text). The wire side also answers
+`HealthCheckRequest` → SERVING on every server that registers it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+
+from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.utils.conntrack import ConnTracker
+
+logger = logging.getLogger(__name__)
+
+_HTTP_PREFIXES = (b"GET ", b"POST", b"HEAD", b"PUT ", b"DELE", b"OPTI", b"PATC")
+
+_RELAY_HIGH_WATER = 4 << 20
+
+SERVING = "SERVING"
+NOT_SERVING = "NOT_SERVING"
+
+
+@dataclasses.dataclass
+class HealthCheckRequest:
+    """pkg/rpc/health: the standard health v1 Check, per-service."""
+
+    service: str = ""
+
+
+@dataclasses.dataclass
+class HealthCheckResponse:
+    status: str = SERVING
+
+
+wire.register_messages(HealthCheckRequest, HealthCheckResponse)
+
+
+class MuxServer:
+    """Accepts on one port; routes each connection to `rpc_handler`
+    (an `async (reader, writer)` — e.g. SchedulerRPCServer._serve_conn)
+    or to the built-in HTTP handler by protocol sniffing."""
+
+    def __init__(
+        self,
+        rpc_handler,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_registry=None,
+        health_check=None,  # () -> bool; liveness beyond "process is up"
+    ):
+        self.rpc_handler = rpc_handler
+        self.host = host
+        self.port = port
+        self.metrics_registry = metrics_registry
+        self.health_check = health_check
+        self._server: asyncio.AbstractServer | None = None
+        self._tracker = ConnTracker()
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._handle), self.host, self.port
+        )
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # long-lived wire streams would hang 3.12's wait_closed()
+            await self._tracker.cancel_all()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            peek = await reader.readexactly(4)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        if peek in _HTTP_PREFIXES:
+            await self._handle_http(peek, reader, writer)
+            return
+        # Wire protocol: hand the consumed prefix back through a fresh
+        # reader fed by a relay task (StreamReader has no un-read).
+        relayed = asyncio.StreamReader()
+        relayed.feed_data(peek)
+
+        async def relay():
+            try:
+                while True:
+                    # A detached StreamReader has no transport, so
+                    # feed_data never back-pressures: without a bound, a
+                    # client blasting frames faster than dispatch drains
+                    # them grows the buffer to OOM. _buffer is CPython's
+                    # stable internal; poll it as the high-water mark.
+                    while len(getattr(relayed, "_buffer", b"")) > _RELAY_HIGH_WATER:
+                        await asyncio.sleep(0.01)
+                    data = await reader.read(1 << 16)
+                    if not data:
+                        relayed.feed_eof()
+                        return
+                    relayed.feed_data(data)
+            except (ConnectionError, asyncio.CancelledError):
+                relayed.feed_eof()
+
+        relay_task = asyncio.create_task(relay())
+        try:
+            await self.rpc_handler(relayed, writer)
+        finally:
+            relay_task.cancel()
+
+    async def _handle_http(self, peek: bytes, reader, writer):
+        try:
+            line = peek + await asyncio.wait_for(reader.readline(), 10)
+            parts = line.decode("latin1").split()
+            path = parts[1] if len(parts) > 1 else "/"
+            # drain headers
+            while True:
+                header = await asyncio.wait_for(reader.readline(), 10)
+                if header in (b"\r\n", b"\n", b""):
+                    break
+            path = path.partition("?")[0].rstrip("/") or "/"
+            if path == "/healthz":
+                ok = True if self.health_check is None else bool(self.health_check())
+                status, body = (200, b"ok") if ok else (503, b"not serving")
+            elif path == "/metrics" and self.metrics_registry is not None:
+                status, body = 200, self.metrics_registry.expose().encode()
+            else:
+                status, body = 404, b"not found"
+            reason = {200: "OK", 404: "Not Found", 503: "Service Unavailable"}[status]
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\nContent-Length: {len(body)}\r\n"
+                "Content-Type: text/plain\r\nConnection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.TimeoutError, UnicodeDecodeError):
+            pass
+        finally:
+            writer.close()
+
+
+def handle_health_request(request):
+    """Shared wire-side health answer — servers call this first in their
+    dispatch: returns a response for HealthCheckRequest, else None."""
+    if isinstance(request, HealthCheckRequest):
+        return HealthCheckResponse(status=SERVING)
+    return None

@@ -16,7 +16,7 @@ import logging
 import time
 
 from dragonfly2_tpu.cluster import messages as msg
-from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc import mux, wire
 from dragonfly2_tpu.telemetry import default_registry
 
 wire.register_module(msg)
@@ -86,6 +86,11 @@ class SchedulerRPCServer:
                 if request is None:
                     return
                 self._m_requests.labels(type(request).__name__).inc()
+                health = mux.handle_health_request(request)
+                if health is not None:
+                    wire.write_frame(writer, health)
+                    await writer.drain()
+                    continue
                 if isinstance(request, msg.AnnounceHostRequest):
                     async with self._lock:
                         self._host_conn[request.host.host_id] = writer
@@ -401,6 +406,11 @@ class TrainerRPCServer:
                     # connection tore (read_frame folds ConnectionError into
                     # None) — never train on a possibly-truncated dataset.
                     break
+                health = mux.handle_health_request(request)
+                if health is not None:
+                    wire.write_frame(writer, health)
+                    await writer.drain()
+                    continue
                 if isinstance(request, msg.TrainEndRequest):
                     host_id = request.host_id or host_id
                     committed = True

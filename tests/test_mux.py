@@ -1,0 +1,124 @@
+"""Single-port protocol mux + health checking (pkg/rpc mux.go +
+pkg/rpc/health parity): one TCP port answers HTTP /healthz and /metrics
+AND serves the full scheduler wire protocol, sniffed per connection."""
+
+import asyncio
+import urllib.request
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc.mux import (
+    HealthCheckRequest,
+    HealthCheckResponse,
+    MuxServer,
+    SERVING,
+)
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+from dragonfly2_tpu.telemetry import default_registry
+
+
+def _host(i):
+    return msg.HostInfo(
+        host_id=f"mux-host-{i}", hostname=f"mux-{i}", ip="127.0.0.1",
+        host_type="normal", port=9000 + i, download_port=9000 + i,
+    )
+
+
+def test_mux_http_and_wire_on_one_port(tmp_path):
+    async def run():
+        service = SchedulerService()
+        rpc = SchedulerRPCServer(service, tick_interval=0.01)
+        # bind the real rpc server too (it owns the tick loop), but talk
+        # through the mux port only
+        await rpc.start()
+        mux_srv = MuxServer(
+            rpc._serve_conn, metrics_registry=default_registry(),
+            health_check=lambda: True,
+        )
+        host, port = await mux_srv.start()
+
+        # -- HTTP side
+        def http_get(path):
+            with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=5) as r:
+                return r.status, r.read()
+
+        loop = asyncio.get_running_loop()
+        status, body = await loop.run_in_executor(None, http_get, "/healthz")
+        assert (status, body) == (200, b"ok")
+        status, body = await loop.run_in_executor(None, http_get, "/metrics")
+        assert status == 200 and b"dragonfly_scheduler" in body
+
+        # -- wire side on the SAME port
+        reader, writer = await asyncio.open_connection(host, port)
+        wire.write_frame(writer, HealthCheckRequest())
+        await writer.drain()
+        response = await asyncio.wait_for(wire.read_frame(reader), 10)
+        assert isinstance(response, HealthCheckResponse) and response.status == SERVING
+
+        wire.write_frame(writer, msg.AnnounceHostRequest(host=_host(1)))
+        await writer.drain()
+        await asyncio.sleep(0.1)
+        assert service.counts()["hosts"] == 1
+        writer.close()
+
+        await mux_srv.stop()
+        await rpc.stop()
+
+    asyncio.run(run())
+
+
+def test_mux_unhealthy_and_unknown_path():
+    async def run():
+        async def never(reader, writer):
+            writer.close()
+
+        mux_srv = MuxServer(never, health_check=lambda: False)
+        host, port = await mux_srv.start()
+
+        def http_get(path):
+            import urllib.error
+
+            try:
+                with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        loop = asyncio.get_running_loop()
+        assert await loop.run_in_executor(None, http_get, "/healthz") == 503
+        assert await loop.run_in_executor(None, http_get, "/nope") == 404
+        await mux_srv.stop()
+
+    asyncio.run(run())
+
+
+def test_health_request_on_all_rpc_servers(tmp_path):
+    """Every service's wire endpoint answers the health Check."""
+    from dragonfly2_tpu.manager.models import Database
+    from dragonfly2_tpu.manager.rpc import ManagerRPCServer
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.rpc.inference import InferenceRPCServer
+
+    async def check(host, port):
+        reader, writer = await asyncio.open_connection(host, port)
+        wire.write_frame(writer, HealthCheckRequest(service="any"))
+        await writer.drain()
+        response = await asyncio.wait_for(wire.read_frame(reader), 10)
+        writer.close()
+        assert isinstance(response, HealthCheckResponse) and response.status == SERVING
+
+    async def run():
+        sched = SchedulerRPCServer(SchedulerService(), tick_interval=0.01)
+        await check(*await sched.start())
+        await sched.stop()
+
+        manager = ManagerRPCServer(ManagerService(db=Database(":memory:")))
+        await check(*await manager.start())
+        await manager.stop()
+
+        infer = InferenceRPCServer({})
+        await check(*await infer.start())
+        await infer.stop()
+
+    asyncio.run(run())
