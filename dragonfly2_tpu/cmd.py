@@ -175,6 +175,12 @@ async def _serve_dfdaemon(args) -> int:
         # '=>' separates regex from redirect host: a bare '=' is common
         # inside URL-query regexes and must stay part of the pattern
         regex, _, redirect = spec.partition("=>")
+        if "=" in regex and not redirect:
+            print(
+                f"warning: --proxy-rule {spec!r} has '=' but no '=>' — the whole "
+                "string is treated as the regex (redirect needs '=>HOST')",
+                file=sys.stderr,
+            )
         rules.append(ProxyRule(regex=regex, direct=direct, redirect=redirect))
     daemon = Daemon(
         data_dir=args.data_dir,

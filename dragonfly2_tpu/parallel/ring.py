@@ -54,7 +54,8 @@ def dense_attention(q, k, v, kv_mask, causal: bool = False) -> jax.Array:
 
 
 def ring_attention(
-    q, k, v, kv_mask, axis_name: str = SP_AXIS, use_flash: bool = False
+    q, k, v, kv_mask, axis_name: str = SP_AXIS, use_flash: bool = False,
+    q_pos=None, k_pos=None,
 ) -> jax.Array:
     """Blockwise attention inside shard_map: every step attends the local
     queries to the current KV block, then rotates KV one hop around the
@@ -65,7 +66,21 @@ def ring_attention(
     partials mode (ops/flash.py) and merges them with the same combine —
     the [Lq, Lk] block score matrix never materializes, so long local
     shards fit where the einsum path would blow HBM. Forward-only (the
-    partials kernel has no VJP); training keeps the einsum path."""
+    partials kernel has no VJP); training keeps the einsum path.
+
+    Causal mode: pass `q_pos`/`k_pos` (the GLOBAL sequence position of
+    each local slot, [L] int32). Keys with k_pos > q_pos are masked as
+    the KV blocks rotate — position-based, so it is correct under ANY
+    sequence layout including the zigzag one `zigzag_positions` builds to
+    balance causal work across the ring (einsum path only)."""
+    causal = q_pos is not None
+    if k_pos is not None and q_pos is None:
+        raise ValueError("k_pos without q_pos: causal masking is keyed on "
+                         "q_pos — passing only k_pos would silently compute "
+                         "full bidirectional attention")
+    if causal and use_flash:
+        raise ValueError("causal ring attention uses the einsum path "
+                         "(the flash partials kernel has no position mask)")
     n = jax.lax.psum(1, axis_name)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     batch, heads, q_len, dim = q.shape
@@ -87,12 +102,14 @@ def ring_attention(
             row_sum = row_sum * c_old + l_b * c_new
             return acc, new_max, row_sum
     else:
-        def attend_block(acc, row_max, row_sum, kb, vb, mb):
+        def attend_block(acc, row_max, row_sum, kb, vb, mb, kpb=None):
             scores = (
                 jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
                 * scale
             )
             key_valid = mb[:, None, None, :]
+            if causal:
+                key_valid = key_valid & (kpb[None, :] <= q_pos[:, None])[None, None]
             scores = jnp.where(key_valid, scores, _NEG)
             block_max = jnp.max(scores, axis=-1)
             new_max = jnp.maximum(row_max, block_max)
@@ -103,6 +120,22 @@ def ring_attention(
             )
             row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
             return acc, new_max, row_sum
+
+    if causal:
+        kp0 = k_pos if k_pos is not None else q_pos
+
+        def body(_, carry):
+            acc, row_max, row_sum, kb, vb, mb, kpb = carry
+            acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
+            kb, vb, mb, kpb = jax.lax.ppermute((kb, vb, mb, kpb), axis_name, perm)
+            return acc, row_max, row_sum, kb, vb, mb, kpb
+
+        acc, row_max, row_sum, kb, vb, mb, kpb = jax.lax.fori_loop(
+            0, n - 1, body, (acc, row_max, row_sum, k, v, kv_mask, kp0)
+        )
+        acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
+        out = acc / jnp.maximum(row_sum, 1e-9)[..., None]
+        return out.astype(q.dtype)
 
     def body(_, carry):
         acc, row_max, row_sum, kb, vb, mb = carry
@@ -136,3 +169,60 @@ def sharded_ring_attention(mesh, q, k, v, kv_mask, use_flash: bool = False) -> j
         check_vma=False,
     )
     return fn(q, k, v, kv_mask)
+
+
+# ------------------------------------------------------------- causal sp
+
+
+def zigzag_positions(seq_len: int, n_shards: int):
+    """Zigzag context-parallel layout: split the sequence into 2n chunks
+    and give shard i chunks (i, 2n-1-i), so every shard owns one early
+    and one late chunk. Under a plain contiguous split, causal masking
+    leaves the first shard with almost no attendable keys and the last
+    with all of them — a ~2x ring-step load imbalance that the zigzag
+    pairing flattens (each shard's key work sums to the same total).
+
+    Returns (order, inverse): `x[..., order, :]` lays the sequence out in
+    zigzag shard order; `y[..., inverse, :]` undoes it. `order` is also
+    each zigzag slot's global position (what the causal mask needs)."""
+    if seq_len % (2 * n_shards):
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*{n_shards}")
+    chunk = seq_len // (2 * n_shards)
+    order = []
+    for i in range(n_shards):
+        order.extend(range(i * chunk, (i + 1) * chunk))
+        j = 2 * n_shards - 1 - i
+        order.extend(range(j * chunk, (j + 1) * chunk))
+    order = jnp.asarray(order, jnp.int32)
+    inverse = jnp.zeros_like(order).at[order].set(jnp.arange(seq_len, dtype=jnp.int32))
+    return order, inverse
+
+
+def sharded_causal_ring_attention(mesh, q, k, v, kv_mask) -> jax.Array:
+    """Causal ring attention over the `sp` axis with zigzag load
+    balancing. Global [B,H,L,D] in and out (contiguous sequence order) —
+    the zigzag reorder and its inverse happen here, positions ride the
+    ring so masking is layout-independent."""
+    n = mesh.shape[SP_AXIS]
+    seq_len = q.shape[2]
+    order, inverse = zigzag_positions(seq_len, n)
+    qz, kz, vz = (x[:, :, order, :] for x in (q, k, v))
+    maskz = kv_mask[:, order]
+
+    qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
+    mask_spec = P(DP_AXIS, SP_AXIS)
+    pos_spec = P(SP_AXIS)
+
+    def local(qb, kb, vb, mb, pos):
+        return ring_attention(qb, kb, vb, mb, axis_name=SP_AXIS,
+                              q_pos=pos, k_pos=pos)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, pos_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    out = fn(qz, kz, vz, maskz, order)
+    return out[:, :, inverse, :]

@@ -27,7 +27,13 @@ logger = logging.getLogger(__name__)
 
 _HTTP_PREFIXES = (b"GET ", b"POST", b"HEAD", b"PUT ", b"DELE", b"OPTI", b"PATC")
 
-_RELAY_HIGH_WATER = 4 << 20
+# Back-pressure bound for the relay reader. MUST exceed MAX_FRAME:
+# read_frame's readexactly() only returns once the whole frame is
+# buffered, so a high-water below the max frame size deadlocks producer
+# against consumer. One max-size frame (+ a chunk) is the same worst-case
+# memory read_frame itself holds; the bound exists to stop UNlimited
+# pipelined-frame growth, not to shrink a single legal frame.
+_RELAY_HIGH_WATER = wire.MAX_FRAME + (1 << 16)
 
 SERVING = "SERVING"
 NOT_SERVING = "NOT_SERVING"
@@ -68,6 +74,9 @@ class MuxServer:
         self.health_check = health_check
         self._server: asyncio.AbstractServer | None = None
         self._tracker = ConnTracker()
+
+    def _healthy(self) -> bool:
+        return True if self.health_check is None else bool(self.health_check())
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -134,7 +143,7 @@ class MuxServer:
                     break
             path = path.partition("?")[0].rstrip("/") or "/"
             if path == "/healthz":
-                ok = True if self.health_check is None else bool(self.health_check())
+                ok = self._healthy()
                 status, body = (200, b"ok") if ok else (503, b"not serving")
             elif path == "/metrics" and self.metrics_registry is not None:
                 status, body = 200, self.metrics_registry.expose().encode()
@@ -146,15 +155,18 @@ class MuxServer:
                 "Content-Type: text/plain\r\nConnection: close\r\n\r\n".encode() + body
             )
             await writer.drain()
-        except (ConnectionError, asyncio.TimeoutError, UnicodeDecodeError):
+        except (ConnectionError, asyncio.TimeoutError, UnicodeDecodeError,
+                ValueError):  # ValueError covers LimitOverrunError readline
             pass
         finally:
             writer.close()
 
 
-def handle_health_request(request):
+def handle_health_request(request, healthy: bool = True):
     """Shared wire-side health answer — servers call this first in their
-    dispatch: returns a response for HealthCheckRequest, else None."""
+    dispatch: returns a response for HealthCheckRequest, else None.
+    `healthy=False` answers NOT_SERVING (a server draining or with a
+    failed dependency must not tell its load balancer SERVING)."""
     if isinstance(request, HealthCheckRequest):
-        return HealthCheckResponse(status=SERVING)
+        return HealthCheckResponse(status=SERVING if healthy else NOT_SERVING)
     return None

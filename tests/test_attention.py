@@ -162,3 +162,41 @@ def test_ring_attention_flash_blocks_match_dense():
             np.asarray(out, np.float32), np.asarray(dense, np.float32),
             atol=5e-2, rtol=5e-2,
         )
+
+
+def test_causal_ring_attention_zigzag_parity():
+    """Zigzag causal ring attention == dense causal attention,
+    layout-independent (positions ride the ring with the KV blocks)."""
+    from dragonfly2_tpu.parallel.ring import (
+        dense_attention,
+        sharded_causal_ring_attention,
+        zigzag_positions,
+    )
+
+    mesh8 = make_mesh(8, dp=2, sp=4)
+    b, h, L, d = 2, 2, 64, 16
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(b, h, L, d)).astype(np.float32)
+    k = rng.normal(size=(b, h, L, d)).astype(np.float32)
+    v = rng.normal(size=(b, h, L, d)).astype(np.float32)
+    mask = np.ones((b, L), bool)
+    mask[1, -7:] = False  # ragged tail on one sequence
+
+    want = np.asarray(dense_attention(q, k, v, mask, causal=True))
+    got = np.asarray(sharded_causal_ring_attention(mesh8, q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    # zigzag order/inverse are a permutation pair
+    order, inverse = zigzag_positions(L, 4)
+    x = np.arange(L)
+    assert (np.asarray(order)[np.asarray(inverse)] == x).all()
+    assert (np.asarray(inverse)[np.asarray(order)] == x).all()
+
+
+def test_causal_ring_rejects_flash():
+    from dragonfly2_tpu.parallel.ring import ring_attention
+
+    q = np.zeros((1, 1, 8, 4), np.float32)
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, q, q, np.ones((1, 8), bool), use_flash=True,
+                       q_pos=np.arange(8, dtype=np.int32))

@@ -122,3 +122,37 @@ def test_health_request_on_all_rpc_servers(tmp_path):
         await infer.stop()
 
     asyncio.run(run())
+
+
+def test_mux_relays_frames_larger_than_backpressure_window():
+    """A frame bigger than the relay's high-water slack must still pass:
+    read_frame buffers the WHOLE frame before consuming, so a bound below
+    MAX_FRAME would deadlock producer against consumer."""
+    import dataclasses
+
+    @dataclasses.dataclass
+    class BigBlob:
+        data: bytes
+
+    wire.register_messages(BigBlob)
+
+    async def echo(reader, writer):
+        request = await wire.read_frame(reader)
+        if request is not None:
+            wire.write_frame(writer, request)
+            await writer.drain()
+        writer.close()
+
+    async def run():
+        mux_srv = MuxServer(echo)
+        host, port = await mux_srv.start()
+        reader, writer = await asyncio.open_connection(host, port)
+        blob = bytes(range(256)) * (24 * 1024)  # 6 MiB, > old 4 MiB bound
+        wire.write_frame(writer, BigBlob(data=blob))
+        await writer.drain()
+        response = await asyncio.wait_for(wire.read_frame(reader), 30)
+        assert response.data == blob
+        writer.close()
+        await mux_srv.stop()
+
+    asyncio.run(run())
