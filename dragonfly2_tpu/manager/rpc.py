@@ -123,8 +123,10 @@ wire.register_messages(
 
 
 class ManagerRPCServer:
-    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 health_check=None):
         self.service = service
+        self.health_check = health_check
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
@@ -157,7 +159,9 @@ class ManagerRPCServer:
             writer.close()
 
     def _dispatch(self, request):
-        health = mux.handle_health_request(request)
+        health = mux.handle_health_request(
+            request, healthy=self.health_check() if self.health_check else True
+        )
         if health is not None:
             return health
         svc = self.service

@@ -93,7 +93,7 @@ def ring_attention(
     if use_flash:
         from dragonfly2_tpu.ops.flash import flash_attention_partials
 
-        def attend_block(acc, row_max, row_sum, kb, vb, mb):
+        def attend_block(acc, row_max, row_sum, kb, vb, mb, kpb=None):
             acc_b, m_b, l_b = flash_attention_partials(q, kb, vb, mb)
             new_max = jnp.maximum(row_max, m_b)
             c_old = jnp.exp(row_max - new_max)
@@ -121,35 +121,27 @@ def ring_attention(
             row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
             return acc, new_max, row_sum
 
+    # ONE rotation loop for both modes: key positions ride the ring as a
+    # loop-carried value; non-causal mode carries a dummy (attend_block
+    # ignores kpb when causal is False).
     if causal:
         kp0 = k_pos if k_pos is not None else q_pos
-
-        def body(_, carry):
-            acc, row_max, row_sum, kb, vb, mb, kpb = carry
-            acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
-            kb, vb, mb, kpb = jax.lax.ppermute((kb, vb, mb, kpb), axis_name, perm)
-            return acc, row_max, row_sum, kb, vb, mb, kpb
-
-        acc, row_max, row_sum, kb, vb, mb, kpb = jax.lax.fori_loop(
-            0, n - 1, body, (acc, row_max, row_sum, k, v, kv_mask, kp0)
-        )
-        acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
-        out = acc / jnp.maximum(row_sum, 1e-9)[..., None]
-        return out.astype(q.dtype)
+    else:
+        kp0 = jnp.zeros((k.shape[2],), jnp.int32)
 
     def body(_, carry):
-        acc, row_max, row_sum, kb, vb, mb = carry
-        acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb)
-        kb, vb, mb = jax.lax.ppermute((kb, vb, mb), axis_name, perm)
-        return acc, row_max, row_sum, kb, vb, mb
+        acc, row_max, row_sum, kb, vb, mb, kpb = carry
+        acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
+        kb, vb, mb, kpb = jax.lax.ppermute((kb, vb, mb, kpb), axis_name, perm)
+        return acc, row_max, row_sum, kb, vb, mb, kpb
 
     # n-1 attend+rotate steps, then the final block attends WITHOUT the
     # trailing rotation — its output would be discarded, and each skipped
     # ppermute saves a full K+V+mask shard crossing the ICI ring.
-    acc, row_max, row_sum, kb, vb, mb = jax.lax.fori_loop(
-        0, n - 1, body, (acc, row_max, row_sum, k, v, kv_mask)
+    acc, row_max, row_sum, kb, vb, mb, kpb = jax.lax.fori_loop(
+        0, n - 1, body, (acc, row_max, row_sum, k, v, kv_mask, kp0)
     )
-    acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb)
+    acc, row_max, row_sum = attend_block(acc, row_max, row_sum, kb, vb, mb, kpb)
     out = acc / jnp.maximum(row_sum, 1e-9)[..., None]
     return out.astype(q.dtype)
 

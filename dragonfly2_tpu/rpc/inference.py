@@ -150,7 +150,9 @@ class InferenceRPCServer:
         host: str = "127.0.0.1",
         port: int = 0,
         refresh_ttl_s: float = 0.5,
+        health_check=None,
     ):
+        self.health_check = health_check
         self.servers = servers
         self.host = host
         self.port = port
@@ -219,7 +221,9 @@ class InferenceRPCServer:
         self._last_refresh[name] = now
 
     def _dispatch(self, request):
-        health = mux.handle_health_request(request)
+        health = mux.handle_health_request(
+            request, healthy=self.health_check() if self.health_check else True
+        )
         if health is not None:
             return health
         if isinstance(request, ServerLiveRequest):

@@ -27,13 +27,14 @@ logger = logging.getLogger(__name__)
 
 _HTTP_PREFIXES = (b"GET ", b"POST", b"HEAD", b"PUT ", b"DELE", b"OPTI", b"PATC")
 
-# Back-pressure bound for the relay reader. MUST exceed MAX_FRAME:
-# read_frame's readexactly() only returns once the whole frame is
-# buffered, so a high-water below the max frame size deadlocks producer
-# against consumer. One max-size frame (+ a chunk) is the same worst-case
-# memory read_frame itself holds; the bound exists to stop UNlimited
-# pipelined-frame growth, not to shrink a single legal frame.
-_RELAY_HIGH_WATER = wire.MAX_FRAME + (1 << 16)
+# The mux enforces its own frame ceiling, far below wire.MAX_FRAME
+# (256 MiB, sized for trainer dataset chunks that never front a mux): the
+# relay is frame-aware, so an oversized length prefix is rejected loudly
+# instead of either deadlocking (a back-pressure bound below the frame
+# size starves read_frame's readexactly) or letting every untrusted
+# connection buffer a quarter-gigabyte.
+MUX_MAX_FRAME = 16 << 20
+_RELAY_HIGH_WATER = 2 * MUX_MAX_FRAME
 
 SERVING = "SERVING"
 NOT_SERVING = "NOT_SERVING"
@@ -103,26 +104,35 @@ class MuxServer:
             await self._handle_http(peek, reader, writer)
             return
         # Wire protocol: hand the consumed prefix back through a fresh
-        # reader fed by a relay task (StreamReader has no un-read).
+        # reader fed by a frame-aware relay task (StreamReader has no
+        # un-read).
         relayed = asyncio.StreamReader()
-        relayed.feed_data(peek)
 
         async def relay():
+            prefix = peek
             try:
                 while True:
                     # A detached StreamReader has no transport, so
-                    # feed_data never back-pressures: without a bound, a
-                    # client blasting frames faster than dispatch drains
-                    # them grows the buffer to OOM. _buffer is CPython's
-                    # stable internal; poll it as the high-water mark.
+                    # feed_data never back-pressures: pause on a high-water
+                    # mark (above the frame ceiling, so readexactly always
+                    # completes). _buffer is CPython's stable internal.
                     while len(getattr(relayed, "_buffer", b"")) > _RELAY_HIGH_WATER:
                         await asyncio.sleep(0.01)
-                    data = await reader.read(1 << 16)
-                    if not data:
+                    if prefix is None:
+                        prefix = await reader.readexactly(4)
+                    frame_len = int.from_bytes(prefix, "big")
+                    if frame_len > MUX_MAX_FRAME:
+                        logger.warning(
+                            "mux: rejecting %d-byte frame (> %d ceiling)",
+                            frame_len, MUX_MAX_FRAME,
+                        )
                         relayed.feed_eof()
                         return
-                    relayed.feed_data(data)
-            except (ConnectionError, asyncio.CancelledError):
+                    payload = await reader.readexactly(frame_len)
+                    relayed.feed_data(prefix + payload)
+                    prefix = None
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    asyncio.CancelledError):
                 relayed.feed_eof()
 
         relay_task = asyncio.create_task(relay())
@@ -156,7 +166,7 @@ class MuxServer:
             )
             await writer.drain()
         except (ConnectionError, asyncio.TimeoutError, UnicodeDecodeError,
-                ValueError):  # ValueError covers LimitOverrunError readline
+                asyncio.LimitOverrunError, asyncio.IncompleteReadError):
             pass
         finally:
             writer.close()

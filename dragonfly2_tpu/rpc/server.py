@@ -29,8 +29,10 @@ SEED_TRIGGER_TTL_S = 60.0
 
 
 class SchedulerRPCServer:
-    def __init__(self, service, host: str = "127.0.0.1", port: int = 0, tick_interval: float = 0.005):
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 tick_interval: float = 0.005, health_check=None):
         self.service = service
+        self.health_check = health_check
         self.host = host
         self.port = port
         self.tick_interval = tick_interval
@@ -86,7 +88,10 @@ class SchedulerRPCServer:
                 if request is None:
                     return
                 self._m_requests.labels(type(request).__name__).inc()
-                health = mux.handle_health_request(request)
+                health = mux.handle_health_request(
+                    request,
+                    healthy=self.health_check() if self.health_check else True,
+                )
                 if health is not None:
                     wire.write_frame(writer, health)
                     await writer.drain()
@@ -406,7 +411,10 @@ class TrainerRPCServer:
                     # connection tore (read_frame folds ConnectionError into
                     # None) — never train on a possibly-truncated dataset.
                     break
-                health = mux.handle_health_request(request)
+                health = mux.handle_health_request(
+                    request,
+                    healthy=self.health_check() if getattr(self, "health_check", None) else True,
+                )
                 if health is not None:
                     wire.write_frame(writer, health)
                     await writer.drain()

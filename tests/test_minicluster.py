@@ -307,3 +307,61 @@ def test_preheat_via_manager_rest(tmp_path, origin):
             rest.stop()
 
     asyncio.run(run())
+
+
+def test_two_schedulers_task_affinity(tmp_path, origin):
+    """Two live schedulers: every peer's RPCs for one task land on the
+    SAME scheduler (consistent-hash affinity, pkg/balancer) — that is the
+    only reason peer 2 can discover peer 1 as a parent — while different
+    tasks spread across the scheduler set."""
+    async def run():
+        services = [_scheduler_service(tmp_path / f"s{i}") for i in (0, 1)]
+        servers = [SchedulerRPCServer(s, tick_interval=0.01) for s in services]
+        addrs = [await s.start() for s in servers]
+
+        sha = hashlib.sha256(origin.payload).hexdigest()
+        daemons = []
+        try:
+            d1 = Daemon(tmp_path / "d1", addrs, hostname="aff-1")
+            d2 = Daemon(tmp_path / "d2", addrs, hostname="aff-2")
+            await d1.start(); await d2.start()
+            daemons = [d1, d2]
+
+            # several distinct tasks via per-task tags (distinct task ids)
+            tags = [f"t{i}" for i in range(6)]
+            for tag in tags:
+                ts1 = await d1.download(origin.url(), piece_length=64 * 1024, tag=tag)
+                with open(ts1.data_path, "rb") as f:
+                    assert hashlib.sha256(f.read()).hexdigest() == sha
+                gets = origin.get_count
+                # peer 2 must find peer 1 through the scheduler that owns
+                # this task — no back-source allowed
+                ts2 = await d2.download(
+                    origin.url(), piece_length=64 * 1024, tag=tag,
+                    back_source_allowed=False,
+                )
+                with open(ts2.data_path, "rb") as f:
+                    assert hashlib.sha256(f.read()).hexdigest() == sha
+                assert origin.get_count == gets, f"tag {tag}: p2p peer hit origin"
+
+            # each task lives on EXACTLY the scheduler its id hashes to —
+            # computed from the same ring the daemons use, so the check is
+            # deterministic for any ephemeral ports
+            from dragonfly2_tpu.utils import idgen
+
+            expected = [0, 0]
+            keys = [f"{h}:{p}" for h, p in addrs]
+            for tag in tags:
+                task_id = idgen.task_id_v1(origin.url(), tag=tag)
+                picked = d1.pool._ring.pick(task_id)
+                expected[keys.index(picked)] += 1
+            counts = [svc.counts()["tasks"] for svc in services]
+            assert counts == expected, (counts, expected)
+            assert sum(counts) == len(tags), counts
+        finally:
+            for d in daemons:
+                await d.stop()
+            for s in servers:
+                await s.stop()
+
+    asyncio.run(run())

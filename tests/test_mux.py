@@ -156,3 +156,50 @@ def test_mux_relays_frames_larger_than_backpressure_window():
         await mux_srv.stop()
 
     asyncio.run(run())
+
+
+def test_wire_health_honors_health_check():
+    """A draining server must answer NOT_SERVING on the wire health
+    protocol, matching its HTTP /healthz."""
+    from dragonfly2_tpu.rpc.mux import NOT_SERVING
+
+    async def run():
+        sched = SchedulerRPCServer(
+            SchedulerService(), tick_interval=0.01, health_check=lambda: False
+        )
+        host, port = await sched.start()
+        reader, writer = await asyncio.open_connection(host, port)
+        wire.write_frame(writer, HealthCheckRequest())
+        await writer.drain()
+        response = await asyncio.wait_for(wire.read_frame(reader), 10)
+        assert response.status == NOT_SERVING
+        writer.close()
+        await sched.stop()
+
+    asyncio.run(run())
+
+
+def test_mux_rejects_oversized_frames():
+    """A length prefix above the mux frame ceiling closes the connection
+    instead of buffering it (or deadlocking the relay)."""
+    from dragonfly2_tpu.rpc.mux import MUX_MAX_FRAME
+
+    async def echo(reader, writer):
+        request = await wire.read_frame(reader)
+        if request is not None:
+            wire.write_frame(writer, request)
+            await writer.drain()
+        writer.close()
+
+    async def run():
+        mux_srv = MuxServer(echo)
+        host, port = await mux_srv.start()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write((MUX_MAX_FRAME + 1).to_bytes(4, "big") + b"x" * 64)
+        await writer.drain()
+        got = await asyncio.wait_for(reader.read(), 10)
+        assert got == b""  # server closed without a response
+        writer.close()
+        await mux_srv.stop()
+
+    asyncio.run(run())
