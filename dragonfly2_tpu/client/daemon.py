@@ -42,6 +42,8 @@ class Daemon:
         gc_interval: float = 60.0,
         probe_interval: float = 0.0,  # 0 disables the probe loop
         object_storage: bool = False,
+        object_storage_backend: str = "fs",
+        object_storage_options: dict | None = None,
         proxy: bool = False,
         proxy_rules: list | None = None,
         registry_mirror: str = "",
@@ -67,11 +69,17 @@ class Daemon:
         self.object_storage = None
         if object_storage:
             # optional object-storage HTTP listener (daemon.go:525-604
-            # serves it alongside upload/proxy when configured)
-            from dragonfly2_tpu.objectstorage.backends import FilesystemBackend
+            # serves it alongside upload/proxy when configured); the
+            # vendor dispatch matches pkg/objectstorage New() — `fs`
+            # local dir or a signed s3/oss/obs endpoint
+            from dragonfly2_tpu.objectstorage.backends import new_backend
             from dragonfly2_tpu.objectstorage.service import ObjectStorageService
 
-            backend = FilesystemBackend(pathlib.Path(data_dir) / "objects")
+            backend = new_backend(
+                object_storage_backend,
+                base_dir=pathlib.Path(data_dir) / "objects",
+                **(object_storage_options or {}),
+            )
             self.object_storage = ObjectStorageService(backend, storage=self.storage, host=ip)
         self.proxy = None
         self.sni_proxy = None

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import contextlib
 import signal
 import sys
@@ -162,6 +163,26 @@ async def _serve_manager(args) -> int:
     return 0
 
 
+def _object_storage_options(args) -> dict | None:
+    if not args.object_storage_endpoint:
+        return None
+    access = os.environ.get("DRAGONFLY_OBJ_ACCESS_KEY", "")
+    secret = os.environ.get("DRAGONFLY_OBJ_SECRET_KEY", "")
+    if not access or not secret:
+        # empty creds would boot cleanly and then fail EVERY request with
+        # vendor signature errors — refuse at startup with the real cause
+        raise SystemExit(
+            "--object-storage-endpoint needs DRAGONFLY_OBJ_ACCESS_KEY and "
+            "DRAGONFLY_OBJ_SECRET_KEY in the environment"
+        )
+    return {
+        "endpoint": args.object_storage_endpoint,
+        "access_key": access,
+        "secret_key": secret,
+        "region": args.object_storage_region,
+    }
+
+
 async def _serve_dfdaemon(args) -> int:
     from dragonfly2_tpu.client.daemon import Daemon
     from dragonfly2_tpu.client.transport import ProxyRule
@@ -191,6 +212,8 @@ async def _serve_dfdaemon(args) -> int:
         location=args.location,
         probe_interval=args.probe_interval,
         object_storage=args.object_storage,
+        object_storage_backend=args.object_storage_backend,
+        object_storage_options=_object_storage_options(args),
         proxy=args.proxy,
         proxy_rules=rules,
         registry_mirror=args.registry_mirror,
@@ -203,6 +226,8 @@ async def _serve_dfdaemon(args) -> int:
         ready += f" PROXY {daemon.proxy.port}"
     if daemon.sni_proxy is not None:
         ready += f" SNI {daemon.sni_proxy.port}"
+    if daemon.object_storage is not None:
+        ready += f" OBJSTORE {daemon.object_storage.port}"
     try:
         async with _monitored(args, ready) as line:
             await _run_until_signalled(line)
@@ -258,6 +283,12 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--location", default="")
     d.add_argument("--probe-interval", type=float, default=0.0)
     d.add_argument("--object-storage", action="store_true")
+    d.add_argument("--object-storage-backend", default="fs",
+                   choices=("fs", "s3", "oss", "obs"))
+    d.add_argument("--object-storage-endpoint", default="",
+                   help="vendor endpoint for s3/oss/obs (credentials via "
+                   "DRAGONFLY_OBJ_ACCESS_KEY / DRAGONFLY_OBJ_SECRET_KEY env)")
+    d.add_argument("--object-storage-region", default="")
     d.add_argument("--proxy", action="store_true",
                    help="serve the HTTP(S) forward proxy listener")
     d.add_argument("--registry-mirror", default="",

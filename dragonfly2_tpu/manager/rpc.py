@@ -159,9 +159,7 @@ class ManagerRPCServer:
             writer.close()
 
     def _dispatch(self, request):
-        health = mux.handle_health_request(
-            request, healthy=self.health_check() if self.health_check else True
-        )
+        health = mux.handle_health_request(request, self.health_check)
         if health is not None:
             return health
         svc = self.service

@@ -221,9 +221,7 @@ class InferenceRPCServer:
         self._last_refresh[name] = now
 
     def _dispatch(self, request):
-        health = mux.handle_health_request(
-            request, healthy=self.health_check() if self.health_check else True
-        )
+        health = mux.handle_health_request(request, self.health_check)
         if health is not None:
             return health
         if isinstance(request, ServerLiveRequest):

@@ -143,14 +143,21 @@ class MuxServer:
 
     async def _handle_http(self, peek: bytes, reader, writer):
         try:
-            line = peek + await asyncio.wait_for(reader.readline(), 10)
-            parts = line.decode("latin1").split()
-            path = parts[1] if len(parts) > 1 else "/"
-            # drain headers
-            while True:
-                header = await asyncio.wait_for(reader.readline(), 10)
-                if header in (b"\r\n", b"\n", b""):
-                    break
+            try:
+                # readline converts LimitOverrunError into ValueError for
+                # over-long request lines/headers — drop those quietly
+                # WITHOUT catching ValueError around the handler bodies
+                # below (a real bug in expose() must stay loud)
+                line = peek + await asyncio.wait_for(reader.readline(), 10)
+                parts = line.decode("latin1").split()
+                path = parts[1] if len(parts) > 1 else "/"
+                # drain headers
+                while True:
+                    header = await asyncio.wait_for(reader.readline(), 10)
+                    if header in (b"\r\n", b"\n", b""):
+                        break
+            except ValueError:
+                return
             path = path.partition("?")[0].rstrip("/") or "/"
             if path == "/healthz":
                 ok = self._healthy()
@@ -166,17 +173,20 @@ class MuxServer:
             )
             await writer.drain()
         except (ConnectionError, asyncio.TimeoutError, UnicodeDecodeError,
-                asyncio.LimitOverrunError, asyncio.IncompleteReadError):
+                asyncio.IncompleteReadError):
             pass
         finally:
             writer.close()
 
 
-def handle_health_request(request, healthy: bool = True):
+def handle_health_request(request, health_check=None):
     """Shared wire-side health answer — servers call this first in their
-    dispatch: returns a response for HealthCheckRequest, else None.
-    `healthy=False` answers NOT_SERVING (a server draining or with a
-    failed dependency must not tell its load balancer SERVING)."""
+    dispatch: returns a response for HealthCheckRequest, else None. The
+    optional `health_check` callable (the server's own) decides
+    SERVING/NOT_SERVING — a draining server must not tell its load
+    balancer SERVING. The null-check lives HERE so the four dispatch
+    sites can all pass `self.health_check` verbatim."""
     if isinstance(request, HealthCheckRequest):
+        healthy = True if health_check is None else bool(health_check())
         return HealthCheckResponse(status=SERVING if healthy else NOT_SERVING)
     return None

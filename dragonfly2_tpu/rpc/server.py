@@ -88,10 +88,7 @@ class SchedulerRPCServer:
                 if request is None:
                     return
                 self._m_requests.labels(type(request).__name__).inc()
-                health = mux.handle_health_request(
-                    request,
-                    healthy=self.health_check() if self.health_check else True,
-                )
+                health = mux.handle_health_request(request, self.health_check)
                 if health is not None:
                     wire.write_frame(writer, health)
                     await writer.drain()
@@ -371,8 +368,10 @@ class TrainerRPCServer:
     the event loop, errors clear only that host's partial files, and the
     single TrainResponse reports the outcome."""
 
-    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
+                 health_check=None):
         self.service = service  # TrainerService (cluster/trainer_service.py)
+        self.health_check = health_check
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
@@ -411,10 +410,7 @@ class TrainerRPCServer:
                     # connection tore (read_frame folds ConnectionError into
                     # None) — never train on a possibly-truncated dataset.
                     break
-                health = mux.handle_health_request(
-                    request,
-                    healthy=self.health_check() if getattr(self, "health_check", None) else True,
-                )
+                health = mux.handle_health_request(request, self.health_check)
                 if health is not None:
                     wire.write_frame(writer, health)
                     await writer.drain()
