@@ -205,3 +205,47 @@ def test_trainer_rpc_torn_connection_aborts(tmp_path):
     asyncio.run(run())
     assert not trainer.storage.list_downloads()
     assert not registry.list_models()
+
+
+def test_wire_decode_is_version_tolerant():
+    """Cross-version compatibility contract (the reference pins previous
+    released images against current code in compatibility-e2e): a peer
+    speaking an OLDER schema (fields missing) or a NEWER one (extra
+    fields) must still decode — missing fields take dataclass defaults,
+    unknown fields are ignored."""
+    import dataclasses
+
+    import msgpack
+
+    from dragonfly2_tpu.rpc import wire
+
+    @dataclasses.dataclass
+    class CompatProbe:
+        host_id: str
+        rtt_ms: float = 0.0
+        new_field: str = "default"
+
+    wire.register_messages(CompatProbe)
+
+    # older sender: new_field absent
+    old = msgpack.packb(
+        {"t": "CompatProbe", "d": {"host_id": "h1", "rtt_ms": 1.5}},
+        use_bin_type=True,
+    )
+    decoded = wire.decode(old)
+    assert decoded == CompatProbe(host_id="h1", rtt_ms=1.5, new_field="default")
+
+    # newer sender: unknown field present
+    new = msgpack.packb(
+        {"t": "CompatProbe",
+         "d": {"host_id": "h2", "rtt_ms": 2.0, "new_field": "x",
+               "field_from_the_future": [1, 2, 3]}},
+        use_bin_type=True,
+    )
+    decoded = wire.decode(new)
+    assert decoded == CompatProbe(host_id="h2", rtt_ms=2.0, new_field="x")
+
+    # a REQUIRED field missing is a hard error, not a silent default
+    broken = msgpack.packb({"t": "CompatProbe", "d": {"rtt_ms": 3.0}}, use_bin_type=True)
+    with pytest.raises(TypeError):
+        wire.decode(broken)
