@@ -20,10 +20,17 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import logging
 import os
 import contextlib
 import signal
 import sys
+
+
+def idgen_host_id(ip: str, hostname: str) -> str:
+    from dragonfly2_tpu.utils import idgen
+
+    return idgen.host_id_v2(ip, hostname)
 
 
 def _parse_addr(value: str) -> tuple[str, int]:
@@ -76,6 +83,14 @@ async def _serve_scheduler(args) -> int:
     service = SchedulerService(config=config, storage=storage, probes=probes)
     server = SchedulerRPCServer(service, host=args.host, port=args.port)
     host, port = await server.start()
+    import socket
+
+    hostname = socket.gethostname()
+    # ONE identity everywhere: the id the announce loop streams under is
+    # the id the trainer publishes models under, which must be the id the
+    # serving side looks up — two different defaults would mean training
+    # succeeds but the inference endpoint never finds an active version.
+    sched_host_id = args.scheduler_host_id or idgen_host_id(host, hostname)
     infer_server = None
     if args.registry_dir:
         # Serve the registry's trained models over the KServe-v2-shaped
@@ -93,7 +108,6 @@ async def _serve_scheduler(args) -> int:
         from dragonfly2_tpu.rpc.inference import InferenceRPCServer
 
         registry = ModelRegistry(args.registry_dir)
-        sched_host_id = args.scheduler_host_id or f"{host}:{port}"
         servers = {
             name: ModelServer(registry, name, sched_host_id, mtype, template_params=None)
             for name, mtype in (
@@ -104,6 +118,78 @@ async def _serve_scheduler(args) -> int:
         }
         infer_server = InferenceRPCServer(servers, host=args.host, port=args.infer_port)
         await infer_server.start()
+    bg_tasks: list[asyncio.Task] = []
+    if args.manager:
+        # register with the manager + keepalive until shutdown (the
+        # scheduler bootstrap's manager edge, scheduler.go:110-299 +
+        # manager keepalive active/inactive flips). Connection handling
+        # lives INSIDE the loop: the manager may not be up yet at our
+        # startup, and may restart later — both must re-register, not
+        # crash or go silently inactive forever.
+        from dragonfly2_tpu.manager.rpc import (
+            KeepAliveRequest, ManagerClient, RegisterInstanceRequest,
+        )
+
+        mh, mp = _parse_addr(args.manager)
+
+        async def manager_loop():
+            log = logging.getLogger(__name__)
+            client = None
+            while True:
+                try:
+                    if client is None:
+                        client = await ManagerClient(mh, mp).connect()
+                        await client.call(RegisterInstanceRequest(
+                            source_type="scheduler", host_name=hostname,
+                            ip=host, port=port, cluster_id=args.cluster_id,
+                        ))
+                    response = await client.call(KeepAliveRequest(
+                        source_type="scheduler", host_name=hostname,
+                        ip=host, cluster_id=args.cluster_id,
+                    ))
+                    if response is None:  # EOF: manager went away
+                        raise ConnectionError("manager closed the connection")
+                except (ConnectionError, RuntimeError, OSError) as e:
+                    log.warning("manager keepalive/registration failed: %s", e)
+                    if client is not None:
+                        await client.close()
+                        client = None
+                await asyncio.sleep(args.keepalive_interval)
+
+        bg_tasks.append(asyncio.create_task(manager_loop()))
+    if args.trainer and storage is not None:
+        # periodic dataset upload to the trainer (announcer.go:127-235;
+        # default cadence is the reference's 7 days). Rotation files are
+        # streamed one at a time — concatenating every backup into one
+        # bytes object would spike RSS by the full trace history (up to
+        # max_size*max_backups per dataset) on every cadence.
+        from dragonfly2_tpu.rpc.client import TrainerClient
+
+        th, tp = _parse_addr(args.trainer)
+
+        async def announce_loop():
+            log = logging.getLogger(__name__)
+            client = TrainerClient(th, tp)
+            while True:
+                await asyncio.sleep(args.announce_interval)
+                try:
+                    storage.flush()
+                    datasets = {}
+                    for name, store in (("download", storage.downloads),
+                                        ("networktopology", storage.topologies)):
+                        paths = store.all_paths()
+                        if paths:
+                            datasets[name] = (p.read_bytes() for p in paths)
+                    if not datasets:
+                        continue
+                    response = await client.train(sched_host_id, host, hostname, datasets)
+                    if not response.ok:
+                        log.warning("trainer upload rejected: %s", response.description)
+                except Exception as e:  # noqa: BLE001 - next interval retries
+                    log.warning("trainer upload failed: %s", e)
+
+        bg_tasks.append(asyncio.create_task(announce_loop()))
+
     ready = f"READY {host} {port}"
     if infer_server is not None:
         ready += f" INFER {infer_server.host} {infer_server.port}"
@@ -111,6 +197,9 @@ async def _serve_scheduler(args) -> int:
         async with _monitored(args, ready) as line:
             await _run_until_signalled(line)
     finally:
+        for task in bg_tasks:
+            task.cancel()
+        await asyncio.gather(*bg_tasks, return_exceptions=True)
         if storage is not None:
             storage.close()  # flush buffered trace rows FIRST — an RPC
             # stop() that raises must not take the buffered rows with it
@@ -151,14 +240,19 @@ async def _serve_manager(args) -> int:
     from dragonfly2_tpu.manager.service import ManagerService
     from dragonfly2_tpu.registry import ModelRegistry
 
+    from dragonfly2_tpu.manager.rpc import ManagerRPCServer
+
     registry = ModelRegistry(args.registry_dir) if args.registry_dir else None
     service = ManagerService(db=Database(args.db), registry=registry)
     rest = ManagerREST(service, host=args.host, port=args.port)
     host, port = rest.start()
+    rpc = ManagerRPCServer(service, host=args.host, port=args.rpc_port)
+    rpc_host, rpc_port = await rpc.start()
     try:
-        async with _monitored(args, f"READY {host} {port}") as line:
+        async with _monitored(args, f"READY {host} {port} RPC {rpc_port}") as line:
             await _run_until_signalled(line)
     finally:
+        await rpc.stop()
         rest.stop()
     return 0
 
@@ -256,6 +350,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "(default host:port)")
     s.add_argument("--metrics-port", type=int, default=None,
                    help="observability HTTP: /metrics /debug/stacks /debug/profile")
+    s.add_argument("--manager", default="",
+                   help="manager RPC host:port; registers + keepalives when set")
+    s.add_argument("--cluster-id", type=int, default=1)
+    s.add_argument("--keepalive-interval", type=float, default=5.0)
+    s.add_argument("--trainer", default="",
+                   help="trainer host:port; streams trace datasets on the cadence")
+    s.add_argument("--announce-interval", type=float, default=7 * 24 * 3600.0,
+                   help="seconds between trainer uploads (reference default 7d)")
 
     t = sub.add_parser("trainer", help="model training service")
     t.add_argument("--host", default="127.0.0.1")
@@ -271,6 +373,7 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--port", type=int, default=0)
     m.add_argument("--db", default=":memory:", help="sqlite path")
     m.add_argument("--registry-dir", default=None)
+    m.add_argument("--rpc-port", type=int, default=0)
     m.add_argument("--metrics-port", type=int, default=None)
 
     d = sub.add_parser("dfdaemon", help="peer data-plane daemon")

@@ -168,20 +168,34 @@ class TrainerClient:
         self.port = port
 
     async def train(
-        self, host_id: str, ip: str, hostname: str, datasets: dict[str, bytes],
+        self, host_id: str, ip: str, hostname: str, datasets: dict,
         chunk_size: int = 128 << 20,
     ) -> msg.TrainResponse:
+        """`datasets` maps name -> bytes OR an iterable of bytes parts
+        (e.g. one per CSV rotation file), so callers can stream a large
+        trace history without materializing it all at once."""
         reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
             try:
-                for dataset, blob in datasets.items():
-                    for off in range(0, max(len(blob), 1), chunk_size):
+                for dataset, value in datasets.items():
+                    parts = [value] if isinstance(value, (bytes, bytearray)) else value
+                    sent_any = False
+                    for blob in parts:
+                        for off in range(0, max(len(blob), 1), chunk_size):
+                            wire.write_frame(
+                                writer,
+                                msg.TrainRequest(
+                                    host_id=host_id, ip=ip, hostname=hostname,
+                                    dataset=dataset, chunk=blob[off : off + chunk_size],
+                                ),
+                            )
+                            await writer.drain()
+                            sent_any = True
+                    if not sent_any:
                         wire.write_frame(
                             writer,
-                            msg.TrainRequest(
-                                host_id=host_id, ip=ip, hostname=hostname,
-                                dataset=dataset, chunk=blob[off : off + chunk_size],
-                            ),
+                            msg.TrainRequest(host_id=host_id, ip=ip, hostname=hostname,
+                                             dataset=dataset, chunk=b""),
                         )
                         await writer.drain()
                 # explicit commit marker: bare EOF means "torn", not "done"

@@ -322,3 +322,110 @@ def test_dfdaemon_proxy_listeners(tmp_path):
         _stop(daemon)
         _stop(sched)
         origin.close()
+
+
+@pytest.mark.slow
+def test_full_system_loops_through_launchers(tmp_path):
+    """The whole control loop with ONLY launcher wiring: manager (REST +
+    RPC) + trainer + scheduler (--manager keepalive, --trainer announce
+    cadence) + daemons downloading. Without any manual streaming, traces
+    must flow scheduler -> trainer on the cadence, models must appear in
+    the registry, and the manager must list the scheduler."""
+    import json
+    import time
+    import urllib.request
+
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.registry import ModelRegistry
+
+    origin = _Origin(os.urandom(1 << 20))
+    manager, m_host, m_port = _spawn(
+        ["manager", "--db", str(tmp_path / "m.db")], tmp_path
+    )
+    m_rpc_port = int(manager.ready_line.split()[manager.ready_line.split().index("RPC") + 1])
+    trainer, t_host, t_port = _spawn(
+        ["trainer", "--data-dir", str(tmp_path / "t-data"),
+         "--registry-dir", str(tmp_path / "registry"), "--epochs", "2"],
+        tmp_path,
+    )
+    sched, s_host, s_port = _spawn(
+        ["scheduler", "--data-dir", str(tmp_path / "s-data"),
+         "--manager", f"{m_host}:{m_rpc_port}", "--keepalive-interval", "0.5",
+         "--trainer", f"{t_host}:{t_port}", "--announce-interval", "3",
+         # NO --scheduler-host-id: the announce-side and serving-side
+         # defaults must agree, or trained models are never servable
+         "--registry-dir", str(tmp_path / "registry")],
+        tmp_path,
+    )
+    try:
+        async def downloads():
+            d1 = Daemon(tmp_path / "p1", [(s_host, s_port)], hostname="loop-1")
+            d2 = Daemon(tmp_path / "p2", [(s_host, s_port)], hostname="loop-2")
+            await d1.start(); await d2.start()
+            url = f"http://127.0.0.1:{origin.port}/blob.bin"
+            await d1.download(url, piece_length=256 * 1024)
+            await d2.download(url, piece_length=256 * 1024, back_source_allowed=False)
+            await d1.stop(); await d2.stop()
+
+        asyncio.run(downloads())
+
+        # announce cadence fires on its own; registry fills with models
+        # (no probe loop in this rig -> no networktopology dataset -> the
+        # MLP regressor has nothing to train on; the GNN ranker trains
+        # from the download traces alone)
+        registry = ModelRegistry(tmp_path / "registry")
+        deadline = time.monotonic() + 60
+        models = []
+        while time.monotonic() < deadline:
+            models = registry.list_models()
+            if any(m["type"] == "gnn" for m in models):
+                break
+            time.sleep(1)
+        assert any(m["type"] == "gnn" for m in models), (
+            f"registry after cadence: {[m['type'] for m in models]}"
+        )
+
+        # ...and the scheduler's own inference endpoint serves it under
+        # the DEFAULT identity (train->publish->auto-activate->serve with
+        # no ids configured anywhere)
+        from dragonfly2_tpu.cluster.trainer_service import GNN_MODEL_NAME
+        from dragonfly2_tpu.rpc.inference import InferenceClient
+
+        parts = sched.ready_line.split()
+        ih = parts[parts.index("INFER") + 1]
+        ip_ = int(parts[parts.index("INFER") + 2])
+
+        async def wait_ready():
+            client = await InferenceClient(ih, ip_).connect()
+            try:
+                for _ in range(30):
+                    if await client.model_ready(GNN_MODEL_NAME):
+                        return True
+                    await asyncio.sleep(1)
+                return False
+            finally:
+                await client.close()
+
+        assert asyncio.run(wait_ready()), "trained model never became servable"
+
+        # the manager saw registration + keepalives: scheduler listed active
+        signin = urllib.request.Request(
+            f"http://{m_host}:{m_port}/api/v1/users/signin",
+            data=json.dumps({"name": "root", "password": "dragonfly"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(signin, timeout=5) as resp:
+            token = json.loads(resp.read())["token"]
+        req = urllib.request.Request(
+            f"http://{m_host}:{m_port}/api/v1/schedulers",
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            rows = json.loads(resp.read())
+        assert rows, "scheduler never registered with the manager"
+        assert any(r.get("state") == "active" for r in rows), rows
+    finally:
+        _stop(sched)
+        _stop(trainer)
+        _stop(manager)
+        origin.close()
