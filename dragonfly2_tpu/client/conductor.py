@@ -25,6 +25,8 @@ from dragonfly2_tpu.client.piece_manager import PieceManager
 from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata, TaskStorage
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc.client import SchedulerConnection
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import daemon_series
 from dragonfly2_tpu.utils import dferrors
 
 logger = logging.getLogger(__name__)
@@ -62,6 +64,7 @@ class PeerTaskConductor:
         # x-df-* object-store credentials, etc.
         self.headers = dict(headers) if headers else None
         self.piece_manager = PieceManager()
+        self.metrics = daemon_series(default_registry())
         self.dispatcher = PieceDispatcher()
         self._parents: dict[str, msg.CandidateParent] = {}
         self._parent_pieces: dict[str, dict] = {}  # parent peer_id -> /pieces doc
@@ -252,6 +255,7 @@ class PeerTaskConductor:
             except dferrors.DFError as e:
                 self._inflight.discard(number)
                 self._failed_parents.add(parent_id)
+                self.metrics.piece_task_failed.labels().inc()
                 logger.info("piece %d from %s failed: %s", number, parent_id, e)
                 await self.conn.send(
                     msg.DownloadPieceFailedRequest(
@@ -262,6 +266,7 @@ class PeerTaskConductor:
             cost = time.perf_counter_ns() - t0
             self._inflight.discard(number)
             self._needed.discard(number)
+            self.metrics.piece_task.labels().inc()
             self.dispatcher.report_cost(parent_id, cost)
             if self.shaper is not None:
                 self.shaper.record(self.task_id, nbytes)
@@ -284,6 +289,7 @@ class PeerTaskConductor:
         loop = asyncio.get_running_loop()
 
         def on_piece(number: int, length: int, cost_ns: int) -> None:
+            self.metrics.piece_task.labels().inc()
             asyncio.run_coroutine_threadsafe(
                 self.conn.send(
                     msg.DownloadPieceFinishedRequest(

@@ -22,7 +22,9 @@ from dragonfly2_tpu.client.storage import StorageManager, TaskStorage
 from dragonfly2_tpu.client.upload import UploadServer
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc.client import SchedulerClientPool
-from dragonfly2_tpu.utils import idgen
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import daemon_series, register_version
+from dragonfly2_tpu.utils import hoststat, idgen
 from dragonfly2_tpu.utils.gc import GC, Task as GCTask
 
 logger = logging.getLogger(__name__)
@@ -56,6 +58,10 @@ class Daemon:
         self.host_type = host_type
         self.idc = idc
         self.location = location
+        self.data_dir = pathlib.Path(data_dir)
+        reg = default_registry()
+        self.metrics = daemon_series(reg)
+        register_version(reg, "dfdaemon")
         self.storage = StorageManager(data_dir)
         self.upload = UploadServer(self.storage, host=ip)
         self.pool = SchedulerClientPool(scheduler_addresses)
@@ -111,6 +117,10 @@ class Daemon:
     # ------------------------------------------------------------ lifecycle
 
     def host_info(self) -> msg.HostInfo:
+        # Live resource sample on every announce (announcer.go:186-252):
+        # these become the host feature columns of the scheduler's
+        # training traces, so they must be real numbers, not defaults.
+        stats = hoststat.collect(str(self.data_dir), upload_port=self.upload.port)
         return msg.HostInfo(
             host_id=self.host_id,
             hostname=self.hostname,
@@ -120,6 +130,11 @@ class Daemon:
             location=self.location,
             port=self.upload.port,
             download_port=self.upload.port,
+            cpu=stats.cpu,
+            memory=stats.memory,
+            disk=stats.disk,
+            tcp_connection_count=stats.tcp_connection_count,
+            upload_tcp_connection_count=stats.upload_tcp_connection_count,
         )
 
     async def start(self) -> None:
@@ -202,9 +217,12 @@ class Daemon:
             )
         existing = self.storage.find_completed_task(task_id)
         if existing is not None:
+            self.metrics.peer_task_cache_hit.labels().inc()
             return existing
         running = self._running.get(task_id)
         if running is None:
+            self.metrics.peer_task.labels().inc()
+            self.metrics.file_task.labels().inc()
             running = asyncio.create_task(
                 self._run_conductor(
                     task_id, url, piece_length, workers, back_source_allowed,
@@ -212,7 +230,16 @@ class Daemon:
                 )
             )
             self._running[task_id] = running
-            running.add_done_callback(lambda _: self._running.pop(task_id, None))
+
+            def _on_done(t: asyncio.Task) -> None:
+                self._running.pop(task_id, None)
+                # counted here, once per task — not per awaiting caller
+                if not t.cancelled() and t.exception() is not None:
+                    self.metrics.peer_task_failed.labels(
+                        type(t.exception()).__name__
+                    ).inc()
+
+            running.add_done_callback(_on_done)
         return await asyncio.shield(running)
 
     async def _run_conductor(
@@ -263,11 +290,13 @@ class Daemon:
             task.add_done_callback(self._seed_downloads.discard)
 
     async def _obtain_seed(self, trigger) -> None:
+        self.metrics.seed_peer_download.labels().inc()
+        already_held = self.storage.find_completed_task(trigger.task_id) is not None
         try:
             # the trigger's task id is authoritative: the requesting peer
             # may have derived it with filtered query params the raw URL
             # alone would not reproduce
-            await self.download(
+            ts = await self.download(
                 trigger.url,
                 tag=trigger.tag,
                 application=trigger.application,
@@ -275,9 +304,15 @@ class Daemon:
                 back_source_allowed=True,
                 schedule_timeout=0.5,  # seeds go straight to origin
                 task_id=trigger.task_id,
+                headers=getattr(trigger, "headers", None) or None,
             )
+            if not already_held:  # cache hits moved zero bytes
+                self.metrics.seed_peer_download_traffic.labels("back_to_source").inc(
+                    max(ts.meta.content_length, 0)
+                )
             logger.info("seeded task %s from %s", trigger.task_id, trigger.url)
         except Exception:  # noqa: BLE001 - a failed seed must not kill the loop
+            self.metrics.seed_peer_download_failure.labels().inc()
             logger.exception("seed download failed for %s", trigger.url)
 
     # -------------------------------------------------------------- probes

@@ -48,6 +48,40 @@ def _header(headers: dict | None, name: str) -> str | None:
     return lowered.get(name.lower())
 
 
+def fetch_bearer_token(
+    challenge: str, basic_auth: str | None = None, timeout: float = 30.0
+) -> str | None:
+    """Resolve a registry `WWW-Authenticate: Bearer ...` challenge into a
+    token: parse realm/service/scope, hit the token endpoint (with HTTP
+    Basic when `basic_auth` is "user:pass" material), return the token.
+
+    Shared by OrasSource's artifact pulls and the manager's image-preheat
+    manifest walk (oras_source_client.go:104 / manager/job/preheat.go
+    imageAuthClient) — both speak the same token-challenge protocol."""
+    if not challenge.lower().startswith("bearer"):
+        return None
+    fields = {}
+    for item in challenge[len("bearer"):].split(","):
+        k, _, v = item.strip().partition("=")
+        fields[k.lower()] = v.strip('"')
+    realm = fields.get("realm")
+    if not realm:
+        return None
+    query = {k: fields[k] for k in ("service", "scope") if k in fields}
+    token_url = realm + ("?" + urllib.parse.urlencode(query) if query else "")
+    req = urllib.request.Request(token_url)
+    if basic_auth:
+        req.add_header(
+            "Authorization", "Basic " + base64.b64encode(basic_auth.encode()).decode()
+        )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+        return body.get("token") or body.get("access_token")
+    except (urllib.error.URLError, ValueError):
+        return None
+
+
 # ----------------------------------------------------------- s3/oss/obs
 
 
@@ -288,27 +322,8 @@ class OrasSource:
             return self._get(url, hdrs), token
 
     def _fetch_token(self, challenge: str, headers: dict | None) -> str | None:
-        if not challenge.lower().startswith("bearer"):
-            return None
-        fields = {}
-        for item in challenge[len("bearer"):].split(","):
-            k, _, v = item.strip().partition("=")
-            fields[k.lower()] = v.strip('"')
-        realm = fields.get("realm")
-        if not realm:
-            return None
-        query = {k: fields[k] for k in ("service", "scope") if k in fields}
-        token_url = realm + ("?" + urllib.parse.urlencode(query) if query else "")
-        req = urllib.request.Request(token_url)
         basic = _header(headers, "x-df-oras-auth")  # "user:pass" for login
-        if basic:
-            req.add_header("Authorization", "Basic " + base64.b64encode(basic.encode()).decode())
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                body = json.loads(resp.read())
-            return body.get("token") or body.get("access_token")
-        except (urllib.error.URLError, ValueError):
-            return None
+        return fetch_bearer_token(challenge, basic_auth=basic, timeout=self.timeout)
 
     def _resolve_blob(
         self, url: str, headers: dict | None

@@ -17,6 +17,8 @@ import base64
 import logging
 
 from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import daemon_series
 from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 logger = logging.getLogger(__name__)
@@ -74,6 +76,7 @@ class ProxyServer:
         self._server: asyncio.AbstractServer | None = None
         self._tracker = ConnTracker()
         self.stats = {"p2p": 0, "direct": 0, "tunnel": 0, "denied": 0}
+        self.metrics = daemon_series(default_registry())
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -96,6 +99,7 @@ class ProxyServer:
             if not request_line:
                 return
             method, target, _ = request_line.split(" ", 2)
+            self.metrics.proxy_request.labels(method).inc()
             headers = {}
             while True:
                 line = (await reader.readline()).decode("latin1").strip()
@@ -151,6 +155,10 @@ class ProxyServer:
                 await self._respond(writer, 502, str(e).encode())
                 return
             self.stats[result.via] += 1
+            if result.via == "p2p":
+                self.metrics.proxy_request_via.labels().inc()
+            else:
+                self.metrics.proxy_request_not_via.labels().inc()
             extra = f"X-Dragonfly-Via: {result.via}\r\n"
             if result.content_range:
                 extra += f"Content-Range: {result.content_range}\r\n"

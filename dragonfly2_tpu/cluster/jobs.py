@@ -18,6 +18,7 @@ import enum
 import itertools
 import uuid
 
+from dragonfly2_tpu.cluster import image_preheat
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.cluster.scheduler import SchedulerService
 from dragonfly2_tpu.utils.hashring import HashRing
@@ -37,6 +38,16 @@ class PreheatRequest:
     application: str = ""
     piece_length: int = 4 << 20
     filtered_query_params: list[str] | None = None
+    # "file" fans the raw URLs out as-is; "image" resolves each URL as an
+    # OCI image reference (registry manifest walk -> config+layer blob
+    # URLs, manager/job/preheat.go:90-168) and preheats every blob. An
+    # empty type sniffs: URLs matching .../v2/<repo>/manifests/<tag> are
+    # treated as images.
+    preheat_type: str = ""
+    username: str = ""
+    password: str = ""
+    platform: str = ""
+    headers: dict | None = None
 
 
 @dataclasses.dataclass
@@ -68,7 +79,30 @@ class JobManager:
         job_id = str(uuid.uuid4())
         task_ids = []
         failures = {}
+        # Resolve the work list first: file preheats are the raw URLs;
+        # image preheats walk the registry manifest into blob URLs
+        # (preheat.go:99-117 CreatePreheat type dispatch).
+        files: list[tuple[str, dict | None]] = []  # (url, headers)
         for url in req.urls:
+            as_image = req.preheat_type == "image" or (
+                not req.preheat_type and image_preheat.is_image_url(url)
+            )
+            if not as_image:
+                files.append((url, req.headers))
+                continue
+            try:
+                layers = image_preheat.resolve_image_layers(
+                    url,
+                    username=req.username,
+                    password=req.password,
+                    platform=req.platform,
+                    headers=req.headers,
+                )
+            except Exception as e:  # noqa: BLE001 - fail THIS url, not the job run
+                failures[url] = f"image resolve failed: {e}"
+                continue
+            files.extend((layer.url, layer.headers) for layer in layers)
+        for url, headers in files:
             # v1 derivation, matching the daemons' dfget path
             # (client/daemon.py download -> idgen.task_id_v1): a preheat
             # that hashes differently from the peers seeds a task nobody
@@ -99,6 +133,7 @@ class JobManager:
                 tag=req.tag,
                 application=req.application,
                 host_id=seed.host_id,
+                headers=headers,
             )
             if not ok:
                 failures[task_id] = "seed trigger queue full"

@@ -15,6 +15,8 @@ from __future__ import annotations
 import dataclasses
 import enum
 
+from dragonfly2_tpu.records.schema import CPUStat, DiskStat, MemoryStat
+
 
 class SizeScope(enum.IntEnum):
     """Task size classes driving the register fast paths
@@ -49,6 +51,14 @@ class HostInfo:
     concurrent_upload_limit: int = 50
     upload_count: int = 0
     upload_failed_count: int = 0
+    # Live resource stats sampled by the daemon at announce time
+    # (announcer.go:186-252 gopsutil) — the host feature columns of the
+    # training CSV; location/idc already ride the fields above.
+    cpu: CPUStat = dataclasses.field(default_factory=CPUStat)
+    memory: MemoryStat = dataclasses.field(default_factory=MemoryStat)
+    disk: DiskStat = dataclasses.field(default_factory=DiskStat)
+    tcp_connection_count: int = 0
+    upload_tcp_connection_count: int = 0
 
 
 @dataclasses.dataclass
@@ -222,6 +232,9 @@ class TriggerSeedRequest:
     piece_length: int = 4 << 20
     tag: str = ""
     application: str = ""
+    # auth/extra headers for the back-source fetch (image preheat carries
+    # the registry bearer token here, manager/job/preheat.go:297-311)
+    headers: dict = dataclasses.field(default_factory=dict)
 
 
 # ----------------------------------------------------------------- stat

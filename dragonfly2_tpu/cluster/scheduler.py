@@ -69,6 +69,7 @@ class _PeerMeta:
     host_id: str
     tag: str = ""
     application: str = ""
+    registered_at: float = dataclasses.field(default_factory=time.monotonic)
     dag_slot: int = -1
     parents: dict[str, dict] = dataclasses.field(default_factory=dict)  # parent peer_id -> stats
     held_parents: set[str] = dataclasses.field(default_factory=set)  # upload slots held
@@ -155,18 +156,7 @@ class SchedulerService:
         self._host_info[host.host_id] = host
         if host.host_type != "normal" and host.host_id not in self._seed_hosts:
             self._seed_hosts.append(host.host_id)
-        rec = HostRecord(
-            id=host.host_id,
-            type=host.host_type,
-            hostname=host.hostname,
-            ip=host.ip,
-            port=host.port,
-            download_port=host.download_port,
-            concurrent_upload_limit=host.concurrent_upload_limit,
-            upload_count=host.upload_count,
-            upload_failed_count=host.upload_failed_count,
-            network=NetworkStat(location=host.location, idc=host.idc),
-        )
+        rec = self._host_record(host)
         return self.state.upsert_host(
             host.host_id,
             id_hash=stable_hash64(host.host_id),
@@ -389,6 +379,7 @@ class SchedulerService:
     def trigger_seed_download(
         self, task_id: str, url: str, piece_length: int = 4 << 20,
         tag: str = "", application: str = "", host_id: str = "",
+        headers: dict | None = None,
     ) -> bool:
         """Enqueue a seed-peer download trigger directly (the preheat job
         edge: manager/job/preheat.go fans TriggerDownloadTask out to seed
@@ -416,6 +407,7 @@ class SchedulerService:
                     piece_length=piece_length,
                     tag=tag,
                     application=application,
+                    headers=dict(headers or {}),
                 )
             )
             return True
@@ -502,7 +494,7 @@ class SchedulerService:
         # biggest chunk at the BASELINE eval shape (1024 tasks/call).
         # Padding rows are valid=False everywhere and fall out of selection.
         limit = self.config.scheduler.candidate_parent_limit
-        sel_parts, val_parts, score_parts = [], [], []
+        packed_parts = []
         for s in range(0, b, _EVAL_BUCKETS[-1]):
             e = min(s + _EVAL_BUCKETS[-1], b)
             bsz = _bucket_rows(e - s)
@@ -511,7 +503,7 @@ class SchedulerService:
             ind = _pad_rows(in_degree[s:e], bsz)
             cae = _pad_rows(can_add_edge[s:e], bsz)
             if self.ml_evaluator is not None and self.algorithm == "ml":
-                out = self.ml_evaluator.schedule(
+                packed = self.ml_evaluator.schedule_packed(
                     fd_c,
                     _pad_rows(child_host_slots[s:e], bsz),
                     _pad_rows(cand_host_slots[s:e], bsz),
@@ -519,27 +511,22 @@ class SchedulerService:
                 )
             elif self.plugin_evaluator is not None:
                 scores = np.asarray(self.plugin_evaluator.evaluate(fd_c), np.float32)
-                out = ev.select_with_scores(
+                packed = ev.select_with_scores_packed(
                     fd_c, scores, bl, ind, cae, limit=limit
                 )
             else:
                 algorithm = self.algorithm if self.algorithm in ("default", "nt") else "default"
-                out = ev.schedule_candidate_parents(
+                packed = ev.schedule_candidate_parents_packed(
                     fd_c, bl, ind, cae, algorithm=algorithm, limit=limit
                 )
-            # One round trip, not three: start async D2H copies for every
-            # output before the first blocking read — over a tunneled device
-            # each blocking np.asarray pays the full link RTT serially.
-            for key in ("selected", "selected_valid", "selected_scores"):
-                arr = out[key]
-                if hasattr(arr, "copy_to_host_async"):
-                    arr.copy_to_host_async()
-            sel_parts.append(np.asarray(out["selected"])[: e - s])
-            val_parts.append(np.asarray(out["selected_valid"])[: e - s])
-            score_parts.append(np.asarray(out["selected_scores"])[: e - s])
-        selected = np.concatenate(sel_parts)
-        selected_valid = np.concatenate(val_parts)
-        selected_scores = np.concatenate(score_parts)
+            # The packed (B, limit, 2) selection is the jit's ONLY output, so
+            # the tick pays exactly one D2H transfer per chunk — a blocking
+            # host read costs a full link round-trip on a tunneled device,
+            # and the old three-array output paid it three times.
+            packed_parts.append(np.asarray(packed)[: e - s])
+        selected, selected_valid, selected_scores = ev.unpack_selection(
+            np.concatenate(packed_parts)
+        )
 
         for i, pending in enumerate(work):
             meta = self._peer_meta[pending.peer_id]
@@ -708,7 +695,15 @@ class SchedulerService:
             concurrent_upload_limit=host.concurrent_upload_limit,
             upload_count=host.upload_count,
             upload_failed_count=host.upload_failed_count,
-            network=NetworkStat(location=host.location, idc=host.idc),
+            cpu=host.cpu,
+            memory=host.memory,
+            disk=host.disk,
+            network=NetworkStat(
+                tcp_connection_count=host.tcp_connection_count,
+                upload_tcp_connection_count=host.upload_tcp_connection_count,
+                location=host.location,
+                idc=host.idc,
+            ),
         )
 
     def _task_dag(self, task_id: str) -> TaskDAG:

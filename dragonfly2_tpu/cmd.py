@@ -91,6 +91,9 @@ async def _serve_scheduler(args) -> int:
     # serving side looks up — two different defaults would mean training
     # succeeds but the inference endpoint never finds an active version.
     sched_host_id = args.scheduler_host_id or idgen_host_id(host, hostname)
+    logging.getLogger("dragonfly2.cmd").info(
+        "scheduler registry host id: %s", sched_host_id
+    )
     infer_server = None
     if args.registry_dir:
         # Serve the registry's trained models over the KServe-v2-shaped
@@ -332,6 +335,17 @@ async def _serve_dfdaemon(args) -> int:
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dragonfly2-tpu", description=__doc__)
+    from dragonfly2_tpu import version as _version
+
+    p.add_argument(
+        "--version",
+        action="version",
+        version=(
+            f"dragonfly2-tpu {_version.GIT_VERSION} "
+            f"(commit {_version.GIT_COMMIT}, {_version.BUILD_PLATFORM})"
+        ),
+        help="print build metadata and exit (version/version.go)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("scheduler", help="peer-scheduling control plane")
@@ -347,7 +361,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--infer-port", type=int, default=0)
     s.add_argument("--scheduler-host-id", default=None,
                    help="registry host id the trainer published under "
-                   "(default host:port)")
+                   "(default: host-id-v2 of this scheduler's ip+hostname, "
+                   "utils/idgen.host_id_v2 — printed at startup)")
     s.add_argument("--metrics-port", type=int, default=None,
                    help="observability HTTP: /metrics /debug/stacks /debug/profile")
     s.add_argument("--manager", default="",

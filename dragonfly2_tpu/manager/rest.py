@@ -20,6 +20,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from dragonfly2_tpu.manager import auth
 from dragonfly2_tpu.manager.models import DuplicateRecord, RecordNotFound
 from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import manager_series, register_version
 
 # Route-group -> Database table for the plain CRUD entities.
 CRUD_TABLES = {
@@ -59,6 +61,9 @@ class _Request:
 class ManagerREST:
     def __init__(self, service: ManagerService, host: str = "127.0.0.1", port: int = 0):
         self.service = service
+        reg = default_registry()
+        self.metrics = manager_series(reg)
+        register_version(reg, "manager")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,6 +85,13 @@ class ManagerREST:
                     status, payload = 400, {"error": str(e)}
                 except Exception as e:  # noqa: BLE001 - surface as 500
                     status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                # totals and failures derive the group label the same way,
+                # so failure/total ratios are well-formed per label set
+                gm = re.match(r"^/(?:api|oapi)/v1/([-a-z_]+)", self.path)
+                group = gm.group(1) if gm else ""
+                outer.metrics.request.labels(self.command, group).inc()
+                if status >= 400:
+                    outer.metrics.request_failure.labels(self.command, group).inc()
                 raw = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

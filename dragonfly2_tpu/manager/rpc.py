@@ -18,6 +18,7 @@ import dataclasses
 import logging
 
 from dragonfly2_tpu.rpc import mux, wire
+from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 logger = logging.getLogger(__name__)
 
@@ -130,9 +131,12 @@ class ManagerRPCServer:
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
+        self._tracker = ConnTracker()
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._serve_conn), self.host, self.port
+        )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
         logger.info("manager rpc listening on %s:%d", self.host, self.port)
@@ -141,6 +145,10 @@ class ManagerRPCServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # Cancel in-flight handlers first: keepalive clients hold their
+            # connection open forever, and 3.12's wait_closed() waits for
+            # every live handler (utils/conntrack.py).
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
 
     async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):

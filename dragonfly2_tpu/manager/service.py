@@ -19,6 +19,8 @@ import time
 from dragonfly2_tpu.manager import auth
 from dragonfly2_tpu.manager.models import Database, DuplicateRecord, RecordNotFound
 from dragonfly2_tpu.manager.searcher import Searcher, new_searcher
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import manager_series
 
 # scheduler/seed-peer service states (manager/models/{scheduler,seed_peer}.go)
 STATE_ACTIVE = "active"
@@ -43,6 +45,7 @@ class ManagerService:
         self.tokens = token_authority or auth.TokenAuthority()
         self.enforcer = auth.Enforcer(self.db)
         self.searcher = searcher or new_searcher(plugin_dir)
+        self.metrics = manager_series(default_registry())
         self.enforcer.init_policies()
         self._ensure_root_user()
 
@@ -208,9 +211,11 @@ class ManagerService:
                 {"scheduler_cluster_id": sc["id"], "state": STATE_ACTIVE},
             )
             clusters.append({**sc, "schedulers": active})
+        self.metrics.search_scheduler_cluster.labels().inc()
         try:
             ranked = self.searcher.find_scheduler_clusters(clusters, ip, hostname, conditions)
         except ValueError:
+            self.metrics.search_scheduler_cluster_failure.labels().inc()
             return []
         return [s for cluster in ranked for s in cluster["schedulers"]]
 
@@ -272,6 +277,13 @@ class ManagerService:
                     tag=args.get("tag", ""),
                     application=args.get("application", ""),
                     piece_length=args.get("piece_length", 4 << 20),
+                    # image-type preheat (manager/job/preheat.go PreheatArgs:
+                    # type/username/password/platform/headers)
+                    preheat_type=args.get("type", ""),
+                    username=args.get("username", ""),
+                    password=args.get("password", ""),
+                    platform=args.get("platform", ""),
+                    headers=args.get("headers"),
                 )
             )
             record = self.db.update(

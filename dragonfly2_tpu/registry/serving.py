@@ -223,6 +223,36 @@ class MLEvaluator:
             feats, blocklist, in_degree, can_add_edge, algorithm=self.fallback, limit=limit
         )
 
+    def schedule_packed(
+        self,
+        feats: dict,
+        child_host_slot: np.ndarray | None = None,
+        cand_host_slot: np.ndarray | None = None,
+        blocklist=None,
+        in_degree=None,
+        can_add_edge=None,
+        limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
+    ):
+        """Serving-path twin of `schedule`: one fused device call whose only
+        output is the packed (B, limit, 2) selection (ops/evaluator.py
+        `_pack_selection`) — one D2H per tick chunk."""
+        if self.server.ready and self._host_emb is not None and child_host_slot is not None:
+            return _ml_schedule_packed(
+                self.server.model,
+                self.server.params,
+                self._host_emb,
+                child_host_slot,
+                cand_host_slot,
+                feats,
+                blocklist,
+                in_degree,
+                can_add_edge,
+                limit,
+            )
+        return ev.schedule_candidate_parents_packed(
+            feats, blocklist, in_degree, can_add_edge, algorithm=self.fallback, limit=limit
+        )
+
 
 @jax.jit
 def _loc_match_fraction(parent_loc, child_loc):
@@ -249,5 +279,25 @@ def _ml_schedule(
     )
     scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
     return ev.select_with_scores(
+        feats, scores, blocklist, in_degree, can_add_edge, limit=limit
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("model", "limit"))
+def _ml_schedule_packed(
+    model, params, host_emb, child_host, cand_host, feats,
+    blocklist, in_degree, can_add_edge, limit,
+):
+    """`_ml_schedule` with the packed single-output selection contract."""
+    child_idc = feats["child_idc"][..., None]
+    pair_feats = jnp.stack(
+        [
+            ((feats["parent_idc"] == child_idc) & (child_idc != 0)).astype(jnp.float32),
+            _loc_match_fraction(feats["parent_location"], feats["child_location"]),
+        ],
+        axis=-1,
+    )
+    scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+    return ev.select_with_scores_packed(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
     )

@@ -26,6 +26,7 @@ import numpy as np
 
 from dragonfly2_tpu.rpc import mux, wire
 from dragonfly2_tpu.utils import dferrors
+from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 logger = logging.getLogger(__name__)
 
@@ -164,9 +165,12 @@ class InferenceRPCServer:
         # writes would apply new-module params... to the old module).
         self._model_locks = {name: threading.Lock() for name in servers}
         self._last_refresh = {name: float("-inf") for name in servers}
+        self._tracker = ConnTracker()
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._serve_conn), self.host, self.port
+        )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
         logger.info("inference rpc listening on %s:%d", self.host, self.port)
@@ -175,6 +179,10 @@ class InferenceRPCServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # InferenceClient holds a persistent connection by design; on
+            # 3.12 wait_closed() would wait for it forever unless the
+            # handler tasks are cancelled first (utils/conntrack.py).
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
 
     async def _serve_conn(self, reader, writer):

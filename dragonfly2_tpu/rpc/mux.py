@@ -40,6 +40,43 @@ SERVING = "SERVING"
 NOT_SERVING = "NOT_SERVING"
 
 
+class _CountedReader(asyncio.StreamReader):
+    """Detached StreamReader that tracks its own buffered byte count.
+
+    A detached reader has no transport, so feed_data never back-pressures;
+    the relay bounds memory by polling `buffered` instead of probing
+    CPython's private `_buffer` (which a future CPython could rename,
+    silently turning the high-water check into a no-op)."""
+
+    def __init__(self):
+        super().__init__()
+        self.buffered = 0
+
+    def feed_data(self, data):
+        self.buffered += len(data)
+        super().feed_data(data)
+
+    async def read(self, n=-1):
+        data = await super().read(n)
+        self.buffered -= len(data)
+        return data
+
+    async def readexactly(self, n):
+        data = await super().readexactly(n)
+        self.buffered -= len(data)
+        return data
+
+    async def readuntil(self, separator=b"\n"):
+        data = await super().readuntil(separator)
+        self.buffered -= len(data)
+        return data
+
+    async def readline(self):
+        data = await super().readline()
+        self.buffered -= len(data)
+        return data
+
+
 @dataclasses.dataclass
 class HealthCheckRequest:
     """pkg/rpc/health: the standard health v1 Check, per-service."""
@@ -106,17 +143,15 @@ class MuxServer:
         # Wire protocol: hand the consumed prefix back through a fresh
         # reader fed by a frame-aware relay task (StreamReader has no
         # un-read).
-        relayed = asyncio.StreamReader()
+        relayed = _CountedReader()
 
         async def relay():
             prefix = peek
             try:
                 while True:
-                    # A detached StreamReader has no transport, so
-                    # feed_data never back-pressures: pause on a high-water
-                    # mark (above the frame ceiling, so readexactly always
-                    # completes). _buffer is CPython's stable internal.
-                    while len(getattr(relayed, "_buffer", b"")) > _RELAY_HIGH_WATER:
+                    # Pause on a high-water mark (above the frame ceiling,
+                    # so readexactly always completes).
+                    while relayed.buffered > _RELAY_HIGH_WATER:
                         await asyncio.sleep(0.01)
                     if prefix is None:
                         prefix = await reader.readexactly(4)

@@ -18,6 +18,16 @@ import time
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import mux, wire
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import (
+    HOST_TRAFFIC_DOWNLOAD,
+    HOST_TRAFFIC_UPLOAD,
+    TRAFFIC_BACK_TO_SOURCE,
+    TRAFFIC_P2P,
+    register_version,
+    scheduler_series,
+    trainer_series,
+)
+from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 wire.register_module(msg)
 
@@ -44,19 +54,24 @@ class SchedulerRPCServer:
         self._trigger_deadline: dict[str, float] = {}
         self._pending_triggers: list = []
         self._lock = asyncio.Lock()
+        self._tracker = ConnTracker()
+        # Adaptive tick: set whenever a dispatched message may have enqueued
+        # scheduling work, so a lone request is served at kernel latency
+        # instead of waiting out the full tick_interval (SURVEY §7 hard
+        # part (b); the interval remains the RETRY cadence for peers that
+        # stay pending with no eligible parents).
+        self._tick_wake = asyncio.Event()
         reg = default_registry()
-        self._m_requests = reg.counter(
-            "dragonfly_scheduler_announce_peer_total", "stream messages", ("type",)
-        )
-        self._m_tick = reg.histogram(
-            "dragonfly_scheduler_tick_seconds", "batched schedule tick latency"
-        )
-        self._m_batch = reg.histogram(
-            "dragonfly_scheduler_tick_batch_size", "peers per tick", buckets=(1, 8, 64, 512, 4096)
-        )
+        self.metrics = scheduler_series(reg)
+        register_version(reg, "scheduler")
+        self._m_requests = self.metrics.announce_peer
+        self._m_tick = self.metrics.schedule_tick
+        self._m_batch = self.metrics.schedule_batch
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._serve_conn), self.host, self.port
+        )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
         self._tick_task = asyncio.create_task(self._tick_loop())
@@ -72,6 +87,9 @@ class SchedulerRPCServer:
                 pass
         if self._server:
             self._server.close()
+            # Announce streams are long-lived; cancel their handler tasks
+            # before wait_closed() or 3.12 shutdown hangs (utils/conntrack.py).
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
         for w in list(self._writers):
             w.close()
@@ -97,10 +115,17 @@ class SchedulerRPCServer:
                     async with self._lock:
                         self._host_conn[request.host.host_id] = writer
                         owned_hosts.add(request.host.host_id)
+                was_empty = not self.service._pending
                 response = await self._dispatch_locked(request, writer, owned_peers)
                 if response is not None:
                     wire.write_frame(writer, response)
                     await writer.drain()
+                # Wake ONLY on the empty->nonempty transition: waking while
+                # work is already pending would let one unschedulable peer
+                # (retrying on the interval cadence by design) turn a busy
+                # message stream into back-to-back device scheduling calls.
+                if was_empty and self.service._pending:
+                    self._tick_wake.set()
                 await self._drain_seed_triggers()
         except Exception:  # noqa: BLE001 - one bad conn must not kill the server
             logger.exception("connection handler failed")
@@ -214,6 +239,7 @@ class SchedulerRPCServer:
 
     def _dispatch(self, request, owned_peers: set[str]):
         svc = self.service
+        self._observe_request(request)
         if isinstance(request, msg.AnnounceHostRequest):
             svc.announce_host(request.host)
             return None
@@ -235,6 +261,81 @@ class SchedulerRPCServer:
             return self._stat_task(request.task_id)
         # announce-stream oneof (routing already recorded on-loop)
         return svc.handle(request)
+
+    def _observe_request(self, request) -> None:
+        """Per-RPC totals + traffic/duration series (scheduler/metrics/
+        metrics.go:44-454). Runs under service.mu (called from _dispatch),
+        so reading _peer_meta/_host_info is race-free."""
+        m = self.metrics
+        svc = self.service
+
+        def peer_labels(peer_id: str) -> tuple[str, str, str]:
+            meta = svc._peer_meta.get(peer_id)
+            if meta is None:
+                return "", "", "normal"
+            info = svc._host_info.get(meta.host_id)
+            return meta.tag, meta.application, info.host_type if info else "normal"
+
+        if isinstance(request, msg.RegisterPeerRequest):
+            m.register_peer.labels(
+                str(request.priority), "STANDARD", request.tag, request.application
+            ).inc()
+        elif isinstance(request, msg.DownloadPieceFinishedRequest):
+            tag, app, host_type = peer_labels(request.peer_id)
+            ttype = TRAFFIC_P2P if request.parent_peer_id else TRAFFIC_BACK_TO_SOURCE
+            m.download_piece_finished.labels(ttype, "STANDARD", tag, app).inc()
+            m.traffic.labels(ttype, "STANDARD", tag, app, host_type).inc(request.length)
+            meta = svc._peer_meta.get(request.peer_id)
+            if meta is not None:
+                m.host_traffic.labels(
+                    HOST_TRAFFIC_DOWNLOAD, host_type, meta.host_id
+                ).inc(request.length)
+            pmeta = svc._peer_meta.get(request.parent_peer_id)
+            if pmeta is not None:
+                pinfo = svc._host_info.get(pmeta.host_id)
+                m.host_traffic.labels(
+                    HOST_TRAFFIC_UPLOAD,
+                    pinfo.host_type if pinfo else "normal",
+                    pmeta.host_id,
+                ).inc(request.length)
+        elif isinstance(request, msg.DownloadPieceFailedRequest):
+            tag, app, _ = peer_labels(request.peer_id)
+            m.download_piece_finished_failure.labels(
+                TRAFFIC_P2P, "STANDARD", tag, app
+            ).inc()
+        elif isinstance(
+            request,
+            (msg.DownloadPeerFinishedRequest, msg.DownloadPeerBackToSourceFinishedRequest),
+        ):
+            tag, app, _ = peer_labels(request.peer_id)
+            m.download_peer_finished.labels("0", "STANDARD", tag, app).inc()
+            meta = svc._peer_meta.get(request.peer_id)
+            if meta is not None and getattr(meta, "registered_at", 0.0):
+                scope = msg.SizeScope.of(request.content_length).name
+                m.download_peer_duration.labels(scope).observe(
+                    (time.monotonic() - meta.registered_at) * 1e3
+                )
+        elif isinstance(
+            request,
+            (msg.DownloadPeerFailedRequest, msg.DownloadPeerBackToSourceFailedRequest),
+        ):
+            tag, app, _ = peer_labels(request.peer_id)
+            m.download_peer_finished_failure.labels("0", "STANDARD", tag, app).inc()
+        elif isinstance(request, msg.DownloadPeerBackToSourceStartedRequest):
+            tag, app, _ = peer_labels(request.peer_id)
+            m.download_peer_back_to_source_started.labels("0", "STANDARD", tag, app).inc()
+        elif isinstance(request, msg.StatPeerRequest):
+            m.stat_peer.labels().inc()
+        elif isinstance(request, msg.LeavePeerRequest):
+            m.leave_peer.labels().inc()
+        elif isinstance(request, msg.StatTaskRequest):
+            m.stat_task.labels().inc()
+        elif isinstance(request, msg.AnnounceHostRequest):
+            m.announce_host.labels().inc()
+        elif isinstance(request, msg.LeaveHostRequest):
+            m.leave_host.labels().inc()
+        elif isinstance(request, msg.ProbeStartedRequest):
+            m.sync_probes.labels().inc()
 
     # --------------------------------------------------------------- probes
 
@@ -315,7 +416,18 @@ class SchedulerRPCServer:
 
     async def _tick_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.tick_interval)
+            # Fire immediately when new work arrives (empty->nonempty wake
+            # from the connection handlers); otherwise tick on the interval,
+            # which doubles as the retry cadence for still-pending peers and
+            # the out-of-band drain cadence. Work arriving DURING a tick
+            # leaves the event set, so the next tick runs back-to-back —
+            # batching under load happens naturally because each device call
+            # takes every pending peer with it.
+            try:
+                await asyncio.wait_for(self._tick_wake.wait(), timeout=self.tick_interval)
+            except asyncio.TimeoutError:
+                pass
+            self._tick_wake.clear()
             try:
                 await self._tick_once()
                 # Seed triggers can be enqueued OUT of band (a manager
@@ -329,6 +441,7 @@ class SchedulerRPCServer:
     async def _tick_once(self) -> None:
         svc = self.service
         pending = len(svc._pending)
+        self.metrics.concurrent_schedule.labels().set(pending)
         if pending == 0:
             return
         t0 = time.perf_counter()
@@ -376,16 +489,17 @@ class TrainerRPCServer:
         self.port = port
         self._server: asyncio.AbstractServer | None = None
         self._writers: set[asyncio.StreamWriter] = set()
+        self._tracker = ConnTracker()
         reg = default_registry()
-        self._m_chunks = reg.counter(
-            "dragonfly_trainer_train_chunks_total", "dataset chunks", ("dataset",)
-        )
-        self._m_trains = reg.counter(
-            "dragonfly_trainer_train_total", "train runs", ("state",)
-        )
+        self.metrics = trainer_series(reg)
+        register_version(reg, "trainer")
+        self._m_chunks = self.metrics.train_chunks
+        self._m_trains = self.metrics.train_runs
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._tracker.tracked(self._serve_conn), self.host, self.port
+        )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
         logger.info("trainer rpc listening on %s:%d", self.host, self.port)
@@ -394,6 +508,9 @@ class TrainerRPCServer:
     async def stop(self) -> None:
         if self._server:
             self._server.close()
+            # Cancel live Train streams before wait_closed() (3.12 waits on
+            # every in-flight handler; utils/conntrack.py).
+            await self._tracker.cancel_all()
             await self._server.wait_closed()
         for w in list(self._writers):
             w.close()
@@ -449,6 +566,7 @@ class TrainerRPCServer:
             try:
                 outcome = await asyncio.to_thread(self.service.train_finish, host_id)
                 self._m_trains.labels("succeeded").inc()
+                self.metrics.training.labels().inc()
                 parts = []
                 if outcome.gnn is not None:
                     parts.append(f"gnn v{outcome.gnn.version}")
@@ -460,6 +578,7 @@ class TrainerRPCServer:
             except Exception as e:  # noqa: BLE001
                 self.service.train_abort(host_id)
                 self._m_trains.labels("failed").inc()
+                self.metrics.training_failure.labels().inc()
                 wire.write_frame(writer, msg.TrainResponse(ok=False, description=str(e)))
             await writer.drain()
         except Exception:  # noqa: BLE001 - one bad conn must not kill the server

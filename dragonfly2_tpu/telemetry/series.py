@@ -1,0 +1,189 @@
+"""Per-service metric families — the reference's metrics packages as one
+declaration site.
+
+Capability parity with scheduler/metrics/metrics.go:44-454 (per-RPC
+totals + failure twins, `traffic` by type/task_type/task_tag/task_app/
+host_type, `host_traffic`, download duration histogram, concurrent
+schedule gauge, version), client/daemon/metrics/metrics.go (proxy +
+peer/piece/file/stream task counters, seed-peer series, cache hits),
+manager/metrics/metrics.go (searcher totals) and trainer/metrics/
+metrics.go (training totals). Each `*_series` function is idempotent on a
+registry (Registry.register returns the existing collector), so servers
+and tests can call them freely.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu import version as _version
+
+# traffic type label values (scheduler/metrics/metrics.go:24-38)
+TRAFFIC_P2P = "p2p"
+TRAFFIC_BACK_TO_SOURCE = "back_to_source"
+HOST_TRAFFIC_UPLOAD = "upload"
+HOST_TRAFFIC_DOWNLOAD = "download"
+
+
+class _Namespace:
+    def __init__(self, **metrics):
+        self.__dict__.update(metrics)
+
+
+def scheduler_series(reg) -> _Namespace:
+    c = reg.counter
+    return _Namespace(
+        announce_peer=c(
+            "dragonfly_scheduler_announce_peer_total", "stream messages", ("type",)
+        ),
+        announce_peer_failure=c(
+            "dragonfly_scheduler_announce_peer_failure_total",
+            "failed stream messages",
+            ("type",),
+        ),
+        register_peer=c(
+            "dragonfly_scheduler_register_peer_total", "peer registrations",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        register_peer_failure=c(
+            "dragonfly_scheduler_register_peer_failure_total",
+            "failed peer registrations",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        download_peer_started=c(
+            "dragonfly_scheduler_download_peer_started_total", "downloads started",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        download_peer_back_to_source_started=c(
+            "dragonfly_scheduler_download_peer_back_to_source_started_total",
+            "back-to-source downloads started",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        download_peer_finished=c(
+            "dragonfly_scheduler_download_peer_finished_total", "downloads finished",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        download_peer_finished_failure=c(
+            "dragonfly_scheduler_download_peer_finished_failure_total",
+            "downloads failed",
+            ("priority", "task_type", "task_tag", "task_app"),
+        ),
+        download_piece_finished=c(
+            "dragonfly_scheduler_download_piece_finished_total", "pieces finished",
+            ("traffic_type", "task_type", "task_tag", "task_app"),
+        ),
+        download_piece_finished_failure=c(
+            "dragonfly_scheduler_download_piece_finished_failure_total",
+            "pieces failed",
+            ("traffic_type", "task_type", "task_tag", "task_app"),
+        ),
+        stat_peer=c("dragonfly_scheduler_stat_peer_total", "StatPeer calls"),
+        leave_peer=c("dragonfly_scheduler_leave_peer_total", "LeavePeer calls"),
+        stat_task=c("dragonfly_scheduler_stat_task_total", "StatTask calls"),
+        announce_host=c("dragonfly_scheduler_announce_host_total", "AnnounceHost calls"),
+        leave_host=c("dragonfly_scheduler_leave_host_total", "LeaveHost calls"),
+        sync_probes=c("dragonfly_scheduler_sync_probes_total", "SyncProbes calls"),
+        traffic=c(
+            "dragonfly_scheduler_traffic", "piece bytes moved",
+            ("type", "task_type", "task_tag", "task_app", "host_type"),
+        ),
+        host_traffic=c(
+            "dragonfly_scheduler_host_traffic", "piece bytes by host",
+            ("type", "host_type", "host_id"),
+        ),
+        download_peer_duration=reg.histogram(
+            "dragonfly_scheduler_download_peer_duration_milliseconds",
+            "download duration by size scope",
+            ("size_scope",),
+            buckets=(100.0, 200.0, 500.0, 1000.0, 1500.0, 2000.0, 3000.0, 5000.0,
+                     10000.0, 20000.0, 60000.0, 120000.0, 300000.0),
+        ),
+        concurrent_schedule=reg.gauge(
+            "dragonfly_scheduler_concurrent_schedule_total", "peers pending schedule"
+        ),
+        schedule_tick=reg.histogram(
+            "dragonfly_scheduler_tick_seconds", "batched schedule tick latency"
+        ),
+        schedule_batch=reg.histogram(
+            "dragonfly_scheduler_tick_batch_size", "peers per tick",
+            buckets=(1, 8, 64, 512, 4096),
+        ),
+    )
+
+
+def daemon_series(reg) -> _Namespace:
+    c = reg.counter
+    return _Namespace(
+        proxy_request=c(
+            "dragonfly_dfdaemon_proxy_request_total", "proxy requests", ("method",)
+        ),
+        proxy_request_via=c(
+            "dragonfly_dfdaemon_proxy_request_via_dragonfly_total",
+            "proxy requests routed through P2P",
+        ),
+        proxy_request_not_via=c(
+            "dragonfly_dfdaemon_proxy_request_not_via_dragonfly_total",
+            "proxy requests passed straight through",
+        ),
+        peer_task=c("dragonfly_dfdaemon_peer_task_total", "peer tasks started"),
+        peer_task_failed=c(
+            "dragonfly_dfdaemon_peer_task_failed_total", "peer tasks failed", ("type",)
+        ),
+        piece_task=c("dragonfly_dfdaemon_piece_task_total", "piece downloads"),
+        piece_task_failed=c(
+            "dragonfly_dfdaemon_piece_task_failed_total", "piece downloads failed"
+        ),
+        file_task=c("dragonfly_dfdaemon_file_task_total", "file tasks"),
+        stream_task=c("dragonfly_dfdaemon_stream_task_total", "stream tasks"),
+        seed_peer_download=c(
+            "dragonfly_dfdaemon_seed_peer_download_total", "seed downloads"
+        ),
+        seed_peer_download_failure=c(
+            "dragonfly_dfdaemon_seed_peer_download_failure_total",
+            "seed downloads failed",
+        ),
+        seed_peer_download_traffic=c(
+            "dragonfly_dfdaemon_seed_peer_download_traffic", "seed bytes", ("type",)
+        ),
+        peer_task_cache_hit=c(
+            "dragonfly_dfdaemon_peer_task_cache_hit_total", "local reuse hits"
+        ),
+    )
+
+
+def manager_series(reg) -> _Namespace:
+    c = reg.counter
+    return _Namespace(
+        search_scheduler_cluster=c(
+            "dragonfly_manager_search_scheduler_cluster_total",
+            "scheduler-cluster searches",
+        ),
+        search_scheduler_cluster_failure=c(
+            "dragonfly_manager_search_scheduler_cluster_failure_total",
+            "failed scheduler-cluster searches",
+        ),
+        request=c(
+            "dragonfly_manager_request_total", "REST requests", ("method", "group")
+        ),
+        request_failure=c(
+            "dragonfly_manager_request_failure_total",
+            "REST requests answered >= 400",
+            ("method", "group"),
+        ),
+    )
+
+
+def trainer_series(reg) -> _Namespace:
+    c = reg.counter
+    return _Namespace(
+        training=c("dragonfly_trainer_training_total", "training runs"),
+        training_failure=c(
+            "dragonfly_trainer_training_failure_total", "failed training runs"
+        ),
+        train_chunks=c(
+            "dragonfly_trainer_train_chunks_total", "dataset chunks", ("dataset",)
+        ),
+        train_runs=c("dragonfly_trainer_train_total", "train runs", ("state",)),
+    )
+
+
+def register_version(reg, service: str) -> None:
+    _version.register_version_gauge(reg, service)

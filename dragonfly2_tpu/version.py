@@ -1,0 +1,39 @@
+"""Build metadata — capability parity with version/version.go (Major/
+Minor/GitVersion/GitCommit/Platform + the per-service `version` metric
+gauge every reference service exports, e.g. scheduler/metrics/
+metrics.go:273-280)."""
+
+from __future__ import annotations
+
+import platform as _platform
+
+MAJOR = "2"
+MINOR = "2"
+GIT_VERSION = "v2.2.0-tpu"
+GIT_COMMIT = "unknown"
+BUILD_PLATFORM = f"{_platform.system().lower()}/{_platform.machine()}"
+
+
+def version() -> str:
+    return GIT_VERSION
+
+
+def version_info() -> dict:
+    return {
+        "major": MAJOR,
+        "minor": MINOR,
+        "git_version": GIT_VERSION,
+        "git_commit": GIT_COMMIT,
+        "platform": BUILD_PLATFORM,
+    }
+
+
+def register_version_gauge(registry, service: str) -> None:
+    """dragonfly_<service>_version{major,minor,git_version,git_commit,
+    platform} = 1 — the reference's BuildInfo gauge."""
+    gauge = registry.gauge(
+        f"dragonfly_{service}_version",
+        "build metadata",
+        ("major", "minor", "git_version", "git_commit", "platform"),
+    )
+    gauge.labels(MAJOR, MINOR, GIT_VERSION, GIT_COMMIT, BUILD_PLATFORM).set(1)

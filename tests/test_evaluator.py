@@ -202,3 +202,78 @@ def test_find_success_parent(batch):
         if succeeded:
             best = max(succeeded, key=lambda j: (scores[i, j], -j))
             assert scores[i, parent[i]] == pytest.approx(scores[i, best])
+
+
+def test_packed_matches_full(batch):
+    """The serving-path packed variant must agree with the debug dict
+    variant bit-for-bit (indices, validity, scores)."""
+    for algorithm in ("default", "nt"):
+        full = ev.schedule_candidate_parents(batch.as_dict(), algorithm=algorithm, limit=4)
+        packed = np.asarray(
+            ev.schedule_candidate_parents_packed(batch.as_dict(), algorithm=algorithm, limit=4)
+        )
+        idx, valid, scores = ev.unpack_selection(packed)
+        fv = np.asarray(full["selected_valid"])
+        assert (valid == fv).all()
+        assert (idx[valid] == np.asarray(full["selected"])[fv]).all()
+        assert (scores[valid] == np.asarray(full["selected_scores"])[fv]).all()
+
+
+def test_select_with_scores_packed_matches(batch):
+    rng = np.random.default_rng(3)
+    scores = rng.random(batch.valid.shape).astype(np.float32)
+    full = ev.select_with_scores(batch.as_dict(), scores, limit=4)
+    packed = np.asarray(ev.select_with_scores_packed(batch.as_dict(), scores, limit=4))
+    idx, valid, vals = ev.unpack_selection(packed)
+    fv = np.asarray(full["selected_valid"])
+    assert (valid == fv).all()
+    assert (idx[valid] == np.asarray(full["selected"])[fv]).all()
+    assert (vals[valid] == np.asarray(full["selected_scores"])[fv]).all()
+
+
+def test_masked_top_k_rank_vs_lax():
+    """The rank-select fast path must match lax.top_k exactly, including
+    lowest-index tie-breaks with duplicate scores and rows with fewer
+    valid candidates than k (the -inf*0=NaN trap regression test)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.ops.topk import NEG_INF, _masked_top_k_rank
+
+    rng = np.random.default_rng(11)
+    scores = rng.random((64, 64)).astype(np.float32)
+    scores[:, 10] = scores[:, 5]  # duplicates -> tie-break by index
+    scores[:, 20] = scores[:, 5]
+    mask = rng.random((64, 64)) < 0.5
+    mask[0] = False          # no valid candidates at all
+    mask[1] = False
+    mask[1, 3] = True        # exactly one valid candidate
+    v, i, m = _masked_top_k_rank(jnp.asarray(scores), jnp.asarray(mask), 4)
+    ref_masked = jnp.where(jnp.asarray(mask), jnp.asarray(scores), NEG_INF)
+    rv, ri = jax.lax.top_k(ref_masked, 4)
+    rm = rv > NEG_INF
+    assert (np.asarray(m) == np.asarray(rm)).all()
+    assert (np.asarray(v)[np.asarray(m)] == np.asarray(rv)[np.asarray(rm)]).all()
+    assert (np.asarray(i)[np.asarray(m)] == np.asarray(ri)[np.asarray(rm)]).all()
+    # invalid slots keep the -inf contract
+    assert np.isneginf(np.asarray(v)[~np.asarray(m)]).all()
+
+
+def test_masked_top_k_rank_hostile_scores():
+    """Externally supplied scores (plugin/ml path) may contain -inf/NaN:
+    those candidates must still outrank every masked-out candidate, and
+    validity must never surface a blocklisted index (r2 review finding)."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.ops.topk import masked_top_k
+
+    scores = np.full((1, 8), 1.0, np.float32)
+    scores[0, 0] = -np.inf   # eligible but scored -inf by a plugin
+    scores[0, 1] = np.nan    # eligible but NaN
+    mask = np.zeros((1, 8), bool)
+    mask[0, :4] = True       # 4 eligible candidates; 4..7 are masked out
+    v, i, m = masked_top_k(jnp.asarray(scores), jnp.asarray(mask), 6)
+    v, i, m = np.asarray(v), np.asarray(i), np.asarray(m)
+    assert m[0].sum() == 4                     # exactly the eligible count
+    assert set(i[0, :4].tolist()) == {0, 1, 2, 3}  # never a masked index
+    assert i[0, :2].tolist() == [2, 3]         # real scores rank first

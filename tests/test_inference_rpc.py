@@ -127,3 +127,19 @@ def test_infer_tensor_roundtrip():
         decoded = wire.decode(wire.encode(t)[4:])
         np.testing.assert_array_equal(decoded.to_numpy(), arr)
         assert decoded.to_numpy().dtype == arr.dtype
+
+
+def test_infer_rpc_stop_with_connected_client(rig):
+    """A persistent InferenceClient connection must not hang stop()
+    (handlers are cancelled before wait_closed; ADVICE round 1)."""
+    _, servers, _ = rig
+
+    async def run():
+        server = InferenceRPCServer(servers, refresh_ttl_s=0.0)
+        host, port = await server.start()
+        client = await InferenceClient(host, port).connect()
+        assert await client.server_live()
+        await asyncio.wait_for(server.stop(), timeout=5.0)
+        await client.close()
+
+    asyncio.new_event_loop().run_until_complete(run())

@@ -378,3 +378,20 @@ def test_manager_rpc_roundtrip(tmp_path):
             await server.stop()
 
     _run_async(scenario())
+
+
+def test_manager_rpc_stop_with_connected_client():
+    """3.12's wait_closed() waits on in-flight handlers; a manager with a
+    connected keepalive client must still stop promptly (the handlers are
+    cancelled via ConnTracker before wait_closed)."""
+
+    async def scenario():
+        svc = ManagerService(Database())
+        server = mrpc.ManagerRPCServer(svc)
+        host, port = await server.start()
+        client = await mrpc.ManagerClient(host, port).connect()
+        # idle, long-lived connection held open across stop()
+        await asyncio.wait_for(server.stop(), timeout=5.0)
+        await client.close()
+
+    _run_async(scenario())

@@ -365,3 +365,97 @@ def test_two_schedulers_task_affinity(tmp_path, origin):
                 await s.stop()
 
     asyncio.run(run())
+
+
+def test_adaptive_tick_latency(tmp_path, origin):
+    """A lone request must be scheduled at kernel latency, not tick-interval
+    latency (VERDICT r1 item 8): with a deliberately huge tick_interval
+    (2 s), a peer's download that needs a real scheduling round must finish
+    far inside one interval, because the empty->nonempty pending transition
+    wakes the tick immediately (rpc/server.py _tick_wake).
+
+    Phase 1 warms the evaluator's XLA compile and seeds two parents through
+    a fast-tick server; phase 2 points a third daemon at a 2 s-tick server
+    sharing the same cluster state and times just its schedule+download."""
+    import time as _time
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        warm = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await warm.start()
+        daemons = []
+        try:
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="host-1")
+            await d1.start()
+            daemons.append(d1)
+            await d1.download(origin.url(), piece_length=32 * 1024)
+            d2 = Daemon(tmp_path / "d2", [(host, port)], hostname="host-2")
+            await d2.start()
+            daemons.append(d2)
+            # real scheduling round -> compiles the evaluator for this shape
+            await d2.download(
+                origin.url(), piece_length=32 * 1024, back_source_allowed=False
+            )
+            await warm.stop()
+
+            slow = SchedulerRPCServer(service, tick_interval=2.0)
+            shost, sport = await slow.start()
+            try:
+                t0 = _time.monotonic()
+                d3 = Daemon(tmp_path / "d3", [(shost, sport)], hostname="host-3")
+                await d3.start()
+                daemons.append(d3)
+                await d3.download(
+                    origin.url(), piece_length=32 * 1024, back_source_allowed=False
+                )
+                elapsed = _time.monotonic() - t0
+                # Without the wake this waits out the 2 s tick; with it the
+                # whole register+schedule+download runs in millis.
+                assert elapsed < 1.0, f"adaptive tick not firing: {elapsed:.2f}s"
+            finally:
+                await slow.stop()
+        finally:
+            for d in daemons:
+                await d.stop()
+
+    asyncio.run(run())
+
+
+def test_download_traces_carry_live_host_stats(tmp_path, origin):
+    """The training CSV's host feature columns must be real /proc samples,
+    not zeros (VERDICT r1 item 3): after a download, the written Download
+    record's host carries non-zero cpu/memory stats."""
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="host-1")
+            await d1.start()
+            await d1.download(origin.url(), piece_length=32 * 1024)
+            # DownloadPeerFinished arrives async after download() returns
+            records = []
+            for _ in range(100):
+                service.storage.flush()
+                records = service.storage.list_downloads()
+                if records:
+                    break
+                await asyncio.sleep(0.05)
+            assert records, "no Download trace rows"
+            rec = records[-1]
+            assert rec.host.cpu.logical_count > 0
+            assert rec.host.memory.total > 0
+            assert rec.host.memory.used_percent > 0.0
+            assert rec.host.disk.total > 0
+            # and the numeric feature vector is non-zero in the host-stat
+            # columns (records/features.py HOST_NUMERIC_FEATURES tail)
+            from dragonfly2_tpu.records.features import host_numeric_features
+
+            feats = host_numeric_features(rec.host)
+            assert feats[10] > 0.0  # memory used_percent column
+            await d1.stop()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

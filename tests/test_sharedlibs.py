@@ -266,3 +266,25 @@ def test_dflog_scoped(tmp_path, caplog):
     with caplog.at_level(logging.INFO, logger="dragonfly2_tpu.core"):
         log.info("hello")
     assert "[task_id=t1 peer_id=p1] hello" in caplog.text
+
+
+def test_hoststat_collects_real_numbers():
+    """utils/hoststat reads /proc: totals and percents must be live values,
+    not zero-filled defaults (announcer.go:186-252 parity)."""
+    from dragonfly2_tpu.utils import hoststat
+
+    stats = hoststat.collect("/")
+    assert stats.cpu.logical_count > 0
+    assert stats.cpu.physical_count > 0
+    assert stats.memory.total > 0
+    assert 0 < stats.memory.used <= stats.memory.total
+    assert 0.0 < stats.memory.used_percent < 100.0
+    assert stats.disk.total > 0
+    assert stats.disk.inodes_total > 0
+    assert stats.tcp_connection_count >= 0
+    # second sample after some work yields a cpu percent in range
+    deadline = sum(i * i for i in range(200_000))  # burn a little cpu
+    assert deadline > 0
+    s2 = hoststat.collect("/")
+    assert 0.0 <= s2.cpu.percent <= 100.0
+    assert s2.cpu.process_percent >= 0.0

@@ -111,3 +111,101 @@ def test_tracing_error_status():
         pass
     assert spans[0].status == "ERROR"
     assert spans[0].events[0]["type"] == "RuntimeError"
+
+
+def test_service_series_families_registered():
+    """Metrics parity sweep (VERDICT r1 item 5): every service's series
+    families from the reference metrics packages exist with their label
+    sets after the series factories run (scheduler/metrics/metrics.go:
+    44-454, client/daemon/metrics, manager/metrics, trainer/metrics)."""
+    from dragonfly2_tpu.telemetry.metrics import Registry
+    from dragonfly2_tpu.telemetry.series import (
+        daemon_series,
+        manager_series,
+        register_version,
+        scheduler_series,
+        trainer_series,
+    )
+
+    reg = Registry()
+    scheduler_series(reg)
+    daemon_series(reg)
+    manager_series(reg)
+    trainer_series(reg)
+    for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
+        register_version(reg, svc)
+    # touch one labeled child per family so exposition shows the labels
+    sched = scheduler_series(reg)
+    sched.traffic.labels("p2p", "STANDARD", "t", "a", "normal").inc(42)
+    sched.register_peer.labels("0", "STANDARD", "", "").inc()
+    sched.download_peer_duration.labels("NORMAL").observe(123.0)
+    daemon = daemon_series(reg)
+    daemon.proxy_request.labels("GET").inc()
+    text = reg.expose()
+    for family in (
+        "dragonfly_scheduler_register_peer_total",
+        "dragonfly_scheduler_download_peer_finished_total",
+        "dragonfly_scheduler_download_piece_finished_total",
+        "dragonfly_scheduler_traffic",
+        "dragonfly_scheduler_host_traffic",
+        "dragonfly_scheduler_download_peer_duration_milliseconds",
+        "dragonfly_scheduler_concurrent_schedule_total",
+        "dragonfly_scheduler_announce_host_total",
+        "dragonfly_scheduler_sync_probes_total",
+        "dragonfly_dfdaemon_proxy_request_total",
+        "dragonfly_dfdaemon_peer_task_total",
+        "dragonfly_dfdaemon_piece_task_total",
+        "dragonfly_dfdaemon_seed_peer_download_total",
+        "dragonfly_dfdaemon_peer_task_cache_hit_total",
+        "dragonfly_manager_search_scheduler_cluster_total",
+        "dragonfly_manager_request_total",
+        "dragonfly_trainer_training_total",
+        "dragonfly_scheduler_version",
+        "dragonfly_dfdaemon_version",
+        "dragonfly_manager_version",
+        "dragonfly_trainer_version",
+    ):
+        assert f"# TYPE {family}" in text, family
+    assert 'traffic{type="p2p",task_type="STANDARD",task_tag="t",task_app="a",host_type="normal"} 42' in text
+    assert 'git_version=' in text
+
+
+def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
+    """Drive a real download through the RPC edge and scrape /metrics over
+    HTTP (MuxServer): per-RPC totals, traffic bytes, and duration
+    histogram must be populated — not just registered."""
+    import asyncio
+    import urllib.request as _rq
+
+    from test_minicluster import _CountingFileServer, _scheduler_service
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.rpc.mux import MuxServer
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+    from dragonfly2_tpu.telemetry import default_registry
+
+    origin = _CountingFileServer(bytes(i % 256 for i in range(150_000)))
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        mux_srv = MuxServer(server._serve_conn, metrics_registry=default_registry())
+        host, port = await mux_srv.start()
+        try:
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="mh-1")
+            await d1.start()
+            await d1.download(origin.url(), piece_length=32 * 1024)
+            text = await asyncio.to_thread(
+                lambda: _rq.urlopen(f"http://{host}:{port}/metrics").read().decode()
+            )
+            assert "dragonfly_scheduler_register_peer_total{" in text
+            assert "dragonfly_scheduler_traffic{" in text
+            assert 'type="back_to_source"' in text
+            assert "dragonfly_scheduler_host_traffic{" in text
+            assert "dragonfly_scheduler_download_peer_duration_milliseconds_count" in text
+            assert "dragonfly_dfdaemon_peer_task_total" in text
+            await d1.stop()
+        finally:
+            await mux_srv.stop()
+            origin.stop()
+
+    asyncio.run(run())
