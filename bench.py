@@ -40,6 +40,22 @@ DEADLINE_S = 480.0
 RETRY_SLEEP_S = 15.0
 PIPELINED_PROBES = 3
 
+# Trainer sub-metrics (second north star, BASELINE.md: >=50x CPU
+# samples/s/chip): a short real GNN training run + a flash-attention MFU
+# probe, emitted as a "trainer" sub-object so the driver-captured artifact
+# carries them (VERDICT r1 weak #6 — previously only builder-run scripts
+# measured the trainer).
+TRAINER_HOSTS = 2_000
+TRAINER_RECORDS = 8_000
+TRAINER_EPOCHS = 3
+# torch-CPU same-architecture baseline (bench_trainer.py cpu_torch path,
+# ~1.8k samples/s on this image's CPU); kept as a constant here so the
+# headline bench stays minutes, not tens of minutes — bench_trainer.py
+# re-measures it live.
+CPU_TORCH_SAMPLES_PER_SEC = 1_840.0
+PEAK_TFLOPS_BF16 = 197.0  # TPU v5e per-chip peak
+ATTN_SHAPE = (4, 8, 8192, 128)  # B, H, L, D for the MFU probe
+
 
 def _paired_trials(call, control, n):
     """Run n (control, kernel) timing pairs; return list of (ctl_ms, ker_ms)."""
@@ -75,6 +91,53 @@ def _pipelined_per_call_ms(call, k0=8, k1=64):
         t_big = run(k1)
         ests.append(max((t_big - t_small) / (k1 - k0), 1e-3))
     return statistics.median(ests)
+
+
+def _trainer_submetrics() -> dict:
+    """Real GNN training throughput + flash-attention MFU on this chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.config.config import TrainerConfig
+    from dragonfly2_tpu.ops.flash import flash_attention
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.features import downloads_to_ranking_dataset
+    from dragonfly2_tpu.training.train import train_gnn
+
+    out: dict = {}
+    cluster = synth.make_cluster(TRAINER_HOSTS, seed=0)
+    records = synth.gen_download_records(
+        cluster, TRAINER_RECORDS, num_tasks=256, max_parents=20
+    )
+    ds, graph = downloads_to_ranking_dataset(records)
+    result = train_gnn(
+        ds, graph, TrainerConfig(hidden_dim=128, batch_size=1024, epochs=TRAINER_EPOCHS)
+    )
+    out["gnn_samples_per_sec"] = round(result.samples_per_sec, 1)
+    out["gnn_vs_cpu_torch"] = round(result.samples_per_sec / CPU_TORCH_SAMPLES_PER_SEC, 1)
+    if result.flops_per_sample:
+        out["gnn_achieved_tflops"] = round(result.flops_per_sec / 1e12, 3)
+        out["gnn_mfu_pct"] = round(
+            100.0 * result.flops_per_sec / (PEAK_TFLOPS_BF16 * 1e12), 3
+        )
+
+    # Flash-attention MFU: the matmul-dominated kernel where MFU is a
+    # meaningful saturation statement (the tiny GNN is dispatch-bound).
+    b, h, l, d = ATTN_SHAPE
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
+    mask = jnp.ones((b, l), bool)
+    fn = jax.jit(flash_attention)
+    jax.block_until_ready(fn(q, k, v, mask))
+    ms = _pipelined_per_call_ms(lambda: fn(q, k, v, mask), k0=2, k1=10)
+    fwd_flops = 4 * b * h * l * l * d  # QK^T + PV, 2 MACs each
+    tflops = fwd_flops / (ms / 1e3) / 1e12
+    out["attention_fwd_ms_8k"] = round(ms, 3)
+    out["attention_fwd_tflops"] = round(tflops, 1)
+    out["attention_mfu_pct"] = round(100.0 * tflops / PEAK_TFLOPS_BF16, 1)
+    return out
 
 
 def main() -> int:
@@ -145,6 +208,11 @@ def main() -> int:
         method = "pipelined_steady_state"
         n_samples = 5
 
+    try:
+        trainer = _trainer_submetrics()
+    except Exception as e:  # noqa: BLE001 - the headline number must survive
+        trainer = {"error": f"{type(e).__name__}: {e}"}
+
     print(
         json.dumps(
             {
@@ -154,6 +222,7 @@ def main() -> int:
                 "vs_baseline": round(BASELINE_MS / p50, 2),
                 "method": method,
                 "samples": n_samples,
+                "trainer": trainer,
             }
         )
     )

@@ -51,6 +51,7 @@ class Daemon:
         registry_mirror: str = "",
         sni_proxy: bool = False,
         sni_allowed_hosts: list[str] | None = None,
+        ssl_context=None,
     ):
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
@@ -64,7 +65,7 @@ class Daemon:
         register_version(reg, "dfdaemon")
         self.storage = StorageManager(data_dir)
         self.upload = UploadServer(self.storage, host=ip)
-        self.pool = SchedulerClientPool(scheduler_addresses)
+        self.pool = SchedulerClientPool(scheduler_addresses, ssl_context=ssl_context)
         self.shaper = TrafficShaper(total_rate_bps, mode="sampling" if total_rate_bps else "plain")
         self.gc = GC()
         self.gc.add(

@@ -33,6 +33,54 @@ def idgen_host_id(ip: str, hostname: str) -> str:
     return idgen.host_id_v2(ip, hostname)
 
 
+def _wire_otlp(args, service: str) -> None:
+    """--otlp-endpoint: export spans from the default tracer to an OTLP
+    collector (the reference's --jaeger flag, dependency.go:263-280)."""
+    endpoint = getattr(args, "otlp_endpoint", None)
+    if not endpoint:
+        return
+    from dragonfly2_tpu.telemetry.tracing import OTLPExporter, default_tracer
+
+    default_tracer().add_exporter(OTLPExporter(endpoint, service=service).export)
+
+
+async def _tls_material(args, common_name: str):
+    """Optional cluster mTLS (scheduler.go:180-219): --tls-dir points at
+    cert.pem/key.pem/ca.pem; --tls-issue certifies against --manager's
+    IssueCertificate RPC first (pkg/issuer flow; issuance itself rides
+    plaintext — bootstrap before any cert exists, like the reference's
+    insecure certify channel). None = plaintext."""
+    tls_dir = getattr(args, "tls_dir", None)
+    if not tls_dir:
+        return None
+    from dragonfly2_tpu.utils.certs import TLSMaterial
+
+    mat = TLSMaterial(tls_dir)
+    if not mat.ready:
+        if getattr(args, "tls_issue", False) and getattr(args, "manager", ""):
+            from dragonfly2_tpu.manager.rpc import obtain_certificate
+
+            mh, mp = _parse_addr(args.manager)
+            sans = {"127.0.0.1", "localhost", getattr(args, "host", "") or "",
+                    getattr(args, "ip", "") or ""}
+            mat = await obtain_certificate(
+                mh, mp, common_name, tls_dir, san_hosts=sorted(s for s in sans if s)
+            )
+        else:
+            raise SystemExit(
+                f"--tls-dir {tls_dir} has no cert material; pass --tls-issue "
+                "with --manager to certify against the cluster CA"
+            )
+    return mat
+
+
+async def _tls_context(args, common_name: str, server: bool):
+    mat = await _tls_material(args, common_name)
+    if mat is None:
+        return None
+    return mat.server_context() if server else mat.client_context()
+
+
 def _parse_addr(value: str) -> tuple[str, int]:
     host, _, port = value.rpartition(":")
     return host or "127.0.0.1", int(port)
@@ -81,7 +129,13 @@ async def _serve_scheduler(args) -> int:
     storage = TraceStorage(args.data_dir) if args.data_dir else None
     probes = ProbeStore(max_hosts=config.scheduler.max_hosts)
     service = SchedulerService(config=config, storage=storage, probes=probes)
-    server = SchedulerRPCServer(service, host=args.host, port=args.port)
+    _wire_otlp(args, "scheduler")
+    tls_mat = await _tls_material(args, "scheduler")
+    tls_server_ctx = tls_mat.server_context() if tls_mat else None
+    tls_client_ctx = tls_mat.client_context() if tls_mat else None
+    server = SchedulerRPCServer(
+        service, host=args.host, port=args.port, ssl_context=tls_server_ctx
+    )
     host, port = await server.start()
     import socket
 
@@ -119,7 +173,9 @@ async def _serve_scheduler(args) -> int:
                 (ATTENTION_MODEL_NAME, MODEL_TYPE_ATTENTION),
             )
         }
-        infer_server = InferenceRPCServer(servers, host=args.host, port=args.infer_port)
+        infer_server = InferenceRPCServer(
+            servers, host=args.host, port=args.infer_port, ssl_context=tls_server_ctx
+        )
         await infer_server.start()
     bg_tasks: list[asyncio.Task] = []
     if args.manager:
@@ -141,7 +197,9 @@ async def _serve_scheduler(args) -> int:
             while True:
                 try:
                     if client is None:
-                        client = await ManagerClient(mh, mp).connect()
+                        client = await ManagerClient(
+                            mh, mp, ssl_context=tls_client_ctx
+                        ).connect()
                         await client.call(RegisterInstanceRequest(
                             source_type="scheduler", host_name=hostname,
                             ip=host, port=port, cluster_id=args.cluster_id,
@@ -172,7 +230,7 @@ async def _serve_scheduler(args) -> int:
 
         async def announce_loop():
             log = logging.getLogger(__name__)
-            client = TrainerClient(th, tp)
+            client = TrainerClient(th, tp, ssl_context=tls_client_ctx)
             while True:
                 await asyncio.sleep(args.announce_interval)
                 try:
@@ -227,7 +285,11 @@ async def _serve_trainer(args) -> int:
         ModelRegistry(args.registry_dir),
         config.trainer,
     )
-    server = TrainerRPCServer(service, host=args.host, port=args.port)
+    _wire_otlp(args, "trainer")
+    server = TrainerRPCServer(
+        service, host=args.host, port=args.port,
+        ssl_context=await _tls_context(args, "trainer", server=True),
+    )
     host, port = await server.start()
     try:
         async with _monitored(args, f"READY {host} {port}") as line:
@@ -246,10 +308,16 @@ async def _serve_manager(args) -> int:
     from dragonfly2_tpu.manager.rpc import ManagerRPCServer
 
     registry = ModelRegistry(args.registry_dir) if args.registry_dir else None
-    service = ManagerService(db=Database(args.db), registry=registry)
+    _wire_otlp(args, "manager")
+    service = ManagerService(
+        db=Database(args.db), registry=registry, cert_dir=args.cert_dir
+    )
     rest = ManagerREST(service, host=args.host, port=args.port)
     host, port = rest.start()
-    rpc = ManagerRPCServer(service, host=args.host, port=args.rpc_port)
+    rpc = ManagerRPCServer(
+        service, host=args.host, port=args.rpc_port,
+        ssl_context=await _tls_context(args, "manager", server=True),
+    )
     rpc_host, rpc_port = await rpc.start()
     try:
         async with _monitored(args, f"READY {host} {port} RPC {rpc_port}") as line:
@@ -316,7 +384,9 @@ async def _serve_dfdaemon(args) -> int:
         registry_mirror=args.registry_mirror,
         sni_proxy=args.sni_proxy,
         sni_allowed_hosts=args.sni_allow or None,
+        ssl_context=await _tls_context(args, "dfdaemon", server=False),
     )
+    _wire_otlp(args, "dfdaemon")
     await daemon.start()
     ready = f"READY {daemon.ip} {daemon.upload.port}"
     if daemon.proxy is not None:
@@ -373,6 +443,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="trainer host:port; streams trace datasets on the cadence")
     s.add_argument("--announce-interval", type=float, default=7 * 24 * 3600.0,
                    help="seconds between trainer uploads (reference default 7d)")
+    s.add_argument("--tls-dir", default=None,
+                   help="cert.pem/key.pem/ca.pem dir; serves cluster mTLS when set")
+    s.add_argument("--tls-issue", action="store_true",
+                   help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    s.add_argument("--otlp-endpoint", default=None,
+                   help="OTLP/HTTP collector base URL for span export (--jaeger parity)")
 
     t = sub.add_parser("trainer", help="model training service")
     t.add_argument("--host", default="127.0.0.1")
@@ -382,6 +458,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--registry-dir", required=True, help="model registry dir")
     t.add_argument("--epochs", type=int, default=0)
     t.add_argument("--metrics-port", type=int, default=None)
+    t.add_argument("--tls-dir", default=None,
+                   help="cert.pem/key.pem/ca.pem dir; serves cluster mTLS when set")
+    t.add_argument("--tls-issue", action="store_true",
+                   help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    t.add_argument("--manager", default="",
+                   help="manager RPC host:port (only needed for --tls-issue)")
+    t.add_argument("--otlp-endpoint", default=None,
+                   help="OTLP/HTTP collector base URL for span export")
 
     m = sub.add_parser("manager", help="REST control plane")
     m.add_argument("--host", default="127.0.0.1")
@@ -390,6 +474,12 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--registry-dir", default=None)
     m.add_argument("--rpc-port", type=int, default=0)
     m.add_argument("--metrics-port", type=int, default=None)
+    m.add_argument("--cert-dir", default=None,
+                   help="cluster CA dir; enables the IssueCertificate RPC (pkg/issuer)")
+    m.add_argument("--tls-dir", default=None,
+                   help="cert.pem/key.pem/ca.pem dir; serves the manager RPC over mTLS")
+    m.add_argument("--otlp-endpoint", default=None,
+                   help="OTLP/HTTP collector base URL for span export")
 
     d = sub.add_parser("dfdaemon", help="peer data-plane daemon")
     d.add_argument("--data-dir", required=True)
@@ -420,6 +510,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="P2P hijack rule REGEX[=>REDIRECT_HOST]; prefix "
                    "'direct:' to match-but-bypass (repeatable)")
     d.add_argument("--metrics-port", type=int, default=None)
+    d.add_argument("--tls-dir", default=None,
+                   help="cert.pem/key.pem/ca.pem dir; dials schedulers over mTLS")
+    d.add_argument("--tls-issue", action="store_true",
+                   help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    d.add_argument("--manager", default="",
+                   help="manager RPC host:port (only needed for --tls-issue)")
+    d.add_argument("--otlp-endpoint", default=None,
+                   help="OTLP/HTTP collector base URL for span export")
     return p
 
 

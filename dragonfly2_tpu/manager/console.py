@@ -1,0 +1,148 @@
+"""Embedded web console — the manager's browser UI.
+
+Capability parity with the reference's embedded console SPA
+(manager/manager.go:61-63 embeds `dist/` and serves it at `/`): a single
+self-contained page (no build step, no external assets) served by
+ManagerREST at `/` that signs in against `/api/v1/users/signin`, then
+browses clusters, schedulers, seed peers, peers, jobs, applications and
+models, and can submit preheat jobs — every call goes through the same
+REST surface external clients use, so the console exercises nothing
+private.
+"""
+
+CONSOLE_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>Dragonfly2-TPU Manager</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f5f6f8; color: #1c2330; }
+  header { background: #16324f; color: #fff; padding: 10px 20px; display: flex;
+           align-items: center; gap: 16px; }
+  header h1 { font-size: 16px; margin: 0; font-weight: 600; }
+  header .who { margin-left: auto; font-size: 13px; opacity: .85; }
+  nav { display: flex; gap: 4px; padding: 8px 16px; background: #fff;
+        border-bottom: 1px solid #dde1e7; flex-wrap: wrap; }
+  nav button { border: 0; background: none; padding: 8px 12px; cursor: pointer;
+               font-size: 14px; border-radius: 6px; color: #3b4456; }
+  nav button.on { background: #e8f0fe; color: #16324f; font-weight: 600; }
+  main { padding: 16px 20px; }
+  table { border-collapse: collapse; width: 100%; background: #fff;
+          box-shadow: 0 1px 2px rgba(20,30,50,.08); border-radius: 8px; overflow: hidden; }
+  th, td { text-align: left; padding: 8px 12px; border-bottom: 1px solid #eef0f4;
+           font-size: 13px; vertical-align: top; max-width: 420px; overflow-wrap: anywhere; }
+  th { background: #fafbfc; font-weight: 600; color: #5a6372; }
+  .error { color: #b3261e; margin: 8px 0; }
+  form.card, .card { background: #fff; padding: 16px; border-radius: 8px; max-width: 440px;
+                     box-shadow: 0 1px 2px rgba(20,30,50,.08); margin-bottom: 16px; }
+  input, select { padding: 7px 9px; margin: 4px 0; width: 100%; box-sizing: border-box;
+                  border: 1px solid #cdd3dc; border-radius: 6px; font-size: 14px; }
+  button.go { background: #16324f; color: #fff; border: 0; padding: 8px 14px;
+              border-radius: 6px; cursor: pointer; margin-top: 8px; font-size: 14px; }
+  .muted { color: #7a8394; font-size: 12px; }
+</style>
+</head>
+<body>
+<header><h1>Dragonfly2-TPU Manager</h1><span class="who" id="who"></span></header>
+<nav id="nav" hidden></nav>
+<main id="main"></main>
+<script>
+"use strict";
+const GROUPS = ["clusters", "schedulers", "seed-peers", "peers", "jobs",
+                "applications", "models"];
+let token = null, user = null, tab = "clusters";
+
+async function api(method, path, body) {
+  const headers = {"Content-Type": "application/json"};
+  if (token) headers["Authorization"] = "Bearer " + token;
+  const resp = await fetch("/api/v1/" + path, {
+    method, headers, body: body === undefined ? undefined : JSON.stringify(body),
+  });
+  const data = await resp.json().catch(() => ({}));
+  if (!resp.ok) throw new Error(data.error || resp.status);
+  return data;
+}
+
+function el(tag, attrs, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {}))
+    (k.startsWith("on")) ? node.addEventListener(k.slice(2), v) : node.setAttribute(k, v);
+  for (const c of children)
+    node.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  return node;
+}
+
+function renderLogin(message) {
+  document.getElementById("nav").hidden = true;
+  const main = document.getElementById("main");
+  main.replaceChildren(el("form", {class: "card", onsubmit: async (e) => {
+    e.preventDefault();
+    try {
+      const body = {name: e.target.name.value, password: e.target.password.value};
+      token = (await api("POST", "users/signin", body)).token;
+      user = body.name;
+      renderApp();
+    } catch (err) { renderLogin(String(err)); }
+  }},
+    el("h2", {}, "Sign in"),
+    message ? el("div", {class: "error"}, message) : "",
+    el("input", {name: "name", placeholder: "user (root)", required: ""}),
+    el("input", {name: "password", type: "password", placeholder: "password", required: ""}),
+    el("button", {class: "go"}, "Sign in"),
+    el("div", {class: "muted"}, "default root / dragonfly")));
+}
+
+function renderApp() {
+  document.getElementById("who").textContent = user || "";
+  const nav = document.getElementById("nav");
+  nav.hidden = false;
+  nav.replaceChildren(...GROUPS.map(g =>
+    el("button", {class: g === tab ? "on" : "", onclick: () => { tab = g; renderApp(); }}, g)),
+    el("button", {onclick: () => { token = null; renderLogin(); }}, "sign out"));
+  renderTab().catch(err =>
+    document.getElementById("main").replaceChildren(el("div", {class: "error"}, String(err))));
+}
+
+async function renderTab() {
+  const main = document.getElementById("main");
+  const rows = await api("GET", tab);
+  const children = [];
+  if (tab === "jobs") children.push(preheatForm());
+  if (!rows.length) {
+    children.push(el("div", {class: "card"}, "no " + tab + " yet"));
+  } else {
+    const cols = [...new Set(rows.flatMap(r => Object.keys(r)))].slice(0, 9);
+    children.push(el("table", {},
+      el("thead", {}, el("tr", {}, ...cols.map(c => el("th", {}, c)))),
+      el("tbody", {}, ...rows.map(r => el("tr", {}, ...cols.map(c =>
+        el("td", {}, r[c] === undefined ? "" :
+          (typeof r[c] === "object" ? JSON.stringify(r[c]) : r[c]))))))));
+  }
+  main.replaceChildren(...children);
+}
+
+function preheatForm() {
+  return el("form", {class: "card", onsubmit: async (e) => {
+    e.preventDefault();
+    try {
+      await api("POST", "jobs", {type: "preheat", args: {
+        type: e.target.ptype.value, url: e.target.url.value,
+      }});
+      renderApp();
+    } catch (err) { alert(err); }
+  }},
+    el("h3", {}, "Preheat"),
+    el("input", {name: "url", placeholder: "https://... (file or image manifest URL)", required: ""}),
+    el("select", {name: "ptype"},
+      el("option", {value: ""}, "auto"),
+      el("option", {value: "file"}, "file"),
+      el("option", {value: "image"}, "image")),
+    el("button", {class: "go"}, "Create preheat job"));
+}
+
+renderLogin();
+</script>
+</body>
+</html>
+"""

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dragonfly2_tpu.manager import auth
@@ -43,6 +44,7 @@ CRUD_TABLES = {
 _OPEN_ROUTES = {
     ("POST", "users", "signup"),
     ("POST", "users", "signin"),
+    ("GET", "users", "signin"),  # oauth signin + callback (router.go:108-109)
     ("POST", "users", "refresh_token"),
     ("GET", "configs", None),
     ("*", "jobs", None),
@@ -50,12 +52,21 @@ _OPEN_ROUTES = {
 
 
 class _Request:
-    def __init__(self, method: str, group: str, parts: list[str], body: dict, user: dict | None):
+    def __init__(
+        self,
+        method: str,
+        group: str,
+        parts: list[str],
+        body: dict,
+        user: dict | None,
+        query: dict | None = None,
+    ):
         self.method = method
         self.group = group
         self.parts = parts  # path segments after the group
         self.body = body
         self.user = user
+        self.query = query or {}  # first value per query param
 
 
 class ManagerREST:
@@ -71,6 +82,20 @@ class ManagerREST:
                 pass
 
             def _run(self):
+                # The console page is served here, OUTSIDE handle(): an
+                # in-band sentinel key in JSON payloads would let any
+                # attacker-controlled record (e.g. the open /jobs CRUD)
+                # smuggle text/html bytes into a response — stored XSS.
+                if self.command == "GET" and self.path.partition("?")[0].rstrip("/") in ("", "/console"):
+                    from dragonfly2_tpu.manager.console import CONSOLE_HTML
+
+                    raw = CONSOLE_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(raw)))
+                    self.end_headers()
+                    self.wfile.write(raw)
+                    return
                 try:
                     status, payload = outer.handle(
                         self.command, self.path, self._body(), self.headers
@@ -94,6 +119,10 @@ class ManagerREST:
                     outer.metrics.request_failure.labels(self.command, group).inc()
                 raw = json.dumps(payload).encode()
                 self.send_response(status)
+                if status in (301, 302) and isinstance(payload, dict) and payload.get("location"):
+                    # oauth signin redirects the browser to the provider's
+                    # consent page (handlers/user.go:204 ctx.Redirect)
+                    self.send_header("Location", payload["location"])
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
@@ -130,7 +159,17 @@ class ManagerREST:
     # ------------------------------------------------------------- dispatch
 
     def handle(self, method: str, path: str, body: dict, headers) -> tuple[int, object]:
-        path = path.split("?", 1)[0].rstrip("/")
+        path, _, query_string = path.partition("?")
+        path = path.rstrip("/")
+        query = {
+            k: v[0] for k, v in urllib.parse.parse_qs(query_string).items()
+        }
+        if method == "GET" and path in ("/swagger.json", "/swagger/doc.json"):
+            # machine-readable API spec from the route table (the
+            # reference ships generated swagger, api/manager/docs.go).
+            # (The console SPA at "/" is served directly by the HTTP
+            # handler — handle() only ever returns JSON payloads.)
+            return 200, openapi_spec()
         m = re.match(r"^/(api|oapi)/v1/([-a-z_]+)(?:/(.*))?$", path)
         if not m:
             return 404, {"error": f"no route for {path}"}
@@ -138,7 +177,7 @@ class ManagerREST:
         parts = [p for p in rest.split("/") if p]
 
         user = self._authenticate(surface, method, group, parts, headers)
-        req = _Request(method, group, parts, body, user)
+        req = _Request(method, group, parts, body, user, query)
         if group == "users":
             return self._users(req)
         if group == "roles":
@@ -235,6 +274,19 @@ class ManagerREST:
             if token is None:
                 raise PermissionError("cannot refresh")
             return 200, {"token": token}
+        # oauth2 authorization-code flow (router.go:108-109)
+        if req.method == "GET" and len(req.parts) == 2 and req.parts[0] == "signin":
+            return 302, {"location": svc.oauth_signin(req.parts[1])}
+        if (
+            req.method == "GET"
+            and len(req.parts) == 3
+            and req.parts[0] == "signin"
+            and req.parts[2] == "callback"
+        ):
+            token = svc.oauth_signin_callback(
+                req.parts[1], req.query.get("code", ""), req.query.get("state", "")
+            )
+            return 200, {"token": token}
         if req.method == "GET" and not req.parts:
             return 200, svc.get_users()
         if not req.parts:
@@ -320,3 +372,106 @@ class ManagerREST:
                 body.setdefault("user_id", req.user.get("id"))
             return 200, svc.create_personal_access_token(body)
         return self._crud("personal_access_tokens", req)
+
+
+def openapi_spec() -> dict:
+    """OpenAPI 3.0 document generated from the live route table — the
+    machine-readable twin of api/manager/docs.go (5.3k generated LoC in
+    the reference), built from CRUD_TABLES + the special routes so it can
+    never drift from what `handle()` actually serves."""
+    from dragonfly2_tpu import version as _version
+
+    def op(summary, group, *, body=False, params=()):
+        entry = {
+            "summary": summary,
+            "tags": [group],
+            "responses": {"200": {"description": "OK"}},
+        }
+        if body:
+            entry["requestBody"] = {
+                "content": {"application/json": {"schema": {"type": "object"}}}
+            }
+        if params:
+            entry["parameters"] = [
+                {
+                    "name": p,
+                    "in": "path",
+                    "required": True,
+                    "schema": {"type": "string"},
+                }
+                for p in params
+            ]
+        return entry
+
+    paths: dict = {}
+    for group in sorted(CRUD_TABLES):
+        paths[f"/api/v1/{group}"] = {
+            "get": op(f"list {group}", group),
+            "post": op(f"create one of {group}", group, body=True),
+        }
+        paths[f"/api/v1/{group}/{{id}}"] = {
+            "get": op(f"get one of {group}", group, params=("id",)),
+            "patch": op(f"update one of {group}", group, body=True, params=("id",)),
+            "delete": op(f"delete one of {group}", group, params=("id",)),
+        }
+    paths["/api/v1/users/signup"] = {"post": op("sign up", "users", body=True)}
+    paths["/api/v1/users/signin"] = {"post": op("sign in -> JWT", "users", body=True)}
+    paths["/api/v1/users/refresh_token"] = {
+        "post": op("refresh JWT", "users", body=True)
+    }
+    paths["/api/v1/users/signin/{name}"] = {
+        "get": op("oauth signin redirect", "users", params=("name",))
+    }
+    paths["/api/v1/users/signin/{name}/callback"] = {
+        "get": op("oauth signin callback -> JWT", "users", params=("name",))
+    }
+    paths["/api/v1/users/{id}/reset_password"] = {
+        "post": op("reset password", "users", body=True, params=("id",))
+    }
+    paths["/api/v1/users/{id}/roles"] = {
+        "get": op("roles for user", "users", params=("id",))
+    }
+    paths["/api/v1/users/{id}/roles/{role}"] = {
+        "put": op("grant role", "users", params=("id", "role")),
+        "delete": op("revoke role", "users", params=("id", "role")),
+    }
+    paths["/api/v1/roles"] = {
+        "get": op("list roles", "roles"),
+        "post": op("create role with permissions", "roles", body=True),
+    }
+    paths["/api/v1/roles/{role}"] = {
+        "get": op("permissions of role", "roles", params=("role",)),
+        "delete": op("delete role", "roles", params=("role",)),
+    }
+    paths["/api/v1/roles/{role}/permissions"] = {
+        "post": op("add permission", "roles", body=True, params=("role",)),
+        "delete": op("remove permission", "roles", body=True, params=("role",)),
+    }
+    paths["/api/v1/permissions"] = {"get": op("list permission objects", "permissions")}
+    paths["/api/v1/jobs"] = {
+        "get": op("list jobs", "jobs"),
+        "post": op("create job (preheat / sync_peers)", "jobs", body=True),
+    }
+    paths["/api/v1/jobs/{id}"] = {"get": op("get job", "jobs", params=("id",))}
+    paths["/api/v1/personal-access-tokens"] = {
+        "get": op("list PATs", "personal-access-tokens"),
+        "post": op("create PAT", "personal-access-tokens", body=True),
+    }
+    paths["/api/v1/personal-access-tokens/{id}"] = {
+        "delete": op("revoke PAT", "personal-access-tokens", params=("id",)),
+    }
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "Dragonfly2-TPU Manager API",
+            "version": _version.GIT_VERSION,
+            "description": "REST control plane (manager/router/router.go parity)",
+        },
+        "components": {
+            "securitySchemes": {
+                "bearerAuth": {"type": "http", "scheme": "bearer", "bearerFormat": "JWT"}
+            }
+        },
+        "security": [{"bearerAuth": []}],
+        "paths": paths,
+    }

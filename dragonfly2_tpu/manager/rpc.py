@@ -100,12 +100,29 @@ class DynconfigResponse:
 
 
 @dataclasses.dataclass
+class IssueCertificateRequest:
+    """CSR-based cert issuance (pkg/issuer DragonflyIssuer + the security
+    client every service runs when mTLS is on, scheduler.go:180-219)."""
+
+    csr_pem: bytes
+    validity_days: int = 365
+
+
+@dataclasses.dataclass
+class IssueCertificateResponse:
+    # leaf first, then the CA — the chain order ssl.load_cert_chain wants
+    certificate_chain: list[bytes]
+
+
+@dataclasses.dataclass
 class Ack:
     ok: bool = True
     error: str = ""
 
 
 wire.register_messages(
+    IssueCertificateRequest,
+    IssueCertificateResponse,
     GetSchedulersRequest,
     SchedulerEntry,
     GetSchedulersResponse,
@@ -125,17 +142,19 @@ wire.register_messages(
 
 class ManagerRPCServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
-                 health_check=None):
+                 health_check=None, ssl_context=None):
         self.service = service
         self.health_check = health_check
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
         self._tracker = ConnTracker()
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
-            self._tracker.tracked(self._serve_conn), self.host, self.port
+            self._tracker.tracked(self._serve_conn), self.host, self.port,
+            ssl=self.ssl_context,
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
@@ -221,6 +240,9 @@ class ManagerRPCServer:
                 return CreateModelResponse(model_id=record["model_id"], version=record["version"])
             if isinstance(request, GetDynconfigRequest):
                 return DynconfigResponse(data=svc.scheduler_dynconfig(request.scheduler_cluster_id))
+            if isinstance(request, IssueCertificateRequest):
+                chain = svc.issue_certificate(request.csr_pem, request.validity_days)
+                return IssueCertificateResponse(certificate_chain=chain)
         except Exception as e:  # noqa: BLE001 - errors cross the wire as acks
             return Ack(ok=False, error=f"{type(e).__name__}: {e}")
         return Ack(ok=False, error=f"unknown request {type(request).__name__}")
@@ -233,15 +255,18 @@ class ManagerClient:
     """Typed client with one connection, used by schedulers/daemons
     (pkg/rpc/manager/client surface)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, ssl_context=None):
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._lock = asyncio.Lock()
 
     async def connect(self) -> "ManagerClient":
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         return self
 
     async def close(self) -> None:
@@ -266,3 +291,38 @@ class ManagerClient:
             except (ConnectionError, RuntimeError) as e:
                 logger.warning("keepalive failed: %s", e)
             await asyncio.sleep(interval)
+
+
+async def obtain_certificate(
+    manager_host: str,
+    manager_port: int,
+    common_name: str,
+    cert_dir,
+    san_hosts: list[str] | None = None,
+    ssl_context=None,
+    validity_days: int = 365,
+):
+    """Service-side certify flow (the reference's security client: generate
+    keypair + CSR locally, IssueCertificate against the manager, install
+    the returned chain). Returns a ready `utils.certs.TLSMaterial` whose
+    server/client contexts speak cluster mTLS. `ssl_context` lets the
+    issuance call itself ride TLS (server-auth-only bootstrap) when the
+    manager already serves it."""
+    from dragonfly2_tpu.utils import certs
+
+    csr_pem, key_pem = certs.generate_csr(
+        common_name, san_hosts or ["127.0.0.1", "localhost"]
+    )
+    client = await ManagerClient(manager_host, manager_port, ssl_context=ssl_context).connect()
+    try:
+        resp = await client.call(
+            IssueCertificateRequest(csr_pem=csr_pem, validity_days=validity_days)
+        )
+    finally:
+        await client.close()
+    chain = resp.certificate_chain
+    if not chain or len(chain) < 2:
+        raise RuntimeError("manager returned an incomplete certificate chain")
+    mat = certs.TLSMaterial(cert_dir)
+    mat.write(cert_pem=chain[0], key_pem=key_pem, ca_pem=chain[-1])
+    return mat

@@ -38,6 +38,7 @@ class ManagerService:
         token_authority: auth.TokenAuthority | None = None,
         searcher: Searcher | None = None,
         plugin_dir: str | None = None,
+        cert_dir: str | None = None,
     ):
         self.db = db or Database()
         self.registry = registry  # registry.ModelRegistry | None
@@ -46,6 +47,11 @@ class ManagerService:
         self.enforcer = auth.Enforcer(self.db)
         self.searcher = searcher or new_searcher(plugin_dir)
         self.metrics = manager_series(default_registry())
+        # cluster CA for mTLS cert issuance (pkg/issuer); lazily created
+        # on first use when a cert_dir is configured, never otherwise
+        self.cert_dir = cert_dir
+        self._ca: tuple[bytes, bytes] | None = None
+        self._oauth_providers: dict = {}  # name -> (config key, provider)
         self.enforcer.init_policies()
         self._ensure_root_user()
 
@@ -87,6 +93,75 @@ class ManagerService:
         if not auth.verify_password(password, user["encrypted_password"]):
             raise PermissionError("bad credentials")
         return self.tokens.issue(user["id"], name)
+
+    # ---------------------------------------------------------- oauth signin
+
+    def _oauth_provider(self, name: str):
+        """Provider built from the `oauth` table row; cached so the state
+        dict survives between signin and callback (handlers/user.go:190
+        OauthSignin -> :216 OauthSigninCallback). The cache key covers the
+        WHOLE record, so any CRUD update (secret rotation, endpoint change)
+        rebuilds the provider instead of serving stale credentials."""
+        import json as _json
+
+        from dragonfly2_tpu.manager import oauth as oauth_mod
+
+        record = self.db.find_one("oauth", {"name": name})
+        if record is None:
+            raise RecordNotFound(f"no oauth provider {name!r} configured")
+        cache_key = _json.dumps(record, sort_keys=True, default=str)
+        cached = self._oauth_providers.get(name)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
+        provider = oauth_mod.provider_from_record(record)
+        self._oauth_providers[name] = (cache_key, provider)
+        return provider
+
+    def oauth_signin(self, name: str) -> str:
+        """-> consent-page URL to redirect the browser to (OauthSignin)."""
+        return self._oauth_provider(name).auth_code_url()
+
+    def oauth_signin_callback(self, name: str, code: str, state: str = "") -> str:
+        """Code exchange -> userinfo -> create-or-get user -> manager JWT
+        (OauthSigninCallback + gin-jwt LoginHandler).
+
+        Account linking keys on the provider's STABLE subject id stored in
+        (oauth_provider, oauth_subject) — never on the display name, which
+        the IdP lets users edit freely (a display name of "root" must not
+        sign in as the bootstrap root account). The state parameter is
+        mandatory: an absent state is a forged/replayed callback."""
+        provider = self._oauth_provider(name)
+        if not provider.check_state(state):
+            raise PermissionError("oauth state missing, mismatched, or expired")
+        token = provider.exchange(code)
+        info = provider.get_user(token)
+        user = self.db.find_one(
+            "users", {"oauth_provider": name, "oauth_subject": info["subject"]}
+        )
+        if user is None:
+            username = info["name"]
+            if self.db.find_one("users", {"name": username}) is not None:
+                # never collide with (and thereby shadow) an existing local
+                # account; scope the visible name by provider+subject
+                username = f"{info['name']}@{name}:{info['subject']}"
+            user = self.db.create(
+                "users",
+                {
+                    "name": username,
+                    "email": info["email"],
+                    "avatar": info["avatar"],
+                    "oauth_provider": name,
+                    "oauth_subject": info["subject"],
+                    # oauth users have no local password; a random one
+                    # keeps the password path closed without a schema fork
+                    "encrypted_password": auth.hash_password(os.urandom(16).hex()),
+                    "state": "enable",
+                },
+            )
+            self.enforcer.add_role_for_user(username, auth.GUEST_ROLE)
+        elif user.get("state") != "enable":
+            raise PermissionError("user disabled")
+        return self.tokens.issue(user["id"], user["name"])
 
     def reset_password(self, user_id: int, new_password: str) -> None:
         self.db.update("users", user_id, {"encrypted_password": auth.hash_password(new_password)})
@@ -251,6 +326,42 @@ class ManagerService:
         for record in self.db.list("models", {"model_id": model_id}, per_page=100000):
             state = "active" if record["version"] == version else "inactive"
             self.db.update("models", record["id"], {"state": state})
+
+    # ------------------------------------------------------------------ pki
+
+    def _cluster_ca(self) -> tuple[bytes, bytes]:
+        """Load-or-create the cluster CA under cert_dir (pkg/issuer roots).
+        (cert_pem, key_pem); persisted so restarts keep issuing from the
+        same root and existing leaf certs stay valid."""
+        if self._ca is not None:
+            return self._ca
+        if self.cert_dir is None:
+            raise RuntimeError("manager has no cert_dir configured; mTLS issuance is off")
+        import pathlib
+
+        from dragonfly2_tpu.utils import certs
+
+        d = pathlib.Path(self.cert_dir)
+        d.mkdir(parents=True, exist_ok=True)
+        ca_cert_p, ca_key_p = d / "ca.pem", d / "ca_key.pem"
+        if ca_cert_p.exists() and ca_key_p.exists():
+            self._ca = (ca_cert_p.read_bytes(), ca_key_p.read_bytes())
+        else:
+            cert_pem, key_pem = certs.generate_ca()
+            ca_cert_p.write_bytes(cert_pem)
+            ca_key_p.write_bytes(key_pem)
+            ca_key_p.chmod(0o600)
+            self._ca = (cert_pem, key_pem)
+        return self._ca
+
+    def issue_certificate(self, csr_pem: bytes, validity_days: int = 365) -> list[bytes]:
+        """Sign a service CSR with the cluster CA -> [leaf, ca] chain
+        (manager-side of the security client's IssueCertificate)."""
+        from dragonfly2_tpu.utils import certs
+
+        ca_cert, ca_key = self._cluster_ca()
+        leaf = certs.sign_csr(ca_cert, ca_key, csr_pem, validity_days=validity_days)
+        return [leaf, ca_cert]
 
     # ----------------------------------------------------------------- jobs
 

@@ -26,9 +26,10 @@ class SchedulerConnection:
     """One long-lived announce stream to a scheduler (AnnouncePeer
     semantics: requests flow up, scheduling responses flow back async)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, ssl_context=None):
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context  # ssl.SSLContext for mTLS, None = plaintext
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._responses: dict[str, asyncio.Queue] = {}
@@ -39,7 +40,9 @@ class SchedulerConnection:
         self._send_lock = asyncio.Lock()
 
     async def connect(self) -> "SchedulerConnection":
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         self._reader_task = asyncio.create_task(self._read_loop())
         return self
 
@@ -112,9 +115,10 @@ class SchedulerClientPool:
     """Task-affine scheduler selection over a scheduler set (the
     consistent-hashing balancer + resolver pair)."""
 
-    def __init__(self, addresses: list[tuple[str, int]]):
+    def __init__(self, addresses: list[tuple[str, int]], ssl_context=None):
         if not addresses:
             raise ValueError("need at least one scheduler address")
+        self.ssl_context = ssl_context
         self._ring = HashRing([f"{h}:{p}" for h, p in addresses])
         self._addr = {f"{h}:{p}": (h, p) for h, p in addresses}
         self._conns: dict[str, SchedulerConnection] = {}
@@ -133,7 +137,7 @@ class SchedulerClientPool:
             conn = self._conns.get(key)
             if conn is None:
                 host, port = self._addr[key]
-                conn = await SchedulerConnection(host, port).connect()
+                conn = await SchedulerConnection(host, port, ssl_context=self.ssl_context).connect()
                 self._conns[key] = conn
             return conn
 
@@ -148,7 +152,9 @@ class SchedulerClientPool:
             for key, (host, port) in self._addr.items():
                 if key not in self._conns:
                     try:
-                        self._conns[key] = await SchedulerConnection(host, port).connect()
+                        self._conns[key] = await SchedulerConnection(
+                            host, port, ssl_context=self.ssl_context
+                        ).connect()
                     except OSError as e:
                         logger.warning("scheduler %s unreachable: %s", key, e)
             return list(self._conns.values())
@@ -163,9 +169,10 @@ class SchedulerClientPool:
 class TrainerClient:
     """Client-streaming dataset upload (trainerv1.Trainer/Train)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, ssl_context=None):
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
 
     async def train(
         self, host_id: str, ip: str, hostname: str, datasets: dict,
@@ -174,7 +181,9 @@ class TrainerClient:
         """`datasets` maps name -> bytes OR an iterable of bytes parts
         (e.g. one per CSV rotation file), so callers can stream a large
         trace history without materializing it all at once."""
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         try:
             try:
                 for dataset, value in datasets.items():

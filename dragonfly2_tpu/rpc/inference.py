@@ -152,8 +152,10 @@ class InferenceRPCServer:
         port: int = 0,
         refresh_ttl_s: float = 0.5,
         health_check=None,
+        ssl_context=None,
     ):
         self.health_check = health_check
+        self.ssl_context = ssl_context
         self.servers = servers
         self.host = host
         self.port = port
@@ -169,7 +171,8 @@ class InferenceRPCServer:
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
-            self._tracker.tracked(self._serve_conn), self.host, self.port
+            self._tracker.tracked(self._serve_conn), self.host, self.port,
+            ssl=self.ssl_context,
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
@@ -326,15 +329,18 @@ class InferenceClient:
     """Typed client mirroring pkg/rpc/inference/client/client_v1.go's
     surface (ModelInfer / ModelReady / ServerLive) over one connection."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, ssl_context=None):
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._reader = None
         self._writer = None
         self._lock = asyncio.Lock()
 
     async def connect(self) -> "InferenceClient":
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context
+        )
         return self
 
     async def close(self) -> None:

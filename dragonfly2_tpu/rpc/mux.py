@@ -57,6 +57,12 @@ class _CountedReader(asyncio.StreamReader):
         super().feed_data(data)
 
     async def read(self, n=-1):
+        if n < 0:
+            # StreamReader.read(-1) loops over self.read(limit) — those
+            # inner calls hit this override and already decrement; doing
+            # it again here would double-count and wedge `buffered`
+            # negative, silently disabling the high-water check.
+            return await super().read(n)
         data = await super().read(n)
         self.buffered -= len(data)
         return data
@@ -104,8 +110,10 @@ class MuxServer:
         port: int = 0,
         metrics_registry=None,
         health_check=None,  # () -> bool; liveness beyond "process is up"
+        ssl_context=None,
     ):
         self.rpc_handler = rpc_handler
+        self.ssl_context = ssl_context
         self.host = host
         self.port = port
         self.metrics_registry = metrics_registry
@@ -118,7 +126,8 @@ class MuxServer:
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
-            self._tracker.tracked(self._handle), self.host, self.port
+            self._tracker.tracked(self._handle), self.host, self.port,
+            ssl=self.ssl_context,
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]

@@ -40,12 +40,13 @@ SEED_TRIGGER_TTL_S = 60.0
 
 class SchedulerRPCServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
-                 tick_interval: float = 0.005, health_check=None):
+                 tick_interval: float = 0.005, health_check=None, ssl_context=None):
         self.service = service
         self.health_check = health_check
         self.host = host
         self.port = port
         self.tick_interval = tick_interval
+        self.ssl_context = ssl_context  # server SSLContext for mTLS; None = plaintext
         self._server: asyncio.AbstractServer | None = None
         self._peer_conn: dict[str, asyncio.StreamWriter] = {}
         self._host_conn: dict[str, asyncio.StreamWriter] = {}
@@ -70,7 +71,8 @@ class SchedulerRPCServer:
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
-            self._tracker.tracked(self._serve_conn), self.host, self.port
+            self._tracker.tracked(self._serve_conn), self.host, self.port,
+            ssl=self.ssl_context,
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
@@ -482,11 +484,12 @@ class TrainerRPCServer:
     single TrainResponse reports the outcome."""
 
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
-                 health_check=None):
+                 health_check=None, ssl_context=None):
         self.service = service  # TrainerService (cluster/trainer_service.py)
         self.health_check = health_check
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
         self._writers: set[asyncio.StreamWriter] = set()
         self._tracker = ConnTracker()
@@ -498,7 +501,8 @@ class TrainerRPCServer:
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
-            self._tracker.tracked(self._serve_conn), self.host, self.port
+            self._tracker.tracked(self._serve_conn), self.host, self.port,
+            ssl=self.ssl_context,
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]

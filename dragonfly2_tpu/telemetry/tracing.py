@@ -116,6 +116,150 @@ def current_span() -> Span | None:
     return _current_span.get()
 
 
+# ------------------------------------------------------------------ OTLP
+
+
+def _otlp_value(value: Any) -> dict:
+    """Python scalar -> OTLP/JSON AnyValue (int64 rides as a string per
+    the protobuf JSON mapping)."""
+    if isinstance(value, bool):
+        return {"boolValue": value}
+    if isinstance(value, int):
+        return {"intValue": str(value)}
+    if isinstance(value, float):
+        return {"doubleValue": value}
+    return {"stringValue": str(value)}
+
+
+def _otlp_attributes(attrs: dict) -> list[dict]:
+    return [{"key": k, "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+def span_to_otlp(span: Span) -> dict:
+    """One Span -> an OTLP/JSON span object (opentelemetry-proto
+    trace/v1, the wire shape `POST /v1/traces` collectors ingest)."""
+    out = {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(span.start_ns),
+        "endTimeUnixNano": str(span.end_ns or span.start_ns),
+        "attributes": _otlp_attributes(span.attributes),
+        "events": [
+            {
+                "timeUnixNano": str(e.get("ts_ns", span.start_ns)),
+                "name": e.get("name", ""),
+                "attributes": _otlp_attributes(
+                    {k: v for k, v in e.items() if k not in ("name", "ts_ns")}
+                ),
+            }
+            for e in span.events
+        ],
+        "status": {"code": 2 if span.status == "ERROR" else 1},
+    }
+    if span.parent_id:
+        out["parentSpanId"] = span.parent_id
+    return out
+
+
+def spans_to_otlp_request(spans: list[Span], service: str) -> dict:
+    """ExportTraceServiceRequest JSON body for a span batch."""
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": _otlp_attributes({"service.name": service})
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "dragonfly2-tpu"},
+                        "spans": [span_to_otlp(s) for s in spans],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class OTLPExporter:
+    """Batching OTLP/HTTP-JSON trace exporter (the reference initializes a
+    Jaeger exporter per binary, cmd/dependency/dependency.go:263-280; OTLP
+    is what that stack speaks today — any collector/Jaeger >=1.35 ingests
+    `POST <endpoint>/v1/traces`). Buffers spans; full batches are handed to
+    a daemon worker thread so span-end NEVER blocks the caller (the tracer
+    runs inside asyncio handlers — a slow collector must not stall the
+    event loop, the reference's BatchSpanProcessor makes the same call).
+    `flush()` posts synchronously (shutdown/tests). Network failures drop
+    the batch with a log line, never break the traced path."""
+
+    def __init__(self, endpoint: str, service: str = "dragonfly2-tpu",
+                 batch_size: int = 64, timeout: float = 10.0):
+        import queue
+
+        self.endpoint = endpoint.rstrip("/")
+        self.service = service
+        self.batch_size = batch_size
+        self.timeout = timeout
+        self._buf: list[Span] = []
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[list[Span]]" = queue.Queue(maxsize=16)
+        self._worker: threading.Thread | None = None
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._drain, name="otlp-exporter", daemon=True
+            )
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            self._post(self._queue.get())
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._buf.append(span)
+            if len(self._buf) < self.batch_size:
+                return
+            batch, self._buf = self._buf, []
+        self._ensure_worker()
+        try:
+            self._queue.put_nowait(batch)
+        except Exception:  # noqa: BLE001 - full queue: drop, never block
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "OTLP export queue full; dropping a %d-span batch", len(batch)
+            )
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if batch:
+            self._post(batch)
+
+    def _post(self, batch: list[Span]) -> None:
+        import logging
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(spans_to_otlp_request(batch, self.service)).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}/v1/traces",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except urllib.error.URLError as e:
+            logging.getLogger(__name__).warning(
+                "OTLP export of %d spans to %s failed: %s",
+                len(batch), self.endpoint, e,
+            )
+
+
 _DEFAULT = Tracer()
 
 

@@ -51,6 +51,27 @@ class TrainResult:
     eval_metrics: dict[str, float]
     samples_per_sec: float
     steps: int
+    # XLA-counted model FLOPs per trained sample (cost_analysis of the
+    # compiled epoch program; 0.0 when the backend reports none). Callers
+    # derive achieved FLOP/s = flops_per_sample * samples_per_sec and
+    # MFU = achieved / chip peak (bench_trainer.py, bench.py).
+    flops_per_sample: float = 0.0
+
+    @property
+    def flops_per_sec(self) -> float:
+        return self.flops_per_sample * self.samples_per_sec
+
+
+def _epoch_flops(jitted, *args) -> float:
+    """Total FLOPs of one compiled epoch call per XLA's cost analysis;
+    the lowering is cached, so the real epoch call pays no extra compile."""
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0] if analysis else {}
+        return float(analysis.get("flops", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 - metrics must never break training
+        return 0.0
 
 
 def _make_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
@@ -130,8 +151,12 @@ def _index_epochs(
 
     def run(params, opt_state):
         losses, epoch_samples, epoch_secs = [], [], []
+        flops_per_sample = 0.0
         for e in range(start_epoch, epochs):
             idx = np.stack(list(D.minibatches(n_rows, batch_size, rng))).astype(np.int32)
+            if not flops_per_sample:
+                total = _epoch_flops(epoch_fn, params, opt_state, data_dev, static_dev, idx)
+                flops_per_sample = total / max(idx.shape[0] * batch_size, 1)
             t0 = time.perf_counter()
             params, opt_state, ep_losses = epoch_fn(
                 params, opt_state, data_dev, static_dev, idx
@@ -144,7 +169,7 @@ def _index_epochs(
                 on_epoch(e, params, opt_state)
         flat = [float(v) for ep in losses for v in np.asarray(ep, np.float64)]
         n_samples, dt = _steady_state_throughput(epoch_samples, epoch_secs)
-        return params, opt_state, flat, n_samples, dt
+        return params, opt_state, flat, n_samples, dt, flops_per_sample
 
     return run
 
@@ -161,11 +186,15 @@ def _stacked_epochs(
 
     def run(params, opt_state):
         losses, epoch_samples, epoch_secs = [], [], []
+        flops_per_sample = 0.0
         for e in range(start_epoch, epochs):
             batches = make_epoch_batches()
             if not batches:
                 continue
             stack = shard_stacked_batches(mesh, _stack_batches(batches))
+            if not flops_per_sample:
+                total = _epoch_flops(epoch_fn, params, opt_state, stack)
+                flops_per_sample = total / max(len(batches) * batch_size, 1)
             t0 = time.perf_counter()
             params, opt_state, ep_losses = epoch_fn(params, opt_state, stack)
             jax.block_until_ready(ep_losses)
@@ -175,7 +204,7 @@ def _stacked_epochs(
             if on_epoch is not None:
                 on_epoch(e, params, opt_state)
         n_samples, dt = _steady_state_throughput(epoch_samples, epoch_secs)
-        return params, opt_state, losses, n_samples, dt
+        return params, opt_state, losses, n_samples, dt, flops_per_sample
 
     return run
 
@@ -270,7 +299,7 @@ def train_mlp(
             optimizer, data_full, len(train_idx), batch_size, config.epochs, rng,
             start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
     else:
         params = jax.device_put(params, replicated(mesh))
         opt_state = jax.device_put(opt_state, replicated(mesh))
@@ -289,7 +318,7 @@ def train_mlp(
             loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches,
             start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
 
     pred = model.apply(params, jnp.asarray(x[eval_idx]))
     eval_metrics = M.regression_report(np.asarray(pred), y[eval_idx])
@@ -299,6 +328,7 @@ def train_mlp(
         eval_metrics=eval_metrics,
         samples_per_sec=n_samples / max(dt, 1e-9),
         steps=len(losses),
+        flops_per_sample=flops_per_sample,
     )
 
 
@@ -357,7 +387,7 @@ def train_gnn(
             loss_fn, optimizer, data_full, len(train_idx), batch_size, config.epochs,
             rng, static_data=garrs_dev, start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
     else:
         sub = _subset_rank_dataset(ds, train_idx)
         run = _stacked_epochs(
@@ -365,7 +395,7 @@ def train_gnn(
             lambda: list(D.rank_batches(sub, batch_size, rng)),
             start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
 
     eval_batch = _take_rank_batch(ds, eval_idx)
     scores = model.apply(
@@ -381,6 +411,7 @@ def train_gnn(
         eval_metrics=eval_metrics,
         samples_per_sec=n_samples / max(dt, 1e-9),
         steps=len(losses),
+        flops_per_sample=flops_per_sample,
     )
 
 
@@ -465,7 +496,7 @@ def train_attention(
             optimizer, data_full, len(train_idx), batch_size, config.epochs, rng,
             start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
     else:
         def make_epoch_batches():
             order = rng.permutation(len(train_idx))
@@ -478,7 +509,7 @@ def train_attention(
             loss_fn, optimizer, mesh, config.epochs, batch_size, make_epoch_batches,
             start_epoch=start_epoch, on_epoch=on_epoch,
         )
-        params, opt_state, losses, n_samples, dt = run(params, opt_state)
+        params, opt_state, losses, n_samples, dt, flops_per_sample = run(params, opt_state)
 
     eb = take(eval_idx)
     n_real = eb["mask"].shape[0]
@@ -505,6 +536,7 @@ def train_attention(
         eval_metrics={k: float(v) for k, v in stats.items()},
         samples_per_sec=n_samples / max(dt, 1e-9),
         steps=len(losses),
+        flops_per_sample=flops_per_sample,
     )
 
 

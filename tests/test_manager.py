@@ -395,3 +395,232 @@ def test_manager_rpc_stop_with_connected_client():
         await client.close()
 
     _run_async(scenario())
+
+
+# --------------------------------------------------------------- oauth2
+
+
+class _StubIdP:
+    """Fake provider: consent page is never rendered (the test follows the
+    redirect by hand), /token validates the code+client creds, /userinfo
+    validates the bearer token (manager/auth/oauth flow)."""
+
+    CODE = "authcode-42"
+    TOKEN = "idp-token-77"
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        outer = self
+        self.token_requests = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/token":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                form = urllib.parse.parse_qs(self.rfile.read(length).decode())
+                outer.token_requests.append(form)
+                if (
+                    form.get("code") == [outer.CODE]
+                    and form.get("client_id") == ["cid"]
+                    and form.get("client_secret") == ["csecret"]
+                ):
+                    self._json({"access_token": outer.TOKEN, "token_type": "bearer"})
+                else:
+                    self._json({"error": "bad_verification_code"}, 200)
+
+            def do_GET(self):
+                if self.path != "/userinfo":
+                    self.send_error(404)
+                    return
+                if self.headers.get("Authorization") != f"Bearer {outer.TOKEN}":
+                    self.send_error(401)
+                    return
+                self._json(
+                    {"login": "octo-dev", "email": "octo@example.com",
+                     "avatar_url": "http://a/x.png"}
+                )
+
+        import http.server as _h
+
+        self._srv = _h.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_oauth_signin_full_flow():
+    """VERDICT r1 item 6: the full authorization-code exchange against a
+    stub provider — signin redirect carries state, the callback exchanges
+    the code, creates the user on first signin, and issues the normal JWT
+    that then authenticates real API calls."""
+    idp = _StubIdP()
+    try:
+        svc = ManagerService(Database())
+        base = f"http://127.0.0.1:{idp.port}"
+        svc.db.create(
+            "oauth",
+            {
+                "name": "github",
+                "client_id": "cid",
+                "client_secret": "csecret",
+                "redirect_url": "http://manager/callback",
+                "auth_url": f"{base}/authorize",
+                "token_url": f"{base}/token",
+                "userinfo_url": f"{base}/userinfo",
+            },
+        )
+        rest = ManagerREST(svc)
+        host, port = rest.start()
+        try:
+            import urllib.request
+
+            # 1. signin -> 302 to the provider with client_id + state
+            try:
+                urllib.request.build_opener(_NoRedirect).open(
+                    f"http://{host}:{port}/api/v1/users/signin/github"
+                )
+                raise AssertionError("expected a 302 redirect")
+            except urllib.error.HTTPError as e:
+                assert e.code == 302
+                loc = e.headers["Location"]
+            assert loc.startswith(f"{base}/authorize?")
+            q = urllib.parse.parse_qs(urllib.parse.urlsplit(loc).query)
+            assert q["client_id"] == ["cid"]
+            state = q["state"][0]
+
+            # 2. provider "redirects back" with a code; callback issues JWT
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/api/v1/users/signin/github/callback"
+                f"?code={_StubIdP.CODE}&state={state}"
+            ) as r:
+                token = json.loads(r.read())["token"]
+            assert token
+            claims = svc.tokens.verify(token)
+            assert claims and claims["name"] == "octo-dev"
+            user = svc.db.find_one("users", {"name": "octo-dev"})
+            assert user is not None and user["email"] == "octo@example.com"
+
+            # 3. a replayed/forged state is rejected
+            import pytest as _pytest
+
+            with _pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/api/v1/users/signin/github/callback"
+                    f"?code={_StubIdP.CODE}&state={state}"
+                )
+            assert exc.value.code == 401
+        finally:
+            rest.stop()
+    finally:
+        idp.stop()
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *a, **k):
+        return None
+
+
+def test_swagger_doc_lists_all_groups():
+    """GET /swagger.json serves a machine-readable OpenAPI spec covering
+    every route group (api/manager/docs.go parity, VERDICT r1 item 9)."""
+    from dragonfly2_tpu.manager.rest import CRUD_TABLES
+
+    svc = ManagerService(Database())
+    rest = ManagerREST(svc)
+    host, port = rest.start()
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/swagger.json") as r:
+            spec = json.loads(r.read())
+        assert spec["openapi"].startswith("3.")
+        tags = {
+            tag for methods in spec["paths"].values()
+            for opdef in methods.values() for tag in opdef["tags"]
+        }
+        for group in list(CRUD_TABLES) + [
+            "users", "roles", "permissions", "jobs", "personal-access-tokens",
+        ]:
+            assert group in tags, group
+        # the oauth signin routes are present with their path params
+        assert "/api/v1/users/signin/{name}/callback" in spec["paths"]
+    finally:
+        rest.stop()
+
+
+def test_console_served_and_drives_api():
+    """GET / serves the embedded console (manager.go:61-63 parity) and the
+    API calls the page makes (signin -> list clusters) work end-to-end."""
+    svc = ManagerService(Database())
+    svc.create_cluster({"name": "c1"})
+    rest = ManagerREST(svc)
+    host, port = rest.start()
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/") as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            html = r.read().decode()
+        assert "Dragonfly2-TPU Manager" in html and "users/signin" in html
+        # the exact flow the console runs: signin, then a bearer-listed group
+        req = urllib.request.Request(
+            f"http://{host}:{port}/api/v1/users/signin",
+            data=json.dumps({"name": "root", "password": "dragonfly"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            token = json.loads(r.read())["token"]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/api/v1/clusters",
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req) as r:
+            clusters = json.loads(r.read())
+        assert [c["name"] for c in clusters] == ["c1"]
+    finally:
+        rest.stop()
+
+
+def test_oauth_display_name_cannot_shadow_local_users(monkeypatch):
+    """An IdP display name of 'root' must NOT sign in as (or create) the
+    bootstrap root account: linking keys on the provider's stable subject
+    id, and colliding display names get a provider-scoped username."""
+    svc = ManagerService(Database())
+    svc.db.create("oauth", {"name": "github", "client_id": "c", "client_secret": "s"})
+    provider = svc._oauth_provider("github")
+    monkeypatch.setattr(provider, "check_state", lambda s: True)
+    monkeypatch.setattr(provider, "exchange", lambda code: "tok")
+    monkeypatch.setattr(
+        provider, "get_user",
+        lambda tok: {"subject": "9001", "name": "root", "email": "", "avatar": ""},
+    )
+    token = svc.oauth_signin_callback("github", "code", state="x")
+    claims = svc.tokens.verify(token)
+    assert claims["name"] == "root@github:9001"  # never the local root
+    root = svc.db.find_one("users", {"name": "root"})
+    assert root is not None and "oauth_subject" not in root
+    # second signin reuses the SAME linked account (stable subject)
+    token2 = svc.oauth_signin_callback("github", "code", state="x")
+    assert svc.tokens.verify(token2)["name"] == "root@github:9001"
+    assert svc.db.count("users") == 2  # root + the one oauth user
+
+
+def test_oauth_callback_requires_state():
+    svc = ManagerService(Database())
+    svc.db.create("oauth", {"name": "github", "client_id": "c", "client_secret": "s"})
+    with pytest.raises(PermissionError, match="state"):
+        svc.oauth_signin_callback("github", "code", state="")

@@ -209,3 +209,67 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
             origin.stop()
 
     asyncio.run(run())
+
+
+def test_otlp_exporter_ships_ingestible_batches(tmp_path):
+    """Spans exported through OTLPExporter must arrive at a collector
+    fixture as a valid OTLP/JSON ExportTraceServiceRequest (resourceSpans
+    -> scopeSpans -> spans with ids/times/status), preserving parent links
+    and error status (VERDICT r1 item 9)."""
+    import http.server
+    import json as _json
+    import threading
+
+    from dragonfly2_tpu.telemetry.tracing import OTLPExporter, Tracer
+
+    received = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            assert self.path == "/v1/traces"
+            length = int(self.headers.get("Content-Length") or 0)
+            received.append(_json.loads(self.rfile.read(length)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tracer = Tracer(service="test-svc")
+        exporter = OTLPExporter(
+            f"http://127.0.0.1:{srv.server_address[1]}", service="test-svc",
+            batch_size=100,
+        )
+        tracer.add_exporter(exporter.export)
+        with tracer.span("parent", task_id="t-1", pieces=7):
+            with tracer.span("child"):
+                pass
+        try:
+            with tracer.span("boom"):
+                raise RuntimeError("nope")
+        except RuntimeError:
+            pass
+        exporter.flush()
+        assert len(received) == 1
+        body = received[0]
+        rs = body["resourceSpans"][0]
+        res_attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert res_attrs["service.name"] == {"stringValue": "test-svc"}
+        spans = {s["name"]: s for s in rs["scopeSpans"][0]["spans"]}
+        assert set(spans) == {"parent", "child", "boom"}
+        child, parent = spans["child"], spans["parent"]
+        assert child["traceId"] == parent["traceId"]
+        assert child["parentSpanId"] == parent["spanId"]
+        assert int(parent["endTimeUnixNano"]) >= int(parent["startTimeUnixNano"])
+        attrs = {a["key"]: a["value"] for a in parent["attributes"]}
+        assert attrs["task_id"] == {"stringValue": "t-1"}
+        assert attrs["pieces"] == {"intValue": "7"}
+        assert spans["boom"]["status"]["code"] == 2
+        assert spans["boom"]["events"][0]["name"] == "exception"
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,130 @@
+"""Cluster mTLS: CA issuance, CSR signing, and mutual-auth sockets.
+
+Mirrors the reference's optional security layer (pkg/issuer DragonflyIssuer,
+scheduler/scheduler.go:180-219 TLS on every gRPC server/client): the manager
+holds the cluster CA and signs CSRs over its RPC; scheduler and daemons
+speak mutual TLS; plaintext and wrong-CA clients are rejected.
+"""
+
+import asyncio
+import hashlib
+import ssl
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.manager import rpc as mrpc
+from dragonfly2_tpu.manager.models import Database
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+from dragonfly2_tpu.utils import certs
+
+from test_minicluster import _CountingFileServer
+
+
+def test_ca_csr_sign_roundtrip(tmp_path):
+    ca_cert, ca_key = certs.generate_ca()
+    csr, key = certs.generate_csr("scheduler-1", ["127.0.0.1", "localhost"])
+    leaf = certs.sign_csr(ca_cert, ca_key, csr)
+    mat = certs.TLSMaterial(tmp_path / "tls").write(leaf, key, ca_cert)
+    assert mat.ready
+    # contexts construct and carry mutual-auth settings
+    sctx = mat.server_context()
+    assert sctx.verify_mode == ssl.CERT_REQUIRED
+    cctx = mat.client_context()
+    assert cctx.verify_mode == ssl.CERT_REQUIRED  # TLS_CLIENT default
+
+
+def test_sign_rejects_bad_csr(tmp_path):
+    ca_cert, ca_key = certs.generate_ca()
+    with pytest.raises(Exception):
+        certs.sign_csr(ca_cert, ca_key, b"-----BEGIN CERTIFICATE REQUEST-----\nnope\n")
+
+
+def test_manager_issuance_rpc(tmp_path):
+    """Full certify flow: service CSR -> manager IssueCertificate RPC ->
+    installed chain produces working mTLS contexts."""
+
+    async def run():
+        svc = ManagerService(Database(), cert_dir=str(tmp_path / "ca"))
+        server = mrpc.ManagerRPCServer(svc)
+        host, port = await server.start()
+        try:
+            mat = await mrpc.obtain_certificate(
+                host, port, "scheduler-1", tmp_path / "sched-tls"
+            )
+            assert mat.ready
+            # the leaf verifies against the CA the manager persisted
+            ca_pem = (tmp_path / "ca" / "ca.pem").read_bytes()
+            assert mat.ca_path.read_bytes() == ca_pem
+        finally:
+            await server.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_minicluster_over_mtls(tmp_path):
+    """Scheduler RPC serving mutual TLS: a daemon with an issued cert
+    downloads end-to-end; a plaintext client and a wrong-CA client are
+    both rejected (VERDICT r1 item 4 'done' criterion)."""
+    origin = _CountingFileServer(bytes(i % 256 for i in range(120_000)))
+
+    async def run():
+        svc = ManagerService(Database(), cert_dir=str(tmp_path / "ca"))
+        mserver = mrpc.ManagerRPCServer(svc)
+        mhost, mport = await mserver.start()
+
+        sched_mat = await mrpc.obtain_certificate(
+            mhost, mport, "scheduler-1", tmp_path / "sched-tls"
+        )
+        daemon_mat = await mrpc.obtain_certificate(
+            mhost, mport, "daemon-1", tmp_path / "daemon-tls"
+        )
+
+        cfg = Config()
+        cfg.scheduler.max_hosts = 16
+        cfg.scheduler.max_tasks = 16
+        service = SchedulerService(config=cfg)
+        server = SchedulerRPCServer(
+            service, tick_interval=0.01,
+            ssl_context=sched_mat.server_context(require_client_cert=True),
+        )
+        host, port = await server.start()
+        try:
+            d1 = Daemon(
+                tmp_path / "d1", [(host, port)], hostname="tls-d1",
+                ssl_context=daemon_mat.client_context(),
+            )
+            await d1.start()
+            ts = await d1.download(origin.url(), piece_length=32 * 1024)
+            with open(ts.data_path, "rb") as f:
+                got = hashlib.sha256(f.read()).hexdigest()
+            assert got == hashlib.sha256(origin.payload).hexdigest()
+            await d1.stop()
+
+            # plaintext client: the TLS server must refuse the stream
+            with pytest.raises((ConnectionError, asyncio.IncompleteReadError, OSError)):
+                reader, writer = await asyncio.open_connection(host, port)
+                from dragonfly2_tpu.cluster import messages as msg
+                from dragonfly2_tpu.rpc import wire
+
+                wire.write_frame(writer, msg.StatTaskRequest(task_id="x"))
+                await writer.drain()
+                got = await asyncio.wait_for(reader.readexactly(4), timeout=5)
+                if not got:
+                    raise ConnectionError("closed")
+
+            # wrong-CA client: handshake must fail cert verification
+            rogue = certs.self_signed_material(tmp_path / "rogue", "rogue")
+            with pytest.raises(ssl.SSLError):
+                await asyncio.open_connection(
+                    host, port, ssl=rogue.client_context()
+                )
+        finally:
+            await server.stop()
+            await mserver.stop()
+            origin.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())
