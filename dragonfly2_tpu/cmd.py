@@ -134,7 +134,8 @@ async def _serve_scheduler(args) -> int:
     tls_server_ctx = tls_mat.server_context() if tls_mat else None
     tls_client_ctx = tls_mat.client_context() if tls_mat else None
     server = SchedulerRPCServer(
-        service, host=args.host, port=args.port, ssl_context=tls_server_ctx
+        service, host=args.host, port=args.port, ssl_context=tls_server_ctx,
+        vsock_port=args.vsock_port,
     )
     host, port = await server.start()
     import socket
@@ -449,6 +450,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="certify into --tls-dir via the manager's IssueCertificate RPC")
     s.add_argument("--otlp-endpoint", default=None,
                    help="OTLP/HTTP collector base URL for span export (--jaeger parity)")
+    s.add_argument("--vsock-port", type=int, default=None,
+                   help="also listen on this AF_VSOCK port (pkg/rpc/vsock.go; "
+                   "VM guests dial vsock://<cid>:<port>)")
 
     t = sub.add_parser("trainer", help="model training service")
     t.add_argument("--host", default="127.0.0.1")

@@ -40,9 +40,19 @@ class SchedulerConnection:
         self._send_lock = asyncio.Lock()
 
     async def connect(self) -> "SchedulerConnection":
-        self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self.ssl_context
-        )
+        from dragonfly2_tpu.utils import vsock as vsock_mod
+
+        if vsock_mod.is_vsock(self.host):
+            # vsock://<cid> host + port -> AF_VSOCK dial (pkg/rpc/vsock.go
+            # VsockDialer; the client_v1.go WithContextDialer path). The
+            # ssl_context rides along — TLS clusters stay TLS over vsock.
+            self._reader, self._writer = await vsock_mod.open_connection(
+                f"{self.host}:{self.port}", ssl_context=self.ssl_context
+            )
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port, ssl=self.ssl_context
+            )
         self._reader_task = asyncio.create_task(self._read_loop())
         return self
 

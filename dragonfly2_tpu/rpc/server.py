@@ -40,13 +40,18 @@ SEED_TRIGGER_TTL_S = 60.0
 
 class SchedulerRPCServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
-                 tick_interval: float = 0.005, health_check=None, ssl_context=None):
+                 tick_interval: float = 0.005, health_check=None, ssl_context=None,
+                 vsock_port: int | None = None):
         self.service = service
         self.health_check = health_check
         self.host = host
         self.port = port
         self.tick_interval = tick_interval
         self.ssl_context = ssl_context  # server SSLContext for mTLS; None = plaintext
+        # optional AF_VSOCK listener alongside TCP (pkg/rpc/vsock.go /
+        # pkg/dfnet VSOCK network type — VM guests dialing the host)
+        self.vsock_port = vsock_port
+        self._vsock_server: asyncio.AbstractServer | None = None
         self._server: asyncio.AbstractServer | None = None
         self._peer_conn: dict[str, asyncio.StreamWriter] = {}
         self._host_conn: dict[str, asyncio.StreamWriter] = {}
@@ -76,6 +81,16 @@ class SchedulerRPCServer:
         )
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
+        if self.vsock_port is not None:
+            from dragonfly2_tpu.utils import vsock as vsock_mod
+
+            # same ssl_context as the TCP listener: a plaintext vsock side
+            # door would silently negate the cluster's mTLS boundary
+            self._vsock_server = await vsock_mod.start_server(
+                self._tracker.tracked(self._serve_conn), self.vsock_port,
+                ssl_context=self.ssl_context,
+            )
+            logger.info("scheduler rpc also on vsock port %d", self.vsock_port)
         self._tick_task = asyncio.create_task(self._tick_loop())
         logger.info("scheduler rpc listening on %s:%d", self.host, self.port)
         return self.host, self.port
@@ -87,12 +102,16 @@ class SchedulerRPCServer:
                 await self._tick_task
             except asyncio.CancelledError:
                 pass
+        if self._vsock_server:
+            self._vsock_server.close()
         if self._server:
             self._server.close()
             # Announce streams are long-lived; cancel their handler tasks
             # before wait_closed() or 3.12 shutdown hangs (utils/conntrack.py).
             await self._tracker.cancel_all()
             await self._server.wait_closed()
+        if self._vsock_server:
+            await self._vsock_server.wait_closed()
         for w in list(self._writers):
             w.close()
 
