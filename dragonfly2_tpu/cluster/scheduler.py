@@ -459,6 +459,7 @@ class SchedulerService:
             sampled = dag.random_vertices(k, self.rng)
             slot_to_peer = self._dag_slot_peer.get(meta.task_id, {})
             ids = []
+            slots: list[int] = []
             j = 0
             for slot in sampled:
                 pid = slot_to_peer.get(int(slot))
@@ -471,12 +472,18 @@ class SchedulerService:
                 cand_valid[i, j] = True
                 blocklist[i, j] = pid in pending.blocklist
                 in_degree[i, j] = dag.in_degree[slot]
-                can_add_edge[i, j] = dag.can_add_edge(int(slot), meta.dag_slot)
                 cand_host_slots[i, j] = self.state.peer_host[pidx]
+                slots.append(int(slot))
                 ids.append(pid)
                 j += 1
                 if j >= k:
                     break
+            if slots:
+                # one batched native cycle check per peer, not one ctypes
+                # round-trip per candidate (graph/dag.py can_add_edges)
+                can_add_edge[i, : len(slots)] = dag.can_add_edges(
+                    np.asarray(slots, np.int64), meta.dag_slot
+                )
             cand_ids.append(ids)
 
         avg_rtt = has_rtt = None

@@ -133,6 +133,39 @@ class TaskDAG:
             return False
         return not self.reachable(v, u)
 
+    def can_add_edges(self, parents: np.ndarray, child: int) -> np.ndarray:
+        """Vectorized `can_add_edge(p, child)` over candidate parents —
+        the scheduler tick's cycle-check hot path. Presence/self-loop/
+        duplicate rules run as array ops; the reachability queries go
+        through the native BATCH entry point (one ctypes call instead of
+        one per candidate, whose marshalling overhead dominated the tick's
+        host-side cost)."""
+        parents = np.asarray(parents, np.int64)
+        n = parents.shape[0]
+        # child may be an unassigned dag_slot (-1): nothing is legal then
+        if n == 0 or not (0 <= child < self.capacity) or not self.present[child]:
+            return np.zeros(n, bool)
+        in_range = (parents >= 0) & (parents < self.capacity)
+        safe = np.where(in_range, parents, 0)
+        ok = in_range & self.present[safe] & (parents != child)
+        word, bit = divmod(child, 64)
+        ok &= (self.adj[safe, word] & (np.uint64(1) << np.uint64(bit))) == 0
+        if not ok.any():
+            return ok
+        from dragonfly2_tpu import native
+
+        idx = np.nonzero(ok)[0]
+        batch = native.dag_reachable_batch(
+            self.adj, np.full(idx.shape[0], child, np.int64), parents[idx]
+        )
+        if batch is not None:
+            ok[idx] &= ~batch
+        else:  # native lib unavailable: per-query fallback
+            for i in idx:
+                if self.reachable(child, int(parents[i])):
+                    ok[i] = False
+        return ok
+
     def add_edge(self, u: int, v: int) -> None:
         if not self.can_add_edge(u, v):
             raise DAGError(f"edge {u}->{v} rejected (missing vertex, duplicate, or cycle)")

@@ -211,16 +211,57 @@ def ring_pick_batch(ring_hashes: np.ndarray, key_hashes: np.ndarray) -> np.ndarr
 
 
 def dag_reachable(adj: np.ndarray, src: int, dst: int) -> bool | None:
-    """Native BFS over the TaskDAG bitmatrix; None when unavailable."""
+    """Native BFS over the TaskDAG bitmatrix; None when unavailable.
+
+    Vertex ids are bounds-checked HERE: the C++ kernel indexes the bit
+    matrix unchecked, so an out-of-range id would be a heap write, not an
+    error return."""
     lib = get_lib()
     if lib is None:
         return None
     adj = np.ascontiguousarray(adj, np.uint64)
     capacity, words = adj.shape
+    if not (0 <= src < capacity and 0 <= dst < capacity):
+        raise ValueError(f"vertex out of range [0, {capacity}): src={src} dst={dst}")
     result = lib.df_dag_reachable(_as_ptr(adj, ctypes.c_uint64), capacity, words, src, dst)
     if result < 0:
-        return None
+        return None  # native-side allocation failure
     return bool(result)
+
+
+def dag_reachable_batch(
+    adj: np.ndarray, srcs: np.ndarray, dsts: np.ndarray
+) -> np.ndarray | None:
+    """N reachability queries in ONE native call; None when unavailable.
+
+    The scheduler tick asks ~15 cycle checks per pending peer — the
+    per-call ctypes marshalling (pointer casts, lib lookup) costs more
+    than the BFS itself, so the batch entry point amortizes it."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    adj = np.ascontiguousarray(adj, np.uint64)
+    srcs = np.ascontiguousarray(srcs, np.int64)
+    dsts = np.ascontiguousarray(dsts, np.int64)
+    if srcs.shape != dsts.shape or srcs.ndim != 1:
+        raise ValueError("srcs/dsts must be equal-length 1-D arrays")
+    capacity, words = adj.shape
+    # bounds-check BEFORE the native call: the C++ kernel indexes the bit
+    # matrix unchecked, so a stale/negative id would be a heap write
+    if srcs.shape[0] and not (
+        (srcs >= 0).all() and (srcs < capacity).all()
+        and (dsts >= 0).all() and (dsts < capacity).all()
+    ):
+        raise ValueError(f"vertex out of range [0, {capacity}) in batch query")
+    out = np.empty(srcs.shape[0], np.int32)
+    lib.df_dag_reachable_batch(
+        _as_ptr(adj, ctypes.c_uint64), capacity, words,
+        _as_ptr(srcs, ctypes.c_int64), _as_ptr(dsts, ctypes.c_int64),
+        srcs.shape[0], _as_ptr(out, ctypes.c_int32),
+    )
+    if (out < 0).any():
+        return None  # native-side allocation failure
+    return out.astype(bool)
 
 
 def csv_parse_numeric(data: bytes, n_cols: int, skip_header: bool = True,

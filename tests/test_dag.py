@@ -117,3 +117,35 @@ def test_batch_can_add_edge_respects_present_mask(rng):
     child = np.array([7], np.int32)
     got = np.asarray(batch_can_add_edge(adj[None], present, parent, child))
     assert not got[0, 0]  # absent parent
+
+
+def test_can_add_edges_matches_scalar(monkeypatch):
+    """Batched cycle check == per-candidate can_add_edge, across self-loop,
+    duplicate-edge, absent-vertex, cycle, and legal cases — with and
+    without the native library."""
+    import numpy as np
+
+    from dragonfly2_tpu.graph.dag import TaskDAG
+
+    dag = TaskDAG(64)
+    a, b, c, d, e, f, g, h = range(8)
+    for v in (a, b, c, d, e, f, g, h):
+        dag.add_vertex(v)
+    dag.add_edge(a, b)
+    dag.add_edge(b, c)
+    dag.add_edge(c, d)
+    dag.add_edge(e, f)
+    dag.delete_vertex(h)
+
+    child = c
+    parents = np.array([a, b, c, d, e, f, g, h, 63], np.int64)
+    want = np.array([dag.can_add_edge(int(p), child) for p in parents])
+    got = dag.can_add_edges(parents, child)
+    assert (got == want).all(), (got, want)
+    # pure-python fallback agrees (monkeypatch restores the env var)
+    monkeypatch.setenv("DF_NATIVE", "0")
+    got_py = dag.can_add_edges(parents, child)
+    assert (got_py == want).all()
+    monkeypatch.undo()
+    # an unassigned child slot (-1) is never legal and never reaches native
+    assert not dag.can_add_edges(parents, -1).any()
