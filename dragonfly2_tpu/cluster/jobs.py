@@ -143,8 +143,15 @@ class JobManager:
         return result
 
     def sync_peers(self) -> dict[str, dict]:
-        """Collect per-scheduler entity counts (scheduler/job/job.go:224)."""
-        return {name: s.counts() for name, s in self.schedulers.items()}
+        """Per-scheduler entity counts plus each scheduler's announced-host
+        list (scheduler/job/job.go:224 responds with its peers). The
+        MANAGER layer merges `announced_hosts` into its peers table
+        (manager/service.py create_job — it owns the database and the
+        upsert idiom); this stays a pure data collection."""
+        return {
+            name: {**s.counts(), "announced_hosts": s.list_hosts()}
+            for name, s in self.schedulers.items()
+        }
 
     def get(self, job_id: str) -> JobResult | None:
         return self.jobs.get(job_id)

@@ -789,6 +789,30 @@ class SchedulerService:
         c["tasks_with_dag"] = len(self._dags)
         return c
 
+    def list_hosts(self) -> list[dict]:
+        """Announced-host snapshot for the sync_peers job (scheduler
+        job.go:224 responds with its peers; the manager merges them into
+        its Peer table, manager/job/sync_peers.go)."""
+        with self.mu:
+            out = []
+            for host_id, info in self._host_info.items():
+                if self.state.host_index(host_id) is None:
+                    continue
+                out.append(
+                    {
+                        "host_id": host_id,
+                        "hostname": info.hostname,
+                        "type": info.host_type,
+                        "ip": info.ip,
+                        "port": info.port,
+                        "download_port": info.download_port,
+                        "idc": info.idc,
+                        "location": info.location,
+                        "state": "active",
+                    }
+                )
+            return out
+
 
 def _round_up_64(n: int) -> int:
     return ((n + 63) // 64) * 64

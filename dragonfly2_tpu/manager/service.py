@@ -406,10 +406,44 @@ class ManagerService:
                 },
             )
         elif self.jobs is not None and job_type == "sync_peers":
+            result = self.jobs.sync_peers()
+            self._merge_sync_peers(result)
             record = self.db.update(
-                "jobs", record["id"], {"state": "SUCCESS", "result": self.jobs.sync_peers()}
+                "jobs", record["id"], {"state": "SUCCESS", "result": result}
             )
         return record
+
+    def _merge_sync_peers(self, result: dict) -> None:
+        """Merge the schedulers' announced hosts into the peers table
+        (manager/job/sync_peers.go:230-255): upsert present hosts as
+        active, flip departed ones inactive. Race-safe under the threaded
+        REST server via the create/except-DuplicateRecord idiom the other
+        registration paths use."""
+        seen: set[tuple[str, str]] = set()
+        for data in result.values():
+            for h in data.get("announced_hosts", []):
+                row = {
+                    "host_name": h["hostname"],
+                    "type": h["type"],
+                    "ip": h["ip"],
+                    "port": h["port"],
+                    "download_port": h["download_port"],
+                    "idc": h["idc"],
+                    "location": h["location"],
+                    "state": "active",
+                }
+                seen.add((h["hostname"], h["ip"]))
+                try:
+                    self.db.create("peers", row)
+                except DuplicateRecord:
+                    existing = self.db.find_one(
+                        "peers", {"host_name": h["hostname"], "ip": h["ip"]}
+                    )
+                    if existing is not None:
+                        self.db.update("peers", existing["id"], row)
+        for r in self.db.list("peers", per_page=1_000_000):
+            if (r["host_name"], r["ip"]) not in seen and r.get("state") == "active":
+                self.db.update("peers", r["id"], {"state": "inactive"})
 
     # --------------------------------------------------- personal access tokens
 

@@ -54,3 +54,40 @@ def test_preheat_task_id_matches_daemon_derivation():
     want = idgen.task_id_v1(url, tag="t", filtered_query_params="v&token")
     assert result.task_ids == [want]
     assert svc.seed_triggers[0].task_id == want
+
+
+def test_sync_peers_merges_hosts_into_manager_db():
+    """The sync_peers job reports each scheduler's announced hosts; the
+    MANAGER merges them into its peers table — upserting present hosts,
+    deactivating departed ones (manager/job/sync_peers.go)."""
+    from dragonfly2_tpu.cluster import messages as msg
+    from dragonfly2_tpu.manager.models import Database
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    svc = SchedulerService()
+    svc.announce_host(msg.HostInfo(host_id="h-1", hostname="peer-1", ip="10.0.0.1"))
+    svc.announce_host(
+        msg.HostInfo(host_id="h-2", hostname="seed-1", ip="10.0.0.2", host_type="super")
+    )
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    mgr = ManagerService(Database(), jobs=jm)
+    record = mgr.create_job({"type": "sync_peers"})
+    assert record["state"] == "SUCCESS"
+    # counts stay intact (hosts remains the INT count); hosts ride a new key
+    assert record["result"]["s1"]["hosts"] == 2
+    assert {h["hostname"] for h in record["result"]["s1"]["announced_hosts"]} == {
+        "peer-1", "seed-1",
+    }
+    rows = mgr.db.list("peers")
+    assert {(r["host_name"], r["type"], r["state"]) for r in rows} == {
+        ("peer-1", "normal", "active"), ("seed-1", "super", "active"),
+    }
+    # idempotent: a second run updates, never duplicates
+    mgr.create_job({"type": "sync_peers"})
+    assert len(mgr.db.list("peers")) == 2
+    # a departed host flips inactive on the next sync
+    svc.leave_host("h-1")
+    mgr.create_job({"type": "sync_peers"})
+    by_name = {r["host_name"]: r for r in mgr.db.list("peers")}
+    assert by_name["peer-1"]["state"] == "inactive"
+    assert by_name["seed-1"]["state"] == "active"
