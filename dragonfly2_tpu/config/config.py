@@ -81,6 +81,11 @@ class TrainerConfig:
     # an interrupted training resumes at the next epoch (train-state
     # resume the reference has no analogue for, SURVEY.md §5)
     checkpoint_dir: str = ""
+    # >1 scans this many epochs' minibatch permutations in ONE device call
+    # (single-chip path): on remote/tunneled devices a small dataset's
+    # epoch costs less than the dispatch round-trip, so fusing amortizes
+    # it. Checkpoint/loss cadence coarsens to the fused block.
+    epoch_fusion: int = 1
     # Also train/publish the attention parent ranker (third model family;
     # the reference's registry only knows gnn|mlp, models/model.go:19-46).
     train_attention: bool = False
