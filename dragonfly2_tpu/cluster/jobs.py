@@ -137,7 +137,18 @@ class JobManager:
             )
             if not ok:
                 failures[task_id] = "seed trigger queue full"
-        state = JobState.FAILURE if failures else JobState.SUCCESS
+        # Enqueueing triggers is not a warm cluster: the job stays PENDING
+        # until `get()` observes every task SUCCEEDED on its scheduler
+        # (machinery group semantics — the reference's preheat e2e polls
+        # the job state until the layers actually landed). No work at all
+        # (empty urls) is an immediately-successful no-op, not a job that
+        # pends forever.
+        if failures:
+            state = JobState.FAILURE
+        elif not task_ids:
+            state = JobState.SUCCESS
+        else:
+            state = JobState.PENDING
         result = JobResult(job_id, state, task_ids, {"failures": failures})
         self.jobs[job_id] = result
         return result
@@ -154,4 +165,35 @@ class JobManager:
         }
 
     def get(self, job_id: str) -> JobResult | None:
-        return self.jobs.get(job_id)
+        """Job state recomputed from LIVE task progress: a preheat is
+        PENDING until every fanned-out task has actually completed on its
+        owning scheduler (the reference's machinery group state the e2e
+        preheat tests poll, internal/job group states + test/e2e/manager/
+        preheat.go) — enqueue-time SUCCESS would claim a warm cluster
+        before any seed finished downloading."""
+        result = self.jobs.get(job_id)
+        # Only ENQUEUE-TIME failures are terminal; a FAILED observed from
+        # task polling must keep recomputing — a retried seed download can
+        # recover the task (FSM allows FAILED -> SUCCEEDED), and latching
+        # would make the job outcome depend on poll timing.
+        if result is None or result.detail.get("failures") or not result.task_ids:
+            return result
+        from dragonfly2_tpu.state.fsm import TaskState
+
+        states = []
+        for task_id in result.task_ids:
+            name = self.ring.pick(task_id)
+            svc = self.schedulers.get(name) if name else None
+            idx = svc.state.task_index(task_id) if svc else None
+            if idx is None:
+                states.append(TaskState.PENDING)  # seed not started yet
+            else:
+                states.append(TaskState(int(svc.state.task_state[idx])))
+        if any(s == TaskState.FAILED for s in states):
+            result.state = JobState.FAILURE
+            result.detail["task_states"] = [s.name for s in states]
+        elif all(s == TaskState.SUCCEEDED for s in states):
+            result.state = JobState.SUCCESS
+        else:
+            result.state = JobState.PENDING
+        return result

@@ -354,9 +354,18 @@ class SchedulerService:
         if idx is None:
             return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
         self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+        task_idx = self.state.peer_task[idx]
         if req.piece_count:
-            task_idx = self.state.peer_task[idx]
             self.state.task_total_pieces[task_idx] = req.piece_count
+        # The origin download proves the task's content exists: the task
+        # FSM goes Succeeded (service_v2 handleDownloadPeerBackToSource-
+        # FinishedRequest) — preheat job state polls exactly this. FAILED
+        # is a legal source too (fsm.py DOWNLOAD_SUCCEEDED transitions): a
+        # retry that lands must recover a task an earlier attempt failed.
+        if self.state.task_state[task_idx] in (
+            int(TaskState.RUNNING), int(TaskState.FAILED)
+        ):
+            self.state.task_event(task_idx, TaskEvent.DOWNLOAD_SUCCEEDED)
         self._write_download_record(req.peer_id, "Succeeded")
         return None
 

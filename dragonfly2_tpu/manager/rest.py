@@ -346,7 +346,7 @@ class ManagerREST:
             return 200, svc.db.list("jobs")
         job_id = int(req.parts[0])
         if req.method == "GET":
-            return 200, svc.db.get("jobs", job_id)
+            return 200, svc.get_job(job_id)
         if req.method == "PATCH":
             return 200, svc.db.update("jobs", job_id, req.body)
         if req.method == "DELETE":

@@ -413,6 +413,23 @@ class ManagerService:
             )
         return record
 
+    def get_job(self, record_id: int) -> dict:
+        """Job record with LIVE state: a preheat stays PENDING until every
+        fanned-out task completed on its scheduler, so GET /jobs/:id polls
+        real progress (the reference's machinery group-state polling,
+        test/e2e/manager/preheat.go)."""
+        record = self.db.get("jobs", record_id)
+        job_id = (record.get("result") or {}).get("job_id")
+        if self.jobs is not None and record["type"] == "preheat" and job_id:
+            live = self.jobs.get(job_id)
+            if live is not None and live.state.value != record["state"]:
+                record = self.db.update(
+                    "jobs", record_id,
+                    {"state": live.state.value,
+                     "result": {**record["result"], **live.detail}},
+                )
+        return record
+
     def _merge_sync_peers(self, result: dict) -> None:
         """Merge the schedulers' announced hosts into the peers table
         (manager/job/sync_peers.go:230-255): upsert present hosts as

@@ -16,7 +16,8 @@ def test_preheat_fans_out_by_hash_ring():
     jm = JobManager(schedulers, [seed_host(0), seed_host(1)])
     urls = [f"https://reg.example.com/layers/{i}" for i in range(12)]
     result = jm.create_preheat(PreheatRequest(urls=urls, tag="preheat"))
-    assert result.state == JobState.SUCCESS
+    # enqueue-time state is PENDING: seeds have not downloaded anything yet
+    assert result.state == JobState.PENDING
     assert len(result.task_ids) == 12
     # one TriggerSeedRequest per task, split across schedulers by the ring
     total_triggers = sum(len(s.seed_triggers) for s in schedulers.values())
@@ -91,3 +92,40 @@ def test_sync_peers_merges_hosts_into_manager_db():
     by_name = {r["host_name"]: r for r in mgr.db.list("peers")}
     assert by_name["peer-1"]["state"] == "inactive"
     assert by_name["seed-1"]["state"] == "active"
+
+
+def test_preheat_job_state_recovers_after_task_retry():
+    """A transiently FAILED task must not latch the job FAILURE: the FSM
+    allows FAILED -> SUCCEEDED on a successful retry, and get() keeps
+    recomputing (r2 review finding)."""
+    from dragonfly2_tpu.cluster import messages as msg
+    from dragonfly2_tpu.state.fsm import TaskEvent, TaskState
+
+    svc = SchedulerService()
+    svc.announce_host(seed_host(0))
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(PreheatRequest(urls=["https://e.com/blob"]))
+    assert result.state == JobState.PENDING
+    tid = result.task_ids[0]
+    # register a peer so the task exists, then drive it FAILED
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="p-1", task_id=tid, host=seed_host(0), url="https://e.com/blob",
+        content_length=10 << 20,
+    ))
+    idx = svc.state.task_index(tid)
+    svc.state.task_event(idx, TaskEvent.DOWNLOAD_FAILED)
+    assert jm.get(result.job_id).state == JobState.FAILURE
+    # a successful back-to-source retry of the same peer recovers the task
+    svc.back_to_source_started(msg.DownloadPeerBackToSourceStartedRequest(peer_id="p-1"))
+    svc.back_to_source_finished(
+        msg.DownloadPeerBackToSourceFinishedRequest(peer_id="p-1", piece_count=3)
+    )
+    assert svc.state.task_state[idx] == int(TaskState.SUCCEEDED)
+    assert jm.get(result.job_id).state == JobState.SUCCESS
+
+
+def test_preheat_empty_url_list_is_immediate_success():
+    jm = JobManager({"s1": SchedulerService()}, [seed_host(0)])
+    result = jm.create_preheat(PreheatRequest(urls=[]))
+    assert result.state == JobState.SUCCESS
+    assert jm.get(result.job_id).state == JobState.SUCCESS
