@@ -78,6 +78,40 @@ def main() -> int:
             "value": round(results[("dense", l)] / results[("flash", l)], 2),
             "unit": "x",
         }))
+
+    # Forward+backward through the flash custom_vjp — the cost a TRAINING
+    # step actually pays. Standard accounting: bwd ~= 2x fwd model FLOPs,
+    # so fwd+bwd = 3 * 4*B*H*L^2*D. Smaller B,H than the fwd sweep: the
+    # bwd's residuals + dq/dk/dv triple the live buffers, and the v5e-lite
+    # compile helper rejects the full fwd shape.
+    bwd_batch, bwd_heads = 2, 4
+    for length in (4096, 8192):
+        shape = (bwd_batch, bwd_heads, length, DIM)
+        q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        mask = jnp.ones((bwd_batch, length), bool)
+
+        grad_fn = jax.jit(
+            jax.grad(
+                lambda q, k, v, m=mask: flash_attention(q, k, v, m).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+        try:
+            ms = _bench(grad_fn, q, k, v)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "attention_flash_fwdbwd_ms", "length": length,
+                "value": None, "error": type(e).__name__,
+            }))
+            continue
+        tflops = 3 * 4 * bwd_batch * bwd_heads * length * length * DIM / (ms / 1e3) / 1e12
+        print(json.dumps({
+            "metric": "attention_flash_fwdbwd_ms", "length": length,
+            "value": round(ms, 3), "unit": "ms", "tflops": round(tflops, 1),
+            "mfu_pct_vs_197tf": round(100 * tflops / 197.0, 1),
+        }))
     return 0
 
 

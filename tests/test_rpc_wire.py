@@ -319,3 +319,57 @@ def test_vsock_target_allows_32bit_ports():
     from dragonfly2_tpu.utils import vsock
 
     assert vsock.parse_target("vsock://2:1000000") == (2, 1000000)
+
+
+def test_wire_server_survives_garbage_bytes():
+    """Robustness: random garbage, oversized length prefixes, truncated
+    frames, and unknown message types must never kill the scheduler RPC
+    server — the next legitimate connection still works."""
+    import asyncio
+    import os
+    import struct
+
+    from dragonfly2_tpu.cluster import messages as msgmod
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.rpc import wire
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def run():
+        server = SchedulerRPCServer(SchedulerService(), tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            import msgpack
+
+            unknown_type = msgpack.packb(
+                {"t": "NoSuchMessage", "d": {}}, use_bin_type=True
+            )
+            payloads = [
+                os.urandom(64),                         # pure noise
+                struct.pack(">I", 0xFFFFFFF0),          # absurd length prefix
+                struct.pack(">I", 100) + b"short",      # truncated frame
+                wire.encode(msgmod.StatTaskRequest(task_id="x"))[:7],  # cut mid-frame
+                struct.pack(">I", len(unknown_type)) + unknown_type,  # unregistered type
+            ]
+            for payload in payloads:
+                writer = None
+                try:
+                    reader, writer = await asyncio.open_connection(host, port)
+                    writer.write(payload)
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass  # server resetting us IS a valid outcome
+                finally:
+                    if writer is not None:
+                        writer.close()
+            await asyncio.sleep(0.05)
+            # the server must still answer a well-formed request
+            reader, writer = await asyncio.open_connection(host, port)
+            wire.write_frame(writer, msgmod.StatTaskRequest(task_id="nope"))
+            await writer.drain()
+            response = await asyncio.wait_for(wire.read_frame(reader), timeout=5)
+            assert isinstance(response, msgmod.StatResponse) and not response.found
+            writer.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
