@@ -624,3 +624,37 @@ def test_oauth_callback_requires_state():
     svc.db.create("oauth", {"name": "github", "client_id": "c", "client_secret": "s"})
     with pytest.raises(PermissionError, match="state"):
         svc.oauth_signin_callback("github", "code", state="")
+
+
+def test_get_job_refreshes_preheat_state_live():
+    """GET /jobs/:id recomputes a preheat's state from the schedulers'
+    live task FSMs (machinery group polling semantics): PENDING at create,
+    SUCCESS once every task succeeded, persisted back into the record."""
+    from dragonfly2_tpu.cluster import messages as cmsg
+    from dragonfly2_tpu.cluster.jobs import JobManager
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+
+    sched = SchedulerService()
+    seed = cmsg.HostInfo(host_id="seed-0", hostname="seed-0", ip="10.1.0.0",
+                         host_type="super")
+    sched.announce_host(seed)
+    jm = JobManager({"s1": sched}, [seed])
+    svc = ManagerService(Database(), jobs=jm)
+    record = svc.create_job({"type": "preheat", "args": {"url": "https://e.com/blob"}})
+    assert record["state"] == "PENDING"
+    # GET while the seed has not downloaded anything: still PENDING
+    assert svc.get_job(record["id"])["state"] == "PENDING"
+    # drive the task to SUCCEEDED the way a finished seed download would
+    task_id = record["result"]["task_ids"][0]
+    sched.register_peer(cmsg.RegisterPeerRequest(
+        peer_id="p-1", task_id=task_id, host=seed, url="https://e.com/blob",
+        content_length=10 << 20,
+    ))
+    sched.back_to_source_started(cmsg.DownloadPeerBackToSourceStartedRequest(peer_id="p-1"))
+    sched.back_to_source_finished(
+        cmsg.DownloadPeerBackToSourceFinishedRequest(peer_id="p-1", piece_count=3)
+    )
+    refreshed = svc.get_job(record["id"])
+    assert refreshed["state"] == "SUCCESS"
+    # persisted: a raw DB read shows the updated state too
+    assert svc.db.get("jobs", record["id"])["state"] == "SUCCESS"
