@@ -1,40 +1,50 @@
 """Long-context attention benchmark: Pallas flash kernel vs dense XLA.
 
 The reference has no sequence models at all (SURVEY.md §5); long-context
-support is new TPU-native territory: ops/flash.py (fused single-chip
-kernel, O(L) memory), parallel/ring.py (sp-sharded ring attention), and
-parallel/ulysses.py (all-to-all head parallelism). This script measures
-the single-chip kernel against the dense reference at growing sequence
-lengths on the real chip — dense attention materializes the [L, L] score
-matrix, so it falls off a memory cliff where flash keeps scaling.
+support is new TPU-native territory: ops/flash.py (fused fwd AND fused
+bwd kernels, O(L) memory), parallel/ring.py (sp-sharded ring attention),
+and parallel/ulysses.py (all-to-all head parallelism). This script
+measures the single-chip kernel against the dense reference at growing
+sequence lengths on the real chip — dense attention materializes the
+[L, L] score matrix, so it falls off a memory cliff where flash keeps
+scaling, and since round 3 the fused backward holds the same O(L)
+contract for training.
 
-Prints one JSON line per (length, impl): median ms over trials, plus a
-final summary line with the speedup at the largest length both complete.
+Timing method: N data-dependent steps inside ONE jit (each step feeds
+eps*output back into the inputs, eps traced so XLA cannot fold the
+chain), timed end-to-end with a D2H fetch forcing completion, divided by
+N. A single dispatch over the axon tunnel can carry ~100 ms of transport
+latency in degraded windows — per-dispatch timing measures the tunnel,
+not the kernel.
+
+Prints one JSON line per (length, impl): ms/step over the best chain,
+plus a summary line with the flash-vs-dense speedup at the largest
+length both complete, and fwd+bwd lines with MFU vs the chip's 197
+TFLOP/s bf16 peak.
 """
 
 from __future__ import annotations
 
 import json
-import statistics
 import time
 
 import numpy as np
 
 BATCH, HEADS, DIM = 4, 8, 128
 LENGTHS = (2048, 4096, 8192, 16384, 32768)
-TRIALS = 20
+CHAIN = 8
+TRIALS = 3
 
 
-def _bench(fn, *args) -> float:
-    import jax
-
-    jax.block_until_ready(fn(*args))  # compile
-    times = []
+def _bench_chain(jfn, *args) -> float:
+    """min wall-ms per chained step; np.asarray forces completion."""
+    np.asarray(jfn(*args))  # compile + warm
+    best = float("inf")
     for _ in range(TRIALS):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append((time.perf_counter() - t0) * 1e3)
-    return statistics.median(times)
+        np.asarray(jfn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / CHAIN * 1e3
 
 
 def main() -> int:
@@ -52,10 +62,21 @@ def main() -> int:
         k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
         mask = jnp.ones((BATCH, length), bool)
-        for name, fn in (("flash", flash_attention), ("dense", dense_attention)):
-            jfn = jax.jit(fn)
+        for name in ("flash", "dense"):
+            if name == "flash":
+                step = lambda q_, k_, v_: flash_attention(q_, k_, v_)  # no-mask fast path
+            else:
+                step = lambda q_, k_, v_: dense_attention(q_, k_, v_, mask)
+
+            @jax.jit
+            def chain(q_, k_, v_, eps, step=step):
+                for _ in range(CHAIN):
+                    o = step(q_, k_, v_)
+                    q_ = q_ + eps * o.astype(q_.dtype)
+                return q_[0, 0, :8, :4].astype(jnp.float32)
+
             try:
-                ms = _bench(jfn, q, k, v, mask)
+                ms = _bench_chain(chain, q, k, v, jnp.bfloat16(0.0))
             except Exception as e:  # noqa: BLE001 - dense OOMs eventually
                 print(json.dumps({
                     "metric": f"attention_{name}_ms", "length": length,
@@ -67,6 +88,7 @@ def main() -> int:
             print(json.dumps({
                 "metric": f"attention_{name}_ms", "length": length,
                 "value": round(ms, 3), "unit": "ms", "tflops": round(tflops, 1),
+                "mfu_pct_vs_197tf": round(100 * tflops / 197.0, 1),
             }))
 
     common = [l for l in LENGTHS if ("flash", l) in results and ("dense", l) in results]
@@ -79,34 +101,41 @@ def main() -> int:
             "unit": "x",
         }))
 
-    # Forward+backward through the flash custom_vjp — the cost a TRAINING
-    # step actually pays. Standard accounting: bwd ~= 2x fwd model FLOPs,
-    # so fwd+bwd = 3 * 4*B*H*L^2*D. Smaller B,H than the fwd sweep: the
-    # bwd's residuals + dq/dk/dv triple the live buffers, and the v5e-lite
-    # compile helper rejects the full fwd shape.
-    bwd_batch, bwd_heads = 2, 4
-    for length in (4096, 8192):
-        shape = (bwd_batch, bwd_heads, length, DIM)
+    # Forward+backward through the fused flash bwd — the cost a TRAINING
+    # step actually pays. Standard accounting: fwd+bwd = 3 * 4*B*H*L^2*D.
+    # Full fwd shape all the way to 32k: the fused dQ and dK/dV kernels
+    # keep the footprint constant in L (round-2's dense-recompute bwd
+    # could not fit these shapes). All three grads feed the chain so no
+    # kernel is dead-code-eliminated.
+    for length in (8192, 16384, 32768):
+        shape = (BATCH, HEADS, length, DIM)
         q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
         k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-        mask = jnp.ones((bwd_batch, length), bool)
 
-        grad_fn = jax.jit(
-            jax.grad(
-                lambda q, k, v, m=mask: flash_attention(q, k, v, m).astype(jnp.float32).sum(),
-                argnums=(0, 1, 2),
-            )
+        grad_fn = jax.grad(
+            lambda a, b, c: flash_attention(a, b, c).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
         )
+
+        @jax.jit
+        def chain_g(q_, k_, v_, eps):
+            for _ in range(CHAIN):
+                dq, dk, dv = grad_fn(q_, k_, v_)
+                q_ = q_ + eps * dq.astype(q_.dtype)
+                k_ = k_ + eps * dk.astype(k_.dtype)
+                v_ = v_ + eps * dv.astype(v_.dtype)
+            return (q_[0, 0, :8, :4] + k_[0, 0, :8, :4] + v_[0, 0, :8, :4]).astype(jnp.float32)
+
         try:
-            ms = _bench(grad_fn, q, k, v)
+            ms = _bench_chain(chain_g, q, k, v, jnp.bfloat16(0.0))
         except Exception as e:  # noqa: BLE001
             print(json.dumps({
                 "metric": "attention_flash_fwdbwd_ms", "length": length,
                 "value": None, "error": type(e).__name__,
             }))
             continue
-        tflops = 3 * 4 * bwd_batch * bwd_heads * length * length * DIM / (ms / 1e3) / 1e12
+        tflops = 3 * 4 * BATCH * HEADS * length * length * DIM / (ms / 1e3) / 1e12
         print(json.dumps({
             "metric": "attention_flash_fwdbwd_ms", "length": length,
             "value": round(ms, 3), "unit": "ms", "tflops": round(tflops, 1),

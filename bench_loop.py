@@ -22,7 +22,6 @@ Usage: python bench_loop.py [--hosts 10000] [--pieces 1000000]
 from __future__ import annotations
 
 import argparse
-import collections
 import json
 import statistics
 import tempfile
@@ -32,13 +31,12 @@ import numpy as np
 
 
 def replay(svc, sim, target_pieces: int, new_downloads: int, probe_every: int = 50):
-    """Run rounds until `target_pieces` pieces have flowed; GC completed
-    peers above a high-water mark the way the reference's TTL GC reclaims
-    dead resource entries (pkg/gc + resource managers)."""
+    """Run rounds until `target_pieces` pieces have flowed. Occupancy is
+    bounded by the SERVICE's own interval GC (SchedulerService.run_gc —
+    the same sweeps the live tick loop schedules, pkg/gc + resource
+    managers), not a bench-side eviction loop: completed peers age out on
+    the configured peer TTL while active ones keep refreshing."""
     tick_ms: list[float] = []
-    completed_order: collections.deque[str] = collections.deque()
-    max_peers = svc.state.max_peers
-    high, low = int(max_peers * 0.75), int(max_peers * 0.6)
     rounds = 0
     t0 = time.perf_counter()
     while sim.stats.pieces < target_pieces:
@@ -49,35 +47,28 @@ def replay(svc, sim, target_pieces: int, new_downloads: int, probe_every: int = 
         tick_ms.append((time.perf_counter() - t1) * 1e3)
         for resp in responses:
             sim._act(resp)
-            pid = getattr(resp, "peer_id", None)
-            if pid is not None:
-                completed_order.append(pid)
         rounds += 1
         if rounds % probe_every == 0:
             sim.run_probe_round(sources=8)
-        used = svc.state.counts().get("peers", 0)
-        if used > high:
-            while used > low and completed_order:
-                pid = completed_order.popleft()
-                if pid in svc._peer_meta:
-                    svc.leave_peer(pid)
-                    used -= 1
+        svc.run_gc()
     wall = time.perf_counter() - t0
     return wall, tick_ms, rounds
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--hosts", type=int, default=10_000)
-    ap.add_argument("--pieces", type=int, default=1_000_000)
-    ap.add_argument("--tasks", type=int, default=512)
-    ap.add_argument("--downloads-per-round", type=int, default=64)
-    ap.add_argument("--quick", action="store_true",
-                    help="1k hosts / 20k pieces smoke configuration")
-    ap.add_argument("--workdir", default=None)
-    args = ap.parse_args()
-    if args.quick:
-        args.hosts, args.pieces, args.tasks = 1000, 20_000, 64
+def run(
+    hosts: int = 10_000,
+    pieces: int = 1_000_000,
+    tasks: int = 512,
+    downloads_per_round: int = 64,
+    workdir: str | None = None,
+) -> list[dict]:
+    """Run the three loop phases; returns the per-phase metric dicts so
+    bench.py can fold a bounded leg into the driver-captured artifact."""
+    import types
+    args = types.SimpleNamespace(
+        hosts=hosts, pieces=pieces, tasks=tasks,
+        downloads_per_round=downloads_per_round, workdir=workdir,
+    )
 
     from dragonfly2_tpu.cluster.announcer import Announcer
     from dragonfly2_tpu.cluster.probes import ProbeStore
@@ -97,6 +88,13 @@ def main() -> int:
     cfg = Config()
     cfg.scheduler.max_hosts = max(16384, 1 << (args.hosts - 1).bit_length())
     cfg.scheduler.max_tasks = max(4096, 2 * args.tasks)
+    # Replay compresses hours of cluster time into seconds of wall time, so
+    # the GC cadence compresses with it: completed peers age out 2s after
+    # their last piece while active ones keep refreshing their TTL.
+    cfg.scheduler.peer_gc_interval_seconds = 0.5
+    cfg.scheduler.peer_ttl_seconds = 2.0
+    cfg.scheduler.piece_download_timeout_seconds = 30.0
+    cfg.scheduler.task_gc_interval_seconds = 5.0
     storage = TraceStorage(f"{workdir}/sched-data")
     probes = ProbeStore(max_pairs=1 << 17, max_hosts=cfg.scheduler.max_hosts)
     svc = SchedulerService(config=cfg, storage=storage, probes=probes)
@@ -199,7 +197,23 @@ def main() -> int:
         "pieces": sim_ml.stats.pieces,
     })
 
-    for r in results:
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=10_000)
+    ap.add_argument("--pieces", type=int, default=1_000_000)
+    ap.add_argument("--tasks", type=int, default=512)
+    ap.add_argument("--downloads-per-round", type=int, default=64)
+    ap.add_argument("--quick", action="store_true",
+                    help="1k hosts / 20k pieces smoke configuration")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+    if args.quick:
+        args.hosts, args.pieces, args.tasks = 1000, 20_000, 64
+    for r in run(args.hosts, args.pieces, args.tasks,
+                 args.downloads_per_round, args.workdir):
         print(json.dumps(r))
     return 0
 

@@ -56,11 +56,13 @@ def tpu_train_result(ds, graph):
     return train_gnn(ds, graph, cfg)
 
 
-def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8) -> float:
+def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8, hidden: int = None, batch: int = None) -> float:
     """Same model family in torch on CPU: 2 SAGE layers (self + neighbor
     mean + edge mean), listwise softmax rank loss, AdamW."""
     import torch
 
+    hidden = hidden or HIDDEN
+    batch = batch or BATCH
     torch.manual_seed(0)
     torch.set_num_threads(max(1, torch.get_num_threads()))
 
@@ -109,7 +111,7 @@ def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8) -> float:
             x = torch.cat([child, parent, pair_feats], dim=-1)
             return self.score(x)[..., 0]
 
-    model = Sage(f_node, f_edge, HIDDEN)
+    model = Sage(f_node, f_edge, hidden)
     opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
     rng = np.random.default_rng(0)
     n = ds.child.shape[0]
@@ -120,7 +122,7 @@ def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8) -> float:
     steps = 0
     t0 = time.perf_counter()
     while steps < max_steps:
-        idx = rng.choice(n, BATCH, replace=False)
+        idx = rng.choice(n, min(batch, n), replace=False)
         child_idx = torch.tensor(ds.child_host_idx[idx], dtype=torch.long)
         parent_idx = torch.tensor(ds.parent_host_idx[idx], dtype=torch.long)
         pf = torch.tensor(pair[idx])
@@ -136,7 +138,7 @@ def torch_cpu_samples_per_sec(ds, graph, max_steps: int = 8) -> float:
         opt.step()
         steps += 1
     dt = time.perf_counter() - t0
-    return steps * BATCH / dt
+    return steps * min(batch, n) / dt
 
 
 def main() -> int:

@@ -52,6 +52,8 @@ class Daemon:
         sni_proxy: bool = False,
         sni_allowed_hosts: list[str] | None = None,
         ssl_context=None,
+        manager_address: tuple[str, int] | None = None,
+        dynconfig_interval: float = 60.0,
     ):
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
@@ -103,6 +105,14 @@ class Daemon:
 
             # deny-by-default: with no allowlist the listener refuses all
             self.sni_proxy = SNIProxy(host=ip, allowed_hosts=sni_allowed_hosts)
+        # Manager-fed scheduler list (client/config/dynconfig_manager.go:346
+        # + the pkg/resolver refresh): when a manager address is given, the
+        # daemon learns/refreshes its scheduler set instead of trusting the
+        # static --scheduler flags forever.
+        self.manager_address = manager_address
+        self.dynconfig_interval = dynconfig_interval
+        self.dynconfig = None
+        self._dynconfig_task: asyncio.Task | None = None
         self._probe_task: asyncio.Task | None = None
         self._seed_tasks: list[asyncio.Task] = []
         self._seed_downloads: set[asyncio.Task] = set()
@@ -153,6 +163,16 @@ class Daemon:
             await self.sni_proxy.start()
         if self.probe_interval > 0:
             self._probe_task = asyncio.create_task(self._probe_loop())
+        if self.manager_address is not None:
+            from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+            self.dynconfig = Dynconfig(
+                self._fetch_scheduler_list,
+                cache_path=self.data_dir / "dynconfig.json",
+                expire=max(self.dynconfig_interval, 1.0),
+            )
+            self.dynconfig.register(self._apply_scheduler_list)
+            self._dynconfig_task = asyncio.create_task(self._dynconfig_loop())
         if self.is_seed:
             # Seed mode: connect + announce to every scheduler up front so
             # TriggerSeedRequests can reach this host, then serve them
@@ -163,7 +183,8 @@ class Daemon:
         logger.info("daemon %s up (upload :%d)", self.host_id, self.upload.port)
 
     async def stop(self, leave: bool = True) -> None:
-        for task in (self._probe_task, *self._seed_tasks, *self._seed_downloads):
+        for task in (self._probe_task, self._dynconfig_task,
+                     *self._seed_tasks, *self._seed_downloads):
             if task is None:
                 continue
             task.cancel()
@@ -172,6 +193,7 @@ class Daemon:
             except asyncio.CancelledError:
                 pass
         self._probe_task = None
+        self._dynconfig_task = None
         self._seed_tasks.clear()
         if self.proxy is not None:
             await self.proxy.stop()
@@ -317,6 +339,55 @@ class Daemon:
             logger.exception("seed download failed for %s", trigger.url)
 
     # -------------------------------------------------------------- probes
+
+    # ---------------------------------------------------------- dynconfig
+
+    def _fetch_scheduler_list(self) -> dict:
+        """Sync Dynconfig client: one GetSchedulers call against the
+        manager (client/config/dynconfig_manager.go:346 list-schedulers
+        refresh). Runs on a worker thread, so a private event loop per
+        fetch keeps the engine's sync contract."""
+        import dataclasses
+
+        from dragonfly2_tpu.manager.rpc import GetSchedulersRequest, ManagerClient
+
+        host, port = self.manager_address
+
+        async def go():
+            client = await ManagerClient(
+                host, port, ssl_context=self.pool.ssl_context
+            ).connect()
+            try:
+                resp = await client.call(GetSchedulersRequest(
+                    ip=self.ip, hostname=self.hostname,
+                    idc=self.idc, location=self.location,
+                ))
+                return {"schedulers": [dataclasses.asdict(e) for e in resp.schedulers]}
+            finally:
+                await client.close()
+
+        return asyncio.run(go())
+
+    def _apply_scheduler_list(self, data: dict) -> None:
+        """Dynconfig observer: feed the ACTIVE schedulers into the pool's
+        hash ring (the resolver refresh hook, rpc/client.py
+        update_addresses). An empty active set keeps the current ring —
+        a flapping manager must not strand the daemon with no schedulers."""
+        active = [
+            (e["ip"], int(e["port"]))
+            for e in data.get("schedulers", [])
+            if e.get("state") == "active" and e.get("port")
+        ]
+        if active:
+            self.pool.update_addresses(active)
+
+    async def _dynconfig_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.to_thread(self.dynconfig.get)
+            except Exception as e:  # noqa: BLE001 - manager may be down
+                logger.debug("dynconfig refresh failed: %s", e)
+            await asyncio.sleep(max(self.dynconfig_interval, 1.0))
 
     async def _probe_loop(self) -> None:
         """client/daemon/networktopology/network_topology.go:71-203: ask the

@@ -175,8 +175,14 @@ class JobManager:
         # Only ENQUEUE-TIME failures are terminal; a FAILED observed from
         # task polling must keep recomputing — a retried seed download can
         # recover the task (FSM allows FAILED -> SUCCEEDED), and latching
-        # would make the job outcome depend on poll timing.
+        # would make the job outcome depend on poll timing. SUCCESS *is*
+        # terminal: once every task was observed SUCCEEDED the layers
+        # landed, and a scheduler later forgetting the task (restart,
+        # capacity eviction, TTL GC) must not regress a completed job
+        # back to PENDING.
         if result is None or result.detail.get("failures") or not result.task_ids:
+            return result
+        if result.state == JobState.SUCCESS:
             return result
         from dragonfly2_tpu.state.fsm import TaskState
 
@@ -184,11 +190,13 @@ class JobManager:
         for task_id in result.task_ids:
             name = self.ring.pick(task_id)
             svc = self.schedulers.get(name) if name else None
-            idx = svc.state.task_index(task_id) if svc else None
-            if idx is None:
+            # Locked snapshot: this runs on manager REST threads while the
+            # scheduler event loop mutates task state.
+            raw = svc.task_states([task_id])[0] if svc else None
+            if raw is None:
                 states.append(TaskState.PENDING)  # seed not started yet
             else:
-                states.append(TaskState(int(svc.state.task_state[idx])))
+                states.append(TaskState(raw))
         if any(s == TaskState.FAILED for s in states):
             result.state = JobState.FAILURE
             result.detail["task_states"] = [s.name for s in states]

@@ -130,6 +130,12 @@ class SchedulerService:
         # drives them from different threads (rpc/server.py). In-proc tests
         # and the simulator are single-threaded and unaffected.
         self.mu = threading.RLock()
+        # Interval GC bookkeeping (pkg/gc/gc.go runner cadence): run_gc()
+        # is called every tick by the live RPC server; each sweep fires
+        # one full interval after construction (a ticker, not an eager
+        # sweep — an instant host sweep would reap a freshly announced
+        # idle host before its first peer registers).
+        self._last_peer_gc = self._last_task_gc = self._last_host_gc = time.time()
 
     # ============================================================ messages
 
@@ -767,6 +773,152 @@ class SchedulerService:
         self._pending.pop(peer_id, None)
         self.state.remove_peer(peer_id)
 
+    # ========================================================= dynconfig
+
+    def apply_dynconfig(self, data: dict) -> None:
+        """Hot-apply manager-pushed cluster limits into the live tick
+        (scheduler/config/dynconfig.go:457 Notify -> the scheduling
+        config the retry loop reads). Registered as a Dynconfig observer
+        by the launcher; tick() reads these fields per call, so the next
+        batch after a refresh already honors the new limits."""
+        cfg = data.get("scheduler_cluster_config") or {}
+        int_fields = (
+            "candidate_parent_limit",
+            "filter_parent_limit",
+            "retry_limit",
+            "retry_back_to_source_limit",
+        )
+        float_fields = (
+            "peer_ttl_seconds",
+            "host_ttl_seconds",
+            "piece_download_timeout_seconds",
+        )
+        with self.mu:
+            for key in int_fields:
+                if key in cfg:
+                    try:
+                        value = int(cfg[key])
+                    except (TypeError, ValueError):
+                        continue
+                    if value >= 1:
+                        setattr(self.config.scheduler, key, value)
+            for key in float_fields:
+                if key in cfg:
+                    try:
+                        value = float(cfg[key])
+                    except (TypeError, ValueError):
+                        continue
+                    if value > 0:
+                        setattr(self.config.scheduler, key, value)
+
+    # ================================================================ gc
+
+    def gc_due(self, now: float | None = None) -> bool:
+        """Lock-free pre-check so the tick loop only pays a thread hop and
+        the service lock when some sweep's interval has actually elapsed."""
+        now = time.time() if now is None else now
+        sched = self.config.scheduler
+        return (
+            now - self._last_peer_gc >= sched.peer_gc_interval_seconds
+            or now - self._last_task_gc >= sched.task_gc_interval_seconds
+            or now - self._last_host_gc >= sched.host_gc_interval_seconds
+        )
+
+    def run_gc(self, now: float | None = None, force: bool = False) -> dict[str, int]:
+        """TTL sweeps over peers/tasks/hosts, each on its own interval
+        (pkg/gc/gc.go:28-63 interval runners wired into the resource
+        managers, scheduler/resource/{peer,task,host}_manager.go RunGC).
+        Called from the live tick loop every tick; cheap no-op between
+        interval boundaries. Returns per-kind reap counts for the sweeps
+        that ran."""
+        now = time.time() if now is None else now
+        sched = self.config.scheduler
+        swept: dict[str, int] = {}
+        with self.mu:
+            if force or now - self._last_peer_gc >= sched.peer_gc_interval_seconds:
+                self._last_peer_gc = now
+                swept["peers"] = self._gc_peers(now)
+            if force or now - self._last_task_gc >= sched.task_gc_interval_seconds:
+                self._last_task_gc = now
+                swept["tasks"] = self._gc_tasks()
+            if force or now - self._last_host_gc >= sched.host_gc_interval_seconds:
+                self._last_host_gc = now
+                swept["hosts"] = self._gc_hosts()
+        return swept
+
+    def _gc_peers(self, now: float) -> int:
+        """peer_manager.go:154-220 RunGC, vectorised: FAILED peers, piece
+        stalls past the download timeout, peer-TTL and host-TTL expiry all
+        leave; _leave_peer does the full host-side cleanup (meta, DAG slot,
+        upload slots, pending queue, SoA row)."""
+        st = self.state
+        sched = self.config.scheduler
+        age = now - st.peer_updated_at
+        pstate = st.peer_state
+        downloading = (pstate == int(PeerState.RUNNING)) | (
+            pstate == int(PeerState.BACK_TO_SOURCE)
+        )
+        host_age = now - st.host_updated_at
+        peer_host_age = host_age[np.clip(st.peer_host, 0, None)]
+        stale = st.peer_alive & (
+            (pstate == int(PeerState.FAILED))
+            | (downloading & (age > sched.piece_download_timeout_seconds))
+            | (age > sched.peer_ttl_seconds)
+            | (peer_host_age > sched.host_ttl_seconds)
+        )
+        reaped = 0
+        for idx in np.nonzero(stale)[0]:
+            pid = st._peer_id[idx]
+            if pid is not None:
+                self._leave_peer(pid)
+                reaped += 1
+        return reaped
+
+    def _gc_tasks(self) -> int:
+        """task_manager.go:116-134 RunGC: a task whose peers have all been
+        reclaimed is reclaimed, along with its host-side DAG and slot maps
+        (the dict leak the SoA free-list can't see)."""
+        reaped = 0
+        for task_id in list(self.state._task_by_id):
+            if self._task_peers.get(task_id):
+                continue
+            self.state.remove_task(task_id)
+            self._drop_task_maps(task_id)
+            reaped += 1
+        # Host-side maps can outlive the SoA row (or never have had one);
+        # sweep orphans so _dags/_task_peers stay bounded too.
+        for task_id in list(self._dags):
+            if self.state.task_index(task_id) is None and not self._task_peers.get(task_id):
+                self._drop_task_maps(task_id)
+        return reaped
+
+    def _drop_task_maps(self, task_id: str) -> None:
+        self._dags.pop(task_id, None)
+        self._dag_slot_peer.pop(task_id, None)
+        self._task_peers.pop(task_id, None)
+
+    def _gc_hosts(self) -> int:
+        """host_manager.go:146-163 RunGC: a normal host with no peers and
+        no upload slots in use is reclaimed (seed/super hosts persist)."""
+        st = self.state
+        peers_per_host = np.bincount(
+            st.peer_host[st.peer_alive], minlength=st.max_hosts
+        )
+        reaped = 0
+        for host_id in list(self._host_info):
+            idx = st.host_index(host_id)
+            if idx is None:
+                self._host_info.pop(host_id, None)
+                continue
+            if (
+                peers_per_host[idx] == 0
+                and int(st.host_upload_used[idx]) == 0
+                and int(st.host_type[idx]) == int(HostType.NORMAL)
+            ):
+                self.leave_host(host_id)
+                reaped += 1
+        return reaped
+
     def snapshot_topology(self, now_ns: int | None = None) -> int:
         """Write the probe graph to trace storage (the networktopology
         Snapshot ticker, network_topology.go:124-138). Returns rows written."""
@@ -797,6 +949,17 @@ class SchedulerService:
         c["pending"] = len(self._pending)
         c["tasks_with_dag"] = len(self._dags)
         return c
+
+    def task_states(self, task_ids: list[str]) -> list[int | None]:
+        """Locked snapshot of per-task FSM states for cross-thread pollers
+        (the manager's job-state refresh). None means the scheduler does
+        not (or no longer) know the task id."""
+        with self.mu:
+            out: list[int | None] = []
+            for task_id in task_ids:
+                idx = self.state.task_index(task_id)
+                out.append(None if idx is None else int(self.state.task_state[idx]))
+            return out
 
     def list_hosts(self) -> list[dict]:
         """Announced-host snapshot for the sync_peers job (scheduler

@@ -64,7 +64,8 @@ async def _tls_material(args, common_name: str):
             sans = {"127.0.0.1", "localhost", getattr(args, "host", "") or "",
                     getattr(args, "ip", "") or ""}
             mat = await obtain_certificate(
-                mh, mp, common_name, tls_dir, san_hosts=sorted(s for s in sans if s)
+                mh, mp, common_name, tls_dir, san_hosts=sorted(s for s in sans if s),
+                enrollment_token=getattr(args, "tls_enrollment_token", "") or "",
             )
         else:
             raise SystemExit(
@@ -219,6 +220,48 @@ async def _serve_scheduler(args) -> int:
                 await asyncio.sleep(args.keepalive_interval)
 
         bg_tasks.append(asyncio.create_task(manager_loop()))
+
+        # Live dynconfig loop (scheduler/config/dynconfig.go:457): poll the
+        # manager's per-cluster payload on the refresh cadence and hot-apply
+        # limit changes into the tick via the service observer. The engine
+        # keeps an on-disk snapshot so a manager outage serves stale-but-
+        # sane limits instead of failing.
+        from dragonfly2_tpu.manager.rpc import GetDynconfigRequest
+        from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+        def fetch_dynconfig() -> dict:
+            async def go():
+                client = await ManagerClient(mh, mp, ssl_context=tls_client_ctx).connect()
+                try:
+                    resp = await client.call(
+                        GetDynconfigRequest(scheduler_cluster_id=args.cluster_id)
+                    )
+                    return resp.data
+                finally:
+                    await client.close()
+
+            # runs on a worker thread (asyncio.to_thread), so a private
+            # event loop per fetch is safe and keeps Dynconfig's sync
+            # client contract
+            return asyncio.run(go())
+
+        dyn = Dynconfig(
+            fetch_dynconfig,
+            cache_path=os.path.join(args.data_dir or ".", "dynconfig.json"),
+            expire=max(args.dynconfig_interval, 1.0),
+        )
+        dyn.register(service.apply_dynconfig)
+
+        async def dynconfig_loop():
+            log = logging.getLogger(__name__)
+            while True:
+                try:
+                    await asyncio.to_thread(dyn.get)
+                except Exception as e:  # noqa: BLE001 - manager may be down
+                    log.debug("dynconfig refresh failed: %s", e)
+                await asyncio.sleep(max(args.dynconfig_interval, 1.0))
+
+        bg_tasks.append(asyncio.create_task(dynconfig_loop()))
     if args.trainer and storage is not None:
         # periodic dataset upload to the trainer (announcer.go:127-235;
         # default cadence is the reference's 7 days). Rotation files are
@@ -311,7 +354,8 @@ async def _serve_manager(args) -> int:
     registry = ModelRegistry(args.registry_dir) if args.registry_dir else None
     _wire_otlp(args, "manager")
     service = ManagerService(
-        db=Database(args.db), registry=registry, cert_dir=args.cert_dir
+        db=Database(args.db), registry=registry, cert_dir=args.cert_dir,
+        enrollment_token=args.tls_enrollment_token or None,
     )
     rest = ManagerREST(service, host=args.host, port=args.port)
     host, port = rest.start()
@@ -386,6 +430,8 @@ async def _serve_dfdaemon(args) -> int:
         sni_proxy=args.sni_proxy,
         sni_allowed_hosts=args.sni_allow or None,
         ssl_context=await _tls_context(args, "dfdaemon", server=False),
+        manager_address=_parse_addr(args.manager) if args.manager else None,
+        dynconfig_interval=args.dynconfig_interval,
     )
     _wire_otlp(args, "dfdaemon")
     await daemon.start()
@@ -440,6 +486,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="manager RPC host:port; registers + keepalives when set")
     s.add_argument("--cluster-id", type=int, default=1)
     s.add_argument("--keepalive-interval", type=float, default=5.0)
+    s.add_argument("--dynconfig-interval", type=float, default=60.0,
+                   help="seconds between manager dynconfig refreshes "
+                   "(hot-applies cluster scheduling limits)")
     s.add_argument("--trainer", default="",
                    help="trainer host:port; streams trace datasets on the cadence")
     s.add_argument("--announce-interval", type=float, default=7 * 24 * 3600.0,
@@ -448,6 +497,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cert.pem/key.pem/ca.pem dir; serves cluster mTLS when set")
     s.add_argument("--tls-issue", action="store_true",
                    help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    s.add_argument("--tls-enrollment-token",
+                   default=os.environ.get("DRAGONFLY_ENROLLMENT_TOKEN", ""),
+                   help="shared secret presented to the manager CA when issuing "
+                   "(env DRAGONFLY_ENROLLMENT_TOKEN)")
     s.add_argument("--otlp-endpoint", default=None,
                    help="OTLP/HTTP collector base URL for span export (--jaeger parity)")
     s.add_argument("--vsock-port", type=int, default=None,
@@ -466,6 +519,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cert.pem/key.pem/ca.pem dir; serves cluster mTLS when set")
     t.add_argument("--tls-issue", action="store_true",
                    help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    t.add_argument("--tls-enrollment-token",
+                   default=os.environ.get("DRAGONFLY_ENROLLMENT_TOKEN", ""),
+                   help="shared secret presented to the manager CA when issuing "
+                   "(env DRAGONFLY_ENROLLMENT_TOKEN)")
     t.add_argument("--manager", default="",
                    help="manager RPC host:port (only needed for --tls-issue)")
     t.add_argument("--otlp-endpoint", default=None,
@@ -480,6 +537,10 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--metrics-port", type=int, default=None)
     m.add_argument("--cert-dir", default=None,
                    help="cluster CA dir; enables the IssueCertificate RPC (pkg/issuer)")
+    m.add_argument("--tls-enrollment-token",
+                   default=os.environ.get("DRAGONFLY_ENROLLMENT_TOKEN", ""),
+                   help="shared secret services must present for cert issuance; "
+                   "empty leaves the CA open (bootstrap-only setups)")
     m.add_argument("--tls-dir", default=None,
                    help="cert.pem/key.pem/ca.pem dir; serves the manager RPC over mTLS")
     m.add_argument("--otlp-endpoint", default=None,
@@ -518,8 +579,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cert.pem/key.pem/ca.pem dir; dials schedulers over mTLS")
     d.add_argument("--tls-issue", action="store_true",
                    help="certify into --tls-dir via the manager's IssueCertificate RPC")
+    d.add_argument("--tls-enrollment-token",
+                   default=os.environ.get("DRAGONFLY_ENROLLMENT_TOKEN", ""),
+                   help="shared secret presented to the manager CA when issuing "
+                   "(env DRAGONFLY_ENROLLMENT_TOKEN)")
     d.add_argument("--manager", default="",
-                   help="manager RPC host:port (only needed for --tls-issue)")
+                   help="manager RPC host:port; refreshes the scheduler "
+                   "list via dynconfig when set (also used for --tls-issue)")
+    d.add_argument("--dynconfig-interval", type=float, default=60.0,
+                   help="seconds between manager scheduler-list refreshes")
     d.add_argument("--otlp-endpoint", default=None,
                    help="OTLP/HTTP collector base URL for span export")
     return p

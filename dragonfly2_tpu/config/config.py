@@ -59,6 +59,14 @@ class SchedulerConfig:
     max_hosts: int = 16384
     max_peers_per_task: int = 256
     max_tasks: int = 4096
+    # resource GC (scheduler/config/config.go GCConfig; pkg/gc/gc.go
+    # interval runner semantics — swept from the live tick loop)
+    peer_gc_interval_seconds: float = CONSTANTS.PEER_GC_INTERVAL_SECONDS
+    peer_ttl_seconds: float = CONSTANTS.PEER_TTL_SECONDS
+    piece_download_timeout_seconds: float = CONSTANTS.PIECE_DOWNLOAD_TIMEOUT_SECONDS
+    task_gc_interval_seconds: float = CONSTANTS.TASK_GC_INTERVAL_SECONDS
+    host_gc_interval_seconds: float = CONSTANTS.HOST_GC_INTERVAL_SECONDS
+    host_ttl_seconds: float = CONSTANTS.HOST_TTL_SECONDS
 
 
 @dataclasses.dataclass
@@ -89,6 +97,26 @@ class TrainerConfig:
     # Also train/publish the attention parent ranker (third model family;
     # the reference's registry only knows gnn|mlp, models/model.go:19-46).
     train_attention: bool = False
+    # --- parallelism knobs for the attention ranker (SURVEY §2.6): each
+    # axis turns on from the config alone; the mesh supplies the axis
+    # sizes (parallel/mesh.py make_mesh).
+    # sequence parallelism: "ring" (KV rotates the ICI ring) or "ulysses"
+    # (all-to-all head exchange) — active when the mesh has sp > 1
+    sp_strategy: str = "ring"
+    # tensor parallelism: shard qkv/proj and the FFN across the mesh's tp
+    # axis via GSPMD param shardings (Megatron column/row split; XLA
+    # inserts the psum) — active when the mesh has tp > 1
+    attention_tp: bool = False
+    # expert parallelism: >0 swaps the block MLP for a top-1 MoE with
+    # this many expert scorers (parallel/moe.py); expert queues ride the
+    # all_to_all when the mesh has ep > 1
+    attention_moe_experts: int = 0
+    # pipeline parallelism: train the DEEP variant with its blocks
+    # partitioned into pp stages (parallel/pipeline.py GPipe schedule)
+    # — active when the mesh has pp > 1
+    attention_pp: bool = False
+    attention_pp_microbatches: int = 4
+    attention_num_layers: int = 2
 
 
 @dataclasses.dataclass

@@ -24,6 +24,14 @@ class Constants:
     RETRY_BACK_TO_SOURCE_LIMIT: int = 3
     RETRY_INTERVAL_SECONDS: float = 0.05
 
+    # --- resource GC (scheduler/config/constants.go:75-91 + pkg/gc) ---
+    PEER_GC_INTERVAL_SECONDS: float = 10.0
+    PEER_TTL_SECONDS: float = 24 * 3600.0
+    PIECE_DOWNLOAD_TIMEOUT_SECONDS: float = 30 * 60.0
+    TASK_GC_INTERVAL_SECONDS: float = 30 * 60.0
+    HOST_GC_INTERVAL_SECONDS: float = 6 * 3600.0
+    HOST_TTL_SECONDS: float = 3600.0
+
     # --- evaluator (evaluator.go:42-61) ---
     MAX_SCORE: float = 1.0
     MIN_SCORE: float = 0.0

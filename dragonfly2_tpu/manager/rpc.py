@@ -106,6 +106,9 @@ class IssueCertificateRequest:
 
     csr_pem: bytes
     validity_days: int = 365
+    # Shared enrollment secret: required when the manager was started with
+    # one, so CA issuance is not granted by mere network reachability.
+    token: str = ""
 
 
 @dataclasses.dataclass
@@ -241,7 +244,9 @@ class ManagerRPCServer:
             if isinstance(request, GetDynconfigRequest):
                 return DynconfigResponse(data=svc.scheduler_dynconfig(request.scheduler_cluster_id))
             if isinstance(request, IssueCertificateRequest):
-                chain = svc.issue_certificate(request.csr_pem, request.validity_days)
+                chain = svc.issue_certificate(
+                    request.csr_pem, request.validity_days, token=request.token
+                )
                 return IssueCertificateResponse(certificate_chain=chain)
         except Exception as e:  # noqa: BLE001 - errors cross the wire as acks
             return Ack(ok=False, error=f"{type(e).__name__}: {e}")
@@ -301,6 +306,7 @@ async def obtain_certificate(
     san_hosts: list[str] | None = None,
     ssl_context=None,
     validity_days: int = 365,
+    enrollment_token: str = "",
 ):
     """Service-side certify flow (the reference's security client: generate
     keypair + CSR locally, IssueCertificate against the manager, install
@@ -316,7 +322,9 @@ async def obtain_certificate(
     client = await ManagerClient(manager_host, manager_port, ssl_context=ssl_context).connect()
     try:
         resp = await client.call(
-            IssueCertificateRequest(csr_pem=csr_pem, validity_days=validity_days)
+            IssueCertificateRequest(
+                csr_pem=csr_pem, validity_days=validity_days, token=enrollment_token
+            )
         )
     finally:
         await client.close()

@@ -39,6 +39,7 @@ class ManagerService:
         searcher: Searcher | None = None,
         plugin_dir: str | None = None,
         cert_dir: str | None = None,
+        enrollment_token: str | None = None,
     ):
         self.db = db or Database()
         self.registry = registry  # registry.ModelRegistry | None
@@ -50,6 +51,7 @@ class ManagerService:
         # cluster CA for mTLS cert issuance (pkg/issuer); lazily created
         # on first use when a cert_dir is configured, never otherwise
         self.cert_dir = cert_dir
+        self.enrollment_token = enrollment_token
         self._ca: tuple[bytes, bytes] | None = None
         self._oauth_providers: dict = {}  # name -> (config key, provider)
         self.enforcer.init_policies()
@@ -354,13 +356,31 @@ class ManagerService:
             self._ca = (cert_pem, key_pem)
         return self._ca
 
-    def issue_certificate(self, csr_pem: bytes, validity_days: int = 365) -> list[bytes]:
+    def issue_certificate(
+        self, csr_pem: bytes, validity_days: int = 365, token: str = ""
+    ) -> list[bytes]:
         """Sign a service CSR with the cluster CA -> [leaf, ca] chain
-        (manager-side of the security client's IssueCertificate)."""
-        from dragonfly2_tpu.utils import certs
+        (manager-side of the security client's IssueCertificate).
 
+        Issuance is the cluster's trust anchor, so it is gated: when the
+        manager is configured with an enrollment token, a request must
+        present it or the CA refuses to sign — otherwise anyone who can
+        reach the RPC port could mint cluster-trusted certs. Every issued
+        (and refused) CN/SAN set is logged for audit either way."""
+        import hmac
+
+        from dragonfly2_tpu.utils import certs
+        from dragonfly2_tpu.utils import dflog
+
+        log = dflog.get("manager.ca")
+        cn, sans = certs.csr_identity(csr_pem)
+        if self.enrollment_token:
+            if not token or not hmac.compare_digest(self.enrollment_token, token):
+                log.warning("refused certificate issuance cn=%r sans=%r: bad enrollment token", cn, sans)
+                raise PermissionError("certificate issuance requires a valid enrollment token")
         ca_cert, ca_key = self._cluster_ca()
         leaf = certs.sign_csr(ca_cert, ca_key, csr_pem, validity_days=validity_days)
+        log.info("issued certificate cn=%r sans=%r validity_days=%d", cn, sans, validity_days)
         return [leaf, ca_cert]
 
     # ----------------------------------------------------------------- jobs
@@ -420,6 +440,10 @@ class ManagerService:
         test/e2e/manager/preheat.go)."""
         record = self.db.get("jobs", record_id)
         job_id = (record.get("result") or {}).get("job_id")
+        # A persisted SUCCESS is terminal — never let a live recompute
+        # (e.g. after a scheduler restart forgot the tasks) regress it.
+        if record["state"] == "SUCCESS":
+            return record
         if self.jobs is not None and record["type"] == "preheat" and job_id:
             live = self.jobs.get(job_id)
             if live is not None and live.state.value != record["state"]:

@@ -28,14 +28,22 @@ AttentionFn = Callable  # (q, k, v, kv_mask) -> out, all [B, H, L, D]
 
 
 class SelfAttentionBlock(nn.Module):
-    """Pre-LN MHA + MLP with an injectable attention inner product."""
+    """Pre-LN MHA + MLP with an injectable attention inner product.
+
+    moe_experts > 0 swaps the dense MLP for a Switch-style top-1
+    mixture of expert scorers (parallel/moe.py): different experts can
+    specialize per traffic class/IDC. On a mesh with ep > 1 the expert
+    queues ride the all_to_all kernel; single-device falls back to the
+    exact no-drop reference."""
 
     hidden_dim: int
     num_heads: int = 4
     compute_dtype: jnp.dtype = jnp.bfloat16
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
-    def __call__(self, x, mask, attention_fn: AttentionFn = dense_attention):
+    def __call__(self, x, mask, attention_fn: AttentionFn = dense_attention, mesh=None):
         batch, length, _ = x.shape
         head_dim = self.hidden_dim // self.num_heads
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
@@ -49,9 +57,35 @@ class SelfAttentionBlock(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(batch, length, self.hidden_dim)
         x = x + nn.Dense(self.hidden_dim, dtype=self.compute_dtype, name="proj")(out)
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
+        if self.moe_experts > 0:
+            return x + self._moe(h, mesh)
         h = nn.Dense(4 * self.hidden_dim, dtype=self.compute_dtype, name="mlp_up")(h)
         h = nn.gelu(h)
         return x + nn.Dense(self.hidden_dim, dtype=self.compute_dtype, name="mlp_down")(h)
+
+    def _moe(self, h, mesh):
+        from dragonfly2_tpu.parallel import moe as moe_lib
+        from dragonfly2_tpu.parallel.mesh import EP_AXIS
+
+        f, e, wide = self.hidden_dim, self.moe_experts, 4 * self.hidden_dim
+        init = nn.initializers.lecun_normal()
+        gate_w = self.param("moe_gate", init, (f, e))
+        w1 = self.param("moe_w1", init, (e, f, wide))
+        b1 = self.param("moe_b1", nn.initializers.zeros, (e, wide))
+        w2 = self.param("moe_w2", init, (e, wide, f))
+        b2 = self.param("moe_b2", nn.initializers.zeros, (e, f))
+        shape = h.shape
+        tokens = h.reshape(-1, f)
+        if mesh is not None and mesh.shape.get(EP_AXIS, 1) > 1:
+            ep = mesh.shape[EP_AXIS]
+            t_local = tokens.shape[0] // ep
+            capacity = max(1, int(t_local / e * self.moe_capacity_factor))
+            out = moe_lib.sharded_moe_ffn(
+                mesh, tokens, gate_w, w1, b1, w2, b2, capacity=capacity
+            )
+        else:
+            out = moe_lib.moe_reference(tokens, gate_w, w1, b1, w2, b2)
+        return out.reshape(shape).astype(self.compute_dtype)
 
 
 class AttentionRanker(nn.Module):
@@ -66,6 +100,8 @@ class AttentionRanker(nn.Module):
     num_heads: int = 4
     num_layers: int = 2
     compute_dtype: jnp.dtype = jnp.bfloat16
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(
@@ -75,6 +111,7 @@ class AttentionRanker(nn.Module):
         pair_feats,  # [N, P, Fp]
         mask,  # [N, P] bool
         attention_fn: AttentionFn = dense_attention,
+        mesh=None,
     ):
         n, p, _ = parent_feats.shape
         tokens = jnp.concatenate(
@@ -90,8 +127,11 @@ class AttentionRanker(nn.Module):
         x = nn.Dense(self.hidden_dim, dtype=self.compute_dtype, name="embed")(tokens)
         for i in range(self.num_layers):
             x = SelfAttentionBlock(
-                self.hidden_dim, self.num_heads, self.compute_dtype, name=f"block_{i}"
-            )(x, mask, attention_fn)
+                self.hidden_dim, self.num_heads, self.compute_dtype,
+                moe_experts=self.moe_experts,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"block_{i}",
+            )(x, mask, attention_fn, mesh=mesh)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         scores = nn.Dense(1, dtype=jnp.float32, name="score")(x)[..., 0]
         return jnp.where(mask, scores, -1e30)

@@ -26,11 +26,12 @@ Design (pallas_guide.md patterns):
 - On CPU (tests, no TPU) the kernel runs in interpret mode; the public
   wrapper pads L to a BLOCK multiple and strips the padding after.
 
-Backward: flash_attention is a @jax.custom_vjp whose bwd recomputes
-attention with the dense jnp path under the same masking contract —
-training keeps exact grads (at dense-bwd memory cost, amortized by
-jax.checkpoint at the layer level), while the forward/serving path gets
-the fused kernel.
+Backward: fused flash backward (the standard flash-bwd construction) —
+the forward saves per-row logsumexp; the bwd recomputes probabilities
+IN-KERNEL per tile and accumulates dQ (one kernel, key sweep innermost)
+and dK/dV (a second kernel, query sweep innermost) in VMEM scratch.
+No [L, L] materialization anywhere, so 32k-token training fits one chip
+with the same constant-in-L footprint as the forward.
 """
 
 from __future__ import annotations
@@ -60,8 +61,9 @@ def _use_interpret() -> bool:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, mask_ref, *refs,
+    q_ref, k_ref, v_ref, *refs,
     block_k: int, causal: bool, num_kb: int, partial: bool = False,
+    save_lse: bool = False, has_mask: bool = True,
 ):
     """One (b, h, iq, jk) program: BLOCK_Q queries vs ONE [BK, D] key block.
 
@@ -77,8 +79,13 @@ def _flash_kernel(
     output, so callers can merge blocks computed elsewhere (the ring
     attention steps in parallel/ring.py compose one partial per KV
     rotation)."""
+    mask_ref = None
+    if has_mask:
+        mask_ref, *refs = refs
     if partial:
         o_ref, om_ref, ol_ref, acc_ref, m_ref, l_ref = refs
+    elif save_lse:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     else:
         o_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(2)
@@ -98,7 +105,6 @@ def _flash_kernel(
     def update():
         kb = k_ref[0, 0]  # [BK, D]
         vb = v_ref[0, 0]
-        mb = mask_ref[0, 0] > 0  # [BK] f32 -> bool
         m = m_ref[:, :1]  # lanes hold copies; column 0 is the value
         l = l_ref[:, :1]
 
@@ -110,7 +116,10 @@ def _flash_kernel(
             )
             * scale
         )  # [BQ, BK] f32
-        valid = jnp.broadcast_to(mb[None, :], scores.shape)
+        valid = None
+        if has_mask:
+            mb = mask_ref[0, 0] > 0  # [BK] f32 -> bool
+            valid = jnp.broadcast_to(mb[None, :], scores.shape)
         if causal:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -118,13 +127,22 @@ def _flash_kernel(
             k_pos = start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            valid = valid & (k_pos <= q_pos)
-        scores = jnp.where(valid, scores, _NEG_F)
+            diag = k_pos <= q_pos
+            valid = diag if valid is None else valid & diag
+        if valid is not None:
+            scores = jnp.where(valid, scores, _NEG_F)
 
         block_max = jnp.max(scores, axis=-1, keepdims=True)  # [BQ, 1]
         new_m = jnp.maximum(m, block_max)
         correction = jnp.exp(m - new_m)
-        probs = jnp.exp(scores - new_m) * valid.astype(jnp.float32)
+        probs = jnp.exp(scores - new_m)
+        if has_mask:
+            # a fully-masked row has new_m = _NEG_F, making every
+            # exp(score - new_m) a bogus 1.0 — the multiply zeroes them.
+            # Without a key mask every row has >= 1 valid key (causal
+            # includes its diagonal), so masked scores underflow to 0 on
+            # their own and the multiply is skipped.
+            probs = probs * valid.astype(jnp.float32)
         acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
             probs.astype(vb.dtype),  # PV matmul also in bf16, f32 accum
             vb, (((1,), (0,)), ((), ())),
@@ -154,6 +172,13 @@ def _flash_kernel(
         else:
             out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-9)
             o_ref[0, 0] = out.astype(o_ref.dtype)
+            if save_lse:
+                # per-row logsumexp residual for the fused backward:
+                # lse = m + log(l). Fully-masked rows (l = 0) get a finite
+                # filler — the bwd kernels zero invalid pairs explicitly,
+                # so the filler value never reaches a gradient.
+                lse = m_ref[:, :8] + jnp.log(jnp.maximum(l_ref[:, :8], 1e-30))
+                lse_ref[0, 0] = lse
 
 
 def _pick_blocks(l: int) -> tuple[int, int]:
@@ -170,7 +195,7 @@ def _pick_blocks(l: int) -> tuple[int, int]:
 
 def _flash_forward(
     q, k, v, kv_mask, causal: bool, block_q: int = None, block_k: int = None,
-    partial: bool = False,
+    partial: bool = False, save_lse: bool = False,
 ):
     if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
         # padding/grid/index maps all derive from q's length; a shorter KV
@@ -186,6 +211,13 @@ def _flash_forward(
         block_k = block_k or auto_k
     b, h, l, d = q.shape
     pad_l = (-l) % block_q
+    # kv_mask=None with no padding skips the mask operand AND its VPU
+    # work per tile (broadcast, where, probs multiply) — the common
+    # full-attention training case. Padding forces a mask: zero-padded
+    # keys must not attend as if they were real.
+    has_mask = kv_mask is not None or pad_l > 0
+    if kv_mask is None and has_mask:
+        kv_mask = jnp.ones((b, l), bool)
     if pad_l:
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_l), (0, 0)))
         kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_l), (0, 0)))
@@ -199,16 +231,18 @@ def _flash_forward(
             f"block_k={block_k} must divide padded length {lp}; trailing "
             "keys would be silently dropped"
         )
-    # [B, 1, L] f32 mask: a (1, 1, L) block's trailing dims equal the array
-    # dims, satisfying the TPU (8, 128) tiling rule; bool sublane=1 does not
-    mp = mp.astype(jnp.float32)[:, None, :]
+    if has_mask:
+        # [B, 1, L] f32 mask: a (1, 1, L) block's trailing dims equal the
+        # array dims, satisfying the TPU (8, 128) tiling rule; bool
+        # sublane=1 does not
+        mp = mp.astype(jnp.float32)[:, None, :]
 
     block_k = min(block_k, lp)
     num_kb = lp // block_k
     grid = (b, h, lp // block_q, num_kb)
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, num_kb=num_kb,
-        partial=partial,
+        partial=partial, save_lse=save_lse, has_mask=has_mask,
     )
     if causal:
         # Above-diagonal key blocks are skipped by pl.when in the kernel;
@@ -258,32 +292,45 @@ def _flash_forward(
             jax.ShapeDtypeStruct((b, h, lp, 8), jnp.float32),  # row-sum (lane copies)
         )
         out_specs = (out_block, row_block, row_block)
+    elif save_lse:
+        out_shape = (
+            jax.ShapeDtypeStruct((b, h, lp, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, lp, 8), jnp.float32),  # logsumexp (lane copies)
+        )
+        out_specs = (out_block, row_block)
     else:
         out_shape = jax.ShapeDtypeStruct((b, h, lp, d), q.dtype)
         out_specs = out_block
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+    ]
+    operands = [qp, kp, vp]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, 1, block_k), mask_index))
+        operands.append(mp)
     out = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-            pl.BlockSpec((1, 1, block_k), mask_index),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch,
         interpret=_use_interpret(),
         **kwargs,
-    )(qp, kp, vp, mp)
+    )(*operands)
     if partial:
         acc, row_max, row_sum = out
         return acc[:, :, :l, :], row_max[:, :, :l, 0], row_sum[:, :, :l, 0]
+    if save_lse:
+        o, lse = out
+        return o[:, :, :l, :], lse[:, :, :l, 0]
     return out[:, :, :l, :]
 
 
 def _dense_reference(q, k, v, kv_mask, causal: bool):
-    """jnp attention with the identical masking contract (bwd recompute).
+    """jnp attention with the identical masking contract (test oracle).
 
     Delegates to the single source of truth for the contract,
     parallel/ring.py::dense_attention."""
@@ -292,31 +339,340 @@ def _dense_reference(q, k, v, kv_mask, causal: bool):
     return dense_attention(q, k, v, kv_mask, causal)
 
 
+# ------------------------------------------------------------- backward
+#
+# Standard flash-bwd construction (no reference analogue — new
+# capability): recompute p = exp(qk^T*scale - lse) per tile from the
+# saved logsumexp, then
+#   dV_j  = sum_i p_ij^T dO_i
+#   dS_ij = p_ij * (dO_i V_j^T - delta_i),  delta_i = rowsum(dO_i * O_i)
+#   dK_j  = sum_i dS_ij^T q_i * scale
+#   dQ_i  = sum_j dS_ij K_j * scale
+# Two kernels so every accumulator lives in VMEM scratch: dK/dV sweep
+# queries innermost (grid b,h,jk,i), dQ sweeps keys innermost (grid
+# b,h,i,jk — the forward's layout). Nothing [L, L] is ever materialized.
+
+
+def _bwd_tile(q, do, lse, delta, kb, vb, mb, *, iq, jk, block_q, block_k, causal):
+    """Shared per-tile math: returns (p, ds), both [BQ, BK] f32.
+    mb=None means every key in the tile is valid (no-mask fast path)."""
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = (
+        jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # [BQ, BK] f32
+    valid = None
+    if mb is not None:
+        valid = jnp.broadcast_to(mb[None, :], s.shape)
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        diag = k_pos <= q_pos
+        valid = diag if valid is None else valid & diag
+    if valid is not None:
+        # explicit zeroing (not exp of a masked score): fully-masked rows
+        # have a filler lse, and exp(_NEG_F - filler) must not leak a 1.0
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    else:
+        p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BQ, BK]
+    ds = p * (dp - delta)
+    return p, ds
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, *rest,
+    block_q: int, block_k: int, causal: bool, num_qb: int, has_mask: bool,
+):
+    """One (b, h, jk, i) program: accumulate this key block's dK/dV over
+    the query sweep (innermost), write once on the last query block."""
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+    jk = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def update():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        mb = (mask_ref[0, 0] > 0) if has_mask else None
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        p, ds = _bwd_tile(
+            q, do, lse, delta, kb, vb, mb,
+            iq=i, jk=jk, block_q=block_q, block_k=block_k, causal=causal,
+        )
+        # p^T dO and dS^T q ride the MXU in the input dtype, f32 accum
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        # query blocks entirely above the diagonal see none of these keys
+        @pl.when((i + 1) * block_q > jk * block_k)
+        def _():
+            update()
+    else:
+        update()
+
+    @pl.when(i == num_qb - 1)
+    def _write():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, *rest,
+    block_q: int, block_k: int, causal: bool, num_kb: int, has_mask: bool,
+):
+    """One (b, h, i, jk) program: accumulate this query block's dQ over
+    the key sweep (innermost) — the forward's grid layout."""
+    if has_mask:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        mask_ref = None
+        dq_ref, dq_acc = rest
+    i = pl.program_id(2)
+    jk = pl.program_id(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def update():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        mb = (mask_ref[0, 0] > 0) if has_mask else None
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        _, ds = _bwd_tile(
+            q, do, lse, delta, kb, vb, mb,
+            iq=i, jk=jk, block_q=block_q, block_k=block_k, causal=causal,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        @pl.when(jk * block_k < (i + 1) * block_q)
+        def _():
+            update()
+    else:
+        update()
+
+    @pl.when(jk == num_kb - 1)
+    def _write():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _pick_blocks_bwd(l: int) -> tuple[int, int]:
+    """The bwd holds ~2x the forward's live tiles (q+dO inputs, two
+    accumulators, four [BQ, BK] intermediates), so tiles are one notch
+    smaller than _pick_blocks; 256x512 keeps the MXU fed without
+    tripping the scoped-vmem ceiling at 32k."""
+    block_q = 256 if l >= 256 else 128
+    lp = l + ((-l) % block_q)
+    for block_k in (512, 256, 128):
+        if lp % block_k == 0:
+            return block_q, block_k
+    return block_q, lp
+
+
+def _row_lanes(x, lp: int):
+    """[B, H, L] f32 row statistic -> padded [B, H, LP, 8] lane copies
+    (a trailing dim equal to the array's satisfies TPU tiling)."""
+    pad = lp - x.shape[-1]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    return jnp.broadcast_to(x[..., None], x.shape + (8,)).astype(jnp.float32)
+
+
+def _flash_backward(q, k, v, kv_mask, o, lse, g, causal: bool):
+    b, h, l, d = q.shape
+    block_q, block_k = _pick_blocks_bwd(l)
+    pad_l = (-l) % block_q
+    lp = l + pad_l
+    if lp % block_k:
+        block_k = block_q  # fallback keeps both divisors aligned
+    num_qb, num_kb = lp // block_q, lp // block_k
+
+    # same no-mask fast path as the forward: padding forces a mask so
+    # zero-padded keys can't leak probability mass into dq
+    has_mask = kv_mask is not None or pad_l > 0
+    if kv_mask is None and has_mask:
+        kv_mask = jnp.ones((b, l), bool)
+
+    # delta_i = rowsum(dO_i * O_i): one cheap bandwidth-bound pass,
+    # computed before the kernels like the lse residual
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def pad4(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, pad_l), (0, 0))) if pad_l else x
+
+    qp, kp, vp, gp = pad4(q), pad4(k), pad4(v), pad4(g)
+    if has_mask:
+        mp = (
+            jnp.pad(kv_mask, ((0, 0), (0, pad_l))) if pad_l else kv_mask
+        ).astype(jnp.float32)[:, None, :]
+    lse_p = _row_lanes(lse, lp)
+    delta_p = _row_lanes(delta, lp)
+
+    interpret = _use_interpret()
+    kwargs = {}
+    if _HAS_PLTPU and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        )
+
+    def scratch(rows):
+        if _HAS_PLTPU:
+            return pltpu.VMEM((rows, d), jnp.float32)
+        return jax.ShapeDtypeStruct((rows, d), jnp.float32)
+
+    # ---- dK/dV: grid (b, h, jk, i), query sweep innermost
+    if causal:
+        # dead (above-diagonal) query steps clamp to the first live query
+        # block so their DMAs collapse into repeat-index no-op copies
+        def q_index(b_, h_, jk, i):
+            live = jnp.maximum(i, (jk * block_k) // block_q)
+            return (b_, h_, live, 0)
+    else:
+        def q_index(b_, h_, jk, i):
+            return (b_, h_, i, 0)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel,
+        block_q=block_q, block_k=block_k, causal=causal, num_qb=num_qb,
+        has_mask=has_mask,
+    )
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), q_index),                      # q
+        pl.BlockSpec((1, 1, block_q, d), q_index),                      # dO
+        pl.BlockSpec((1, 1, block_q, 8), q_index),                      # lse
+        pl.BlockSpec((1, 1, block_q, 8), q_index),                      # delta
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, jk, i: (b_, h_, jk, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, jk, i: (b_, h_, jk, 0)),
+    ]
+    dkv_operands = [qp, gp, lse_p, delta_p, kp, vp]
+    if has_mask:
+        dkv_in_specs.append(pl.BlockSpec((1, 1, block_k), lambda b_, h_, jk, i: (b_, 0, jk)))
+        dkv_operands.append(mp)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, lp, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, lp, d), v.dtype),
+        ),
+        grid=(b, h, num_kb, num_qb),
+        in_specs=dkv_in_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, jk, i: (b_, h_, jk, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, jk, i: (b_, h_, jk, 0)),
+        ),
+        scratch_shapes=[scratch(block_k), scratch(block_k)],
+        interpret=interpret,
+        **kwargs,
+    )(*dkv_operands)
+
+    # ---- dQ: grid (b, h, i, jk), key sweep innermost (forward layout)
+    if causal:
+        def kv_index(b_, h_, i, j):
+            live = jnp.minimum(j, ((i + 1) * block_q + block_k - 1) // block_k - 1)
+            return (b_, h_, live, 0)
+
+        def mask_index(b_, h_, i, j):
+            live = jnp.minimum(j, ((i + 1) * block_q + block_k - 1) // block_k - 1)
+            return (b_, 0, live)
+    else:
+        def kv_index(b_, h_, i, j):
+            return (b_, h_, j, 0)
+
+        def mask_index(b_, h_, i, j):
+            return (b_, 0, j)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel,
+        block_q=block_q, block_k=block_k, causal=causal, num_kb=num_kb,
+        has_mask=has_mask,
+    )
+    q_row = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    stat_row = pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq_in_specs = [
+        q_row,                                                           # q
+        q_row,                                                           # dO
+        stat_row,                                                        # lse
+        stat_row,                                                        # delta
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+    ]
+    dq_operands = [qp, gp, lse_p, delta_p, kp, vp]
+    if has_mask:
+        dq_in_specs.append(pl.BlockSpec((1, 1, block_k), mask_index))
+        dq_operands.append(mp)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, lp, d), q.dtype),
+        grid=(b, h, num_qb, num_kb),
+        in_specs=dq_in_specs,
+        out_specs=q_row,
+        scratch_shapes=[scratch(block_q)],
+        interpret=interpret,
+        **kwargs,
+    )(*dq_operands)
+
+    return dq[:, :, :l, :], dk[:, :, :l, :], dv[:, :, :l, :]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _flash(q, k, v, kv_mask, causal):
     return _flash_forward(q, k, v, kv_mask, causal)
 
 
 def _flash_fwd(q, k, v, kv_mask, causal):
-    return _flash_forward(q, k, v, kv_mask, causal), (q, k, v, kv_mask)
+    o, lse = _flash_forward(q, k, v, kv_mask, causal, save_lse=True)
+    return o, (q, k, v, kv_mask, o, lse)
 
 
 def _flash_bwd(causal, res, g):
-    q, k, v, kv_mask = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_reference(q_, k_, v_, kv_mask, causal), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_mask, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, kv_mask, o, lse, g, causal)
     return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, kv_mask, causal: bool = False) -> jax.Array:
+def flash_attention(q, k, v, kv_mask=None, causal: bool = False) -> jax.Array:
     """Fused TPU attention. [B,H,L,D] x [B,L] -> [B,H,L,D].
 
     Drop-in for parallel/ring.py::dense_attention (same masking contract:
     invalid keys contribute nothing; fully-masked rows return 0) and for
-    models/attention.py's injectable attention_fn."""
+    models/attention.py's injectable attention_fn. kv_mask=None means
+    every key is valid AND skips the mask's per-tile VPU work in both the
+    fwd and bwd kernels — prefer it over an all-ones mask."""
     return _flash(q, k, v, kv_mask, causal)
 
 

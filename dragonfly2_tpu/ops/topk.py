@@ -73,7 +73,15 @@ def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
     scores = scores.astype(jnp.float32)
     if scores.shape[-1] <= _RANK_SELECT_MAX_WIDTH:
         return _masked_top_k_rank(scores, mask, k)
-    masked = jnp.where(mask, scores, NEG_INF)
+    # Wide fallback keeps the SAME hostile-score contract as the rank
+    # path: sanitize NaN/-inf up to the score floor so eligible-but-awful
+    # candidates still outrank masked ones, and derive validity from the
+    # eligible COUNT, never from sentinel compares.
+    sane = jnp.maximum(
+        jnp.nan_to_num(scores, nan=_SCORE_FLOOR, neginf=_SCORE_FLOOR), _SCORE_FLOOR
+    )
+    masked = jnp.where(mask, sane, _FINITE_MIN)
     values, indices = jax.lax.top_k(masked, k)
-    valid = values > NEG_INF
-    return values, indices, valid
+    pos = jnp.arange(k, dtype=jnp.int32)
+    valid = pos < mask.sum(axis=-1, dtype=jnp.int32)[..., None]
+    return jnp.where(valid, values, NEG_INF), indices, valid

@@ -42,7 +42,10 @@ def pipeline_apply(
     num_micro = x.shape[0]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def tick(t, carry):
+    def tick(carry, t):
+        # lax.scan (not fori_loop): the schedule must be reverse-mode
+        # differentiable so the pp TRAINING path can backprop through the
+        # whole pipeline (while_loop has no transpose rule; scan does).
         outputs, state = carry
         feed = jax.lax.dynamic_index_in_dim(
             x, jnp.clip(t, 0, num_micro - 1), 0, keepdims=False
@@ -56,11 +59,13 @@ def pipeline_apply(
         take = (idx == pp - 1) & (out_t >= 0) & (out_t < num_micro)
         outputs = jnp.where(take, collected, outputs)
         state = jax.lax.ppermute(y, axis_name, perm)
-        return outputs, state
+        return (outputs, state), None
 
     outputs0 = jnp.zeros_like(x)
     state0 = jnp.zeros_like(x[0])
-    outputs, _ = jax.lax.fori_loop(0, num_micro + pp - 1, tick, (outputs0, state0))
+    (outputs, _), _ = jax.lax.scan(
+        tick, (outputs0, state0), jnp.arange(num_micro + pp - 1)
+    )
     # only the last stage holds real outputs; broadcast to all devices
     outputs = jnp.where(idx == pp - 1, outputs, jnp.zeros_like(outputs))
     return jax.lax.psum(outputs, axis_name)

@@ -456,6 +456,14 @@ class SchedulerRPCServer:
                 # draining alone would leave them stuck until some peer
                 # happens to send a message.
                 await self._drain_seed_triggers()
+                # Interval resource GC rides the same loop (pkg/gc wired
+                # into the scheduler bootstrap, scheduler.go:110-299):
+                # cheap due-check inline, the actual sweep off-loop since
+                # it takes the service lock.
+                if self.service.gc_due():
+                    swept = await asyncio.to_thread(self.service.run_gc)
+                    if any(swept.values()):
+                        logger.info("resource gc reaped %s", swept)
             except Exception:  # noqa: BLE001 - keep ticking
                 logger.exception("schedule tick failed")
 

@@ -462,26 +462,37 @@ def train_attention(
     seed: int = 0,
     eval_fraction: float = 0.2,
     checkpointer=None,
-    sp_strategy: str = "ring",
+    sp_strategy: str | None = None,
 ) -> TrainResult:
     """Train the set-transformer parent ranker (models/attention.py) on
     the same RankingDataset the GNN consumes — candidates attend to each
-    other, no graph needed. With a mesh, batches shard over dp and the
-    attention inner product can run as ring attention over sp."""
+    other, no graph needed.
+
+    Every parallelism axis turns on from TrainerConfig alone (SURVEY
+    §2.6; the round-2 gap was sp being the only reachable knob):
+    - mesh dp > 1: batches shard over dp (always on with a mesh)
+    - mesh sp > 1: ring or ulysses attention per `config.sp_strategy`
+    - mesh tp > 1 + `config.attention_tp`: Megatron column/row split of
+      qkv/proj/FFN via GSPMD param shardings — XLA inserts the psum
+    - mesh ep > 1 + `config.attention_moe_experts`: top-1 MoE scorer
+      FFN, expert queues over all_to_all (parallel/moe.py)
+    - mesh pp > 1 + `config.attention_pp`: deep variant, one block per
+      pp stage on the GPipe schedule (parallel/pipeline.py)
+    """
     import functools
 
     from dragonfly2_tpu.models.attention import AttentionRanker
     from dragonfly2_tpu.parallel.ring import sharded_ring_attention
     from dragonfly2_tpu.parallel.ulysses import sharded_ulysses_attention
-    from dragonfly2_tpu.parallel.mesh import SP_AXIS
+    from dragonfly2_tpu.parallel.mesh import PP_AXIS, SP_AXIS, TP_AXIS
 
     config = config or TrainerConfig()
+    sp_strategy = sp_strategy or config.sp_strategy
     rng = np.random.default_rng(seed)
     n = ds.child.shape[0]
     perm = rng.permutation(n)
     eval_idx, train_idx = _train_eval_split(perm, eval_fraction)
 
-    model = AttentionRanker(hidden_dim=config.hidden_dim)
     # ring and ulysses are drop-in swaps (same global-shape contract); ring
     # moves KV around the ICI ring, ulysses all-to-alls heads — pick per
     # workload (ulysses needs heads % sp == 0). Validated regardless of
@@ -496,11 +507,6 @@ def train_attention(
     if mesh is not None and mesh.shape.get(SP_AXIS, 1) > 1:
         attention_fn = functools.partial(strategies[sp_strategy], mesh)
 
-    def apply(params, child, parents, pair, mask):
-        if attention_fn is not None:
-            return model.apply(params, child, parents, pair, mask, attention_fn=attention_fn)
-        return model.apply(params, child, parents, pair, mask)
-
     def take(idx):
         return {
             "child": ds.child[idx],
@@ -511,9 +517,30 @@ def train_attention(
         }
 
     sample = take(train_idx[: min(2, len(train_idx))])
-    params = model.init(
-        jax.random.key(seed), sample["child"], sample["parents"], sample["pair"], sample["mask"]
+    use_pp = (
+        config.attention_pp and mesh is not None and mesh.shape.get(PP_AXIS, 1) > 1
     )
+    if use_pp:
+        apply, params = _build_pp_ranker(config, mesh, sample, seed)
+    else:
+        model = AttentionRanker(
+            hidden_dim=config.hidden_dim,
+            num_layers=config.attention_num_layers,
+            moe_experts=config.attention_moe_experts,
+        )
+
+        def apply(params, child, parents, pair, mask):
+            if attention_fn is not None:
+                return model.apply(
+                    params, child, parents, pair, mask,
+                    attention_fn=attention_fn, mesh=mesh,
+                )
+            return model.apply(params, child, parents, pair, mask, mesh=mesh)
+
+        params = model.init(
+            jax.random.key(seed), sample["child"], sample["parents"],
+            sample["pair"], sample["mask"], mesh=mesh,
+        )
     optimizer = optax.adamw(config.learning_rate)
     opt_state = optimizer.init(params)
     params, opt_state, start_epoch, on_epoch = _resume_hooks(
@@ -525,7 +552,12 @@ def train_attention(
         return listwise_rank_loss(scores, batch["throughput"], batch["mask"])
 
     if mesh is not None:
-        params = jax.device_put(params, replicated(mesh))
+        if config.attention_tp and mesh.shape.get(TP_AXIS, 1) > 1 and not use_pp:
+            params = jax.device_put(params, _attention_tp_shardings(mesh, params))
+        else:
+            params = jax.device_put(params, replicated(mesh))
+        # opt state starts replicated; GSPMD re-shards the adam moments to
+        # follow their (possibly tp-sharded) params inside the jitted step
         opt_state = jax.device_put(opt_state, replicated(mesh))
 
     batch_size = min(config.batch_size, len(train_idx))
@@ -580,6 +612,111 @@ def train_attention(
         flops_per_sample=flops_per_sample,
         peak_samples_per_sec=peak,
     )
+
+
+def _attention_tp_shardings(mesh, params):
+    """Megatron tensor-parallel GSPMD shardings for AttentionRanker
+    params: qkv and mlp_up kernels column-split over tp (their biases
+    follow the split output dim), proj and mlp_down kernels row-split
+    (bias replicated — it adds after the psum XLA inserts). Everything
+    else (embed, layer norms, score head) is replicated. No shard_map
+    needed: annotating the params is the whole mechanism (scaling-book
+    recipe; the hand-written kernel contract lives in parallel/tensor.py
+    and its oracle tests)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dragonfly2_tpu.parallel.mesh import TP_AXIS
+
+    def spec_for(path, leaf):
+        joined = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "qkv" in joined or "mlp_up" in joined:
+            if leaf.ndim == 2:
+                spec = P(None, TP_AXIS)
+            else:
+                spec = P(TP_AXIS)
+        elif ("proj" in joined or "mlp_down" in joined) and leaf.ndim == 2:
+            spec = P(TP_AXIS, None)
+        else:
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _build_pp_ranker(config: TrainerConfig, mesh, sample: dict, seed: int):
+    """Deep pipeline-parallel variant of the attention ranker: embed and
+    score stay replicated on every device; the transformer blocks (one
+    per pp stage) run the GPipe schedule (parallel/pipeline.py). The
+    candidate mask rides the microbatch tensor as an extra channel so
+    the single-argument stage contract holds. Returns (apply, params)."""
+    import flax.linen as nn
+
+    from dragonfly2_tpu.models.attention import SelfAttentionBlock
+    from dragonfly2_tpu.parallel.mesh import PP_AXIS
+    from dragonfly2_tpu.parallel.pipeline import sharded_pipeline_apply
+
+    pp = mesh.shape[PP_AXIS]
+    hidden = config.hidden_dim
+    num_micro = config.attention_pp_microbatches
+    dtype = jnp.bfloat16
+
+    embed = nn.Dense(hidden, dtype=dtype)
+    block = SelfAttentionBlock(hidden, compute_dtype=dtype)
+    final_ln = nn.LayerNorm(dtype=dtype)
+    score = nn.Dense(1, dtype=jnp.float32)
+
+    def tokens_of(child, parents, pair):
+        n, p, _ = parents.shape
+        return jnp.concatenate(
+            [
+                parents.astype(dtype),
+                jnp.broadcast_to(child[:, None, :], (n, p, child.shape[-1])).astype(dtype),
+                pair.astype(dtype),
+            ],
+            axis=-1,
+        )
+
+    def stage_fn(p_block, a):  # a: [mb, P, hidden+1]
+        tok, flag = a[..., :hidden], a[..., hidden:]
+        y = block.apply(p_block, tok, flag[..., 0] > 0.5)
+        return jnp.concatenate([y, flag], axis=-1)
+
+    def apply(params, child, parents, pair, mask):
+        x = embed.apply(params["embed"], tokens_of(child, parents, pair))
+        n, p, _ = x.shape
+        pad = (-n) % num_micro
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, p, hidden), x.dtype)])
+            mask_p = jnp.concatenate([mask, jnp.zeros((pad, p), mask.dtype)])
+        else:
+            mask_p = mask
+        a = jnp.concatenate([x, mask_p[..., None].astype(x.dtype)], axis=-1)
+        a = a.reshape(num_micro, -1, p, hidden + 1)
+        y = sharded_pipeline_apply(mesh, stage_fn, params["blocks"], a)
+        y = y.reshape(-1, p, hidden + 1)[:n, :, :hidden]
+        h = final_ln.apply(params["ln"], y)
+        scores = score.apply(params["score"], h)[..., 0]
+        return jnp.where(mask, scores, -1e30)
+
+    key = jax.random.key(seed)
+    k_embed, k_ln, k_score, *k_blocks = jax.random.split(key, 3 + pp)
+    tok = tokens_of(
+        jnp.asarray(sample["child"]), jnp.asarray(sample["parents"]),
+        jnp.asarray(sample["pair"]),
+    )
+    x0 = embed.init(k_embed, tok)
+    x_sample = jnp.zeros(tok.shape[:-1] + (hidden,), dtype)
+    stage_params = [
+        block.init(k, x_sample, jnp.asarray(sample["mask"])) for k in k_blocks
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_params)
+    params = {
+        "embed": x0,
+        "blocks": stacked,
+        "ln": final_ln.init(k_ln, x_sample),
+        "score": score.init(k_score, x_sample),
+    }
+    return apply, params
 
 
 def _pair_feats(ds: RankingDataset, idx: np.ndarray) -> np.ndarray:

@@ -104,6 +104,21 @@ def generate_csr(common_name: str, san_hosts: list[str] | None = None) -> tuple[
     return csr.public_bytes(serialization.Encoding.PEM), _key_pem(key)
 
 
+def csr_identity(csr_pem: bytes) -> tuple[str, list[str]]:
+    """(common name, SAN strings) a CSR asks for — the identity the CA is
+    about to vouch for, surfaced so issuance can be audited."""
+    _require_crypto()
+    csr = x509.load_pem_x509_csr(csr_pem)
+    cn_attrs = csr.subject.get_attributes_for_oid(x509.NameOID.COMMON_NAME)
+    cn = cn_attrs[0].value if cn_attrs else ""
+    try:
+        ext = csr.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+        sans = [str(g.value) for g in ext.value]
+    except x509.ExtensionNotFound:
+        sans = []
+    return str(cn), sans
+
+
 def sign_csr(
     ca_cert_pem: bytes,
     ca_key_pem: bytes,

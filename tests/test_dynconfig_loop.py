@@ -1,0 +1,154 @@
+"""The CLOSED dynconfig loop (round-2 gap: engine + endpoint + hook all
+existed, nothing polled): schedulers hot-apply manager-pushed limits into
+the live tick (scheduler/config/dynconfig.go:457), daemons learn their
+scheduler list from the manager (client/config/dynconfig_manager.go:346),
+and the Dynconfig engine carries both over the real manager RPC."""
+
+import asyncio
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.manager import rpc as mrpc
+from dragonfly2_tpu.manager.models import Database
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+
+def host(i, seed=False):
+    return msg.HostInfo(
+        host_id=f"host-{i}", hostname=f"node-{i}", ip=f"10.0.0.{i}",
+        host_type="super" if seed else "normal",
+    )
+
+
+def register(svc, peer_id, task_id, h, pieces=4):
+    return svc.register_peer(msg.RegisterPeerRequest(
+        peer_id=peer_id, task_id=task_id, host=h, url="https://e.com/blob",
+        content_length=pieces * (4 << 20), total_piece_count=pieces,
+    ))
+
+
+def test_apply_dynconfig_changes_the_next_tick():
+    """A manager-pushed candidate_parent_limit must bound the very next
+    scheduling batch — the observer writes the field tick() reads live."""
+    svc = SchedulerService()
+    for i in range(4):
+        register(svc, f"parent-{i}", "task-1", host(i, seed=i == 0))
+        svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id=f"parent-{i}", piece_count=4))
+    svc.tick()
+    register(svc, "child-wide", "task-1", host(10))
+    wide = [r for r in svc.tick() if isinstance(r, msg.NormalTaskResponse)]
+    assert wide and len(wide[0].candidate_parents) > 1
+
+    svc.apply_dynconfig({"scheduler_cluster_config": {"candidate_parent_limit": 1}})
+    assert svc.config.scheduler.candidate_parent_limit == 1
+    register(svc, "child-narrow", "task-1", host(11))
+    narrow = [r for r in svc.tick() if isinstance(r, msg.NormalTaskResponse)]
+    assert narrow and len(narrow[0].candidate_parents) == 1
+
+    # hostile payloads are ignored, not applied
+    svc.apply_dynconfig({"scheduler_cluster_config": {
+        "candidate_parent_limit": 0, "filter_parent_limit": "bogus",
+    }})
+    assert svc.config.scheduler.candidate_parent_limit == 1
+
+
+def test_scheduler_polls_manager_dynconfig_over_rpc(tmp_path):
+    """End-to-end limit push: REST PATCH on the scheduler cluster ->
+    GetDynconfig RPC payload -> Dynconfig refresh -> live service config
+    (the loop the launcher's dynconfig_loop runs on a cadence)."""
+
+    async def run():
+        mgr = ManagerService(Database())
+        mgr.create_cluster({"name": "c1"})
+        mgr.register_scheduler({
+            "host_name": "sched-1", "ip": "127.0.0.1", "port": 9000,
+            "scheduler_cluster_id": 1,
+        })
+        server = mrpc.ManagerRPCServer(mgr)
+        mhost, mport = await server.start()
+        sched = SchedulerService()
+        try:
+            def fetch():
+                async def go():
+                    client = await mrpc.ManagerClient(mhost, mport).connect()
+                    try:
+                        resp = await client.call(mrpc.GetDynconfigRequest(
+                            scheduler_cluster_id=1))
+                        return resp.data
+                    finally:
+                        await client.close()
+                return asyncio.run(go())
+
+            dyn = Dynconfig(fetch, cache_path=tmp_path / "dyn.json", expire=3600.0)
+            dyn.register(sched.apply_dynconfig)
+            await asyncio.to_thread(dyn.get)
+            default_limit = sched.config.scheduler.candidate_parent_limit
+
+            # the operator patches the cluster config via the manager
+            # (REST PATCH /scheduler-clusters/:id writes the same table)
+            mgr.db.update("scheduler_clusters", 1, {
+                "config": {"candidate_parent_limit": 2, "filter_parent_limit": 9},
+            })
+            await asyncio.to_thread(dyn.refresh)
+            assert sched.config.scheduler.candidate_parent_limit == 2
+            assert sched.config.scheduler.filter_parent_limit == 9
+            assert sched.config.scheduler.candidate_parent_limit != default_limit
+
+            # manager outage: the disk snapshot keeps serving the last limits
+            await server.stop()
+            await asyncio.to_thread(dyn.refresh)
+            assert sched.config.scheduler.candidate_parent_limit == 2
+        finally:
+            await server.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_daemon_refreshes_scheduler_list_from_manager(tmp_path):
+    """A daemon pointed at the manager re-resolves its scheduler set: an
+    inactive scheduler leaves the hash ring, a newly registered one joins
+    (pkg/resolver semantics through SchedulerClientPool.update_addresses)."""
+
+    async def run():
+        from dragonfly2_tpu.client.daemon import Daemon
+
+        mgr = ManagerService(Database())
+        mgr.create_cluster({"name": "c1"})
+        mgr.register_scheduler({
+            "host_name": "s-a", "ip": "10.9.0.1", "port": 9001,
+            "scheduler_cluster_id": 1, "state": "active",
+        })
+        mgr.register_scheduler({
+            "host_name": "s-b", "ip": "10.9.0.2", "port": 9002,
+            "scheduler_cluster_id": 1, "state": "active",
+        })
+        server = mrpc.ManagerRPCServer(mgr)
+        mhost, mport = await server.start()
+        try:
+            daemon = Daemon(
+                data_dir=tmp_path / "daemon",
+                scheduler_addresses=[("10.9.0.1", 9001)],  # static bootstrap
+                manager_address=(mhost, mport),
+            )
+            data = await asyncio.to_thread(daemon._fetch_scheduler_list)
+            daemon._apply_scheduler_list(data)
+            assert set(daemon.pool._addr.values()) == {
+                ("10.9.0.1", 9001), ("10.9.0.2", 9002),
+            }
+
+            # s-a misses keepalives -> inactive -> next refresh drops it
+            mgr.db.update("schedulers", 1, {"state": "inactive"})
+            data = await asyncio.to_thread(daemon._fetch_scheduler_list)
+            daemon._apply_scheduler_list(data)
+            assert set(daemon.pool._addr.values()) == {("10.9.0.2", 9002)}
+
+            # an all-inactive payload must NOT strand the daemon
+            mgr.db.update("schedulers", 2, {"state": "inactive"})
+            data = await asyncio.to_thread(daemon._fetch_scheduler_list)
+            daemon._apply_scheduler_list(data)
+            assert set(daemon.pool._addr.values()) == {("10.9.0.2", 9002)}
+        finally:
+            await server.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())

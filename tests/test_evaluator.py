@@ -277,3 +277,26 @@ def test_masked_top_k_rank_hostile_scores():
     assert m[0].sum() == 4                     # exactly the eligible count
     assert set(i[0, :4].tolist()) == {0, 1, 2, 3}  # never a masked index
     assert i[0, :2].tolist() == [2, 3]         # real scores rank first
+
+
+def test_masked_top_k_wide_path_hostile_scores():
+    """The lax.top_k fallback (K > rank-select width) honors the same
+    hostile-score contract as the rank path: eligible -inf/NaN candidates
+    outrank masked ones and validity comes from the eligible count
+    (r2 advisor finding)."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.ops.topk import _RANK_SELECT_MAX_WIDTH, masked_top_k
+
+    n = _RANK_SELECT_MAX_WIDTH * 2  # force the wide fallback
+    scores = np.full((1, n), 1.0, np.float32)
+    scores[0, 0] = -np.inf
+    scores[0, 1] = np.nan
+    mask = np.zeros((1, n), bool)
+    mask[0, :4] = True
+    v, i, m = masked_top_k(jnp.asarray(scores), jnp.asarray(mask), 6)
+    v, i, m = np.asarray(v), np.asarray(i), np.asarray(m)
+    assert m[0].sum() == 4
+    assert set(i[0, :4].tolist()) == {0, 1, 2, 3}
+    assert i[0, :2].tolist() == [2, 3]
+    assert np.isneginf(v[0, 4:]).all()

@@ -1,0 +1,147 @@
+"""Scheduler-side interval GC (pkg/gc/gc.go:28-63 wired into the resource
+managers, scheduler/resource/{peer,task,host}_manager.go RunGC): the
+sweeps must run from the live service path and keep BOTH the SoA slots
+and the host-side dicts bounded under churn."""
+
+import time
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.state.fsm import PeerState
+
+
+def host(i, host_type="normal"):
+    return msg.HostInfo(
+        host_id=f"h-{i}", hostname=f"h-{i}", ip=f"10.0.{i // 256}.{i % 256}",
+        host_type=host_type,
+    )
+
+
+def register(svc, peer, task, h):
+    return svc.register_peer(msg.RegisterPeerRequest(
+        peer_id=peer, task_id=task, host=h, url=f"https://o.example/{task}",
+        content_length=64 << 20,
+    ))
+
+
+def small_config(**overrides):
+    cfg = Config()
+    cfg.scheduler.max_hosts = 64
+    cfg.scheduler.max_tasks = 32
+    for k, v in overrides.items():
+        setattr(cfg.scheduler, k, v)
+    return cfg
+
+
+def test_peer_ttl_sweep_reaps_soa_and_host_side_dicts():
+    svc = SchedulerService(config=small_config())
+    svc.announce_host(host(0, "super"))
+    for i in range(8):
+        register(svc, f"p-{i}", "t-1", host(i + 1))
+    assert svc.state.counts()["peers"] == 8
+    # age half the peers past the TTL
+    for i in range(4):
+        idx = svc.state.peer_index(f"p-{i}")
+        svc.state.peer_updated_at[idx] -= svc.config.scheduler.peer_ttl_seconds + 1
+    swept = svc.run_gc(force=True)
+    assert swept["peers"] == 4
+    assert svc.state.counts()["peers"] == 4
+    for i in range(4):
+        assert svc.state.peer_index(f"p-{i}") is None
+        assert f"p-{i}" not in svc._peer_meta
+        assert f"p-{i}" not in svc._pending
+    # survivors untouched
+    assert all(svc.state.peer_index(f"p-{i}") is not None for i in range(4, 8))
+
+
+def test_failed_and_stalled_peers_reaped():
+    cfg = small_config(piece_download_timeout_seconds=10.0)
+    svc = SchedulerService(config=cfg)
+    register(svc, "p-failed", "t-1", host(1))
+    register(svc, "p-stalled", "t-1", host(2))
+    register(svc, "p-live", "t-1", host(3))
+    # FAILED peers leave on the next sweep (peer_manager.go:213-220)
+    fidx = svc.state.peer_index("p-failed")
+    svc.state.peer_state[fidx] = int(PeerState.FAILED)
+    # a RUNNING peer whose last piece update exceeds the download timeout
+    sidx = svc.state.peer_index("p-stalled")
+    svc.state.peer_state[sidx] = int(PeerState.RUNNING)
+    svc.state.peer_updated_at[sidx] -= 11.0
+    swept = svc.run_gc(force=True)
+    assert swept["peers"] == 2
+    assert svc.state.peer_index("p-failed") is None
+    assert svc.state.peer_index("p-stalled") is None
+    assert svc.state.peer_index("p-live") is not None
+
+
+def test_task_sweep_reclaims_empty_tasks_and_dag_maps():
+    svc = SchedulerService(config=small_config())
+    register(svc, "p-0", "t-keep", host(1))
+    register(svc, "p-1", "t-empty", host(2))
+    # all peers of t-empty age out -> next task sweep reclaims the task
+    idx = svc.state.peer_index("p-1")
+    svc.state.peer_updated_at[idx] -= svc.config.scheduler.peer_ttl_seconds + 1
+    swept = svc.run_gc(force=True)
+    assert swept["tasks"] >= 1
+    assert svc.state.task_index("t-empty") is None
+    assert "t-empty" not in svc._dags
+    assert "t-empty" not in svc._dag_slot_peer
+    assert "t-empty" not in svc._task_peers
+    assert svc.state.task_index("t-keep") is not None
+    assert "t-keep" in svc._dags
+
+
+def test_host_sweep_reaps_idle_normal_hosts_only():
+    svc = SchedulerService(config=small_config())
+    svc.announce_host(host(0, "super"))
+    svc.announce_host(host(1))          # idle normal -> reaped
+    register(svc, "p-0", "t-1", host(2))  # has a peer -> kept
+    swept = svc.run_gc(force=True)
+    assert swept["hosts"] == 1
+    assert svc.state.host_index("h-1") is None
+    assert "h-1" not in svc._host_info
+    assert svc.state.host_index("h-0") is not None  # seed persists
+    assert svc.state.host_index("h-2") is not None
+
+
+def test_interval_gating():
+    """run_gc without force is a no-op until each sweep's interval has
+    elapsed; gc_due mirrors that without taking the lock."""
+    cfg = small_config(
+        peer_gc_interval_seconds=3600.0,
+        task_gc_interval_seconds=3600.0,
+        host_gc_interval_seconds=3600.0,
+    )
+    svc = SchedulerService(config=cfg)
+    now = time.time()
+    # a ticker, not an eager sweep: nothing fires until one full interval
+    # after construction (an instant sweep would reap freshly announced
+    # idle hosts before their first peer registers)
+    assert svc.run_gc(now=now + 10) == {}
+    assert not svc.gc_due(now=now + 10)
+    assert svc.gc_due(now=now + 3601)
+    assert set(svc.run_gc(now=now + 3601)) == {"peers", "tasks", "hosts"}
+    assert svc.run_gc(now=now + 3611) == {}
+
+
+def test_churn_occupancy_stays_bounded():
+    """Register/complete several times the peer capacity with the service's
+    own GC running: occupancy stays bounded and no CapacityError fires
+    (the round-2 leak: a long-running scheduler filled its free lists)."""
+    cfg = small_config(peer_ttl_seconds=0.05)
+    svc = SchedulerService(config=cfg)
+    capacity = svc.state.max_peers
+    total = 3 * capacity
+    peak = 0
+    for i in range(total):
+        register(svc, f"p-{i}", f"t-{i % 8}", host(i % 48))
+        if i % 32 == 31:
+            time.sleep(0.06)  # let the batch age past the TTL
+            svc.run_gc(force=True)
+        peak = max(peak, svc.state.counts()["peers"])
+    assert peak < capacity
+    svc.run_gc(force=True)
+    # host-side dicts bounded along with the SoA slots
+    assert len(svc._peer_meta) == svc.state.counts()["peers"]
+    assert len(svc._pending) <= svc.state.counts()["peers"]

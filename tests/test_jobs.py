@@ -129,3 +129,26 @@ def test_preheat_empty_url_list_is_immediate_success():
     result = jm.create_preheat(PreheatRequest(urls=[]))
     assert result.state == JobState.SUCCESS
     assert jm.get(result.job_id).state == JobState.SUCCESS
+
+
+def test_preheat_success_is_terminal_after_scheduler_forgets_task():
+    """Once every task was observed SUCCEEDED, the job latches SUCCESS:
+    a scheduler restart / TTL GC forgetting the task id must not regress
+    the completed job back to PENDING (r2 advisor finding)."""
+    from dragonfly2_tpu.state.fsm import TaskEvent
+
+    svc = SchedulerService()
+    svc.announce_host(seed_host(0))
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(PreheatRequest(urls=["https://e.com/blob"]))
+    tid = result.task_ids[0]
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="p-1", task_id=tid, host=seed_host(0), url="https://e.com/blob",
+        content_length=10 << 20,
+    ))
+    idx = svc.state.task_index(tid)
+    svc.state.task_event(idx, TaskEvent.DOWNLOAD_SUCCEEDED)
+    assert jm.get(result.job_id).state == JobState.SUCCESS
+    # the scheduler forgets everything (restart) — SUCCESS must hold
+    jm.schedulers["s1"] = SchedulerService()
+    assert jm.get(result.job_id).state == JobState.SUCCESS

@@ -128,3 +128,28 @@ def test_minicluster_over_mtls(tmp_path):
             origin.stop()
 
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_issuance_requires_enrollment_token(tmp_path):
+    """A manager configured with an enrollment token refuses CSRs that
+    don't present it — CA trust must not be granted by mere network
+    reachability (r2 advisor finding)."""
+
+    async def run():
+        svc = ManagerService(
+            Database(), cert_dir=str(tmp_path / "ca"), enrollment_token="sekrit"
+        )
+        server = mrpc.ManagerRPCServer(svc)
+        host, port = await server.start()
+        try:
+            with pytest.raises(RuntimeError, match="enrollment token"):
+                await mrpc.obtain_certificate(host, port, "rogue", tmp_path / "rogue-tls")
+            mat = await mrpc.obtain_certificate(
+                host, port, "scheduler-1", tmp_path / "sched-tls",
+                enrollment_token="sekrit",
+            )
+            assert mat.ready
+        finally:
+            await server.stop()
+
+    asyncio.new_event_loop().run_until_complete(run())
