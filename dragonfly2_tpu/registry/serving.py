@@ -109,8 +109,13 @@ class ModelServer:
         new_params = self.registry.load_params(
             self.model_id, active.version, template=self._template
         )
+        # Commit to device ONCE here: load_params returns numpy leaves
+        # (topology portability), and numpy params passed to every jitted
+        # infer/schedule call would re-pay one host->device transfer PER
+        # LEAF PER CALL — ~25 round-trips on the tunneled TPU, which
+        # dominated the ml tick (~2 s/tick in a degraded window).
         self.model = new_model
-        self.params = new_params
+        self.params = jax.device_put(new_params)
         self.version = active.version
         return True
 
@@ -253,6 +258,22 @@ class MLEvaluator:
             feats, blocklist, in_degree, can_add_edge, algorithm=self.fallback, limit=limit
         )
 
+    def schedule_from_packed(
+        self, buf, b, k, c, l, n,
+        limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
+    ):
+        """Single-buffer-transport twin of `schedule_packed` (the tick's
+        one-H2D contract; ops/evaluator.pack_eval_batch). Falls back to
+        the linear blend over the same buffer until a model is served."""
+        if self.server.ready and self._host_emb is not None:
+            return _ml_schedule_from_packed(
+                self.server.model, self.server.params, self._host_emb,
+                buf, b, k, c, l, n, limit,
+            )
+        return ev.schedule_from_packed(
+            buf, b, k, c, l, n, algorithm=self.fallback, limit=limit
+        )
+
 
 @jax.jit
 def _loc_match_fraction(parent_loc, child_loc):
@@ -300,4 +321,29 @@ def _ml_schedule_packed(
     scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
     return ev.select_with_scores_packed(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "b", "k", "c", "l", "n", "limit")
+)
+def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit):
+    """`_ml_schedule_packed` over the single-buffer transport
+    (ops/evaluator.pack_eval_batch): the whole ml tick is one H2D + one
+    dispatch + one D2H like the linear-blend path — only the (device-
+    resident) embedding table and params stay out of the buffer."""
+    f = ev.unpack_eval_batch(buf, b, k, c, l, n)
+    child_idc = f["child_idc"][..., None]
+    pair_feats = jnp.stack(
+        [
+            ((f["parent_idc"] == child_idc) & (child_idc != 0)).astype(jnp.float32),
+            _loc_match_fraction(f["parent_location"], f["child_location"]),
+        ],
+        axis=-1,
+    )
+    scores = gnn_score(
+        model, params, host_emb, f["child_host_slot"], f["cand_host_slot"], pair_feats
+    )
+    return ev.select_with_scores_packed(
+        f, scores, f["blocklist"], f["in_degree"], f["can_add_edge"], limit=limit
     )

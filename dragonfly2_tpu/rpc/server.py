@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 
 from dragonfly2_tpu.cluster import messages as msg
@@ -29,7 +30,10 @@ from dragonfly2_tpu.telemetry.series import (
 )
 from dragonfly2_tpu.utils.conntrack import ConnTracker
 
+from dragonfly2_tpu.cluster import service_v1 as sv1
+
 wire.register_module(msg)
+wire.register_module(sv1)
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +71,12 @@ class SchedulerRPCServer:
         # part (b); the interval remains the RETRY cadence for peers that
         # stay pending with no eligible parents).
         self._tick_wake = asyncio.Event()
+        # v1 compat surface (cluster/service_v1.py): peers that registered
+        # through the v1 dialect get their scheduling responses converted
+        # to PeerPacket frames (the reference serves both generations off
+        # one resource layer, service_v1.go + service_v2.go).
+        self.v1 = sv1.SchedulerServiceV1(service)
+        self._v1_peers: set[str] = set()
         reg = default_registry()
         self.metrics = scheduler_series(reg)
         register_version(reg, "scheduler")
@@ -92,8 +102,20 @@ class SchedulerRPCServer:
             )
             logger.info("scheduler rpc also on vsock port %d", self.vsock_port)
         self._tick_task = asyncio.create_task(self._tick_loop())
+        # Pre-compile the per-bucket serving programs off-loop so the
+        # first real peers don't eat a multi-second XLA compile; READY is
+        # not delayed (warmup touches no service state — scheduler.py).
+        threading.Thread(
+            target=self._safe_warmup, name="eval-warmup", daemon=True
+        ).start()
         logger.info("scheduler rpc listening on %s:%d", self.host, self.port)
         return self.host, self.port
+
+    def _safe_warmup(self) -> None:
+        try:
+            self.service.warmup()
+        except Exception:  # noqa: BLE001 - warmup is best-effort
+            logger.exception("evaluator warmup failed")
 
     async def stop(self) -> None:
         if self._tick_task:
@@ -155,6 +177,9 @@ class SchedulerRPCServer:
             async with self._lock:
                 for peer_id in owned_peers:
                     self._peer_conn.pop(peer_id, None)
+                    # v1 marking follows the route entry's lifetime, or the
+                    # set grows one string per v1 download forever
+                    self._v1_peers.discard(peer_id)
                 for host_id in owned_hosts:
                     self._host_conn.pop(host_id, None)
             writer.close()
@@ -246,7 +271,7 @@ class SchedulerRPCServer:
         # route bookkeeping must happen on-loop (touches asyncio state)
         peer_id = getattr(request, "peer_id", None)
         if peer_id is not None and not isinstance(
-            request, (msg.StatPeerRequest, msg.LeavePeerRequest)
+            request, (msg.StatPeerRequest, msg.LeavePeerRequest, sv1.V1PeerTarget)
         ):
             async with self._lock:
                 self._peer_conn[peer_id] = writer
@@ -280,8 +305,34 @@ class SchedulerRPCServer:
             return self._stat_peer(request.peer_id)
         if isinstance(request, msg.StatTaskRequest):
             return self._stat_task(request.task_id)
+        if isinstance(request, sv1.V1_REQUEST_TYPES):
+            return self._dispatch_v1(request, owned_peers)
         # announce-stream oneof (routing already recorded on-loop)
         return svc.handle(request)
+
+    def _dispatch_v1(self, request, owned_peers: set[str]):
+        """v1-dialect requests (cluster/service_v1.py) translated onto the
+        service; immediate v2-shaped answers convert to PeerPacket here,
+        tick-delivered ones convert in _send_responses via _v1_peers."""
+        v1 = self.v1
+        if isinstance(request, sv1.V1PeerTaskRequest):
+            self._v1_peers.add(request.peer_id)
+            return v1.register_peer_task(request)
+        if isinstance(request, sv1.V1PieceResult):
+            self._v1_peers.add(request.src_pid)
+            response = v1.report_piece_result(request)
+            return v1.to_peer_packet(response) if response is not None else None
+        if isinstance(request, sv1.V1PeerResult):
+            return v1.report_peer_result(request)
+        if isinstance(request, sv1.V1AnnounceTaskRequest):
+            v1.announce_task(request)
+            return None
+        if isinstance(request, sv1.V1PeerTarget):
+            v1.leave_task(request)
+            owned_peers.discard(request.peer_id)
+            self._v1_peers.discard(request.peer_id)
+            return None
+        return None
 
     def _observe_request(self, request) -> None:
         """Per-RPC totals + traffic/duration series (scheduler/metrics/
@@ -492,6 +543,10 @@ class SchedulerRPCServer:
                 writer = self._peer_conn.get(peer_id)
             if writer is None:
                 continue
+            if peer_id in self._v1_peers:
+                response = self.v1.to_peer_packet(response)
+                if response is None:
+                    continue
             try:
                 wire.write_frame(writer, response)
                 await writer.drain()

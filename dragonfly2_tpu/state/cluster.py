@@ -234,15 +234,28 @@ class ClusterState:
         self.peer_piece_cost_count[idx] = 0
         self.peer_cost_cursor[idx] = 0
         self.peer_updated_at[idx] = time.time()
+        self.touch_peer_host(idx)
         return idx
 
     def peer_index(self, peer_id: str) -> int | None:
         return self._peer_by_id.get(peer_id)
 
+    def touch_peer_host(self, peer_idx: int, now: float | None = None) -> None:
+        """Peer activity counts as host liveness. The repo's daemons
+        announce once per connection (not on the reference's ~5 min
+        re-announce cadence, announcer.go), so without this the host-TTL
+        sweep would reap every peer on a host after host_ttl_seconds of
+        daemon uptime — including RUNNING downloads and long-TTL cache
+        peers (ADVICE r3 high)."""
+        h = int(self.peer_host[peer_idx])
+        if 0 <= h < self.max_hosts and self.host_alive[h]:
+            self.host_updated_at[h] = time.time() if now is None else now
+
     def peer_event(self, idx: int, event: PeerEvent) -> None:
         current = PeerState(int(self.peer_state[idx]))
         self.peer_state[idx] = int(peer_transition(current, event))
         self.peer_updated_at[idx] = time.time()
+        self.touch_peer_host(idx)
 
     def remove_peer(self, peer_id: str) -> None:
         idx = self._peer_by_id.pop(peer_id, None)
@@ -268,6 +281,7 @@ class ClusterState:
             int(self.peer_piece_cost_count[peer_idx]) + 1, self.piece_cost_capacity
         )
         self.peer_updated_at[peer_idx] = time.time()
+        self.touch_peer_host(peer_idx)
 
     def peer_piece_costs_ordered(self, peer_idx: int) -> np.ndarray:
         """Costs oldest->newest (ring unrolled) for the 3-sigma rule."""

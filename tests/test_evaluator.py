@@ -219,6 +219,72 @@ def test_packed_matches_full(batch):
         assert (scores[valid] == np.asarray(full["selected_scores"])[fv]).all()
 
 
+def test_packed_transport_roundtrip(batch):
+    """pack_eval_batch -> unpack_eval_batch reconstructs every field
+    exactly (int64 identity fields travel as int32, matching what the
+    x32 dict path already does at device_put time)."""
+    import jax
+    import jax.numpy as jnp
+
+    fd = batch.as_dict()
+    b, k = fd["valid"].shape
+    c, l, n = (
+        fd["piece_costs"].shape[-1],
+        fd["parent_location"].shape[-1],
+        fd["numeric"].shape[-1],
+    )
+    rng = np.random.default_rng(5)
+    bl = rng.random((b, k)) < 0.2
+    ind = rng.integers(0, 3, (b, k)).astype(np.int32)
+    cae = rng.random((b, k)) < 0.8
+    buf = ev.pack_eval_batch(fd, blocklist=bl, in_degree=ind, can_add_edge=cae,
+                             child_host_slot=np.arange(b, dtype=np.int32),
+                             cand_host_slot=np.tile(np.arange(k, dtype=np.int32), (b, 1)))
+    unpack = jax.jit(ev.unpack_eval_batch, static_argnames=("b", "k", "c", "l", "n"))
+    out = {key: np.asarray(v) for key, v in unpack(jnp.asarray(buf), b=b, k=k, c=c, l=l, n=n).items()}
+    for name, want in fd.items():
+        want = np.asarray(want)
+        if want.dtype == np.int64:
+            want = want.astype(np.int32)
+        got = out[name]
+        assert np.array_equal(got.astype(want.dtype), want), name
+    assert np.array_equal(out["blocklist"], bl)
+    assert np.array_equal(out["in_degree"], ind)
+    assert np.array_equal(out["can_add_edge"], cae)
+    assert np.array_equal(out["child_host_slot"], np.arange(b, dtype=np.int32))
+
+
+def test_schedule_from_packed_matches_dict_transport(batch):
+    """The single-buffer transport selects the SAME parents as the dict
+    transport (scores may differ by float-fusion ulps, never ordering):
+    the serving tick's one-H2D contract cannot drift from the oracle-
+    tested dict path."""
+    fd = batch.as_dict()
+    b, k = fd["valid"].shape
+    c, l, n = (
+        fd["piece_costs"].shape[-1],
+        fd["parent_location"].shape[-1],
+        fd["numeric"].shape[-1],
+    )
+    rng = np.random.default_rng(6)
+    bl = rng.random((b, k)) < 0.2
+    ind = rng.integers(0, 3, (b, k)).astype(np.int32)
+    cae = rng.random((b, k)) < 0.8
+    for algorithm in ("default", "nt"):
+        want = np.asarray(ev.schedule_candidate_parents_packed(
+            fd, bl, ind, cae, algorithm=algorithm, limit=4
+        ))
+        buf = ev.pack_eval_batch(fd, blocklist=bl, in_degree=ind, can_add_edge=cae)
+        got = np.asarray(ev.schedule_from_packed(
+            buf, b, k, c, l, n, algorithm=algorithm, limit=4
+        ))
+        assert np.array_equal(want[..., 0], got[..., 0]), algorithm
+        valid = want[..., 0] >= 0
+        np.testing.assert_allclose(
+            got[..., 1][valid], want[..., 1][valid], atol=1e-5
+        )
+
+
 def test_select_with_scores_packed_matches(batch):
     rng = np.random.default_rng(3)
     scores = rng.random(batch.valid.shape).astype(np.float32)

@@ -105,6 +105,29 @@ def test_host_sweep_reaps_idle_normal_hosts_only():
     assert svc.state.host_index("h-2") is not None
 
 
+def test_peer_activity_refreshes_host_liveness():
+    """A daemon announces once per connection (no ~5min re-announce
+    cadence), so host liveness must ride on peer activity: piece reports
+    and FSM events refresh host_updated_at, keeping the host-TTL sweep
+    away from hosts with live traffic (ADVICE r3 high — without this,
+    after host_ttl_seconds of daemon uptime every peer on the host was
+    reaped, including RUNNING downloads)."""
+    svc = SchedulerService(config=small_config())
+    register(svc, "p-active", "t-1", host(1))
+    register(svc, "p-idle", "t-2", host(2))
+    ttl = svc.config.scheduler.host_ttl_seconds
+    for hid in ("h-1", "h-2"):
+        hidx = svc.state.host_index(hid)
+        svc.state.host_updated_at[hidx] -= ttl + 1
+    # activity on p-active's host: one piece report refreshes liveness
+    aidx = svc.state.peer_index("p-active")
+    svc.state.record_piece(aidx, 0, 1_000_000.0)
+    swept = svc.run_gc(force=True)
+    assert svc.state.peer_index("p-active") is not None
+    assert svc.state.peer_index("p-idle") is None
+    assert swept["peers"] == 1
+
+
 def test_interval_gating():
     """run_gc without force is a no-op until each sweep's interval has
     elapsed; gc_due mirrors that without taking the lock."""

@@ -1,0 +1,211 @@
+"""Scheduler v1 compat surface (VERDICT r3 missing #3).
+
+The reference serves BOTH protocol generations off one resource layer
+(scheduler/service/service_v1.go:95 RegisterPeerTask, :187
+ReportPieceResult, :294 ReportPeerResult, :349 AnnounceTask, :434
+StatTask, :457 LeaveTask); these tests drive the repo's v1 dialect
+(cluster/service_v1.py) both at the adapter level and over the real
+wire through SchedulerRPCServer."""
+
+import asyncio
+
+import numpy as np
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster import service_v1 as sv1
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.state.fsm import PeerState, TaskState
+
+
+def v1_host(i: int) -> sv1.V1PeerHost:
+    return sv1.V1PeerHost(
+        id=f"host-{i}", ip=f"10.1.0.{i}", rpc_port=8002 + i, down_port=8001,
+        host_name=f"h{i}", idc="idc-a", location="region|zone",
+    )
+
+
+def v1_register(adapter, peer_id: str, task_id: str, i: int, url="https://o.example/f"):
+    return adapter.register_peer_task(sv1.V1PeerTaskRequest(
+        url=url, peer_id=peer_id, peer_host=v1_host(i), task_id=task_id,
+        url_meta=sv1.V1UrlMeta(tag="t", application="app"),
+    ))
+
+
+def test_register_scopes_and_task_id_derivation():
+    svc = SchedulerService()
+    v1 = sv1.SchedulerServiceV1(svc)
+    # explicit task id, unknown length -> NORMAL scheduling path
+    result = v1_register(v1, "p-1", "t-1", 1)
+    assert result.size_scope == int(msg.SizeScope.NORMAL)
+    assert result.task_id == "t-1"
+    assert svc.state.peer_index("p-1") is not None
+    # empty task id -> derived exactly like the daemons derive it
+    from dragonfly2_tpu.utils import idgen
+
+    result = v1.register_peer_task(sv1.V1PeerTaskRequest(
+        url="https://o.example/g", peer_id="p-2", peer_host=v1_host(2),
+        url_meta=sv1.V1UrlMeta(tag="t", application="app"),
+    ))
+    assert result.task_id == idgen.task_id_v1(
+        "https://o.example/g", tag="t", application="app", filtered_query_params=""
+    )
+
+
+def test_piece_stream_drives_state_and_failure_reschedules():
+    svc = SchedulerService()
+    v1 = sv1.SchedulerServiceV1(svc)
+    v1_register(v1, "parent-1", "t-1", 1)
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id="parent-1"))
+    svc.handle(msg.DownloadPeerBackToSourceFinishedRequest(peer_id="parent-1", piece_count=4))
+    v1_register(v1, "child-1", "t-1", 2)
+    responses = svc.tick()
+    normal = [r for r in responses if isinstance(r, msg.NormalTaskResponse)]
+    assert normal and normal[0].peer_id == "child-1"
+    packet = v1.to_peer_packet(normal[0])
+    assert isinstance(packet, sv1.V1PeerPacket)
+    assert packet.main_peer.peer_id == "parent-1"
+    assert packet.code == sv1.CODE_SUCCESS
+
+    # begin-of-piece sentinel is a no-op frame
+    assert v1.report_piece_result(sv1.V1PieceResult(
+        task_id="t-1", src_pid="child-1",
+        piece_info=sv1.V1PieceInfo(piece_num=sv1.BEGIN_OF_PIECE),
+    )) is None
+    # successful piece updates the child's bitset + the parent's costs
+    v1.report_piece_result(sv1.V1PieceResult(
+        task_id="t-1", src_pid="child-1", dst_pid="parent-1", success=True,
+        piece_info=sv1.V1PieceInfo(piece_num=0, range_size=1 << 20, download_cost=12),
+    ))
+    idx = svc.state.peer_index("child-1")
+    assert svc.state.peer_finished_count[idx] == 1
+    # failed piece blocklists the parent and re-queues the child
+    v1.report_piece_result(sv1.V1PieceResult(
+        task_id="t-1", src_pid="child-1", dst_pid="parent-1", success=False,
+        piece_info=sv1.V1PieceInfo(piece_num=1),
+    ))
+    assert "child-1" in svc._pending
+    assert "parent-1" in svc._pending["child-1"].blocklist
+
+
+def test_report_peer_result_four_way_dispatch():
+    svc = SchedulerService()
+    v1 = sv1.SchedulerServiceV1(svc)
+    # back-to-source success
+    v1_register(v1, "p-b2s", "t-1", 1)
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id="p-b2s"))
+    v1.report_peer_result(sv1.V1PeerResult(
+        task_id="t-1", peer_id="p-b2s", success=True, total_piece_count=3,
+    ))
+    idx = svc.state.peer_index("p-b2s")
+    assert svc.state.peer_state[idx] == int(PeerState.SUCCEEDED)
+    assert svc.state.task_state[svc.state.task_index("t-1")] == int(TaskState.SUCCEEDED)
+    # p2p success
+    v1_register(v1, "p-ok", "t-1", 2)
+    v1.report_peer_result(sv1.V1PeerResult(task_id="t-1", peer_id="p-ok", success=True))
+    assert svc.state.peer_state[svc.state.peer_index("p-ok")] == int(PeerState.SUCCEEDED)
+    # p2p failure
+    v1_register(v1, "p-bad", "t-1", 3)
+    v1.report_peer_result(sv1.V1PeerResult(task_id="t-1", peer_id="p-bad", success=False))
+    assert svc.state.peer_state[svc.state.peer_index("p-bad")] == int(PeerState.FAILED)
+    # unknown peer -> SchedPeerGone packet
+    packet = v1.report_peer_result(sv1.V1PeerResult(task_id="t-1", peer_id="ghost"))
+    assert packet.code == sv1.CODE_SCHED_PEER_GONE
+
+
+def test_announce_task_makes_replica_schedulable():
+    svc = SchedulerService()
+    v1 = sv1.SchedulerServiceV1(svc)
+    v1.announce_task(sv1.V1AnnounceTaskRequest(
+        task_id="t-c", url="d7y:///cache-key", peer_host=v1_host(1),
+        peer_id="cache-1", total_piece_count=2, content_length=8 << 20,
+    ))
+    idx = svc.state.peer_index("cache-1")
+    assert svc.state.peer_state[idx] == int(PeerState.SUCCEEDED)
+    stat = v1.stat_task(msg.StatTaskRequest(task_id="t-c"))
+    assert stat.has_available_peer and stat.peer_count == 1
+    # a fresh child schedules against the announced replica
+    v1_register(v1, "child-c", "t-c", 2, url="d7y:///cache-key")
+    responses = svc.tick()
+    normal = [r for r in responses if isinstance(r, msg.NormalTaskResponse)]
+    assert normal and normal[0].candidate_parents[0].peer_id == "cache-1"
+
+
+def test_leave_task_and_stat_unknown():
+    svc = SchedulerService()
+    v1 = sv1.SchedulerServiceV1(svc)
+    v1_register(v1, "p-1", "t-1", 1)
+    v1.leave_task(sv1.V1PeerTarget(task_id="t-1", peer_id="p-1"))
+    assert svc.state.peer_index("p-1") is None
+    stat = v1.stat_task(msg.StatTaskRequest(task_id="nope"))
+    assert stat.peer_count == 0 and not stat.has_available_peer
+
+
+def test_v1_dialect_over_the_wire():
+    """Full v1 conversation against the real RPC server: register, get a
+    NeedBackToSource PeerPacket (cold task), report back-to-source
+    success, then a second v1 peer receives a PeerPacket whose main peer
+    is the first — the reference's RegisterPeerTask/ReportPieceResult/
+    ReportPeerResult loop end to end."""
+    from dragonfly2_tpu.rpc import wire
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    async def drive():
+        svc = SchedulerService()
+        server = SchedulerRPCServer(svc, tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            r1, w1 = await asyncio.open_connection(host, port)
+            wire.write_frame(w1, sv1.V1PeerTaskRequest(
+                url="https://o.example/f", peer_id="v1-a", peer_host=v1_host(1),
+                task_id="t-wire",
+            ))
+            await w1.drain()
+            result = await asyncio.wait_for(wire.read_frame(r1), 5)
+            assert isinstance(result, sv1.V1RegisterResult)
+            assert result.size_scope == int(msg.SizeScope.NORMAL)
+
+            # cold task, no parents: retries escalate to back-to-source,
+            # delivered as a v1 PeerPacket with the v1 code
+            packet = await asyncio.wait_for(wire.read_frame(r1), 10)
+            assert isinstance(packet, sv1.V1PeerPacket), packet
+            assert packet.code == sv1.CODE_SCHED_NEED_BACK_SOURCE
+
+            wire.write_frame(w1, sv1.V1PieceResult(
+                task_id="t-wire", src_pid="v1-a", success=True,
+                piece_info=sv1.V1PieceInfo(piece_num=0, range_size=1 << 20),
+            ))
+            wire.write_frame(w1, sv1.V1PeerResult(
+                task_id="t-wire", peer_id="v1-a", success=True,
+                total_piece_count=1,
+            ))
+            await w1.drain()
+            # state converges to SUCCEEDED (dispatch is async)
+            for _ in range(100):
+                idx = svc.state.peer_index("v1-a")
+                if idx is not None and svc.state.peer_state[idx] == int(
+                    PeerState.SUCCEEDED
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            assert svc.state.peer_state[svc.state.peer_index("v1-a")] == int(
+                PeerState.SUCCEEDED
+            )
+
+            r2, w2 = await asyncio.open_connection(host, port)
+            wire.write_frame(w2, sv1.V1PeerTaskRequest(
+                url="https://o.example/f", peer_id="v1-b", peer_host=v1_host(2),
+                task_id="t-wire",
+            ))
+            await w2.drain()
+            result2 = await asyncio.wait_for(wire.read_frame(r2), 5)
+            assert isinstance(result2, sv1.V1RegisterResult)
+            packet2 = await asyncio.wait_for(wire.read_frame(r2), 10)
+            assert isinstance(packet2, sv1.V1PeerPacket), packet2
+            assert packet2.code == sv1.CODE_SUCCESS
+            assert packet2.main_peer.peer_id == "v1-a"
+            assert packet2.main_peer.rpc_port == 8003  # v1_host(1).rpc_port
+            w1.close(); w2.close()
+        finally:
+            await server.stop()
+
+    asyncio.new_event_loop().run_until_complete(drive())
