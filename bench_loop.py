@@ -38,10 +38,17 @@ def replay(svc, sim, target_pieces: int, new_downloads: int, probe_every: int = 
     the configured peer TTL while active ones keep refreshing."""
     tick_ms: list[float] = []
     rounds = 0
+    # compile every bucket's serving program BEFORE the timed region: a
+    # 35 s XLA compile landing inside a short replay becomes the median
+    # tick (the r4 ml-leg artifact said 15 s/tick until this moved out)
+    svc.warmup()
     t0 = time.perf_counter()
     while sim.stats.pieces < target_pieces:
         for _ in range(new_downloads):
             sim.start_download()
+        # the seed-daemon leg (ObtainSeeds): without it no task ever has a
+        # first parent and back-to-source balloons (VERDICT r3 weak #6)
+        sim.consume_seed_triggers()
         t1 = time.perf_counter()
         responses = svc.tick()
         tick_ms.append((time.perf_counter() - t1) * 1e3)
@@ -111,6 +118,14 @@ def run(
         "pieces": sim.stats.pieces,
         "completed": sim.stats.completed,
         "back_to_source": sim.stats.back_to_source,
+        # cause split + seed origin fetches (origin traffic by design):
+        # starved = no live finished peer existed for the task at
+        # escalation time (GC'd swarm / seed race), with_parents = the
+        # interesting rate — candidates existed but filtering rejected
+        # every attempt for retry_back_to_source_limit ticks
+        "back_to_source_starved": sim.stats.back_to_source_starved,
+        "back_to_source_with_parents": sim.stats.back_to_source_with_parents,
+        "seed_downloads": sim.stats.seed_downloads,
         "rounds": rounds,
         "hosts": args.hosts,
         "wall_s": round(wall, 2),
@@ -121,6 +136,12 @@ def run(
         "unit": "ms",
         "p95": round(sorted(tick_ms)[int(0.95 * len(tick_ms))], 3),
         "ticks": len(tick_ms),
+        # Per-phase p50 breakdown (VERDICT r3 weak #5): host work vs the
+        # device conversation. device_call includes the H2D of the single
+        # packed buffer, the dispatch, and the D2H of the selection — on
+        # the tunneled dev TPU a degraded window puts a ~100 ms round-trip
+        # floor under it that no host-side work can remove.
+        "phases_p50_ms": _phase_p50(svc),
     })
 
     # topology snapshot feeding the GNN dataset
@@ -153,6 +174,12 @@ def run(
         "precision": round(active.evaluation.precision, 4),
         "recall": round(active.evaluation.recall, 4),
         "f1": round(active.evaluation.f1_score, 4),
+        # one pick per row vs several relevant candidates per row caps
+        # recall below 1.0 (models/metrics.py top1_selection_stats);
+        # the ceiling contextualizes the recall number (VERDICT r3 #10)
+        "recall_ceiling": round(
+            float(active.metadata.get("recall_ceiling", 0.0)), 4
+        ) if isinstance(active.metadata, dict) else 0.0,
     })
 
     # ---------------- phase 3: serve the model on the ml path at scale
@@ -195,9 +222,21 @@ def run(
         "unit": "ms",
         "pieces_per_sec": round(sim_ml.stats.pieces / max(wall_ml, 1e-9), 1),
         "pieces": sim_ml.stats.pieces,
+        "phases_p50_ms": _phase_p50(svc_ml),
     })
 
     return results
+
+
+def _phase_p50(svc) -> dict:
+    """p50 of each tick phase recorded by SchedulerService.tick."""
+    if not svc.tick_phases:
+        return {}
+    keys = set().union(*svc.tick_phases)
+    return {
+        k: round(statistics.median([p.get(k, 0.0) for p in svc.tick_phases]), 3)
+        for k in sorted(keys)
+    }
 
 
 def main() -> int:

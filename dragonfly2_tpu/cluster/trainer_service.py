@@ -182,6 +182,9 @@ class TrainerService:
                 "final_loss": result.losses[-1] if result.losses else None,
                 "samples_per_sec": result.samples_per_sec,
                 "hidden_dim": self.config.hidden_dim,
+                # structural bound on single-pick recall — judge recall
+                # against this, not 1.0 (models/metrics.py)
+                "recall_ceiling": result.eval_metrics.get("recall_ceiling", 0.0),
                 **extra,
             },
         )

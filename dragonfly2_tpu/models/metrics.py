@@ -67,7 +67,20 @@ def top1_selection_stats(scores: jax.Array, throughput: jax.Array, mask: jax.Arr
     per_row_regret = jnp.clip((best - picked_tp) / span, 0.0, 1.0)
     regret_rows = valid_rows & (finite.sum(-1) >= 2) & (picked_tp > neg / 2)
     regret = (per_row_regret * regret_rows).sum() / jnp.maximum(regret_rows.sum(), 1)
-    return {"precision": precision, "recall": recall, "f1": f1, "regret": regret}
+    # Recall is STRUCTURALLY capped below 1.0 here: the ranker makes one
+    # pick per row while a row can hold several relevant candidates, so
+    # even a perfect picker scores at most one TP per row that HAS a
+    # relevant candidate. recall_ceiling is that perfect-picker bound —
+    # judge recall against it, not against 1.0. Rows whose masked
+    # throughputs are all non-finite have no relevant candidates and are
+    # excluded from the numerator (using n_rows there could push the
+    # "ceiling" above 1.0 on degenerate inputs).
+    rows_with_relevant = (relevant.any(-1) & valid_rows).sum()
+    recall_ceiling = rows_with_relevant / n_relevant
+    return {
+        "precision": precision, "recall": recall, "f1": f1, "regret": regret,
+        "recall_ceiling": recall_ceiling,
+    }
 
 
 def regression_report(pred, target, mask=None) -> dict:

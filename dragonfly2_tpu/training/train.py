@@ -56,6 +56,12 @@ class TrainResult:
     # derive achieved FLOP/s = flops_per_sample * samples_per_sec and
     # MFU = achieved / chip peak (bench_trainer.py, bench.py).
     flops_per_sample: float = 0.0
+    # Hand-counted matmul-only FLOPs per sample (a LOWER bound on the work
+    # the compiled program must do — XLA cannot skip the model's matmuls).
+    # Cross-checks `flops_per_sample`: on some backends cost_analysis is
+    # unreliable (BENCH_r03 reported ~250x below the dense-adjacency
+    # cost); bench.py publishes min(positive of the two) with provenance.
+    analytic_flops_per_sample: float = 0.0
     # Best single timed block's rate (compile-carrying first block
     # excluded): on a tunneled device whose latency swings by minutes,
     # the peak is the honest steady-state number — degradation only ever
@@ -65,6 +71,46 @@ class TrainResult:
     @property
     def flops_per_sec(self) -> float:
         return self.flops_per_sample * self.samples_per_sec
+
+
+def analytic_gnn_flops_per_sample(
+    n_nodes: int,
+    node_feat_dim: int,
+    edge_feat_dim: int,
+    hidden: int,
+    batch: int,
+    parents: int,
+    pair_feat_dim: int,
+    num_layers: int = 2,
+    dense_adj: bool = True,
+) -> float:
+    """Matmul-only FLOP lower bound per trained sample for one
+    GraphSAGERanker train step (fwd + bwd ~ 3x fwd). Counts only the
+    dense-layer and adjacency matmuls (models/graphsage.py) — gathers,
+    segment reductions, activations, and the optimizer are excluded, so
+    this is a floor on true executed FLOPs. The graph embedding runs once
+    per STEP and is shared by the whole batch; per-sample cost divides it
+    by `batch`. Dense-adjacency aggregation (dense_graph_arrays) adds the
+    2*N^2*F_in matmul per layer that dominates at bench scale
+    (VERDICT r3 weak #1: the published rate implied ~250x fewer FLOPs
+    than this floor)."""
+    fwd = 0.0
+    f_in = node_feat_dim
+    for _ in range(num_layers):
+        if dense_adj:
+            fwd += 2.0 * n_nodes * n_nodes * f_in          # adj @ h
+        fwd += 2.0 * n_nodes * f_in * hidden               # W_self
+        fwd += 2.0 * n_nodes * f_in * hidden               # W_neigh
+        fwd += 2.0 * n_nodes * edge_feat_dim * hidden      # W_edge
+        f_in = hidden
+    # scoring head: B*P rows of [child, parent, pair] -> hidden -> hidden/2 -> 1
+    rows = float(batch) * parents
+    head_in = 2 * hidden + pair_feat_dim
+    fwd += 2.0 * rows * head_in * hidden
+    fwd += 2.0 * rows * hidden * (hidden // 2)
+    fwd += 2.0 * rows * (hidden // 2)
+    step = 3.0 * fwd  # value_and_grad ~ fwd + 2x fwd for the backward
+    return step / max(batch, 1)
 
 
 def _epoch_flops(jitted, *args) -> float:
@@ -185,10 +231,15 @@ def _index_epochs(
             params, opt_state, ep_losses = epoch_fn(
                 params, opt_state, data_dev, static_dev, idx
             )
-            jax.block_until_ready(ep_losses)
+            # Time via a forced device->host fetch of the (tiny) loss
+            # vector, NOT block_until_ready: on the tunneled `axon`
+            # backend block_until_ready returns before execution finishes,
+            # which produced BENCH_r03's physically impossible 156% MFU.
+            # A D2H read cannot complete until the computation has.
+            ep_np = np.asarray(jax.device_get(ep_losses))
             epoch_secs.append(time.perf_counter() - t0)
             epoch_samples.append(idx.shape[0] * batch_size)
-            losses.append(ep_losses)
+            losses.append(ep_np)
             e += k
             if on_epoch is not None:
                 on_epoch(e - 1, params, opt_state)
@@ -223,10 +274,11 @@ def _stacked_epochs(
                 flops_per_sample = total / max(len(batches) * batch_size, 1)
             t0 = time.perf_counter()
             params, opt_state, ep_losses = epoch_fn(params, opt_state, stack)
-            jax.block_until_ready(ep_losses)
+            # Forced D2H fetch, not block_until_ready — see _index_epochs.
+            ep_np = np.asarray(jax.device_get(ep_losses))
             epoch_secs.append(time.perf_counter() - t0)
             epoch_samples.append(len(batches) * batch_size)
-            losses.extend(np.asarray(ep_losses, np.float64).tolist())
+            losses.extend(np.asarray(ep_np, np.float64).tolist())
             if on_epoch is not None:
                 on_epoch(e, params, opt_state)
         n_samples, dt = _steady_state_throughput(epoch_samples, epoch_secs)
@@ -444,6 +496,17 @@ def train_gnn(
         np.asarray(scores), eval_batch.throughput, eval_batch.mask
     )
     eval_metrics = {k: float(v) for k, v in stats.items()}
+    analytic = analytic_gnn_flops_per_sample(
+        n_nodes=graph.node_feats.shape[0],
+        node_feat_dim=graph.node_feats.shape[1],
+        edge_feat_dim=graph.edge_feats.shape[1],
+        hidden=config.hidden_dim,
+        batch=batch_size,
+        parents=sample.parent_idx.shape[1],
+        pair_feat_dim=sample.pair_feats.shape[-1],
+        num_layers=model.num_layers,
+        dense_adj=use_dense,
+    )
     return TrainResult(
         params=params,
         losses=losses,
@@ -452,6 +515,7 @@ def train_gnn(
         steps=len(losses),
         flops_per_sample=flops_per_sample,
         peak_samples_per_sec=peak,
+        analytic_flops_per_sample=analytic,
     )
 
 
