@@ -29,6 +29,11 @@ class JobState(str, enum.Enum):
     PENDING = "PENDING"
     SUCCESS = "SUCCESS"
     FAILURE = "FAILURE"
+    # A task this job fanned out was observed alive earlier but is now
+    # unknown to its scheduler (TTL GC, restart) without a latched terminal
+    # outcome — the job's result is indeterminate, not forever-PENDING
+    # (ADVICE r3: GC + an unpolled job used to pin PENDING permanently).
+    EXPIRED = "EXPIRED"
 
 
 @dataclasses.dataclass
@@ -68,6 +73,11 @@ class JobManager:
         self.seed_hosts = [h for h in seed_hosts]
         self._seed_rr = itertools.cycle(range(max(len(self.seed_hosts), 1)))
         self.jobs: dict[str, JobResult] = {}
+        # per-job (task_done, task_seen) poll latches — PRIVATE bookkeeping,
+        # deliberately not in JobResult.detail (the manager serializes
+        # detail into the REST payload and DB record; these maps grow with
+        # task count and are implementation state, not job output)
+        self._latches: dict[str, tuple[dict, dict]] = {}
 
     def create_preheat(self, req: PreheatRequest) -> JobResult:
         """Resolve urls -> task ids and enqueue a TriggerSeedRequest per
@@ -186,22 +196,51 @@ class JobManager:
             return result
         from dragonfly2_tpu.state.fsm import TaskState
 
+        # Per-task terminal SUCCEEDED outcomes latch across polls: task
+        # TTL GC (or a scheduler restart) forgetting a completed task must
+        # not regress it — without the latch a job whose tasks all
+        # succeeded between polls would report PENDING forever once the
+        # sweep reclaimed them (ADVICE r3). A task observed alive earlier
+        # but now unknown WITHOUT a latched outcome is indeterminate and
+        # expires the job.
+        done, seen = self._latches.setdefault(result.job_id, ({}, {}))
         states = []
+        expired = False
         for task_id in result.task_ids:
+            if done.get(task_id):
+                states.append(TaskState.SUCCEEDED)
+                continue
             name = self.ring.pick(task_id)
             svc = self.schedulers.get(name) if name else None
             # Locked snapshot: this runs on manager REST threads while the
             # scheduler event loop mutates task state.
             raw = svc.task_states([task_id])[0] if svc else None
             if raw is None:
-                states.append(TaskState.PENDING)  # seed not started yet
+                if seen.get(task_id) == int(TaskState.FAILED):
+                    # last observation before the task vanished was FAILED
+                    # and no recovery was ever seen: the observation
+                    # stands — a known-failed job must not drift to
+                    # EXPIRED/PENDING just because GC reclaimed the task
+                    states.append(TaskState.FAILED)
+                elif seen.get(task_id) is not None:
+                    expired = True
+                    states.append(TaskState.PENDING)
+                else:
+                    states.append(TaskState.PENDING)  # seed not started yet
             else:
-                states.append(TaskState(raw))
+                state = TaskState(raw)
+                seen[task_id] = int(state)
+                if state == TaskState.SUCCEEDED:
+                    done[task_id] = True
+                states.append(state)
         if any(s == TaskState.FAILED for s in states):
             result.state = JobState.FAILURE
             result.detail["task_states"] = [s.name for s in states]
         elif all(s == TaskState.SUCCEEDED for s in states):
             result.state = JobState.SUCCESS
+        elif expired:
+            result.state = JobState.EXPIRED
+            result.detail["task_states"] = [s.name for s in states]
         else:
             result.state = JobState.PENDING
         return result

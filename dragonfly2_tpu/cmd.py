@@ -160,13 +160,13 @@ async def _serve_scheduler(args) -> int:
         from dragonfly2_tpu.cluster.trainer_service import (
             ATTENTION_MODEL_NAME, GNN_MODEL_NAME, MLP_MODEL_NAME,
         )
-        from dragonfly2_tpu.registry import ModelRegistry, ModelServer
+        from dragonfly2_tpu.registry import ModelServer, open_registry
         from dragonfly2_tpu.registry.registry import (
             MODEL_TYPE_ATTENTION, MODEL_TYPE_GNN, MODEL_TYPE_MLP,
         )
         from dragonfly2_tpu.rpc.inference import InferenceRPCServer
 
-        registry = ModelRegistry(args.registry_dir)
+        registry = open_registry(args.registry_dir)
         servers = {
             name: ModelServer(registry, name, sched_host_id, mtype, template_params=None)
             for name, mtype in (
@@ -245,9 +245,21 @@ async def _serve_scheduler(args) -> int:
             # client contract
             return asyncio.run(go())
 
+        # Cache file keyed by cluster id (+ the CONFIGURED port when one
+        # was given): different clusters on one host never share limits
+        # (ADVICE r3), while the name stays STABLE across restarts — a
+        # bound auto-port in the name would orphan the snapshot exactly
+        # when the fallback matters (manager down + scheduler restart).
+        # Same-cluster schedulers sharing a data_dir share the file, which
+        # is the same payload; concurrent refresh writes are safe because
+        # Dynconfig uses a unique temp file per writer.
+        suffix = f"-{args.port}" if args.port else ""
         dyn = Dynconfig(
             fetch_dynconfig,
-            cache_path=os.path.join(args.data_dir or ".", "dynconfig.json"),
+            cache_path=os.path.join(
+                args.data_dir or ".",
+                f"dynconfig-cluster{args.cluster_id}{suffix}.json",
+            ),
             expire=max(args.dynconfig_interval, 1.0),
         )
         dyn.register(service.apply_dynconfig)
@@ -318,7 +330,7 @@ async def _serve_trainer(args) -> int:
     from dragonfly2_tpu.cluster.trainer_service import TrainerService
     from dragonfly2_tpu.config.config import Config
     from dragonfly2_tpu.records.storage import HostTraceStorage
-    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.registry import open_registry
     from dragonfly2_tpu.rpc.server import TrainerRPCServer
 
     config = Config.load(args.config) if args.config else Config()
@@ -326,7 +338,7 @@ async def _serve_trainer(args) -> int:
         config.trainer.epochs = args.epochs
     service = TrainerService(
         HostTraceStorage(args.data_dir),
-        ModelRegistry(args.registry_dir),
+        open_registry(args.registry_dir),
         config.trainer,
     )
     _wire_otlp(args, "trainer")
@@ -347,11 +359,11 @@ async def _serve_manager(args) -> int:
     from dragonfly2_tpu.manager.models import Database
     from dragonfly2_tpu.manager.rest import ManagerREST
     from dragonfly2_tpu.manager.service import ManagerService
-    from dragonfly2_tpu.registry import ModelRegistry
+    from dragonfly2_tpu.registry import open_registry
 
     from dragonfly2_tpu.manager.rpc import ManagerRPCServer
 
-    registry = ModelRegistry(args.registry_dir) if args.registry_dir else None
+    registry = open_registry(args.registry_dir) if args.registry_dir else None
     _wire_otlp(args, "manager")
     service = ManagerService(
         db=Database(args.db), registry=registry, cert_dir=args.cert_dir,

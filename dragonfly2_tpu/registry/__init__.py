@@ -1,4 +1,13 @@
 from dragonfly2_tpu.registry.registry import ModelRegistry, ModelVersion, ModelEvaluation
+from dragonfly2_tpu.registry.bucket import BucketModelRegistry, open_registry
 from dragonfly2_tpu.registry.serving import ModelServer, MLEvaluator
 
-__all__ = ["ModelRegistry", "ModelVersion", "ModelEvaluation", "ModelServer", "MLEvaluator"]
+__all__ = [
+    "ModelRegistry",
+    "ModelVersion",
+    "ModelEvaluation",
+    "BucketModelRegistry",
+    "open_registry",
+    "ModelServer",
+    "MLEvaluator",
+]

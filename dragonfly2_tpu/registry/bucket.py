@@ -1,0 +1,216 @@
+"""Object-storage-backed model registry.
+
+Capability parity with the reference's CreateModel upload path
+(manager/rpcserver/manager_server_v1.go:880-952: model bytes -> an
+object-storage bucket; metadata keys laid out per
+manager/types/model.go:66-75 ``<id>/<version>/model.graphdef`` +
+``<id>/config.pbtxt``): a trainer on host A publishes a version, a
+scheduler on host B serves it, and the ONLY thing they share is the
+bucket — no common filesystem (the round-3 gap: registry/registry.py is
+a local directory).
+
+Speaks the backend protocol from objectstorage/backends.py, so the same
+registry runs over the local FilesystemBackend or any signed
+S3/OSS/OBS-compatible endpoint (objectstorage/remote.py + signing.py).
+Params travel as one msgpack object (flax.serialization — a pytree of
+numpy arrays), not an orbax directory tree: a bucket stores blobs, and
+one PUT/GET per version keeps publish/fetch atomic per object.
+
+Key layout under an optional prefix:
+    <model_id>/model.json             active-version pointer (+ name/type)
+    <model_id>/<version>/version.json   metadata + evaluation
+    <model_id>/<version>/params.msgpack trained params
+
+`open_registry` dispatches a plain path to the orbax/fs ModelRegistry and
+a ``<vendor>://bucket/prefix?endpoint=...`` URL here, so every
+``--registry-dir`` flag accepts either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.parse
+from typing import Any
+
+import jax
+
+from dragonfly2_tpu.objectstorage.backends import new_backend
+from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_ATTENTION,
+    MODEL_TYPE_GNN,
+    MODEL_TYPE_MLP,
+    STATE_ACTIVE,
+    STATE_INACTIVE,
+    ModelEvaluation,
+    ModelRegistry,
+    ModelVersion,
+    _version_from_json,
+)
+from dragonfly2_tpu.utils.idgen import model_id as make_model_id
+
+
+class BucketModelRegistry:
+    """Same public surface as ModelRegistry, stored in an object bucket."""
+
+    def __init__(self, backend, bucket: str, prefix: str = ""):
+        self.backend = backend
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if not backend.is_bucket_exist(bucket):
+            backend.create_bucket(bucket)
+
+    def _key(self, *parts: str) -> str:
+        parts = tuple(str(p) for p in parts)
+        return "/".join((self.prefix,) + parts if self.prefix else parts)
+
+    def _get_json(self, *parts: str) -> dict | None:
+        try:
+            return json.loads(self.backend.get_object(self.bucket, self._key(*parts)))
+        except Exception:  # noqa: BLE001 - missing object == missing entry
+            return None
+
+    def _put_json(self, data: dict, *parts: str) -> None:
+        self.backend.put_object(
+            self.bucket, self._key(*parts), json.dumps(data, indent=2).encode()
+        )
+
+    # -------------------------------------------------------------- write
+
+    def create_model_version(
+        self,
+        name: str,
+        model_type: str,
+        scheduler_host_id: str,
+        params: Any,
+        evaluation: ModelEvaluation,
+        metadata: dict | None = None,
+    ) -> ModelVersion:
+        """CreateModel semantics (manager_server_v1.go:880-952): next
+        version number, params + evaluation uploaded, version starts
+        inactive."""
+        from flax import serialization
+
+        if model_type not in (MODEL_TYPE_GNN, MODEL_TYPE_MLP, MODEL_TYPE_ATTENTION):
+            raise ValueError(f"unknown model type {model_type!r}")
+        mid = make_model_id(name, scheduler_host_id)
+        versions = self.list_versions(mid)
+        next_version = max((v.version for v in versions), default=0) + 1
+        blob = serialization.msgpack_serialize(jax.device_get(params))
+        self.backend.put_object(
+            self.bucket, self._key(mid, next_version, "params.msgpack"), blob
+        )
+        mv = ModelVersion(
+            model_id=mid,
+            name=name,
+            type=model_type,
+            version=next_version,
+            state=STATE_INACTIVE,
+            evaluation=evaluation,
+            scheduler_host_id=scheduler_host_id,
+            created_at=time.time(),
+            metadata=metadata or {},
+        )
+        self._put_json(dataclasses.asdict(mv), mid, next_version, "version.json")
+        if self._get_json(mid, "model.json") is None:
+            self._put_json(
+                {"model_id": mid, "name": name, "type": model_type, "active_version": None},
+                mid, "model.json",
+            )
+        return mv
+
+    def activate(self, model_id: str, version: int) -> None:
+        """Flip the active pointer (manager/service/model.go:109-151)."""
+        if self._get_json(model_id, version, "version.json") is None:
+            raise FileNotFoundError(f"{model_id} v{version} not found")
+        manifest = self._get_json(model_id, "model.json") or {}
+        for v in self.list_versions(model_id):
+            state = STATE_ACTIVE if v.version == version else STATE_INACTIVE
+            if v.state != state:
+                data = self._get_json(model_id, v.version, "version.json")
+                data["state"] = state
+                self._put_json(data, model_id, v.version, "version.json")
+        manifest["active_version"] = version
+        self._put_json(manifest, model_id, "model.json")
+
+    def delete_version(self, model_id: str, version: int) -> None:
+        if self._get_json(model_id, version, "version.json") is None:
+            return
+        manifest = self._get_json(model_id, "model.json")
+        if manifest and manifest.get("active_version") == version:
+            raise ValueError("cannot delete the active version")
+        for leaf in ("version.json", "params.msgpack"):
+            self.backend.delete_object(self.bucket, self._key(model_id, version, leaf))
+
+    # --------------------------------------------------------------- read
+
+    def list_models(self) -> list[dict]:
+        out = []
+        for meta in self.backend.get_object_metadatas(self.bucket, prefix=self.prefix):
+            if meta.key.endswith("/model.json"):
+                out.append(json.loads(self.backend.get_object(self.bucket, meta.key)))
+        return sorted(out, key=lambda m: m["model_id"])
+
+    def list_versions(self, model_id: str) -> list[ModelVersion]:
+        prefix = self._key(model_id) + "/"
+        out = []
+        for meta in self.backend.get_object_metadatas(self.bucket, prefix=prefix):
+            if meta.key.endswith("/version.json"):
+                out.append(
+                    _version_from_json(
+                        json.loads(self.backend.get_object(self.bucket, meta.key))
+                    )
+                )
+        return sorted(out, key=lambda v: v.version)
+
+    def active_version(self, model_id: str) -> ModelVersion | None:
+        manifest = self._get_json(model_id, "model.json")
+        if not manifest or manifest.get("active_version") is None:
+            return None
+        data = self._get_json(model_id, manifest["active_version"], "version.json")
+        return _version_from_json(data) if data else None
+
+    def load_params(self, model_id: str, version: int, template: Any = None) -> Any:
+        """One GET; numpy leaves (placement happens at the first jit call,
+        so a TPU-trained version restores on a CPU scheduler — the same
+        topology-portability contract as ModelRegistry.load_params)."""
+        from flax import serialization
+
+        blob = self.backend.get_object(
+            self.bucket, self._key(model_id, version, "params.msgpack")
+        )
+        if template is not None:
+            return serialization.from_bytes(template, blob)
+        return serialization.msgpack_restore(blob)
+
+    def model_id(self, name: str, scheduler_host_id: str) -> str:
+        return make_model_id(name, scheduler_host_id)
+
+
+def open_registry(spec) -> ModelRegistry | BucketModelRegistry:
+    """Dispatch a --registry-dir value: a plain path opens the local
+    orbax/fs ModelRegistry; a ``s3://bucket/prefix?endpoint=H:P&
+    access_key=AK&secret_key=SK[&region=R][&virtual_hosted=1]`` (or
+    oss://, obs://) URL opens the bucket registry over the signed remote
+    backend; ``fs://bucket/prefix?base_dir=DIR`` uses the filesystem
+    backend through the same blob layout (in-proc tests, NFS buckets)."""
+    spec = str(spec)
+    if "://" not in spec:
+        return ModelRegistry(spec)
+    u = urllib.parse.urlsplit(spec)
+    q = {k: v[-1] for k, v in urllib.parse.parse_qs(u.query).items()}
+    bucket = u.netloc
+    prefix = u.path.strip("/")
+    if u.scheme == "fs":
+        backend = new_backend("fs", base_dir=q.get("base_dir", "."))
+    else:
+        backend = new_backend(
+            u.scheme,
+            endpoint=q.get("endpoint", ""),
+            access_key=q.get("access_key", ""),
+            secret_key=q.get("secret_key", ""),
+            region=q.get("region", ""),
+            virtual_hosted=q.get("virtual_hosted", "") in ("1", "true"),
+        )
+    return BucketModelRegistry(backend, bucket, prefix)

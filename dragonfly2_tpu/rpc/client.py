@@ -129,24 +129,71 @@ class SchedulerClientPool:
         if not addresses:
             raise ValueError("need at least one scheduler address")
         self.ssl_context = ssl_context
-        self._ring = HashRing([f"{h}:{p}" for h, p in addresses])
-        self._addr = {f"{h}:{p}": (h, p) for h, p in addresses}
+        # (ring, addr) swap as ONE tuple: update_addresses runs on the
+        # dynconfig worker thread while the event loop reads in for_task;
+        # two separate assignments could pair a new ring with the old addr
+        # map and KeyError on a just-added scheduler (ADVICE r3).
+        self._state: tuple[HashRing, dict] = (
+            HashRing([f"{h}:{p}" for h, p in addresses]),
+            {f"{h}:{p}": (h, p) for h, p in addresses},
+        )
         self._conns: dict[str, SchedulerConnection] = {}
+        # (connection, parked_at): closed by for_task only after a grace
+        # period, so an RPC already in flight on a just-removed scheduler
+        # finishes instead of dying mid-exchange
+        self._stale_conns: list[tuple[SchedulerConnection, float]] = []
         self._lock = asyncio.Lock()
 
+    STALE_CLOSE_GRACE_S = 30.0
+
+    @property
+    def _ring(self) -> HashRing:
+        return self._state[0]
+
+    @property
+    def _addr(self) -> dict:
+        return self._state[1]
+
     def update_addresses(self, addresses: list[tuple[str, int]]) -> None:
-        """Dynconfig-driven refresh (pkg/resolver semantics)."""
-        self._ring = HashRing([f"{h}:{p}" for h, p in addresses])
-        self._addr = {f"{h}:{p}": (h, p) for h, p in addresses}
+        """Dynconfig-driven refresh (pkg/resolver semantics). Thread-safe
+        against the event loop: one atomic tuple swap; connections to
+        removed schedulers are parked and closed on the loop by the next
+        for_task (closing an asyncio transport from this worker thread
+        would race the loop)."""
+        addr = {f"{h}:{p}": (h, p) for h, p in addresses}
+        self._state = (HashRing(list(addr)), addr)
+        import time as _time
+
+        for key in list(self._conns):
+            if key not in addr:
+                conn = self._conns.pop(key, None)
+                if conn is not None:
+                    self._stale_conns.append((conn, _time.monotonic()))
 
     async def for_task(self, task_id: str) -> SchedulerConnection:
-        key = self._ring.pick(task_id)
+        ring, addr = self._state
+        key = ring.pick(task_id)
         if key is None:
             raise RuntimeError("scheduler ring is empty")
         async with self._lock:
+            import time as _time
+
+            now = _time.monotonic()
+            # swap the list out ATOMICALLY before any await: the dynconfig
+            # worker thread appends concurrently, and a read-modify-write
+            # across an await point would drop (and leak) its entry
+            pending, self._stale_conns = self._stale_conns, []
+            for parked, at in pending:
+                if now - at < self.STALE_CLOSE_GRACE_S:
+                    self._stale_conns.append((parked, at))
+                    continue
+                try:
+                    await parked.close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
             conn = self._conns.get(key)
             if conn is None:
-                host, port = self._addr[key]
+                host, port = addr[key]
                 conn = await SchedulerConnection(host, port, ssl_context=self.ssl_context).connect()
                 self._conns[key] = conn
             return conn

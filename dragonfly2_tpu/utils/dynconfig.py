@@ -90,7 +90,23 @@ class Dynconfig:
 
     def _store_disk(self, data: dict) -> None:
         self._cache_path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = self._cache_path.with_suffix(".tmp")
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        tmp.replace(self._cache_path)
+        # UNIQUE temp per writer: two processes sharing one cache file
+        # (same-cluster schedulers on one data_dir) must not interleave
+        # writes into a common .tmp and rename a torn snapshot into place
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            prefix=self._cache_path.name + ".", suffix=".tmp",
+            dir=self._cache_path.parent,
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._cache_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

@@ -152,3 +152,44 @@ def test_daemon_refreshes_scheduler_list_from_manager(tmp_path):
             await server.stop()
 
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_pool_update_swaps_atomically_and_prunes_connections():
+    """update_addresses runs on the dynconfig worker thread while the
+    event loop reads the ring: the (ring, addr) pair must swap as one
+    tuple, and connections to removed schedulers must be closed on the
+    loop, not leaked (ADVICE r3)."""
+
+    async def run():
+        from dragonfly2_tpu.rpc.client import SchedulerClientPool
+
+        pool = SchedulerClientPool([("10.0.0.1", 1), ("10.0.0.2", 2)])
+
+        class FakeConn:
+            closed = False
+
+            async def close(self):
+                self.closed = True
+
+        a, b = FakeConn(), FakeConn()
+        pool._conns["10.0.0.1:1"] = a
+        pool._conns["10.0.0.2:2"] = b
+        pool.update_addresses([("10.0.0.2", 2), ("10.0.0.3", 3)])
+        ring, addr = pool._state  # one tuple: never a new ring + old addr
+        assert set(addr) == {"10.0.0.2:2", "10.0.0.3:3"}
+        assert all(ring.pick(f"t-{i}") in addr for i in range(32))
+        assert "10.0.0.1:1" not in pool._conns
+
+        # next for_task drains the parked stale connection on the loop —
+        # after a grace period so in-flight RPCs on the removed scheduler
+        # finish first (zero here to test the close itself)
+        pool.STALE_CLOSE_GRACE_S = 0.0
+        tid = next(
+            t for t in (f"t-{i}" for i in range(1000))
+            if ring.pick(t) == "10.0.0.2:2"
+        )
+        conn = await pool.for_task(tid)
+        assert conn is b
+        assert a.closed and not b.closed
+
+    asyncio.new_event_loop().run_until_complete(run())

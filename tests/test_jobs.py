@@ -152,3 +152,75 @@ def test_preheat_success_is_terminal_after_scheduler_forgets_task():
     # the scheduler forgets everything (restart) — SUCCESS must hold
     jm.schedulers["s1"] = SchedulerService()
     assert jm.get(result.job_id).state == JobState.SUCCESS
+
+
+def _register(svc, peer_id, tid):
+    from dragonfly2_tpu.cluster import messages as msg
+
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id=peer_id, task_id=tid, host=seed_host(0), url="https://e.com/blob",
+        content_length=10 << 20,
+    ))
+
+
+def test_preheat_per_task_success_latches_across_gc():
+    """PER-TASK terminal outcomes latch at poll time: task A succeeds and
+    is then GC'd before task B finishes — the job must still conclude
+    SUCCESS once B lands, not report PENDING forever because A's id is
+    unknown to the scheduler (ADVICE r3: the r3 SUCCESS latch only
+    protected jobs whose EVERY task was observed done in one poll)."""
+    from dragonfly2_tpu.state.fsm import TaskEvent
+
+    svc = SchedulerService()
+    svc.announce_host(seed_host(0))
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(
+        PreheatRequest(urls=["https://e.com/a", "https://e.com/b"])
+    )
+    tid_a, tid_b = result.task_ids
+    _register(svc, "p-a", tid_a)
+    _register(svc, "p-b", tid_b)
+    svc.state.task_event(svc.state.task_index(tid_a), TaskEvent.DOWNLOAD_SUCCEEDED)
+    assert jm.get(result.job_id).state == JobState.PENDING  # A done, B not
+    # GC reclaims the finished task A (no peers left on it)
+    svc.state.remove_task(tid_a)
+    assert svc.state.task_index(tid_a) is None
+    svc.state.task_event(svc.state.task_index(tid_b), TaskEvent.DOWNLOAD_SUCCEEDED)
+    assert jm.get(result.job_id).state == JobState.SUCCESS
+
+
+def test_preheat_failure_observation_survives_task_gc():
+    """A task last observed FAILED that then vanishes (TTL GC) keeps the
+    job FAILURE — without evidence of recovery the observation stands;
+    demoting to EXPIRED would make a known-failed job 'indeterminate'
+    (r4 review finding)."""
+    from dragonfly2_tpu.state.fsm import TaskEvent
+
+    svc = SchedulerService()
+    svc.announce_host(seed_host(0))
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(PreheatRequest(urls=["https://e.com/blob"]))
+    tid = result.task_ids[0]
+    _register(svc, "p-1", tid)
+    svc.state.task_event(svc.state.task_index(tid), TaskEvent.DOWNLOAD_FAILED)
+    assert jm.get(result.job_id).state == JobState.FAILURE
+    jm.schedulers["s1"] = SchedulerService()  # GC / restart forgets the task
+    assert jm.get(result.job_id).state == JobState.FAILURE
+
+
+def test_preheat_expires_when_unfinished_task_vanishes():
+    """A task observed ALIVE earlier that disappears without a terminal
+    outcome (TTL GC of a stalled task, scheduler wipe) makes the job
+    EXPIRED — indeterminate — rather than forever-PENDING (ADVICE r3)."""
+    svc = SchedulerService()
+    svc.announce_host(seed_host(0))
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(PreheatRequest(urls=["https://e.com/blob"]))
+    tid = result.task_ids[0]
+    _register(svc, "p-1", tid)
+    assert jm.get(result.job_id).state == JobState.PENDING  # seen alive
+    jm.schedulers["s1"] = SchedulerService()  # task vanishes unfinished
+    assert jm.get(result.job_id).state == JobState.EXPIRED
+    # never-seen tasks keep PENDING (seed may simply not have started)
+    result2 = jm.create_preheat(PreheatRequest(urls=["https://e.com/c"]))
+    assert jm.get(result2.job_id).state == JobState.PENDING

@@ -431,6 +431,197 @@ def test_full_system_loops_through_launchers(tmp_path):
         origin.close()
 
 
+@pytest.mark.slow
+def test_sigterm_under_load_bounded_exit_and_clean_restart(tmp_path):
+    """SIGTERM while the scheduler is under real load (VERDICT r3 weak #7):
+    an in-flight download streaming pieces from a throttled origin, a
+    connected inference client, and live manager keepalives. The process
+    must exit within the grace window (rc 0, no SIGKILL), the daemon's
+    task storage must reload uncorrupted on restart, and the same URL
+    must complete against a fresh scheduler afterwards."""
+    import time as _time
+
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.rpc.inference import InferenceClient
+
+    payload = os.urandom(2 * (1 << 20) + 999)
+    digest = hashlib.sha256(payload).hexdigest()
+
+    class _SlowOrigin(_Origin):
+        def __init__(self, payload, delay=0.15):
+            self.delay = delay
+            super().__init__(payload)
+
+    origin = _SlowOrigin(payload)
+    # throttle GETs so the download is provably in flight at kill time;
+    # _Origin's handler class is defined per-instance (inside __init__),
+    # so this rebinding cannot leak into other tests' origins — but
+    # restore it in the finally block anyway for hygiene
+    base_handler = origin.srv.RequestHandlerClass
+    orig_get = base_handler.do_GET
+
+    def slow_get(handler):
+        _time.sleep(origin.delay)
+        orig_get(handler)
+
+    base_handler.do_GET = slow_get
+
+    manager, m_host, m_port = _spawn(
+        ["manager", "--db", str(tmp_path / "m.db")], tmp_path
+    )
+    m_rpc = int(manager.ready_line.split()[manager.ready_line.split().index("RPC") + 1])
+    sched, s_host, s_port = _spawn(
+        ["scheduler", "--data-dir", str(tmp_path / "s-data"),
+         "--manager", f"{m_host}:{m_rpc}", "--keepalive-interval", "0.3",
+         "--registry-dir", str(tmp_path / "registry")],
+        tmp_path,
+    )
+    parts = sched.ready_line.split()
+    ih = parts[parts.index("INFER") + 1]
+    ip_ = int(parts[parts.index("INFER") + 2])
+    daemon_dir = tmp_path / "peer-restart"
+    try:
+        async def load_and_kill():
+            d = Daemon(daemon_dir, [(s_host, s_port)], hostname="sigterm-peer")
+            await d.start()
+            url = f"http://127.0.0.1:{origin.port}/blob.bin"
+            dl = asyncio.ensure_future(d.download(url, piece_length=128 * 1024))
+            # wait until pieces are actually flowing
+            for _ in range(100):
+                if origin.gets > 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert origin.gets > 2, "download never started"
+            infer = await InferenceClient(ih, ip_).connect()
+            assert await infer.server_live()
+
+            t0 = _time.monotonic()
+            sched.send_signal(signal.SIGTERM)
+            rc = await asyncio.to_thread(sched.wait, 10)
+            exit_s = _time.monotonic() - t0
+            assert rc == 0, f"scheduler exited rc={rc} under load"
+            assert exit_s < 10, f"exit took {exit_s:.1f}s"
+
+            dl.cancel()
+            try:
+                await dl
+            except (Exception, asyncio.CancelledError):
+                pass
+            await infer.close()
+            await d.stop(leave=False)
+
+        asyncio.run(load_and_kill())
+
+        # fresh scheduler; SAME daemon data dir must reload cleanly and
+        # complete the interrupted URL (partial-resume/persistent reload,
+        # storage_manager.go:545,674 semantics)
+        origin.delay = 0.0
+        sched2, s2_host, s2_port = _spawn(
+            ["scheduler", "--data-dir", str(tmp_path / "s2-data")], tmp_path
+        )
+        try:
+            async def resume():
+                d = Daemon(daemon_dir, [(s2_host, s2_port)], hostname="sigterm-peer")
+                await d.start()  # persistent-task reload runs here
+                url = f"http://127.0.0.1:{origin.port}/blob.bin"
+                ts = await d.download(url, piece_length=128 * 1024)
+                await d.export_file(ts, str(tmp_path / "resumed.bin"))
+                await d.stop()
+
+            asyncio.run(resume())
+            got = hashlib.sha256((tmp_path / "resumed.bin").read_bytes()).hexdigest()
+            assert got == digest, "resumed download corrupt after SIGTERM"
+        finally:
+            _stop(sched2)
+    finally:
+        base_handler.do_GET = orig_get
+        _stop(sched)
+        _stop(manager)
+        origin.close()
+
+
+@pytest.mark.slow
+def test_bucket_registry_shared_across_processes(tmp_path):
+    """Trainer process on "host A" publishes models into a SIGNED S3
+    bucket; a scheduler process on "host B" serves them — the two share
+    ONLY the bucket endpoint, no filesystem (VERDICT r3 missing #2
+    done-criterion: the e2e passes with --registry-dir pointing at a
+    bucket URL; reference upload path manager_server_v1.go:880-952)."""
+    from test_remote_sources import ACCESS, REGION, SECRET, _S3Handler, _Store, _serve
+
+    from dragonfly2_tpu.cluster.trainer_service import GNN_MODEL_NAME
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.storage import TraceStorage
+    from dragonfly2_tpu.registry import open_registry
+    from dragonfly2_tpu.rpc.client import TrainerClient
+    from dragonfly2_tpu.rpc.inference import InferenceClient
+
+    store = _Store()
+    handler = type("H", (_S3Handler,), {"store": store})
+    srv, addr = _serve(handler)
+    url = (
+        f"s3://models?endpoint={addr}"
+        f"&access_key={ACCESS}&secret_key={SECRET}&region={REGION}"
+    )
+
+    # traces a scheduler would have streamed (synthetic download records)
+    cluster = synth.make_cluster(16, seed=3)
+    records = synth.gen_download_records(cluster, 60, num_tasks=4)
+    tstore = TraceStorage(tmp_path / "traces")
+    for r in records:
+        tstore.create_download(r)
+
+    trainer, t_host, t_port = _spawn(
+        ["trainer", "--data-dir", str(tmp_path / "t-data"),
+         "--registry-dir", url, "--epochs", "2"],
+        tmp_path,
+    )
+    sched = None
+    try:
+        async def train():
+            client = TrainerClient(t_host, t_port)
+            return await client.train(
+                "sched-b", "127.0.0.1", "sched-node",
+                datasets={"download": tstore.open_download()},
+                chunk_size=1 << 20,
+            )
+
+        response = asyncio.run(train())
+        assert response.ok, response.description
+
+        # the bucket (not any local dir) holds the published model
+        reg = open_registry(url)
+        assert any(m["type"] == "gnn" for m in reg.list_models())
+        assert not (tmp_path / "models").exists(), "registry leaked to disk"
+
+        sched, _, _ = _spawn(
+            ["scheduler", "--registry-dir", url,
+             "--scheduler-host-id", "sched-b"],
+            tmp_path,
+        )
+        parts = sched.ready_line.split()
+        ih = parts[parts.index("INFER") + 1]
+        ip_ = int(parts[parts.index("INFER") + 2])
+
+        async def serve_check():
+            client = await InferenceClient(ih, ip_).connect()
+            try:
+                for _ in range(20):
+                    if await client.model_ready(GNN_MODEL_NAME):
+                        return True
+                    await asyncio.sleep(0.5)
+                return False
+            finally:
+                await client.close()
+
+        assert asyncio.run(serve_check()), "bucket model never became servable"
+    finally:
+        if sched is not None:
+            _stop(sched)
+        _stop(trainer)
+        srv.shutdown()
+
+
 def test_mtls_launchers_end_to_end(tmp_path):
     """Launcher-level mTLS (VERDICT r1 item 4): manager issues the cluster
     CA, scheduler certifies + serves mutual TLS, a dfget download rides the

@@ -1,0 +1,152 @@
+"""Object-storage-backed model registry (VERDICT r3 missing #2).
+
+The reference uploads model bytes to a bucket
+(manager/rpcserver/manager_server_v1.go:880-952, keys per
+manager/types/model.go:66-75); these tests drive the same lifecycle
+through BucketModelRegistry over (a) the local FilesystemBackend and
+(b) a fake SIGNED S3 endpoint that verifies every SigV4 signature by
+recomputing it — so a publish from "trainer host A" reaches a serve on
+"scheduler host B" with nothing shared but the bucket."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# same-directory test module: the fake signature-verifying S3 server
+from test_remote_sources import ACCESS, REGION, SECRET, _S3Handler, _serve, _Store
+
+from dragonfly2_tpu.models import ProbeRTTRegressor
+from dragonfly2_tpu.objectstorage.backends import FilesystemBackend, new_backend
+from dragonfly2_tpu.registry import (
+    BucketModelRegistry,
+    ModelEvaluation,
+    ModelRegistry,
+    ModelServer,
+    open_registry,
+)
+from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_MLP,
+    STATE_ACTIVE,
+    STATE_INACTIVE,
+)
+
+
+@pytest.fixture
+def mlp_setup():
+    model = ProbeRTTRegressor(hidden_dim=8)
+    x = jnp.ones((2, 8))
+    params = model.init(jax.random.key(0), x)
+    return model, params, x
+
+
+@pytest.fixture
+def s3_bucket():
+    store = _Store()
+    handler = type("H", (_S3Handler,), {"store": store})
+    srv, addr = _serve(handler)
+    yield addr
+    srv.shutdown()
+
+
+def _registries(tmp_path, s3_addr):
+    yield "fs-backend", lambda: BucketModelRegistry(
+        FilesystemBackend(tmp_path / "bucket-store"), "models"
+    )
+    url = (
+        f"s3://models/team-a?endpoint={s3_addr}"
+        f"&access_key={ACCESS}&secret_key={SECRET}&region={REGION}"
+    )
+    yield "signed-s3", lambda: open_registry(url)
+
+
+def test_bucket_lifecycle_parity(tmp_path, s3_bucket, mlp_setup):
+    """create/version/activate/delete semantics match the fs registry."""
+    _, params, _ = mlp_setup
+    for label, make in _registries(tmp_path, s3_bucket):
+        reg = make()
+        v1 = reg.create_model_version(
+            "rtt", MODEL_TYPE_MLP, "sched-host", params, ModelEvaluation(mse=0.5)
+        )
+        v2 = reg.create_model_version(
+            "rtt", MODEL_TYPE_MLP, "sched-host", params, ModelEvaluation(mse=0.2)
+        )
+        assert (v1.version, v2.version) == (1, 2), label
+        assert reg.active_version(v1.model_id) is None, label
+        assert [v.state for v in reg.list_versions(v1.model_id)] == [
+            STATE_INACTIVE, STATE_INACTIVE,
+        ], label
+        reg.activate(v1.model_id, 1)
+        states = {v.version: v.state for v in reg.list_versions(v1.model_id)}
+        assert states == {1: STATE_ACTIVE, 2: STATE_INACTIVE}, label
+        reg.activate(v1.model_id, 2)
+        assert reg.active_version(v1.model_id).version == 2, label
+        with pytest.raises(ValueError):
+            reg.delete_version(v1.model_id, 2)
+        reg.delete_version(v1.model_id, 1)
+        assert [v.version for v in reg.list_versions(v1.model_id)] == [2], label
+        assert [m["model_id"] for m in reg.list_models()] == [v1.model_id], label
+
+
+def test_bucket_load_params_roundtrip(tmp_path, s3_bucket, mlp_setup):
+    model, params, x = mlp_setup
+    want = model.apply(params, x)
+    for label, make in _registries(tmp_path, s3_bucket):
+        reg = make()
+        mv = reg.create_model_version(
+            "rtt", MODEL_TYPE_MLP, "h", params, ModelEvaluation()
+        )
+        # template-less restore -> numpy leaves, placement at first apply
+        loaded = reg.load_params(mv.model_id, mv.version)
+        got = model.apply(loaded, x)
+        assert np.allclose(np.asarray(got), np.asarray(want)), label
+        # template restore preserves the pytree structure
+        loaded_t = reg.load_params(mv.model_id, mv.version, template=params)
+        got_t = model.apply(loaded_t, x)
+        assert np.allclose(np.asarray(got_t), np.asarray(want)), label
+
+
+def test_publish_on_a_serves_on_b_without_shared_fs(s3_bucket, mlp_setup):
+    """Trainer-side registry publishes + activates; a COMPLETELY separate
+    registry client (fresh backend connection — what a scheduler on
+    another host constructs) sees the activation and serves the params.
+    The only shared state is the signed HTTP bucket."""
+    model, params, x = mlp_setup
+    url = (
+        f"s3://models?endpoint={s3_bucket}"
+        f"&access_key={ACCESS}&secret_key={SECRET}&region={REGION}"
+    )
+    trainer_reg = open_registry(url)
+    mv = trainer_reg.create_model_version(
+        "rtt-regressor", MODEL_TYPE_MLP, "sched-1", params,
+        ModelEvaluation(mse=0.1), metadata={"hidden_dim": 8},
+    )
+    trainer_reg.activate(mv.model_id, mv.version)
+
+    scheduler_reg = open_registry(url)  # new client, no local state
+    server = ModelServer(
+        scheduler_reg, "rtt-regressor", "sched-1", MODEL_TYPE_MLP,
+        template_params=None, model=ProbeRTTRegressor(hidden_dim=8),
+    )
+    assert server.refresh() is True
+    assert server.version == mv.version
+    out = server.infer_mlp(x)
+    assert np.asarray(out).shape == (2,)
+
+
+def test_bad_credentials_rejected(s3_bucket, mlp_setup):
+    _, params, _ = mlp_setup
+    url = (
+        f"s3://models?endpoint={s3_bucket}"
+        f"&access_key={ACCESS}&secret_key=WRONG&region={REGION}"
+    )
+    with pytest.raises(Exception):
+        reg = open_registry(url)
+        reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
+
+
+def test_open_registry_dispatch(tmp_path):
+    assert isinstance(open_registry(tmp_path / "plain"), ModelRegistry)
+    reg = open_registry(f"fs://models/pre?base_dir={tmp_path / 'store'}")
+    assert isinstance(reg, BucketModelRegistry)
+    assert (reg.bucket, reg.prefix) == ("models", "pre")
