@@ -387,3 +387,15 @@ V1_REQUEST_TYPES = (
     V1PeerTarget,
     V1AnnounceTaskRequest,
 )
+
+
+# Register the dialect with the wire codec at import time (like
+# rpc/inference.py does for its message set): any client or server that
+# imports this module can speak it without also importing rpc/server.
+# register_module picks up every dataclass defined here, so a future V1
+# message cannot be forgotten from a hand-maintained list.
+import sys as _sys  # noqa: E402
+
+from dragonfly2_tpu.rpc import wire as _wire  # noqa: E402
+
+_wire.register_module(_sys.modules[__name__])
