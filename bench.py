@@ -296,22 +296,15 @@ def _trainer_submetrics() -> dict:
     # cost_analysis reported ~250x below the floor). The roofline rate —
     # the hard ceiling any credible measurement must respect — comes from
     # the analytic floor.
+    from dragonfly2_tpu.training.train import flops_basis
+
     analytic = result.analytic_flops_per_sample
     xla = result.flops_per_sample
-    # The analytic floor is a LOWER bound on executed work (the model
-    # cannot run fewer FLOPs than its matmuls), so MFU computed from it
-    # can only understate utilization. cost_analysis BELOW the floor is
-    # therefore invalid data, not a smaller truth (observed ~200x low on
-    # this backend) — discard it; above the floor, the floor is still the
-    # conservative basis. Both raw values are published either way.
-    if analytic > 0:
-        flops_src, flops_ps = "analytic_matmul_floor", analytic
-        if 0 < xla < analytic:
-            flops_src = "analytic_matmul_floor (xla_cost_analysis invalid: below floor)"
-    elif xla > 0:
-        flops_src, flops_ps = "xla_cost_analysis", xla
-    else:
-        flops_src, flops_ps = "none", 0.0
+    # Shared policy (train.flops_basis): the analytic floor is a LOWER
+    # bound on executed work, so MFU from it can only understate
+    # utilization; cost_analysis below the floor is invalid data
+    # (observed ~200x low on this backend). Both raw values publish.
+    flops_src, flops_ps = flops_basis(result)
     roofline = (
         PEAK_TFLOPS_BF16 * 1e12 / analytic if analytic > 0 else float("inf")
     )

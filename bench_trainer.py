@@ -146,7 +146,11 @@ def main() -> int:
     cpu = torch_cpu_samples_per_sec(ds, graph)
     result = tpu_train_result(ds, graph)
     tpu = result.samples_per_sec
-    achieved_tflops = result.flops_per_sec / 1e12
+    # MFU basis from the ONE shared policy (training.train.flops_basis)
+    from dragonfly2_tpu.training.train import flops_basis
+
+    flops_src, flops_ps = flops_basis(result)
+    achieved_tflops = flops_ps * tpu / 1e12
     print(
         json.dumps(
             {
@@ -155,12 +159,11 @@ def main() -> int:
                 "unit": "samples/s",
                 "vs_baseline": round(tpu / cpu, 2),
                 "cpu_torch_baseline": round(cpu, 1),
-                # "is it actually fast" vs chip peak (VERDICT r1 weak #6):
-                # XLA-counted model FLOPs, so the tiny ranker's low MFU is
-                # an honest statement that this model is dispatch/memory
-                # bound, not a claim of matmul saturation
+                # "is it actually fast" vs chip peak (VERDICT r1 weak #6)
                 "achieved_tflops": round(achieved_tflops, 3),
                 "mfu_pct": round(100.0 * achieved_tflops / PEAK_TFLOPS, 3),
+                "flops_source": flops_src,
+                "flops_per_sample_xla": round(result.flops_per_sample, 1),
             }
         )
     )

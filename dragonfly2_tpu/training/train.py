@@ -73,6 +73,26 @@ class TrainResult:
         return self.flops_per_sample * self.samples_per_sec
 
 
+def flops_basis(result: "TrainResult") -> tuple[str, float]:
+    """(source, flops_per_sample) every MFU claim must use — ONE policy
+    shared by bench.py and bench_trainer.py so the two artifacts can
+    never report utilization on different bases. The analytic matmul
+    floor wins when present (a lower bound on executed work, so MFU can
+    only be understated); XLA cost_analysis BELOW that floor is invalid
+    data and flagged as such; "none" when no basis exists at all."""
+    analytic, xla = result.analytic_flops_per_sample, result.flops_per_sample
+    if analytic > 0:
+        if 0 < xla < analytic:
+            return (
+                "analytic_matmul_floor (xla_cost_analysis invalid: below floor)",
+                analytic,
+            )
+        return "analytic_matmul_floor", analytic
+    if xla > 0:
+        return "xla_cost_analysis", xla
+    return "none", 0.0
+
+
 def analytic_gnn_flops_per_sample(
     n_nodes: int,
     node_feat_dim: int,

@@ -1,0 +1,121 @@
+"""Concurrency hammer — the race-detector analog for the scheduler
+service (SURVEY §5 race safety; the reference leans on Go's -race in CI).
+
+Many threads drive the full message surface of ONE SchedulerService under
+its RPC-edge lock (exactly how rpc/server.py dispatches: every mutation
+under service.mu) while another thread runs tick() + run_gc() in a loop.
+Afterwards the service must be INTERNALLY CONSISTENT — no exception ever
+escaped, every live peer's state is a legal FSM value, the SoA free lists
+agree with the id maps, and host-side dicts hold no entries for reclaimed
+peers. Any forgotten lock or dict/array divergence shows up as a torn
+invariant within a few thousand operations."""
+
+import threading
+
+import numpy as np
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.state.fsm import PeerState
+
+
+def _host(i: int) -> msg.HostInfo:
+    return msg.HostInfo(host_id=f"ch-{i}", hostname=f"ch-{i}", ip=f"10.3.0.{i % 250}")
+
+
+def test_concurrent_message_storm_keeps_service_consistent():
+    cfg = Config()
+    cfg.scheduler.max_hosts = 256
+    cfg.scheduler.max_tasks = 128
+    svc = SchedulerService(config=cfg)
+    svc.announce_host(msg.HostInfo(host_id="seed", hostname="seed", ip="10.3.1.1",
+                                   host_type="super"))
+    errors: list[BaseException] = []
+    stop = threading.Event()
+    n_workers, ops_per_worker = 8, 400
+
+    def worker(wid: int) -> None:
+        rng = np.random.default_rng(wid)
+        my_peers: list[tuple[str, str]] = []
+        try:
+            for op in range(ops_per_worker):
+                with svc.mu:
+                    roll = rng.random()
+                    if roll < 0.35 or not my_peers:
+                        pid = f"p-{wid}-{op}"
+                        task = f"t-{int(rng.integers(0, 24))}"
+                        svc.register_peer(msg.RegisterPeerRequest(
+                            peer_id=pid, task_id=task,
+                            host=_host(int(rng.integers(0, 40))),
+                            url=f"https://o.example/{task}",
+                            content_length=16 << 20,
+                        ))
+                        my_peers.append((pid, task))
+                    elif roll < 0.6:
+                        pid, _ = my_peers[int(rng.integers(len(my_peers)))]
+                        svc.handle(msg.DownloadPieceFinishedRequest(
+                            peer_id=pid, piece_number=int(rng.integers(0, 8)),
+                            length=1 << 20, cost_ns=int(rng.integers(1, 9)) * 1_000_000,
+                        ))
+                    elif roll < 0.75:
+                        pid, _ = my_peers[int(rng.integers(len(my_peers)))]
+                        # may be protocol-illegal for the current state —
+                        # must answer ScheduleFailure, never corrupt/raise
+                        svc.handle(msg.DownloadPeerFinishedRequest(peer_id=pid))
+                    elif roll < 0.85:
+                        pid, _ = my_peers[int(rng.integers(len(my_peers)))]
+                        svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id=pid))
+                        svc.handle(msg.DownloadPeerBackToSourceFinishedRequest(
+                            peer_id=pid, piece_count=4,
+                        ))
+                    else:
+                        pid, _ = my_peers.pop(int(rng.integers(len(my_peers))))
+                        svc.leave_peer(pid)
+        except BaseException as e:  # noqa: BLE001 - recorded for the assert
+            errors.append(e)
+
+    def ticker() -> None:
+        try:
+            while not stop.is_set():
+                with svc.mu:
+                    svc.tick()
+                svc.run_gc(force=True)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(n_workers)]
+    t_tick = threading.Thread(target=ticker)
+    t_tick.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "worker wedged — scheduler starved or deadlocked"
+    stop.set()
+    t_tick.join(timeout=30)
+    assert not t_tick.is_alive(), "ticker wedged"
+
+    assert not errors, errors[:3]
+
+    # ---- internal consistency under the lock ----
+    with svc.mu:
+        st = svc.state
+        legal = {int(s) for s in PeerState}
+        alive_idx = np.nonzero(st.peer_alive)[0]
+        for idx in alive_idx:
+            pid = st._peer_id[idx]
+            assert pid is not None, f"alive slot {idx} has no id"
+            assert st.peer_index(pid) == idx, "id map diverged from SoA"
+            assert int(st.peer_state[idx]) in legal
+            assert pid in svc._peer_meta, f"alive peer {pid} lost its meta"
+        # no host-side entries for reclaimed peers
+        for pid in svc._peer_meta:
+            assert st.peer_index(pid) is not None, f"meta for dead peer {pid}"
+        for pid in svc._pending:
+            assert st.peer_index(pid) is not None, f"pending dead peer {pid}"
+        # free-list accounting matches the alive mask
+        counts = st.counts()
+        assert counts["peers"] == len(alive_idx)
+        # upload accounting can never be negative
+        assert (st.host_upload_used[: st.max_hosts] >= 0).all()
