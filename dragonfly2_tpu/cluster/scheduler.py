@@ -536,6 +536,12 @@ class SchedulerService:
         child_host_slots = np.zeros(b, np.int32)
         cand_host_slots = np.zeros((b, k), np.int32)
 
+        # Cycle checks batch PER TASK, not per peer: all pending peers of
+        # one task share a DAG, and the (parent_slot, child_slot) pairs
+        # API pays one ctypes round-trip per task per tick — the per-peer
+        # call's ~100 us marshalling was the biggest host-side tick cost
+        # after the transport fix.
+        task_pairs: dict[str, list[tuple[int, int, int, int]]] = {}
         for i, pending in enumerate(work):
             meta = self._peer_meta[pending.peer_id]
             child_peer_idx[i] = self.state.peer_index(pending.peer_id)
@@ -544,7 +550,7 @@ class SchedulerService:
             sampled = dag.random_vertices(k, self.rng)
             slot_to_peer = self._dag_slot_peer.get(meta.task_id, {})
             ids = []
-            slots: list[int] = []
+            pairs = task_pairs.setdefault(meta.task_id, [])
             j = 0
             for slot in sampled:
                 pid = slot_to_peer.get(int(slot))
@@ -558,18 +564,18 @@ class SchedulerService:
                 blocklist[i, j] = pid in pending.blocklist
                 in_degree[i, j] = dag.in_degree[slot]
                 cand_host_slots[i, j] = self.state.peer_host[pidx]
-                slots.append(int(slot))
+                pairs.append((int(slot), meta.dag_slot, i, j))
                 ids.append(pid)
                 j += 1
                 if j >= k:
                     break
-            if slots:
-                # one batched native cycle check per peer, not one ctypes
-                # round-trip per candidate (graph/dag.py can_add_edges)
-                can_add_edge[i, : len(slots)] = dag.can_add_edges(
-                    np.asarray(slots, np.int64), meta.dag_slot
-                )
             cand_ids.append(ids)
+        for task_id, pairs in task_pairs.items():
+            if not pairs:
+                continue
+            arr = np.asarray(pairs, np.int64)
+            ok = self._task_dag(task_id).can_add_edges_pairs(arr[:, 0], arr[:, 1])
+            can_add_edge[arr[:, 2], arr[:, 3]] = ok
         _mark("candidate_fill")
 
         avg_rtt = has_rtt = None

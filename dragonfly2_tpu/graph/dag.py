@@ -135,34 +135,49 @@ class TaskDAG:
 
     def can_add_edges(self, parents: np.ndarray, child: int) -> np.ndarray:
         """Vectorized `can_add_edge(p, child)` over candidate parents —
-        the scheduler tick's cycle-check hot path. Presence/self-loop/
-        duplicate rules run as array ops; the reachability queries go
-        through the native BATCH entry point (one ctypes call instead of
-        one per candidate, whose marshalling overhead dominated the tick's
-        host-side cost)."""
+        `can_add_edges_pairs` with one shared child (the legality rules
+        live ONLY there so the two batch paths cannot diverge)."""
         parents = np.asarray(parents, np.int64)
         n = parents.shape[0]
         # child may be an unassigned dag_slot (-1): nothing is legal then
         if n == 0 or not (0 <= child < self.capacity) or not self.present[child]:
             return np.zeros(n, bool)
-        in_range = (parents >= 0) & (parents < self.capacity)
-        safe = np.where(in_range, parents, 0)
-        ok = in_range & self.present[safe] & (parents != child)
-        word, bit = divmod(child, 64)
-        ok &= (self.adj[safe, word] & (np.uint64(1) << np.uint64(bit))) == 0
+        return self.can_add_edges_pairs(parents, np.full(n, child, np.int64))
+
+    def can_add_edges_pairs(self, parents: np.ndarray, children: np.ndarray) -> np.ndarray:
+        """`can_add_edge(p, c)` over ALIGNED (parent, child) pairs in one
+        native call — `can_add_edges` with the child varying per pair.
+        The tick batches EVERY pending peer of a task through here, so a
+        task with m peers x k candidates pays one ctypes round-trip
+        instead of m (the per-call marshalling cost ~100 us dominated the
+        host-side tick at scale)."""
+        parents = np.asarray(parents, np.int64)
+        children = np.asarray(children, np.int64)
+        n = parents.shape[0]
+        if n == 0:
+            return np.zeros(0, bool)
+        p_in = (parents >= 0) & (parents < self.capacity)
+        c_in = (children >= 0) & (children < self.capacity)
+        safe_p = np.where(p_in, parents, 0)
+        safe_c = np.where(c_in, children, 0)
+        ok = (
+            p_in & c_in
+            & self.present[safe_p] & self.present[safe_c]
+            & (parents != children)
+        )
+        word, bit = np.divmod(safe_c, 64)
+        ok &= (self.adj[safe_p, word] & (np.uint64(1) << bit.astype(np.uint64))) == 0
         if not ok.any():
             return ok
         from dragonfly2_tpu import native
 
         idx = np.nonzero(ok)[0]
-        batch = native.dag_reachable_batch(
-            self.adj, np.full(idx.shape[0], child, np.int64), parents[idx]
-        )
+        batch = native.dag_reachable_batch(self.adj, children[idx], parents[idx])
         if batch is not None:
             ok[idx] &= ~batch
         else:  # native lib unavailable: per-query fallback
             for i in idx:
-                if self.reachable(child, int(parents[i])):
+                if self.reachable(int(children[i]), int(parents[i])):
                     ok[i] = False
         return ok
 

@@ -149,3 +149,39 @@ def test_can_add_edges_matches_scalar(monkeypatch):
     monkeypatch.undo()
     # an unassigned child slot (-1) is never legal and never reaches native
     assert not dag.can_add_edges(parents, -1).any()
+
+
+def test_can_add_edges_pairs_matches_scalar(monkeypatch):
+    """Pairs-batched cycle check (ONE native call for every pending peer
+    of a task — the tick's per-task batching) == per-pair can_add_edge,
+    across self-loop, duplicate, absent-vertex, cycle, unassigned (-1)
+    and out-of-range ids, with and without the native library."""
+    import numpy as np
+
+    from dragonfly2_tpu.graph.dag import TaskDAG
+
+    dag = TaskDAG(64)
+    for v in range(8):
+        dag.add_vertex(v)
+    dag.add_edge(0, 1)
+    dag.add_edge(1, 2)
+    dag.add_edge(2, 3)
+    dag.add_edge(4, 5)
+    dag.delete_vertex(7)
+
+    rng = np.random.default_rng(0)
+    parents = rng.integers(-1, 10, 64).astype(np.int64)
+    children = rng.integers(-1, 10, 64).astype(np.int64)
+    parents[:5] = [0, 3, 2, 7, 63]
+    children[:5] = [1, 0, 2, 1, 1]  # duplicate, cycle, self-loop, absent, oob
+    want = np.array([
+        dag.can_add_edge(int(p), int(c)) if 0 <= c < 64 and 0 <= p < 64 else False
+        for p, c in zip(parents, children)
+    ])
+    got = dag.can_add_edges_pairs(parents, children)
+    assert (got == want).all(), np.nonzero(got != want)
+    monkeypatch.setenv("DF_NATIVE", "0")
+    got_py = dag.can_add_edges_pairs(parents, children)
+    assert (got_py == want).all()
+    monkeypatch.undo()
+    assert dag.can_add_edges_pairs(np.zeros(0, np.int64), np.zeros(0, np.int64)).shape == (0,)

@@ -1,0 +1,20 @@
+"""LLM weight-shard P2P prefetch scenario (BASELINE.json configs[4]
+stretch): a fleet cold-starting one sharded checkpoint must pull every
+fleet byte from the mesh — the origin sees exactly one pass (the seed's)
+— with every shard digest-exact on every host."""
+
+import asyncio
+
+
+def test_fleet_prefetch_full_origin_offload(tmp_path):
+    from tools.llm_prefetch import run
+
+    result = asyncio.run(run(
+        shards=3, shard_bytes=256 * 1024, hosts=3,
+        piece_length=64 * 1024, workdir=str(tmp_path),
+    ))
+    # seed pass = shards * shard_bytes (+ tiny HEAD noise); the fleet's
+    # bytes all rode P2P
+    assert result["fleet_offload_pct"] == 100.0, result
+    assert result["origin_bytes"] <= 3 * 256 * 1024 + 4096, result
+    assert result["aggregate_mib_s"] > 0

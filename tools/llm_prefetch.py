@@ -1,0 +1,233 @@
+"""LLM weight-shard P2P prefetch scenario — BASELINE.json configs[4].
+
+The stretch workload: a fleet of inference hosts cold-starting the same
+sharded checkpoint (Llama-3-70B ships as ~30 x ~4.6 GiB safetensors
+shards). Without P2P every host pulls every shard from the model store;
+with the mesh, ONE seed fetches each shard from the origin and the fleet
+exchanges pieces over the scheduler's parent selection.
+
+This harness builds the full rig in-process over real localhost sockets
+(scheduler RPC server + seed daemon + N client daemons), serves a
+synthetic shard repo over HTTP (this environment has no egress; shard
+count/size are scaled down by default and configurable up to the real
+layout), prefetches every shard on every host with piece-level demand,
+and prints ONE JSON line:
+
+    {"metric": "llm_prefetch_origin_offload_pct", "value": ...,
+     "shards": S, "hosts": N, "bytes_total": ..., "origin_bytes": ...,
+     "p2p_bytes": ..., "wall_s": ..., "aggregate_mib_s": ...}
+
+origin offload = fraction of delivered bytes that did NOT come from the
+model store: (total_delivered - origin_fetched) / total_delivered. The
+reference's headline P2P claim is exactly this ratio at fleet scale.
+
+Usage: python tools/llm_prefetch.py [--shards 8] [--shard-mib 4]
+       [--hosts 6] [--piece-kib 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import http.server
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class ShardRepo:
+    """In-process model store: /model/model-{i:05d}-of-{S:05d}.safetensors."""
+
+    def __init__(self, shards: int, shard_bytes: int, seed: int = 0):
+        self.shards = shards
+        self.payloads = {}
+        rng_state = hashlib.sha256(str(seed).encode()).digest()
+        for i in range(shards):
+            # deterministic pseudo-random bytes without holding S copies
+            # of os.urandom in page cache twice
+            block = hashlib.sha256(rng_state + str(i).encode()).digest()
+            reps = shard_bytes // len(block) + 1
+            self.payloads[self._name(i)] = (block * reps)[:shard_bytes]
+        self.gets = 0
+        self.bytes_served = 0
+        self._mu = threading.Lock()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _payload(self):
+                return outer.payloads.get(self.path.rsplit("/", 1)[-1])
+
+            def do_HEAD(self):
+                data = self._payload()
+                if data is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+
+            def do_GET(self):
+                data = self._payload()
+                if data is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                with outer._mu:
+                    outer.gets += 1
+                rng = self.headers.get("Range")
+                status = 200
+                if rng and rng.startswith("bytes="):
+                    lo, _, hi = rng[6:].partition("-")
+                    lo = int(lo or 0)
+                    hi = int(hi) if hi else len(data) - 1
+                    data = data[lo : hi + 1]
+                    status = 206
+                with outer._mu:
+                    outer.bytes_served += len(data)
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def _name(self, i: int) -> str:
+        return f"model-{i + 1:05d}-of-{self.shards:05d}.safetensors"
+
+    def url(self, i: int) -> str:
+        return f"http://127.0.0.1:{self.port}/model/{self._name(i)}"
+
+    def sha(self, i: int) -> str:
+        return hashlib.sha256(self.payloads[self._name(i)]).hexdigest()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+async def run(
+    shards: int, shard_bytes: int, hosts: int, piece_length: int,
+    workdir: str,
+) -> dict:
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+    from dragonfly2_tpu.config.config import Config
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+
+    repo = ShardRepo(shards, shard_bytes)
+    cfg = Config()
+    cfg.scheduler.max_hosts = max(64, 2 * hosts)
+    cfg.scheduler.max_tasks = max(64, 2 * shards)
+    svc = SchedulerService(config=cfg)
+    server = SchedulerRPCServer(svc, tick_interval=0.005)
+    host, port = await server.start()
+
+    daemons = []
+    try:
+        # the SEED host prefetches first (the reference's preheat step):
+        # one origin fetch per shard, the fleet rides P2P afterwards
+        seed = Daemon(f"{workdir}/seed", [(host, port)], hostname="seed-host")
+        await seed.start()
+        daemons.append(seed)
+        t0 = time.perf_counter()
+        for i in range(shards):
+            await seed.download(repo.url(i), piece_length=piece_length)
+        seed_wall = time.perf_counter() - t0
+
+        fleet = []
+        for n in range(hosts):
+            d = Daemon(f"{workdir}/h{n}", [(host, port)], hostname=f"infer-{n}")
+            await d.start()
+            daemons.append(d)
+            fleet.append(d)
+
+        t0 = time.perf_counter()
+
+        async def prefetch(d: Daemon):
+            # demand order: shards arrive in index order per host (the
+            # loader maps them sequentially), hosts race concurrently
+            for i in range(shards):
+                ts = await d.download(
+                    repo.url(i), piece_length=piece_length,
+                    back_source_allowed=False,
+                )
+                with open(ts.data_path, "rb") as f:
+                    digest = hashlib.sha256(f.read()).hexdigest()
+                assert digest == repo.sha(i), f"shard {i} corrupt on {d.hostname}"
+
+        await asyncio.gather(*(prefetch(d) for d in fleet))
+        fleet_wall = time.perf_counter() - t0
+
+        total_delivered = shard_bytes * shards * (hosts + 1)
+        origin_bytes = repo.bytes_served
+        p2p_bytes = total_delivered - origin_bytes
+        offload = 100.0 * p2p_bytes / total_delivered
+        # the sharper number: of the FLEET's bytes (seed's one necessary
+        # origin pass excluded from both sides), how much rode the mesh?
+        fleet_bytes = shard_bytes * shards * hosts
+        fleet_origin = max(origin_bytes - shard_bytes * shards, 0)
+        fleet_offload = 100.0 * (fleet_bytes - fleet_origin) / max(fleet_bytes, 1)
+        return {
+            "metric": "llm_prefetch_origin_offload_pct",
+            "value": round(offload, 2),
+            "fleet_offload_pct": round(fleet_offload, 2),
+            "unit": "%",
+            "shards": shards,
+            "shard_mib": round(shard_bytes / (1 << 20), 2),
+            "hosts": hosts,
+            "bytes_total": total_delivered,
+            "origin_bytes": origin_bytes,
+            "p2p_bytes": p2p_bytes,
+            "seed_wall_s": round(seed_wall, 2),
+            "fleet_wall_s": round(fleet_wall, 2),
+            "aggregate_mib_s": round(
+                shard_bytes * shards * hosts / (1 << 20) / max(fleet_wall, 1e-9), 1
+            ),
+            "algorithm": svc.algorithm,
+        }
+    finally:
+        # one failing stop must not leak the rest of the rig
+        import contextlib
+
+        for d in daemons:
+            with contextlib.suppress(Exception):
+                await d.stop()
+        with contextlib.suppress(Exception):
+            await server.stop()
+        repo.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--shard-mib", type=float, default=4.0)
+    ap.add_argument("--hosts", type=int, default=6)
+    ap.add_argument("--piece-kib", type=int, default=1024)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="llm-prefetch-")
+    result = asyncio.run(run(
+        args.shards, int(args.shard_mib * (1 << 20)), args.hosts,
+        args.piece_kib << 10, workdir,
+    ))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
