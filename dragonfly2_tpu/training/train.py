@@ -133,6 +133,44 @@ def analytic_gnn_flops_per_sample(
     return step / max(batch, 1)
 
 
+def analytic_mlp_flops_per_sample(
+    feat_dim: int, hidden: int, num_layers: int = 3
+) -> float:
+    """Matmul-only FLOP floor per trained sample for ProbeRTTRegressor
+    (models/mlp.py: (num_layers-1) hidden Dense + 1 output Dense;
+    fwd + bwd ~ 3x fwd)."""
+    fwd = 2.0 * feat_dim * hidden
+    fwd += max(num_layers - 2, 0) * 2.0 * hidden * hidden
+    fwd += 2.0 * hidden
+    return 3.0 * fwd
+
+
+def analytic_attention_flops_per_sample(
+    token_feat_dim: int,
+    hidden: int,
+    parents: int,
+    num_layers: int = 2,
+) -> float:
+    """Matmul-only FLOP lower bound per trained sample for one
+    AttentionRanker train step (models/attention.py: embed Dense, per
+    block qkv/attention/proj + 4x FFN, score head; fwd + bwd ~ 3x fwd).
+    Each sample is one row of P candidate tokens, so per-sample cost is
+    P tokens' worth of transformer math — no batch-shared embedding to
+    amortize like the GNN's graph pass."""
+    p, h = float(parents), float(hidden)
+    fwd = 2.0 * p * token_feat_dim * h                      # embed
+    per_block = (
+        2.0 * p * h * 3 * h        # qkv
+        + 4.0 * p * p * h          # scores + weighted sum (2 matmuls)
+        + 2.0 * p * h * h          # proj
+        + 2.0 * p * h * 4 * h      # mlp_up
+        + 2.0 * p * 4 * h * h      # mlp_down
+    )
+    fwd += num_layers * per_block
+    fwd += 2.0 * p * h             # score head
+    return 3.0 * fwd
+
+
 def _epoch_flops(jitted, *args) -> float:
     """Total FLOPs of one compiled epoch call per XLA's cost analysis;
     the lowering is cached, so the real epoch call pays no extra compile."""
@@ -439,6 +477,9 @@ def train_mlp(
         steps=len(losses),
         flops_per_sample=flops_per_sample,
         peak_samples_per_sec=peak,
+        analytic_flops_per_sample=analytic_mlp_flops_per_sample(
+            x.shape[1], config.hidden_dim, model.num_layers
+        ),
     )
 
 
@@ -687,6 +728,17 @@ def train_attention(
     stats = M.top1_selection_stats(
         np.asarray(scores)[:n_real], eb["throughput"][:n_real], eb["mask"][:n_real]
     )
+    analytic = analytic_attention_flops_per_sample(
+        # dims read from the ACTUAL eval batch (the same arrays the model
+        # consumed), never re-derived: an overstated floor would inflate
+        # every published attention MFU with no error
+        token_feat_dim=(
+            eb["parents"].shape[-1] + eb["child"].shape[1] + eb["pair"].shape[-1]
+        ),
+        hidden=config.hidden_dim,
+        parents=eb["parents"].shape[1],
+        num_layers=config.attention_num_layers,
+    )
     return TrainResult(
         params=params,
         losses=losses,
@@ -695,6 +747,7 @@ def train_attention(
         steps=len(losses),
         flops_per_sample=flops_per_sample,
         peak_samples_per_sec=peak,
+        analytic_flops_per_sample=analytic,
     )
 
 

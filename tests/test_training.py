@@ -50,6 +50,27 @@ def test_mlp_learns_rtt_structure(mlp_data):
     assert res.samples_per_sec > 0
 
 
+def test_every_family_reports_an_analytic_flop_floor(mlp_data, rank_data):
+    """All three trainers carry a positive matmul-only FLOP floor so
+    flops_basis (the ONE MFU policy) never falls back to 'none' or to an
+    invalid cost_analysis value on a backend that misreports."""
+    from dragonfly2_tpu.training.train import flops_basis
+
+    x, y = mlp_data
+    ds, graph = rank_data
+    cfg = TrainerConfig(epochs=1, batch_size=64, hidden_dim=32)
+    for res in (
+        train_mlp(x, y, cfg, seed=0),
+        train_gnn(ds, graph, cfg, seed=0),
+        train_attention(ds, cfg, seed=0),
+    ):
+        assert res.analytic_flops_per_sample > 0
+        src, flops = flops_basis(res)
+        # with a positive floor the basis IS the floor, always
+        assert src.startswith("analytic_matmul_floor"), src
+        assert flops == res.analytic_flops_per_sample
+
+
 def test_mlp_dp_sharded_matches_semantics(mlp_data):
     x, y = mlp_data
     cfg = TrainerConfig(epochs=2, batch_size=64, hidden_dim=32)

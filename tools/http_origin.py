@@ -1,0 +1,84 @@
+"""Shared in-process HTTP origin for the bench/scenario harnesses.
+
+One Range-correct file server (incl. suffix ranges ``bytes=-N``, which
+ad-hoc copies tended to mishandle) parameterized by a path->payload map,
+with lock-guarded GET/byte counters — the single implementation behind
+tools/stress.py and tools/llm_prefetch.py so range semantics cannot
+drift between harnesses. (The multi-process e2e keeps its own minimal
+origin because its tests monkeypatch the handler class.)
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+
+
+class HTTPOrigin:
+    def __init__(self, payloads: dict[str, bytes], default: bytes | None = None):
+        """`payloads` maps exact paths to bodies; `default` (if given)
+        answers every other path — harnesses that only need "one blob at
+        any URL" (tools/stress.py) use it alone."""
+        self.payloads = dict(payloads)
+        self.default = default
+        self.gets = 0
+        self.bytes_served = 0
+        self._mu = threading.Lock()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _payload(self):
+                return outer.payloads.get(
+                    self.path.split("?", 1)[0], outer.default
+                )
+
+            def do_HEAD(self):
+                data = self._payload()
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+
+            def do_GET(self):
+                data = self._payload()
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                status = 200
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    spec = rng[len("bytes="):].split(",")[0].strip()
+                    lo_s, _, hi_s = spec.partition("-")
+                    if lo_s == "" and hi_s:  # suffix range: last N bytes
+                        data = data[-int(hi_s):] if int(hi_s) else b""
+                    else:
+                        lo = int(lo_s or 0)
+                        hi = int(hi_s) if hi_s else len(data) - 1
+                        data = data[lo : hi + 1]
+                    status = 206
+                with outer._mu:
+                    outer.gets += 1
+                    outer.bytes_served += len(data)
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self) -> None:
+        self.srv.shutdown()
+        self.srv.server_close()

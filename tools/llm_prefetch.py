@@ -30,79 +30,41 @@ from __future__ import annotations
 import argparse
 import asyncio
 import hashlib
-import http.server
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class ShardRepo:
-    """In-process model store: /model/model-{i:05d}-of-{S:05d}.safetensors."""
+    """In-process model store: /model/model-{i:05d}-of-{S:05d}.safetensors
+    served by the shared Range-correct origin (tools/http_origin.py)."""
 
     def __init__(self, shards: int, shard_bytes: int, seed: int = 0):
+        from tools.http_origin import HTTPOrigin
+
         self.shards = shards
         self.payloads = {}
         rng_state = hashlib.sha256(str(seed).encode()).digest()
         for i in range(shards):
-            # deterministic pseudo-random bytes without holding S copies
-            # of os.urandom in page cache twice
+            # deterministic pseudo-random bytes, cheap to regenerate
             block = hashlib.sha256(rng_state + str(i).encode()).digest()
             reps = shard_bytes // len(block) + 1
             self.payloads[self._name(i)] = (block * reps)[:shard_bytes]
-        self.gets = 0
-        self.bytes_served = 0
-        self._mu = threading.Lock()
-        outer = self
+        self._origin = HTTPOrigin(
+            {f"/model/{name}": data for name, data in self.payloads.items()}
+        )
+        self.port = self._origin.port
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+    @property
+    def gets(self) -> int:
+        return self._origin.gets
 
-            def log_message(self, *a):
-                pass
-
-            def _payload(self):
-                return outer.payloads.get(self.path.rsplit("/", 1)[-1])
-
-            def do_HEAD(self):
-                data = self._payload()
-                if data is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-
-            def do_GET(self):
-                data = self._payload()
-                if data is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                with outer._mu:
-                    outer.gets += 1
-                rng = self.headers.get("Range")
-                status = 200
-                if rng and rng.startswith("bytes="):
-                    lo, _, hi = rng[6:].partition("-")
-                    lo = int(lo or 0)
-                    hi = int(hi) if hi else len(data) - 1
-                    data = data[lo : hi + 1]
-                    status = 206
-                with outer._mu:
-                    outer.bytes_served += len(data)
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.port = self.srv.server_address[1]
-        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+    @property
+    def bytes_served(self) -> int:
+        return self._origin.bytes_served
 
     def _name(self, i: int) -> str:
         return f"model-{i + 1:05d}-of-{self.shards:05d}.safetensors"
@@ -114,8 +76,7 @@ class ShardRepo:
         return hashlib.sha256(self.payloads[self._name(i)]).hexdigest()
 
     def close(self):
-        self.srv.shutdown()
-        self.srv.server_close()
+        self._origin.close()
 
 
 async def run(

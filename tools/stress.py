@@ -32,34 +32,12 @@ if __name__ == "__main__":  # library imports (tests) already have the repo on s
 
 
 def _origin(payload: bytes):
-    class Handler(http.server.BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
+    """Shared Range-correct origin (tools/http_origin.py); payload served
+    at every path so the proxy's URL choice doesn't matter."""
+    from tools.http_origin import HTTPOrigin
 
-        def log_message(self, *a):
-            pass
-
-        def do_HEAD(self):
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-
-        def do_GET(self):
-            data = payload
-            r = self.headers.get("Range")
-            status = 200
-            if r and r.startswith("bytes="):
-                lo, _, hi = r[6:].partition("-")
-                lo = int(lo or 0)
-                hi = int(hi) if hi else len(data) - 1
-                data, status = data[lo : hi + 1], 206
-            self.send_response(status)
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
-    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    return srv, srv.server_address[1]
+    origin = HTTPOrigin({}, default=payload)
+    return origin.srv, origin.port
 
 
 def _fetch_once(proxy_addr: str, url: str) -> float:
