@@ -29,7 +29,7 @@ import numpy as np
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.cluster.probes import ProbeStore
 from dragonfly2_tpu.config.config import Config
-from dragonfly2_tpu.graph.dag import DAGError, TaskDAG
+from dragonfly2_tpu.graph.dag import TaskDAG
 from dragonfly2_tpu.ops import evaluator as ev
 from dragonfly2_tpu.records.features import (
     host_numeric_features,
@@ -710,13 +710,20 @@ class SchedulerService:
     def _apply_selection(self, pending: _Pending, meta: _PeerMeta, parents: list[tuple[str, float]]):
         dag = self._task_dag(meta.task_id)
         kept = []
-        for pid, score in parents:
-            pmeta = self._peer_meta.get(pid)
-            if pmeta is None:
-                continue
-            try:
-                dag.add_edge(pmeta.dag_slot, meta.dag_slot)
-            except DAGError:
+        # All of this child's new edges END at its slot, so one batched
+        # legality pass equals the old per-edge add_edge sequence
+        # (graph/dag.py add_edges_from) at one native round-trip.
+        known = [
+            (pid, score, pm)
+            for pid, score in parents
+            if (pm := self._peer_meta.get(pid)) is not None
+        ]
+        accepted = dag.add_edges_from(
+            np.asarray([pm.dag_slot for _, _, pm in known], np.int64),
+            meta.dag_slot,
+        )
+        for (pid, score, pmeta), ok in zip(known, accepted):
+            if not ok:
                 continue
             pidx = self.state.peer_index(pid)
             self.state.host_upload_used[self.state.peer_host[pidx]] += 1

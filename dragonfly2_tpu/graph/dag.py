@@ -184,10 +184,35 @@ class TaskDAG:
     def add_edge(self, u: int, v: int) -> None:
         if not self.can_add_edge(u, v):
             raise DAGError(f"edge {u}->{v} rejected (missing vertex, duplicate, or cycle)")
+        self._add_edge_unchecked(u, v)
+
+    def _add_edge_unchecked(self, u: int, v: int) -> None:
         word, bit = divmod(v, 64)
         self.adj[u, word] |= np.uint64(1) << np.uint64(bit)
         self.out_degree[u] += 1
         self.in_degree[v] += 1
+
+    def add_edges_from(self, parents: np.ndarray, child: int) -> np.ndarray:
+        """Add every legal `p -> child` edge in ONE legality batch; returns
+        the per-parent accepted mask. Equivalent to sequential add_edge
+        over the same list: all the new edges END at `child`, so none can
+        change reachability FROM `child` — each edge's cycle check against
+        the pre-call graph is exactly the check sequential adds would
+        make. One native reachability round-trip per scheduled peer
+        instead of one per selected parent (scheduler _apply_selection)."""
+        parents = np.asarray(parents, np.int64)
+        ok = self.can_add_edges(parents, child)
+        # a parent repeated IN THIS BATCH must only add once
+        if ok.any():
+            seen: set[int] = set()
+            for i in np.nonzero(ok)[0]:
+                p = int(parents[i])
+                if p in seen:
+                    ok[i] = False
+                    continue
+                seen.add(p)
+                self._add_edge_unchecked(p, child)
+        return ok
 
     def delete_edge(self, u: int, v: int) -> None:
         if not self.has_edge(u, v):

@@ -151,6 +151,41 @@ def test_can_add_edges_matches_scalar(monkeypatch):
     assert not dag.can_add_edges(parents, -1).any()
 
 
+def test_add_edges_from_equals_sequential_add_edge():
+    """Batched in-edge insertion (one legality pass, the scheduler's
+    _apply_selection path) must accept exactly what sequential add_edge
+    would and leave an identical graph — including duplicate parents in
+    one batch, pre-existing edges, cycles, and absent vertices."""
+    rng = np.random.default_rng(3)
+    for trial in range(20):
+        a, b = TaskDAG(64), TaskDAG(64)
+        alive = rng.choice(16, size=10, replace=False)
+        for v in alive:
+            a.add_vertex(int(v)); b.add_vertex(int(v))
+        # random pre-existing edges
+        for _ in range(12):
+            u, v = rng.choice(alive, 2, replace=False)
+            try:
+                a.add_edge(int(u), int(v)); b.add_edge(int(u), int(v))
+            except DAGError:
+                pass
+        child = int(rng.choice(alive))
+        parents = rng.integers(-1, 20, size=6).astype(np.int64)
+        parents[rng.integers(6)] = parents[rng.integers(6)]  # force dupes
+        want = []
+        for p in parents:
+            try:
+                a.add_edge(int(p), child)
+                want.append(True)
+            except (DAGError, IndexError):
+                want.append(False)
+        got = b.add_edges_from(parents, child)
+        assert list(got) == want, (trial, parents, child)
+        assert np.array_equal(a.adj, b.adj), trial
+        assert np.array_equal(a.in_degree, b.in_degree), trial
+        assert np.array_equal(a.out_degree, b.out_degree), trial
+
+
 def test_can_add_edges_pairs_matches_scalar(monkeypatch):
     """Pairs-batched cycle check (ONE native call for every pending peer
     of a task — the tick's per-task batching) == per-pair can_add_edge,
