@@ -150,8 +150,14 @@ def _chained_kernel_per_call_ms(d) -> float:
     output, eps a traced 0.0, so XLA can neither fold nor overlap the
     chain), force completion with a D2H fetch, and difference two depths
     so the single tunnel round-trip cancels: (t(K1) - t(K0)) / (K1 - K0).
-    Unlike the pipelined marginal this cannot under-measure — every call
-    in the chain provably executed before the fetched value existed."""
+    Every call in the chain provably executed before the fetched value
+    existed, so dispatch overlap cannot under-time it — but it is still a
+    LOWER bound on a real tick's cost: the scan's working set (~11 MB)
+    fits in VMEM, so XLA can keep the perturbed arrays chip-resident
+    across iterations where a fresh tick re-streams them from HBM (a
+    run measured 10 us/call, under the ~14 us HBM floor for the same
+    arrays — VMEM residency is the only physical explanation). Published
+    as a bound; the headline prefers gated/pipelined measurements."""
     import jax
     import jax.numpy as jnp
 
@@ -485,13 +491,25 @@ def main() -> int:
     measurements["pipelined_marginal_raw_ms"] = round(min(raws), 4)
     measurements["pipelined_marginal_floored_ms"] = round(min(floors), 4)
 
+    # Headline preference, most- to least-representative of the real
+    # serving cost: (1) control-gated wall p50 in a good tunnel window;
+    # (2) the RAW pipelined marginal when it's above the 10 us overlap-
+    # artifact floor — it includes the H2D/HBM traffic a fresh tick pays;
+    # (3) the chained in-jit estimate — a LOWER bound (the scan can keep
+    # its working set VMEM-resident across iterations, which a real tick
+    # with fresh features cannot); (4) the floored marginal. Everything
+    # is published either way.
     if "control_gated_p50_ms" in measurements:
         p50 = measurements["control_gated_p50_ms"]
         method = "control_gated_p50"
         n_samples = measurements["control_gated_samples"]
+    elif measurements["pipelined_marginal_raw_ms"] >= 1e-2:
+        p50 = measurements["pipelined_marginal_raw_ms"]
+        method = "pipelined_steady_state"
+        n_samples = 5
     elif "chained_kernel_per_call_ms" in measurements:
         p50 = measurements["chained_kernel_per_call_ms"]
-        method = "chained_in_jit_kernel"
+        method = "chained_in_jit_kernel_lower_bound"
         n_samples = 5  # min over 5 timed runs per depth
     else:
         p50 = measurements["pipelined_marginal_floored_ms"]
