@@ -49,9 +49,9 @@ CONSOLE_HTML = """<!DOCTYPE html>
 <main id="main"></main>
 <script>
 "use strict";
-const GROUPS = ["clusters", "schedulers", "seed-peers", "peers", "jobs",
-                "applications", "models"];
-let token = null, user = null, tab = "clusters";
+const GROUPS = ["overview", "clusters", "schedulers", "seed-peers", "peers",
+                "jobs", "applications", "models"];
+let token = null, user = null, tab = "overview";
 
 async function api(method, path, body) {
   const headers = {"Content-Type": "application/json"};
@@ -106,6 +106,7 @@ function renderApp() {
 
 async function renderTab() {
   const main = document.getElementById("main");
+  if (tab === "overview") { main.replaceChildren(...await overview()); return; }
   const rows = await api("GET", tab);
   const children = [];
   if (tab === "jobs") children.push(preheatForm());
@@ -113,13 +114,65 @@ async function renderTab() {
     children.push(el("div", {class: "card"}, "no " + tab + " yet"));
   } else {
     const cols = [...new Set(rows.flatMap(r => Object.keys(r)))].slice(0, 9);
+    const extra = tab === "models" ? 1 : 0;
     children.push(el("table", {},
-      el("thead", {}, el("tr", {}, ...cols.map(c => el("th", {}, c)))),
+      el("thead", {}, el("tr", {}, ...cols.map(c => el("th", {}, c)),
+                         ...(extra ? [el("th", {}, "actions")] : []))),
       el("tbody", {}, ...rows.map(r => el("tr", {}, ...cols.map(c =>
         el("td", {}, r[c] === undefined ? "" :
-          (typeof r[c] === "object" ? JSON.stringify(r[c]) : r[c]))))))));
+          (typeof r[c] === "object" ? JSON.stringify(r[c]) : r[c]))),
+        ...(extra ? [el("td", {}, modelActions(r))] : []))))));
   }
   main.replaceChildren(...children);
+}
+
+function modelActions(row) {
+  // activate = the reference's version-policy flip (PATCH state: active)
+  if (row.state === "active") return el("span", {class: "muted"}, "active");
+  return el("button", {class: "go", onclick: async () => {
+    try { await api("PATCH", "models/" + row.id, {state: "active"}); renderApp(); }
+    catch (err) { alert(err); }
+  }}, "activate");
+}
+
+async function overview() {
+  // stat tiles + a scheduler-state bar, all through the public REST
+  // surface; auth failures must NOT render as healthy-looking zeros
+  const groups = GROUPS.filter(g => g !== "overview");
+  const results = await Promise.all(groups.map(g => api("GET", g).catch(err => {
+    if (String(err).includes("401")) throw err;
+    return [];
+  })));
+  const counts = Object.fromEntries(groups.map((g, i) => [g, results[i].length]));
+  const scheds = results[groups.indexOf("schedulers")];
+  const active = scheds.filter(s => s.state === "active").length;
+  const tiles = el("div", {style: "display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px"},
+    ...groups.map(g => el("div", {class: "card", style: "max-width:130px;text-align:center"},
+      el("div", {style: "font-size:26px;font-weight:700"}, counts[g]),
+      el("div", {class: "muted"}, g))));
+  const ns = "http://www.w3.org/2000/svg";
+  // SVG elements need the SVG namespace: el() uses createElement, which
+  // would yield an HTMLUnknownElement whose child rects never render
+  const svg = document.createElementNS(ns, "svg");
+  svg.setAttribute("width", "400"); svg.setAttribute("height", "28");
+  svg.setAttribute("role", "img");
+  svg.setAttribute("aria-label", active + " of " + scheds.length + " schedulers active");
+  const total = Math.max(scheds.length, 1);
+  const seg = (x, w, fill) => {
+    const r = document.createElementNS(ns, "rect");
+    r.setAttribute("x", x); r.setAttribute("y", 4);
+    r.setAttribute("width", w); r.setAttribute("height", 18);
+    r.setAttribute("rx", 4); r.setAttribute("fill", fill);
+    svg.appendChild(r);
+  };
+  seg(0, 400, "#dde1e7");
+  if (active) seg(0, 400 * active / total, "#2c7a4b");
+  const bar = el("div", {class: "card"},
+    el("h3", {style: "margin-top:0"}, "scheduler health"),
+    svg,
+    el("div", {class: "muted"}, active + " active / " + (scheds.length - active) +
+       " inactive of " + scheds.length));
+  return [tiles, bar];
 }
 
 function preheatForm() {

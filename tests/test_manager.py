@@ -634,6 +634,9 @@ def test_console_served_and_drives_api():
         with urllib.request.urlopen(req) as r:
             clusters = json.loads(r.read())
         assert [c["name"] for c in clusters] == ["c1"]
+        # the overview tab + model-activation affordances ship in the page
+        assert "overview" in html and "scheduler health" in html
+        assert "activate" in html and "PATCH" in html
     finally:
         rest.stop()
 
