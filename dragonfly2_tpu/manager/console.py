@@ -60,7 +60,11 @@ async function api(method, path, body) {
     method, headers, body: body === undefined ? undefined : JSON.stringify(body),
   });
   const data = await resp.json().catch(() => ({}));
-  if (!resp.ok) throw new Error(data.error || resp.status);
+  if (!resp.ok) {
+    const err = new Error(data.error || resp.status);
+    err.status = resp.status;  // message text alone can't signal auth
+    throw err;
+  }
   return data;
 }
 
@@ -138,12 +142,15 @@ function modelActions(row) {
 async function overview() {
   // stat tiles + a scheduler-state bar, all through the public REST
   // surface; auth failures must NOT render as healthy-looking zeros
+  const CAP = 1000;
   const groups = GROUPS.filter(g => g !== "overview");
-  const results = await Promise.all(groups.map(g => api("GET", g).catch(err => {
-    if (String(err).includes("401")) throw err;
-    return [];
-  })));
-  const counts = Object.fromEntries(groups.map((g, i) => [g, results[i].length]));
+  const results = await Promise.all(groups.map(g =>
+    api("GET", g + "?per_page=" + CAP).catch(err => {
+      if (err.status === 401) throw err;  // never render auth failure as zeros
+      return [];
+    })));
+  const counts = Object.fromEntries(groups.map((g, i) =>
+    [g, results[i].length >= CAP ? CAP + "+" : results[i].length]));
   const scheds = results[groups.indexOf("schedulers")];
   const active = scheds.filter(s => s.state === "active").length;
   const tiles = el("div", {style: "display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px"},

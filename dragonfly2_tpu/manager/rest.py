@@ -225,8 +225,20 @@ class ManagerREST:
                 return 200, svc.create_cluster(req.body)
             return 200, svc.db.create(table, req.body)
         if req.method == "GET" and not req.parts:
-            where = {k: v for k, v in req.body.items()} if req.body else None
-            return 200, svc.db.list(table, where)
+            # ?page=&per_page= pagination + query-by-example filters from
+            # the remaining query params (handlers' GORM listing parity;
+            # values compare as strings, matching the reference's query
+            # binding). Default per_page=100 used to silently truncate
+            # every list — and any count derived from it.
+            query = dict(req.query)
+            try:
+                page = max(int(query.pop("page", 1) or 1), 1)
+                per_page = min(int(query.pop("per_page", 100) or 100), 10_000)
+            except ValueError:
+                return 400, {"error": "page/per_page must be integers"}
+            where = {k: v for k, v in req.body.items()} if req.body else {}
+            where.update(query)
+            return 200, svc.db.list(table, where or None, page=page, per_page=per_page)
         if not req.parts:
             return 405, {"error": "method not allowed"}
         record_id = int(req.parts[0])

@@ -531,9 +531,16 @@ class SchedulerRPCServer:
                 return svc.tick()
 
         # The device call blocks; run it off-loop so streams stay live.
+        last_phases = svc.tick_phases[-1] if svc.tick_phases else None
         responses = await asyncio.to_thread(run)
         self._m_tick.labels().observe(time.perf_counter() - t0)
         self._m_batch.labels().observe(pending)
+        # identity check, not length: a tick with no device work appends
+        # nothing (and the deque's length saturates at its maxlen), so a
+        # length guard would double-count or go silent
+        if svc.tick_phases and svc.tick_phases[-1] is not last_phases:
+            for phase, ms in svc.tick_phases[-1].items():
+                self.metrics.schedule_phase.labels(phase).observe(ms / 1e3)
         await self._send_responses(responses)
 
     async def _send_responses(self, responses) -> None:

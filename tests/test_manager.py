@@ -331,6 +331,34 @@ def test_rest_duplicate_is_409_and_missing_404(rest_server):
     assert _http(rest_server, "GET", "/api/v1/applications/999", None, token)[0] == 404
 
 
+def test_rest_list_pagination_and_query_filters(rest_server):
+    """GET lists honor ?page/?per_page and treat remaining query params
+    as query-by-example filters (GORM listing parity) — the old fixed
+    per_page=100 silently truncated every list and every count derived
+    from one."""
+    _, out = _http(rest_server, "POST", "/api/v1/users/signin",
+                   {"name": "root", "password": "dragonfly"})
+    token = out["token"]
+    for i in range(130):
+        status, _ = _http(rest_server, "POST", "/api/v1/applications",
+                          {"name": f"app-{i:03d}", "tier": "a" if i % 2 else "b"}, token)
+        assert status == 200
+    status, rows = _http(rest_server, "GET", "/api/v1/applications?per_page=1000",
+                         None, token)
+    assert status == 200 and len(rows) == 130
+    status, rows = _http(rest_server, "GET", "/api/v1/applications", None, token)
+    assert len(rows) == 100  # documented default page size
+    status, page2 = _http(rest_server, "GET",
+                          "/api/v1/applications?page=2&per_page=100", None, token)
+    assert len(page2) == 30
+    status, odd = _http(rest_server, "GET", "/api/v1/applications?tier=a&per_page=1000",
+                        None, token)
+    assert len(odd) == 65 and all(r["tier"] == "a" for r in odd)
+    status, _ = _http(rest_server, "GET", "/api/v1/applications?per_page=bogus",
+                      None, token)
+    assert status == 400
+
+
 def test_rest_pat_flow_and_oapi(rest_server):
     _, out = _http(rest_server, "POST", "/api/v1/users/signin", {"name": "root", "password": "dragonfly"})
     token = out["token"]

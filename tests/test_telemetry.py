@@ -188,6 +188,11 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
     async def run():
         service = _scheduler_service(tmp_path)
         server = SchedulerRPCServer(service, tick_interval=0.01)
+        # the tick loop lives in the rpc server; without start() every
+        # mux-connected peer silently waited out the 10 s schedule
+        # timeout and back-sourced (shared service => ticks serve peers
+        # connected through either listener)
+        await server.start()
         mux_srv = MuxServer(server._serve_conn, metrics_registry=default_registry())
         host, port = await mux_srv.start()
         try:
@@ -203,9 +208,11 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
             assert "dragonfly_scheduler_host_traffic{" in text
             assert "dragonfly_scheduler_download_peer_duration_milliseconds_count" in text
             assert "dragonfly_dfdaemon_peer_task_total" in text
+            assert 'dragonfly_scheduler_tick_phase_seconds_count{phase="device_call"}' in text
             await d1.stop()
         finally:
             await mux_srv.stop()
+            await server.stop()
             origin.stop()
 
     asyncio.run(run())
