@@ -146,8 +146,24 @@ class Database:
         for key, value in (where or {}).items():
             if not _IDENT.fullmatch(key):
                 raise ValueError(f"bad filter key {key!r}")
-            clauses.append(f"json_extract(data,'$.{key}') = ?")
-            params.append(value)
+            expr = f"json_extract(data,'$.{key}')"
+            # SQLite compares 1 = '1' as FALSE, and REST query params
+            # arrive as strings — a numeric-looking string filter must
+            # still match integer-typed JSON fields (the reference's GORM
+            # binding is typed by the model and converts; this store is
+            # schemaless, so match either representation)
+            if isinstance(value, str):
+                try:
+                    as_num = int(value)
+                except ValueError:
+                    clauses.append(f"{expr} = ?")
+                    params.append(value)
+                else:
+                    clauses.append(f"({expr} = ? OR {expr} = ?)")
+                    params += [value, as_num]
+            else:
+                clauses.append(f"{expr} = ?")
+                params.append(value)
         sql = f"SELECT id, created_at, updated_at, data FROM {table}"
         if clauses:
             sql += " WHERE " + " AND ".join(clauses)

@@ -225,19 +225,10 @@ class ManagerREST:
                 return 200, svc.create_cluster(req.body)
             return 200, svc.db.create(table, req.body)
         if req.method == "GET" and not req.parts:
-            # ?page=&per_page= pagination + query-by-example filters from
-            # the remaining query params (handlers' GORM listing parity;
-            # values compare as strings, matching the reference's query
-            # binding). Default per_page=100 used to silently truncate
-            # every list — and any count derived from it.
-            query = dict(req.query)
             try:
-                page = max(int(query.pop("page", 1) or 1), 1)
-                per_page = min(int(query.pop("per_page", 100) or 100), 10_000)
-            except ValueError:
-                return 400, {"error": "page/per_page must be integers"}
-            where = {k: v for k, v in req.body.items()} if req.body else {}
-            where.update(query)
+                page, per_page, where = self._list_params(req)
+            except ValueError as e:
+                return 400, {"error": str(e)}
             return 200, svc.db.list(table, where or None, page=page, per_page=per_page)
         if not req.parts:
             return 405, {"error": "method not allowed"}
@@ -350,12 +341,35 @@ class ManagerREST:
                 return 200, {}
         return 405, {"error": "method not allowed"}
 
+    @staticmethod
+    def _list_params(req: _Request) -> tuple[int, int, dict]:
+        """?page/?per_page pagination (bounded BOTH ways — SQLite treats a
+        negative LIMIT as unlimited, so an unclamped per_page=-1 would
+        dump the whole table) + query-by-example filters from the
+        remaining query params (the handlers' GORM listing parity; the DB
+        layer matches numeric-looking strings against integer JSON
+        fields). The old fixed per_page=100 silently truncated every list
+        and any count derived from one."""
+        query = dict(req.query)
+        try:
+            page = max(int(query.pop("page", 1) or 1), 1)
+            per_page = min(max(int(query.pop("per_page", 100) or 100), 1), 10_000)
+        except ValueError:
+            raise ValueError("page/per_page must be integers") from None
+        where = {k: v for k, v in req.body.items()} if req.body else {}
+        where.update(query)
+        return page, per_page, where
+
     def _jobs(self, req: _Request) -> tuple[int, object]:
         svc = self.service
         if req.method == "POST" and not req.parts:
             return 200, svc.create_job(req.body)
         if req.method == "GET" and not req.parts:
-            return 200, svc.db.list("jobs")
+            try:
+                page, per_page, where = self._list_params(req)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            return 200, svc.db.list("jobs", where or None, page=page, per_page=per_page)
         job_id = int(req.parts[0])
         if req.method == "GET":
             return 200, svc.get_job(job_id)

@@ -357,6 +357,17 @@ def test_rest_list_pagination_and_query_filters(rest_server):
     status, _ = _http(rest_server, "GET", "/api/v1/applications?per_page=bogus",
                       None, token)
     assert status == 400
+    # a negative per_page must not become SQLite's LIMIT -1 (= unlimited)
+    status, rows = _http(rest_server, "GET", "/api/v1/applications?per_page=-1",
+                         None, token)
+    assert status == 200 and len(rows) == 1
+    # numeric-looking string filters match integer-typed JSON fields
+    # (SQLite would otherwise compare 1 = '1' as false and return [])
+    _http(rest_server, "POST", "/api/v1/applications",
+          {"name": "int-field-app", "priority": 7}, token)
+    status, pri = _http(rest_server, "GET", "/api/v1/applications?priority=7",
+                        None, token)
+    assert status == 200 and [r["name"] for r in pri] == ["int-field-app"]
 
 
 def test_rest_pat_flow_and_oapi(rest_server):

@@ -1,0 +1,103 @@
+"""Property test over the ENTIRE wire-message surface: every dataclass
+registered with the codec (cluster v2 messages, the v1 dialect, manager
+RPC, inference RPC, health) must roundtrip decode(encode(x)) == x for
+randomized field values generated from its own type hints — so a new or
+changed message type is covered the moment it is registered, without a
+hand-written roundtrip test (the reference gets this from protobuf
+codegen; this repo's codec is hand-rolled, so the property stands in)."""
+
+import dataclasses
+import enum
+import typing
+
+import numpy as np
+import pytest
+
+# importing the servers registers every message set with the codec
+import dragonfly2_tpu.manager.rpc  # noqa: F401
+import dragonfly2_tpu.rpc.inference  # noqa: F401
+import dragonfly2_tpu.rpc.server  # noqa: F401
+from dragonfly2_tpu.rpc import wire
+
+
+def _random_value(hint, rng: np.random.Generator, depth: int = 0):
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if not args or rng.random() < 0.3:
+            return None
+        return _random_value(args[0], rng, depth)
+    if origin in (list, tuple):
+        (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
+        n = 0 if depth > 2 else int(rng.integers(0, 3))
+        seq = [_random_value(inner, rng, depth + 1) for _ in range(n)]
+        return seq if origin is list else tuple(seq)
+    if origin is dict:
+        kt, vt = (typing.get_args(hint) + (typing.Any, typing.Any))[:2]
+        if depth > 2:
+            return {}
+        return {
+            str(_random_value(str, rng, depth + 1)) + str(i):
+                _random_value(vt, rng, depth + 1)
+            for i in range(int(rng.integers(0, 3)))
+        }
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return _random_instance(hint, rng, depth + 1)
+        if issubclass(hint, enum.Enum):
+            members = list(hint)
+            return members[int(rng.integers(len(members)))]
+        if hint is bool:
+            return bool(rng.random() < 0.5)
+        if hint is int:
+            return int(rng.integers(-(1 << 40), 1 << 40))
+        if hint is float:
+            return float(np.round(rng.standard_normal() * 1e6, 6))
+        if hint is str:
+            return "s" + str(int(rng.integers(1 << 30)))
+        if hint is bytes:
+            return bytes(rng.integers(0, 256, int(rng.integers(0, 16)), dtype=np.uint8))
+    return None  # typing.Any and anything unhandled
+
+
+def _random_instance(cls, rng: np.random.Generator, depth: int = 0):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        kwargs[f.name] = _random_value(hints.get(f.name, typing.Any), rng, depth)
+    return cls(**kwargs)
+
+
+def _registered_types():
+    # _REGISTRY is the codec's single source of truth
+    return sorted(wire._REGISTRY.items())
+
+
+@pytest.mark.parametrize("name,cls", _registered_types(), ids=lambda v: v if isinstance(v, str) else "")
+def test_every_registered_message_roundtrips(name, cls):
+    import zlib
+
+    # crc32, not hash(): str hashing is salted per process, which would
+    # make a failing case unreproducible across runs
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    for _ in range(5):
+        msg = _random_instance(cls, rng)
+        try:
+            encoded = wire.encode(msg)
+        except ValueError as e:
+            if "frame too large" in str(e):
+                continue  # randomized payload overshot the frame cap
+            raise
+        decoded = wire.decode(encoded[4:])
+        assert decoded == msg, f"{name} failed roundtrip"
+
+
+def test_registry_covers_the_known_surfaces():
+    names = set(wire._REGISTRY)
+    for expected in (
+        "RegisterPeerRequest", "NormalTaskResponse", "TriggerSeedRequest",
+        "V1PeerTaskRequest", "V1PeerPacket",
+        "HealthCheckRequest",
+    ):
+        assert expected in names, expected
+    assert len(names) > 40, sorted(names)
