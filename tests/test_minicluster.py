@@ -132,6 +132,51 @@ def test_p2p_distribution(tmp_path, origin):
     asyncio.run(run())
 
 
+def test_tiny_and_small_size_scopes_end_to_end(tmp_path):
+    """TINY (<=128 B) and SMALL (<= one piece) files through the REAL
+    daemon + scheduler path (the conductor's size-scope handling,
+    peertask_conductor.go + handleRegisterPeerRequest fast paths): exact
+    bytes, single-piece metadata, and P2P reuse for a second peer."""
+
+    async def run():
+        for payload, piece_length, label in (
+            (b"tiny!" * 20, 4 << 20, "tiny"),        # 100 B -> TINY
+            (bytes(range(256)) * 12, 4096, "small"),  # 3 KiB <= 4 KiB piece
+        ):
+            origin = _CountingFileServer(payload)
+            service = _scheduler_service(tmp_path / label)
+            server = SchedulerRPCServer(service, tick_interval=0.01)
+            host, port = await server.start()
+            sha = hashlib.sha256(payload).hexdigest()
+            daemons = []
+            try:
+                d1 = Daemon(tmp_path / f"{label}-1", [(host, port)], hostname=f"{label}-1")
+                await d1.start()
+                daemons.append(d1)
+                ts1 = await d1.download(origin.url(), piece_length=piece_length)
+                with open(ts1.data_path, "rb") as f:
+                    assert hashlib.sha256(f.read()).hexdigest() == sha, label
+                assert len(ts1.meta.pieces) == 1, (label, ts1.meta.pieces)
+                gets = origin.get_count
+
+                d2 = Daemon(tmp_path / f"{label}-2", [(host, port)], hostname=f"{label}-2")
+                await d2.start()
+                daemons.append(d2)
+                ts2 = await d2.download(
+                    origin.url(), piece_length=piece_length, back_source_allowed=False
+                )
+                with open(ts2.data_path, "rb") as f:
+                    assert hashlib.sha256(f.read()).hexdigest() == sha, label
+                assert origin.get_count == gets, f"{label}: second peer hit origin"
+            finally:
+                for d in daemons:
+                    await d.stop()
+                await server.stop()
+                origin.stop()
+
+    asyncio.run(run())
+
+
 def test_child_recovers_when_parent_vanishes(tmp_path, origin):
     """Failure recovery through the conductor's full retry chain
     (peertask_conductor.go error path): the scheduled parent crashed
