@@ -23,6 +23,7 @@ from dragonfly2_tpu.client.upload import UploadServer
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc.client import SchedulerClientPool
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import daemon_series, register_version
 from dragonfly2_tpu.utils import hoststat, idgen
 from dragonfly2_tpu.utils.gc import GC, Task as GCTask
@@ -270,23 +271,33 @@ class Daemon:
         back_source_allowed: bool, schedule_timeout: float,
         headers: dict[str, str] | None = None,
     ) -> TaskStorage:
-        conn = await self.pool.for_task(task_id)
-        await self._ensure_announced(conn)
-        conductor = PeerTaskConductor(
-            conn=conn,
-            storage=self.storage,
-            host=self.host_info(),
-            peer_id=idgen.peer_id_v2(),
-            task_id=task_id,
-            url=url,
+        # one span per task download — the client-boundary trace the
+        # reference emits around its peer-task lifecycle (dfdaemon spans);
+        # exported when an --otlp-endpoint exporter is registered, a
+        # cheap context object otherwise
+        with default_tracer().span(
+            "dfdaemon.peer_task", task_id=task_id, url=url,
             piece_length=piece_length,
-            workers=workers,
-            shaper=self.shaper,
-            back_source_allowed=back_source_allowed,
-            schedule_timeout=schedule_timeout,
-            headers=headers,
-        )
-        return await conductor.run()
+        ) as span:
+            conn = await self.pool.for_task(task_id)
+            await self._ensure_announced(conn)
+            conductor = PeerTaskConductor(
+                conn=conn,
+                storage=self.storage,
+                host=self.host_info(),
+                peer_id=idgen.peer_id_v2(),
+                task_id=task_id,
+                url=url,
+                piece_length=piece_length,
+                workers=workers,
+                shaper=self.shaper,
+                back_source_allowed=back_source_allowed,
+                schedule_timeout=schedule_timeout,
+                headers=headers,
+            )
+            ts = await conductor.run()
+            span.attributes["pieces"] = len(ts.meta.pieces)
+            return ts
 
     async def export_file(self, ts: TaskStorage, output: str | pathlib.Path) -> None:
         """Copy a completed task's bytes to a user path (dfget output)."""

@@ -19,6 +19,7 @@ import time
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import mux, wire
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import (
     HOST_TRAFFIC_DOWNLOAD,
     HOST_TRAFFIC_UPLOAD,
@@ -532,7 +533,8 @@ class SchedulerRPCServer:
 
         # The device call blocks; run it off-loop so streams stay live.
         last_phases = svc.tick_phases[-1] if svc.tick_phases else None
-        responses = await asyncio.to_thread(run)
+        with default_tracer().span("scheduler.tick", pending=pending):
+            responses = await asyncio.to_thread(run)
         self._m_tick.labels().observe(time.perf_counter() - t0)
         self._m_batch.labels().observe(pending)
         # identity check, not length: a tick with no device work appends

@@ -64,7 +64,14 @@ class Tracer:
         self._lock = threading.Lock()
 
     def add_exporter(self, fn: Callable[[Span], None]) -> None:
-        self._exporters.append(fn)
+        with self._lock:
+            self._exporters.append(fn)
+
+    def remove_exporter(self, fn: Callable[[Span], None]) -> None:
+        """Detach by identity; the process-global tracer outlives tests
+        and short-lived consumers, which must not leak exporters into it."""
+        with self._lock:
+            self._exporters = [f for f in self._exporters if f is not fn]
 
     def export_to_memory(self) -> list[Span]:
         """Attach an in-memory exporter; returns the live list of spans."""

@@ -199,9 +199,17 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
             d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="mh-1")
             await d1.start()
             await d1.download(origin.url(), piece_length=32 * 1024)
-            text = await asyncio.to_thread(
-                lambda: _rq.urlopen(f"http://{host}:{port}/metrics").read().decode()
-            )
+            # download() resolves when the bytes land; the daemon's final
+            # DownloadPeer*Finished report rides the announce stream right
+            # after, so the duration series can trail the return by a beat
+            text = ""
+            for _ in range(50):
+                text = await asyncio.to_thread(
+                    lambda: _rq.urlopen(f"http://{host}:{port}/metrics").read().decode()
+                )
+                if "dragonfly_scheduler_download_peer_duration_milliseconds_count" in text:
+                    break
+                await asyncio.sleep(0.1)
             assert "dragonfly_scheduler_register_peer_total{" in text
             assert "dragonfly_scheduler_traffic{" in text
             assert 'type="back_to_source"' in text
@@ -216,6 +224,50 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
             origin.stop()
 
     asyncio.run(run())
+
+
+def test_spans_emitted_at_live_service_boundaries(tmp_path):
+    """A real download emits boundary spans (dfdaemon.peer_task around
+    the conductor lifecycle, scheduler.tick around the device call) —
+    the tracing row's claim, proven on live traffic instead of
+    hand-created spans."""
+    import asyncio
+
+    from test_minicluster import _CountingFileServer, _scheduler_service
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+    from dragonfly2_tpu.telemetry.tracing import default_tracer
+
+    captured = []
+    exporter = captured.append  # bind ONCE so removal-by-identity works
+    tracer = default_tracer()
+    tracer.add_exporter(exporter)
+    origin = _CountingFileServer(bytes(i % 256 for i in range(120_000)))
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="tr-1")
+            await d1.start()
+            await d1.download(origin.url(), piece_length=32 * 1024)
+            await d1.stop()
+        finally:
+            await server.stop()
+            origin.stop()
+
+    try:
+        asyncio.run(run())
+        names = {s.name for s in captured}
+        assert "dfdaemon.peer_task" in names, names
+        assert "scheduler.tick" in names, names
+        task_span = next(s for s in captured if s.name == "dfdaemon.peer_task")
+        assert task_span.attributes["pieces"] >= 1
+        assert task_span.end_ns > task_span.start_ns
+    finally:
+        # default_tracer() is process-global: leave no exporter behind
+        tracer.remove_exporter(exporter)
 
 
 def test_otlp_exporter_ships_ingestible_batches(tmp_path):
