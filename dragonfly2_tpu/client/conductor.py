@@ -143,6 +143,17 @@ class PeerTaskConductor:
                 await self._back_to_source(ts)
                 return
             if isinstance(response, msg.ScheduleFailure):
+                if response.code == "Unavailable":
+                    # synthesized by the client read loop when the announce
+                    # stream itself died (rpc/client.py _read_loop) — not a
+                    # scheduling verdict. Surface it as retryable so the
+                    # daemon redials the restarted scheduler instead of
+                    # silently abandoning P2P for the origin (or failing
+                    # permanently when back-source is disallowed).
+                    self._error = dferrors.Unavailable(
+                        f"scheduler stream died: {response.description}"
+                    )
+                    return
                 if self.back_source_allowed:
                     await self._back_to_source(ts)
                     return

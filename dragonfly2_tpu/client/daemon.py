@@ -25,7 +25,7 @@ from dragonfly2_tpu.rpc.client import SchedulerClientPool
 from dragonfly2_tpu.telemetry import default_registry
 from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import daemon_series, register_version
-from dragonfly2_tpu.utils import hoststat, idgen
+from dragonfly2_tpu.utils import dferrors, hoststat, idgen
 from dragonfly2_tpu.utils.gc import GC, Task as GCTask
 
 logger = logging.getLogger(__name__)
@@ -118,7 +118,6 @@ class Daemon:
         self._seed_tasks: list[asyncio.Task] = []
         self._seed_downloads: set[asyncio.Task] = set()
         self._running: dict[str, asyncio.Task] = {}  # task dedup
-        self._announced: set[str] = set()  # scheduler addrs we announced to
 
     @property
     def is_seed(self) -> bool:
@@ -279,46 +278,94 @@ class Daemon:
             "dfdaemon.peer_task", task_id=task_id, url=url,
             piece_length=piece_length,
         ) as span:
-            conn = await self.pool.for_task(task_id)
-            await self._ensure_announced(conn)
-            conductor = PeerTaskConductor(
-                conn=conn,
-                storage=self.storage,
-                host=self.host_info(),
-                peer_id=idgen.peer_id_v2(),
-                task_id=task_id,
-                url=url,
-                piece_length=piece_length,
-                workers=workers,
-                shaper=self.shaper,
-                back_source_allowed=back_source_allowed,
-                schedule_timeout=schedule_timeout,
-                headers=headers,
-            )
-            ts = await conductor.run()
-            span.attributes["pieces"] = len(ts.meta.pieces)
-            return ts
+            last_err: BaseException | None = None
+            for attempt in range(2):
+                try:
+                    # dial + announce INSIDE the retried region: during a
+                    # scheduler restart the redial itself is what fails
+                    # (ConnectionRefusedError while the port rebinds)
+                    conn = await self.pool.for_task(task_id)
+                    await self._ensure_announced(conn)
+                    conductor = PeerTaskConductor(
+                        conn=conn,
+                        storage=self.storage,
+                        host=self.host_info(),
+                        peer_id=idgen.peer_id_v2(),
+                        task_id=task_id,
+                        url=url,
+                        piece_length=piece_length,
+                        workers=workers,
+                        shaper=self.shaper,
+                        back_source_allowed=back_source_allowed,
+                        schedule_timeout=schedule_timeout,
+                        headers=headers,
+                    )
+                    ts = await conductor.run()
+                except (
+                    OSError,  # ConnectionError and friends, dial refusals
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,  # bounded pool dial
+                    dferrors.Unavailable,
+                ) as e:
+                    # the announce stream died mid-task (scheduler restart
+                    # or network cut): the pool evicts the dead connection
+                    # on the next for_task, so retry ONCE as a fresh peer —
+                    # already-written pieces resume from the task storage
+                    # (the reference rides gRPC channel reconnect here)
+                    last_err = e
+                    span.attributes["retried"] = True
+                    await asyncio.sleep(0.5)  # let the scheduler rebind
+                    continue
+                span.attributes["pieces"] = len(ts.meta.pieces)
+                return ts
+            assert last_err is not None
+            raise last_err
 
     async def export_file(self, ts: TaskStorage, output: str | pathlib.Path) -> None:
         """Copy a completed task's bytes to a user path (dfget output)."""
         await asyncio.to_thread(shutil.copyfile, ts.data_path, output)
 
     async def _ensure_announced(self, conn) -> None:
-        key = f"{conn.host}:{conn.port}"
-        if key in self._announced:
+        # Announced-ness is a property of the CONNECTION, not the address:
+        # after a scheduler restart the pool redials, the new server has
+        # fresh state, and an address-keyed set would skip the re-announce
+        # forever (stranding seed-host registration in particular).
+        if conn.announced:
             return
         await conn.send(msg.AnnounceHostRequest(host=self.host_info()))
-        self._announced.add(key)
+        conn.announced = True
 
     # ---------------------------------------------------------- seed peer
 
     async def _seed_loop(self, conn) -> None:
-        """Serve TriggerSeedRequests from one scheduler connection: back-
-        source the task so the cluster has a parent (ObtainSeeds). Spawned
-        downloads are strongly referenced (the loop holds only weak refs)
-        and cancelled on stop."""
+        """Serve TriggerSeedRequests from one scheduler ADDRESS: back-
+        source the task so the cluster has a parent (ObtainSeeds). Bound
+        to the scheduler, not the connection — when the stream dies
+        (scheduler restart) the loop redials and RE-ANNOUNCES, otherwise a
+        restarted scheduler's triggers would be enqueued on a connection
+        nobody reads and preheat would be dead forever. Spawned downloads
+        are strongly referenced (the loop holds only weak refs) and
+        cancelled on stop."""
+        host, port = conn.host, conn.port
         while True:
-            trigger = await conn.seed_triggers.get()
+            if conn.is_closed:
+                try:
+                    conn = await self.pool.for_address(host, port)
+                    await self._ensure_announced(conn)
+                except LookupError:
+                    # dynconfig removed this scheduler from the active
+                    # set: the seed loop must die with it, not resurrect
+                    # a decommissioned scheduler every grace period
+                    logger.info("seed loop for %s:%d ending: scheduler "
+                                "left the active set", host, port)
+                    return
+                except (OSError, asyncio.TimeoutError):
+                    await asyncio.sleep(2.0)  # scheduler still down
+                    continue
+            try:
+                trigger = await asyncio.wait_for(conn.seed_triggers.get(), timeout=2.0)
+            except asyncio.TimeoutError:
+                continue  # periodic liveness recheck
             task = asyncio.create_task(self._obtain_seed(trigger))
             self._seed_downloads.add(task)
             task.add_done_callback(self._seed_downloads.discard)

@@ -38,6 +38,19 @@ class SchedulerConnection:
         self.seed_triggers: asyncio.Queue = asyncio.Queue()
         self._reader_task: asyncio.Task | None = None
         self._send_lock = asyncio.Lock()
+        # set by the daemon once AnnounceHost was sent ON THIS connection
+        # (announced-ness cannot outlive the connection: a restarted
+        # scheduler has fresh state)
+        self.announced = False
+
+    @property
+    def is_closed(self) -> bool:
+        """True once the transport is gone (peer restart, network cut) —
+        the pool uses this to evict dead cached connections and redial,
+        the behavior the reference gets from gRPC channel reconnects."""
+        if self._writer is None or self._reader is None:
+            return False  # never connected; nothing to evict
+        return self._writer.is_closing() or self._reader.at_eof()
 
     async def connect(self) -> "SchedulerConnection":
         from dragonfly2_tpu.utils import vsock as vsock_mod
@@ -53,8 +66,29 @@ class SchedulerConnection:
             self._reader, self._writer = await asyncio.open_connection(
                 self.host, self.port, ssl=self.ssl_context
             )
+        self._enable_tcp_keepalive()
         self._reader_task = asyncio.create_task(self._read_loop())
         return self
+
+    def _enable_tcp_keepalive(self) -> None:
+        """Kernel keepalives (~60 s to declare death) so a SILENT network
+        cut — no FIN/RST: power loss, stateful firewall drop — surfaces
+        as EOF on the read loop and flips `is_closed`. A mostly-idle seed
+        connection would otherwise stay half-open forever and never learn
+        its scheduler died (grpc's keepalive pings play this role for the
+        reference)."""
+        import socket as _socket
+
+        sock = self._writer.get_extra_info("socket") if self._writer else None
+        if sock is None:
+            return
+        try:
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPIDLE, 30)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPINTVL, 10)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_KEEPCNT, 3)
+        except (OSError, AttributeError):
+            pass  # non-TCP transports (vsock) / platforms without the knobs
 
     async def close(self) -> None:
         if self._reader_task:
@@ -175,6 +209,22 @@ class SchedulerClientPool:
         key = ring.pick(task_id)
         if key is None:
             raise RuntimeError("scheduler ring is empty")
+        return await self._get(key, addr)
+
+    DIAL_TIMEOUT_S = 5.0
+
+    async def for_address(self, host: str, port: int) -> SchedulerConnection:
+        """Live connection to a SPECIFIC scheduler (seed loops are bound
+        to the scheduler that owns them, not to a task hash). Raises
+        LookupError when that scheduler has left the active set — callers
+        must NOT resurrect schedulers dynconfig decommissioned."""
+        key = f"{host}:{port}"
+        _, addr = self._state
+        if key not in addr:
+            raise LookupError(f"scheduler {key} no longer in the active set")
+        return await self._get(key, addr)
+
+    async def _get(self, key: str, addr: dict) -> SchedulerConnection:
         async with self._lock:
             import time as _time
 
@@ -192,11 +242,31 @@ class SchedulerClientPool:
                 except Exception:  # noqa: BLE001 - best-effort teardown
                     pass
             conn = self._conns.get(key)
-            if conn is None:
-                host, port = addr[key]
-                conn = await SchedulerConnection(host, port, ssl_context=self.ssl_context).connect()
-                self._conns[key] = conn
-            return conn
+            if conn is not None and conn.is_closed:
+                # scheduler restarted / connection died: evict and redial
+                self._conns.pop(key, None)
+                try:
+                    await conn.close()
+                except Exception:  # noqa: BLE001 - already dead
+                    pass
+                conn = None
+            if conn is not None:
+                return conn
+        # Dial OUTSIDE the pool lock, bounded: one blackholed scheduler
+        # (SYN drop after its connection died) must not stall every
+        # download to the healthy ones behind this lock for the kernel's
+        # multi-minute connect timeout.
+        host, port = addr[key]
+        fresh = SchedulerConnection(host, port, ssl_context=self.ssl_context)
+        await asyncio.wait_for(fresh.connect(), timeout=self.DIAL_TIMEOUT_S)
+        async with self._lock:
+            raced = self._conns.get(key)
+            if raced is not None and not raced.is_closed:
+                # another coroutine dialed while we were; keep one
+                await fresh.close()
+                return raced
+            self._conns[key] = fresh
+            return fresh
 
     def connections(self) -> list[SchedulerConnection]:
         return list(self._conns.values())

@@ -167,6 +167,7 @@ def test_pool_update_swaps_atomically_and_prunes_connections():
 
         class FakeConn:
             closed = False
+            is_closed = False  # pool liveness probe (SchedulerConnection)
 
             async def close(self):
                 self.closed = True

@@ -268,6 +268,55 @@ def test_child_rejects_corrupt_parent_piece(tmp_path, origin):
     asyncio.run(run())
 
 
+def test_daemon_survives_scheduler_restart(tmp_path, origin):
+    """The daemon's pooled announce connection dies when its scheduler
+    restarts; the pool must evict the dead connection, redial, and
+    RE-ANNOUNCE on the new connection (announced-ness is per connection,
+    not per address) so the next download just works — the resilience the
+    reference gets from gRPC channel reconnects. Without the eviction the
+    daemon was permanently broken after any scheduler restart."""
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        sha = hashlib.sha256(origin.payload).hexdigest()
+        d = Daemon(tmp_path / "d", [(host, port)], hostname="restart-peer")
+        server2 = None
+        try:
+            await d.start()
+            ts1 = await d.download(origin.url(), piece_length=64 * 1024)
+            with open(ts1.data_path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == sha
+
+            await server.stop()  # scheduler crashes/restarts, same port
+            server2 = SchedulerRPCServer(
+                _scheduler_service(tmp_path / "s2"), host=host, port=port,
+                tick_interval=0.01,
+            )
+            await server2.start()
+
+            payload2 = bytes(reversed(origin.payload))
+            origin2 = _CountingFileServer(payload2)
+            try:
+                ts2 = await asyncio.wait_for(
+                    d.download(origin2.url(), piece_length=64 * 1024), 40
+                )
+                with open(ts2.data_path, "rb") as f:
+                    got = hashlib.sha256(f.read()).hexdigest()
+                assert got == hashlib.sha256(payload2).hexdigest()
+                # the fresh scheduler really was re-announced + re-registered
+                assert server2.service.counts()["hosts"] >= 1
+            finally:
+                origin2.stop()
+        finally:
+            await d.stop()
+            if server2 is not None:
+                await server2.stop()
+
+    asyncio.run(run())
+
+
 def test_probe_cycle_over_rpc(tmp_path, origin):
     async def run():
         service = _scheduler_service(tmp_path)
