@@ -238,6 +238,13 @@ class JobManager:
             result.detail["task_states"] = [s.name for s in states]
         elif all(s == TaskState.SUCCEEDED for s in states):
             result.state = JobState.SUCCESS
+            # SUCCESS is the one truly terminal outcome (the early return
+            # above never recomputes it), so its latch bookkeeping is dead
+            # weight from here on — without this pop the per-task maps
+            # grow for every job over the manager's lifetime (ADVICE r4
+            # low). FAILURE/EXPIRED keep their latches: both keep
+            # recomputing because a retried seed can still recover.
+            self._latches.pop(result.job_id, None)
         elif expired:
             result.state = JobState.EXPIRED
             result.detail["task_states"] = [s.name for s in states]

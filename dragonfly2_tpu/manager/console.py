@@ -144,18 +144,25 @@ async function overview() {
   // surface; auth failures must NOT render as healthy-looking zeros
   const CAP = 1000;
   const groups = GROUPS.filter(g => g !== "overview");
+  // every non-OK fetch (500, network) marks its tile "?" instead of
+  // rendering 0 — a broken manager must not look like an empty-but-
+  // healthy cluster (ADVICE r4 low); 401 still aborts to the login view
+  const failed = {};
   const results = await Promise.all(groups.map(g =>
     api("GET", g + "?per_page=" + CAP).catch(err => {
       if (err.status === 401) throw err;  // never render auth failure as zeros
+      failed[g] = String(err);
       return [];
     })));
   const counts = Object.fromEntries(groups.map((g, i) =>
-    [g, results[i].length >= CAP ? CAP + "+" : results[i].length]));
+    [g, failed[g] ? "?" : (results[i].length >= CAP ? CAP + "+" : results[i].length)]));
   const scheds = results[groups.indexOf("schedulers")];
   const active = scheds.filter(s => s.state === "active").length;
   const tiles = el("div", {style: "display:flex;gap:12px;flex-wrap:wrap;margin-bottom:16px"},
-    ...groups.map(g => el("div", {class: "card", style: "max-width:130px;text-align:center"},
-      el("div", {style: "font-size:26px;font-weight:700"}, counts[g]),
+    ...groups.map(g => el("div", {class: "card", style: "max-width:130px;text-align:center",
+        ...(failed[g] ? {title: failed[g]} : {})},
+      el("div", {style: "font-size:26px;font-weight:700" +
+        (failed[g] ? ";color:#b4231f" : "")}, counts[g]),
       el("div", {class: "muted"}, g))));
   const ns = "http://www.w3.org/2000/svg";
   // SVG elements need the SVG namespace: el() uses createElement, which
@@ -177,8 +184,10 @@ async function overview() {
   const bar = el("div", {class: "card"},
     el("h3", {style: "margin-top:0"}, "scheduler health"),
     svg,
-    el("div", {class: "muted"}, active + " active / " + (scheds.length - active) +
-       " inactive of " + scheds.length));
+    el("div", {class: "muted"}, failed["schedulers"]
+       ? "unavailable: " + failed["schedulers"]
+       : active + " active / " + (scheds.length - active) +
+         " inactive of " + scheds.length));
   return [tiles, bar];
 }
 

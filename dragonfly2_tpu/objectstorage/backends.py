@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 import pathlib
 import shutil
 
@@ -81,6 +82,27 @@ class FilesystemBackend:
         # etag sidecar: listings must not re-hash every object's bytes
         _etag_path(path).write_text(hashlib.md5(data).hexdigest())
         return self.get_object_metadata(bucket, key)
+
+    def put_object_if_absent(self, bucket: str, key: str, data: bytes) -> bool:
+        """Atomic create-if-missing (the S3 `If-None-Match: *` conditional
+        PUT): returns False, writing nothing, when the key already exists.
+        The bytes are staged to a tmp file and os.link'd into place —
+        link fails if the target exists (the CAS) and publishes the fully
+        written file in one step, so a concurrent reader can never observe
+        a half-written object (a direct O_EXCL open would expose empty/
+        partial bytes between create and close)."""
+        path = self._object_path(bucket, key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f"{path.name}.{os.getpid()}.{id(data):x}.tmp")
+        tmp.write_bytes(data)
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            return False
+        finally:
+            tmp.unlink(missing_ok=True)
+        _etag_path(path).write_text(hashlib.md5(data).hexdigest())
+        return True
 
     def get_object(self, bucket: str, key: str, range_: tuple[int, int] | None = None) -> bytes:
         path = self._object_path(bucket, key)

@@ -97,6 +97,7 @@ class _RemoteBackend:
         headers: dict | None = None,
         body: bytes = b"",
         want_body: bool = True,
+        conflict_is_exists: bool = False,
     ):
         url = self._url(bucket, key, query)
         signed = self._sign(method, url, dict(headers or {}), body, bucket, key, query)
@@ -116,6 +117,16 @@ class _RemoteBackend:
                 raise dferrors.NotFound(f"{method} {bucket}/{key}: {detail or e}") from e
             if e.code in (401, 403):
                 raise dferrors.PermissionDenied(
+                    f"{method} {bucket}/{key}: {detail or e}"
+                ) from e
+            if conflict_is_exists and e.code in (409, 412):
+                # Only a request that CARRIED a conditional-create header
+                # reads conflict as "key exists": 412 PreconditionFailed
+                # (S3 If-None-Match), 409 FileAlreadyExists (OSS/OBS
+                # forbid-overwrite). An unscoped mapping would turn e.g.
+                # 409 BucketNotEmpty on DELETE into a nonsense
+                # AlreadyExists.
+                raise dferrors.AlreadyExists(
                     f"{method} {bucket}/{key}: {detail or e}"
                 ) from e
             raise dferrors.Unavailable(f"{method} {bucket}/{key}: {detail or e}") from e
@@ -164,6 +175,26 @@ class _RemoteBackend:
             etag=headers.get("ETag", "").strip('"'),
             last_modified_at=0.0,
         )
+
+    # header carrying the create-if-absent condition; vendor-specific
+    # (S3: If-None-Match per the 2024 conditional-write API; OSS/OBS
+    # ignore If-None-Match on PUT and use their forbid-overwrite headers,
+    # answering 409 FileAlreadyExists)
+    _conditional_create_header = ("If-None-Match", "*")
+
+    def put_object_if_absent(self, bucket: str, key: str, data: bytes) -> bool:
+        """Conditional create: the PUT carries the vendor's create-if-
+        absent header; an existing key answers 412 (S3) / 409 (OSS/OBS),
+        both mapped to AlreadyExists."""
+        name, value = self._conditional_create_header
+        try:
+            self._request(
+                "PUT", bucket, key, headers={name: value}, body=data,
+                conflict_is_exists=True,
+            )
+        except dferrors.AlreadyExists:
+            return False
+        return True
 
     def get_object(
         self, bucket: str, key: str, range_: tuple[int, int] | None = None
@@ -330,6 +361,7 @@ class _HeaderStyleBackend(_RemoteBackend):
 
 class OSSBackend(_HeaderStyleBackend):
     _scheme = "OSS"
+    _conditional_create_header = ("x-oss-forbid-overwrite", "true")
 
     def _copy_source_header(self) -> str:
         return "x-oss-copy-source"
@@ -337,6 +369,7 @@ class OSSBackend(_HeaderStyleBackend):
 
 class OBSBackend(_HeaderStyleBackend):
     _scheme = "OBS"
+    _conditional_create_header = ("x-obs-forbid-overwrite", "true")
 
     def _copy_source_header(self) -> str:
         return "x-obs-copy-source"

@@ -95,44 +95,86 @@ class BucketModelRegistry:
         if model_type not in (MODEL_TYPE_GNN, MODEL_TYPE_MLP, MODEL_TYPE_ATTENTION):
             raise ValueError(f"unknown model type {model_type!r}")
         mid = make_model_id(name, scheduler_host_id)
-        versions = self.list_versions(mid)
-        next_version = max((v.version for v in versions), default=0) + 1
+        # Version allocation is a conditional create (`If-None-Match: *` /
+        # O_EXCL): the version.json RESERVES the number before any params
+        # bytes move, so two publishers racing on one bucket get distinct
+        # versions instead of silently overwriting each other (ADVICE r4
+        # medium; the reference serializes this through the manager DB's
+        # auto-increment). A reader can briefly see the reserved INACTIVE
+        # version before params.msgpack lands; only activate() makes a
+        # version servable, and the publisher activates only after this
+        # method returns.
+        next_version = max(
+            (v.version for v in self.list_versions(mid)), default=0
+        ) + 1
+        while True:
+            mv = ModelVersion(
+                model_id=mid,
+                name=name,
+                type=model_type,
+                version=next_version,
+                state=STATE_INACTIVE,
+                evaluation=evaluation,
+                scheduler_host_id=scheduler_host_id,
+                created_at=time.time(),
+                metadata=metadata or {},
+            )
+            reserved = self.backend.put_object_if_absent(
+                self.bucket,
+                self._key(mid, next_version, "version.json"),
+                json.dumps(dataclasses.asdict(mv), indent=2).encode(),
+            )
+            if reserved:
+                break
+            next_version += 1
         blob = serialization.msgpack_serialize(jax.device_get(params))
         self.backend.put_object(
             self.bucket, self._key(mid, next_version, "params.msgpack"), blob
         )
-        mv = ModelVersion(
-            model_id=mid,
-            name=name,
-            type=model_type,
-            version=next_version,
-            state=STATE_INACTIVE,
-            evaluation=evaluation,
-            scheduler_host_id=scheduler_host_id,
-            created_at=time.time(),
-            metadata=metadata or {},
+        self.backend.put_object_if_absent(
+            self.bucket,
+            self._key(mid, "model.json"),
+            json.dumps(
+                {"model_id": mid, "name": name, "type": model_type,
+                 "active_version": None},
+            ).encode(),
         )
-        self._put_json(dataclasses.asdict(mv), mid, next_version, "version.json")
-        if self._get_json(mid, "model.json") is None:
-            self._put_json(
-                {"model_id": mid, "name": name, "type": model_type, "active_version": None},
-                mid, "model.json",
-            )
         return mv
 
     def activate(self, model_id: str, version: int) -> None:
-        """Flip the active pointer (manager/service/model.go:109-151)."""
+        """Flip the active pointer (manager/service/model.go:109-151).
+
+        The manifest's ``active_version`` pointer is the AUTHORITATIVE
+        record — active_version() reads only it — and it is flipped first
+        in a single PUT, so a crash mid-activate leaves serving consistent
+        and only the denormalized per-version ``state`` fields stale (the
+        next activate repairs them). Concurrent activates of the SAME
+        model_id are last-writer-wins on the pointer: model_id embeds the
+        scheduler_host_id, so each model has exactly one natural activator
+        (its owning scheduler's trainer) and the reference's DB
+        transaction is not re-created here."""
         if self._get_json(model_id, version, "version.json") is None:
             raise FileNotFoundError(f"{model_id} v{version} not found")
+        # A publisher that died between reserving version.json and
+        # uploading params leaves a permanently-visible params-less
+        # version; activating it would make load_params fail at SERVING
+        # time, so the gap is checked here instead.
+        if not self.backend.is_object_exist(
+            self.bucket, self._key(model_id, version, "params.msgpack")
+        ):
+            raise FileNotFoundError(
+                f"{model_id} v{version} has no params uploaded "
+                "(publisher died mid-publish?)"
+            )
         manifest = self._get_json(model_id, "model.json") or {}
+        manifest["active_version"] = version
+        self._put_json(manifest, model_id, "model.json")
         for v in self.list_versions(model_id):
             state = STATE_ACTIVE if v.version == version else STATE_INACTIVE
             if v.state != state:
                 data = self._get_json(model_id, v.version, "version.json")
                 data["state"] = state
                 self._put_json(data, model_id, v.version, "version.json")
-        manifest["active_version"] = version
-        self._put_json(manifest, model_id, "model.json")
 
     def delete_version(self, model_id: str, version: int) -> None:
         if self._get_json(model_id, version, "version.json") is None:

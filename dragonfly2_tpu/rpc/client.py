@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import wire
@@ -174,8 +175,13 @@ class SchedulerClientPool:
         self._conns: dict[str, SchedulerConnection] = {}
         # (connection, parked_at): closed by for_task only after a grace
         # period, so an RPC already in flight on a just-removed scheduler
-        # finishes instead of dying mid-exchange
+        # finishes instead of dying mid-exchange. Guarded by _stale_mu
+        # (a THREAD lock, held only across list ops, never an await):
+        # the dynconfig worker thread appends while _get swaps, and an
+        # unguarded append landing on the just-swapped-out list would
+        # leak that connection unclosed forever (ADVICE r4 low).
         self._stale_conns: list[tuple[SchedulerConnection, float]] = []
+        self._stale_mu = threading.Lock()
         self._lock = asyncio.Lock()
 
     STALE_CLOSE_GRACE_S = 30.0
@@ -202,7 +208,8 @@ class SchedulerClientPool:
             if key not in addr:
                 conn = self._conns.pop(key, None)
                 if conn is not None:
-                    self._stale_conns.append((conn, _time.monotonic()))
+                    with self._stale_mu:
+                        self._stale_conns.append((conn, _time.monotonic()))
 
     async def for_task(self, task_id: str) -> SchedulerConnection:
         ring, addr = self._state
@@ -229,13 +236,15 @@ class SchedulerClientPool:
             import time as _time
 
             now = _time.monotonic()
-            # swap the list out ATOMICALLY before any await: the dynconfig
-            # worker thread appends concurrently, and a read-modify-write
-            # across an await point would drop (and leak) its entry
-            pending, self._stale_conns = self._stale_conns, []
+            # swap the list out under the thread lock: the dynconfig
+            # worker appends concurrently, and an append racing the swap
+            # would land on the dead list and leak its connection
+            with self._stale_mu:
+                pending, self._stale_conns = self._stale_conns, []
             for parked, at in pending:
                 if now - at < self.STALE_CLOSE_GRACE_S:
-                    self._stale_conns.append((parked, at))
+                    with self._stale_mu:
+                        self._stale_conns.append((parked, at))
                     continue
                 try:
                     await parked.close()
@@ -258,7 +267,16 @@ class SchedulerClientPool:
         # multi-minute connect timeout.
         host, port = addr[key]
         fresh = SchedulerConnection(host, port, ssl_context=self.ssl_context)
-        await asyncio.wait_for(fresh.connect(), timeout=self.DIAL_TIMEOUT_S)
+        try:
+            await asyncio.wait_for(fresh.connect(), timeout=self.DIAL_TIMEOUT_S)
+        except BaseException:
+            # a timed-out/cancelled dial must not abandon the half-open
+            # socket (ADVICE r4 low)
+            try:
+                await fresh.close()
+            except Exception:  # noqa: BLE001 - teardown of a dead dial
+                pass
+            raise
         async with self._lock:
             raced = self._conns.get(key)
             if raced is not None and not raced.is_closed:

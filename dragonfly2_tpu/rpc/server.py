@@ -77,6 +77,14 @@ class SchedulerRPCServer:
         # to PeerPacket frames (the reference serves both generations off
         # one resource layer, service_v1.go + service_v2.go).
         self.v1 = sv1.SchedulerServiceV1(service)
+        # _v1_mu guards every _v1_peers mutation AND the tick thread's
+        # snapshot copy: adds happen on dispatch threads (under
+        # service.mu), but the connection-close discard runs on the event
+        # loop where taking service.mu would stall the loop for a whole
+        # tick — a dedicated lock held only across set ops costs nothing
+        # and stops set(...) from racing a concurrent discard
+        # (RuntimeError: set changed size during iteration).
+        self._v1_mu = threading.Lock()
         self._v1_peers: set[str] = set()
         reg = default_registry()
         self.metrics = scheduler_series(reg)
@@ -180,7 +188,8 @@ class SchedulerRPCServer:
                     self._peer_conn.pop(peer_id, None)
                     # v1 marking follows the route entry's lifetime, or the
                     # set grows one string per v1 download forever
-                    self._v1_peers.discard(peer_id)
+                    with self._v1_mu:
+                        self._v1_peers.discard(peer_id)
                 for host_id in owned_hosts:
                     self._host_conn.pop(host_id, None)
             writer.close()
@@ -314,13 +323,16 @@ class SchedulerRPCServer:
     def _dispatch_v1(self, request, owned_peers: set[str]):
         """v1-dialect requests (cluster/service_v1.py) translated onto the
         service; immediate v2-shaped answers convert to PeerPacket here,
-        tick-delivered ones convert in _send_responses via _v1_peers."""
+        tick-delivered ones convert inside the tick thread (under
+        service.mu) via the _v1_peers snapshot in _tick_once."""
         v1 = self.v1
         if isinstance(request, sv1.V1PeerTaskRequest):
-            self._v1_peers.add(request.peer_id)
+            with self._v1_mu:
+                self._v1_peers.add(request.peer_id)
             return v1.register_peer_task(request)
         if isinstance(request, sv1.V1PieceResult):
-            self._v1_peers.add(request.src_pid)
+            with self._v1_mu:
+                self._v1_peers.add(request.src_pid)
             response = v1.report_piece_result(request)
             return v1.to_peer_packet(response) if response is not None else None
         if isinstance(request, sv1.V1PeerResult):
@@ -331,7 +343,8 @@ class SchedulerRPCServer:
         if isinstance(request, sv1.V1PeerTarget):
             v1.leave_task(request)
             owned_peers.discard(request.peer_id)
-            self._v1_peers.discard(request.peer_id)
+            with self._v1_mu:
+                self._v1_peers.discard(request.peer_id)
             return None
         return None
 
@@ -527,9 +540,28 @@ class SchedulerRPCServer:
             return
         t0 = time.perf_counter()
 
+        # v1 responses convert to PeerPacket INSIDE the tick thread while
+        # service.mu is still held — to_peer_packet reads svc._peer_meta,
+        # which dispatch threads mutate, so converting later on the event
+        # loop could see a racing leave/GC and emit a packet with an empty
+        # task_id (ADVICE r4 low). The membership snapshot is ALSO taken
+        # under svc.mu: _dispatch_v1 mutates _v1_peers while holding it,
+        # so a pre-lock snapshot could miss a v1 peer that registered
+        # between snapshot and tick and hand its connection a raw v2 frame.
+
         def run():
             with svc.mu:
-                return svc.tick()
+                with self._v1_mu:
+                    v1_peers = set(self._v1_peers)
+                out = []
+                for response in svc.tick():
+                    peer_id = getattr(response, "peer_id", None)
+                    if peer_id in v1_peers:
+                        response = self.v1.to_peer_packet(response)
+                        if response is None:
+                            continue
+                    out.append(response)
+                return out
 
         # The device call blocks; run it off-loop so streams stay live.
         last_phases = svc.tick_phases[-1] if svc.tick_phases else None
@@ -546,16 +578,17 @@ class SchedulerRPCServer:
         await self._send_responses(responses)
 
     async def _send_responses(self, responses) -> None:
+        # v1 responses arrive here already converted to V1PeerPacket (the
+        # conversion runs in the tick thread under service.mu — ADVICE r4
+        # low); a packet routes by its src_pid.
         for response in responses:
-            peer_id = getattr(response, "peer_id", None)
+            peer_id = getattr(response, "peer_id", None) or getattr(
+                response, "src_pid", None
+            )
             async with self._lock:
                 writer = self._peer_conn.get(peer_id)
             if writer is None:
                 continue
-            if peer_id in self._v1_peers:
-                response = self.v1.to_peer_packet(response)
-                if response is None:
-                    continue
             try:
                 wire.write_frame(writer, response)
                 await writer.drain()

@@ -145,6 +145,75 @@ def test_bad_credentials_rejected(s3_bucket, mlp_setup):
         reg.create_model_version("m", MODEL_TYPE_MLP, "h", params, ModelEvaluation())
 
 
+def test_concurrent_publishers_get_distinct_versions(tmp_path, s3_bucket, mlp_setup):
+    """Two publishers sharing one bucket race create_model_version: the
+    conditional version.json create (If-None-Match / O_EXCL) must hand
+    them DISTINCT version numbers — the ADVICE r4 list-then-put race
+    silently overwrote one publisher's params with the other's."""
+    import threading
+
+    _, params, _ = mlp_setup
+    for label, make in _registries(tmp_path, s3_bucket):
+        reg_a, reg_b = make(), make()
+        barrier = threading.Barrier(2)
+        out, errs = [], []
+
+        def publish(reg):
+            try:
+                barrier.wait(timeout=10)
+                mv = reg.create_model_version(
+                    "raced", MODEL_TYPE_MLP, "h", params, ModelEvaluation()
+                )
+                out.append(mv.version)
+            except Exception as e:  # noqa: BLE001 - surface in the assert
+                errs.append(e)
+
+        threads = [threading.Thread(target=publish, args=(r,)) for r in (reg_a, reg_b)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, (label, errs)
+        assert sorted(out) == [1, 2], (label, out)
+        # both versions fully landed: distinct params objects exist
+        for v in (1, 2):
+            assert reg_a.load_params(reg_a.model_id("raced", "h"), v) is not None, label
+
+
+def test_put_object_if_absent_semantics(tmp_path, s3_bucket):
+    """The CAS primitive itself: second create of a key reports False and
+    leaves the first writer's bytes intact, on every backend. OSS/OBS do
+    NOT honor If-None-Match on PUT — their conditional create is the
+    vendor forbid-overwrite header answering 409 — so each vendor
+    backend must send ITS header (the fake servers enforce both)."""
+    from test_remote_sources import _OSSHandler, _Store, _serve
+
+    vendor_servers = []
+    backends = [
+        ("fs", FilesystemBackend(tmp_path / "cas-store")),
+        ("s3", new_backend(
+            "s3", endpoint=s3_bucket, access_key=ACCESS,
+            secret_key=SECRET, region=REGION,
+        )),
+    ]
+    for vendor in ("oss", "obs"):
+        handler = type("H", (_OSSHandler,), {"store": _Store(), "scheme": vendor.upper()})
+        srv, addr = _serve(handler)
+        vendor_servers.append(srv)
+        backends.append((vendor, new_backend(
+            vendor, endpoint=addr, access_key=ACCESS, secret_key=SECRET,
+        )))
+    try:
+        for label, backend in backends:
+            backend.create_bucket("cas")
+            assert backend.put_object_if_absent("cas", "k", b"first") is True, label
+            assert backend.put_object_if_absent("cas", "k", b"second") is False, label
+            assert backend.get_object("cas", "k") == b"first", label
+    finally:
+        for srv in vendor_servers:
+            srv.shutdown()
+
+
 def test_open_registry_dispatch(tmp_path):
     assert isinstance(open_registry(tmp_path / "plain"), ModelRegistry)
     reg = open_registry(f"fs://models/pre?base_dir={tmp_path / 'store'}")

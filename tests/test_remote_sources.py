@@ -140,6 +140,16 @@ class _S3Handler(_BaseHandler):
                     return self._reply(404, b"<Error/>")
                 store[bucket][key] = data
                 return self._reply(200, b"<CopyObjectResult/>")
+            # conditional create — real S3 answers 412 PreconditionFailed
+            # to If-None-Match: *; OSS/OBS answer 409 FileAlreadyExists to
+            # their forbid-overwrite headers
+            if self.headers.get("If-None-Match") == "*" and key in store[bucket]:
+                return self._reply(412, b"<Error><Code>PreconditionFailed</Code></Error>")
+            if key in store[bucket] and (
+                self.headers.get("x-oss-forbid-overwrite") == "true"
+                or self.headers.get("x-obs-forbid-overwrite") == "true"
+            ):
+                return self._reply(409, b"<Error><Code>FileAlreadyExists</Code></Error>")
             store[bucket][key] = body
             etag = hashlib.md5(body).hexdigest()
             return self._reply(200, headers={"ETag": f'"{etag}"'})
