@@ -63,14 +63,77 @@ class JobResult:
     detail: dict = dataclasses.field(default_factory=dict)
 
 
+class RemoteScheduler:
+    """The JobManager-facing surface of a scheduler in ANOTHER process,
+    over the wire RPC job edge (rpc/server.py JobTriggerSeed/TaskStates/
+    SchedulerInfo) — the role the reference's Redis-backed machinery bus
+    plays between manager and scheduler processes (internal/job/
+    job.go:53-87). Degrades per-call: an unreachable scheduler fails THIS
+    trigger/poll, not the manager."""
+
+    def __init__(self, host: str, port: int, ssl_context=None):
+        from dragonfly2_tpu.rpc.client import SyncSchedulerClient
+
+        self.address = (host, port)
+        self._client = SyncSchedulerClient(host, port, ssl_context=ssl_context)
+
+    def trigger_seed_download(self, task_id, url, piece_length=4 << 20,
+                              tag="", application="", host_id="",
+                              headers=None) -> bool:
+        try:
+            resp = self._client.call(msg.JobTriggerSeedRequest(
+                task_id=task_id, url=url, piece_length=piece_length,
+                tag=tag, application=application, host_id=host_id,
+                headers=headers or {},
+            ))
+        except ConnectionError:
+            return False
+        return isinstance(resp, msg.JobTriggerSeedResponse) and resp.ok
+
+    def task_states(self, task_ids: list[str]) -> list[int | None]:
+        try:
+            resp = self._client.call(msg.TaskStatesRequest(task_ids=task_ids))
+        except ConnectionError:
+            return [None] * len(task_ids)
+        if not isinstance(resp, msg.TaskStatesResponse):
+            return [None] * len(task_ids)
+        return [None if s < 0 else s for s in resp.states]
+
+    def info(self) -> tuple[dict, list]:
+        """(counts, hosts) in ONE round trip — the response carries both."""
+        try:
+            resp = self._client.call(msg.SchedulerInfoRequest())
+        except ConnectionError:
+            return {}, []
+        if not isinstance(resp, msg.SchedulerInfoResponse):
+            return {}, []
+        return resp.counts, resp.hosts
+
+    def counts(self) -> dict:
+        return self.info()[0]
+
+    def list_hosts(self) -> list[dict]:
+        return self.info()[1]
+
+    def close(self) -> None:
+        self._client.close()
+
+
 class JobManager:
     """Routes jobs to schedulers by task-id consistent hashing — the same
-    affinity the reference gets from pkg/balancer."""
+    affinity the reference gets from pkg/balancer. Entries may be local
+    SchedulerService objects (in-proc clusters, tests) or RemoteScheduler
+    proxies (the launched manager's cross-process job edge)."""
 
-    def __init__(self, schedulers: dict[str, SchedulerService], seed_hosts: list[msg.HostInfo]):
+    def __init__(self, schedulers: dict[str, SchedulerService],
+                 seed_hosts: list[msg.HostInfo] | None = None):
         self.schedulers = schedulers
         self.ring = HashRing(list(schedulers))
-        self.seed_hosts = [h for h in seed_hosts]
+        # Optional: with no explicit seed hosts, triggers go out with an
+        # empty host_id and each SCHEDULER round-robins its own announced
+        # seed hosts (SchedulerService.trigger_seed_download) — the
+        # launched manager does not track per-scheduler seed daemons.
+        self.seed_hosts = [h for h in (seed_hosts or [])]
         self._seed_rr = itertools.cycle(range(max(len(self.seed_hosts), 1)))
         self.jobs: dict[str, JobResult] = {}
         # per-job (task_done, task_seen) poll latches — PRIVATE bookkeeping,
@@ -78,6 +141,31 @@ class JobManager:
         # detail into the REST payload and DB record; these maps grow with
         # task count and are implementation state, not job output)
         self._latches: dict[str, tuple[dict, dict]] = {}
+
+    def update_schedulers(self, schedulers: dict[str, SchedulerService]) -> None:
+        """Swap the scheduler set (the launched manager refreshes it from
+        its DB registrations before each job operation; schedulers come
+        and go at runtime). Existing entries are kept by NAME so cached
+        remote connections survive a no-op refresh."""
+        merged = {
+            name: self.schedulers.get(name, sched)
+            for name, sched in schedulers.items()
+        }
+        for name, old in self.schedulers.items():
+            if name not in merged and isinstance(old, RemoteScheduler):
+                old.close()
+        self.schedulers = merged
+        self.ring = HashRing(list(merged))
+
+    def adopt(self, job_id: str, task_ids: list[str]) -> JobResult:
+        """Re-register a job known only from a durable record (the manager
+        restarted; in-proc job state is documented non-durable). State
+        recomputes from live task polling on the next get()."""
+        result = self.jobs.get(job_id)
+        if result is None:
+            result = JobResult(job_id, JobState.PENDING, list(task_ids), {})
+            self.jobs[job_id] = result
+        return result
 
     def create_preheat(self, req: PreheatRequest) -> JobResult:
         """Resolve urls -> task ids and enqueue a TriggerSeedRequest per
@@ -127,11 +215,22 @@ class JobManager:
             )
             task_ids.append(task_id)
             scheduler_name = self.ring.pick(task_id)
-            if scheduler_name is None or not self.seed_hosts:
-                failures[task_id] = "no scheduler or seed hosts"
+            if scheduler_name is None:
+                failures[task_id] = "no scheduler"
                 continue
-            seed = self.seed_hosts[next(self._seed_rr) % len(self.seed_hosts)]
-            scheduler = self.schedulers[scheduler_name]
+            # explicit seed list -> manager round-robin; empty -> each
+            # scheduler picks among ITS announced seed daemons
+            seed_host_id = ""
+            if self.seed_hosts:
+                seed = self.seed_hosts[next(self._seed_rr) % len(self.seed_hosts)]
+                seed_host_id = seed.host_id
+            # .get, not []: a concurrent update_schedulers (manager REST
+            # threads) can swap the map between the ring pick and this
+            # lookup — a departed scheduler fails THIS task, not the job run
+            scheduler = self.schedulers.get(scheduler_name)
+            if scheduler is None:
+                failures[task_id] = f"scheduler {scheduler_name} departed"
+                continue
             # TriggerDownloadTask to the seed daemon (preheat.go:90-286 ->
             # scheduler job.go:152 -> seed ObtainSeeds) — NOT a proxy peer
             # registration: a peer registered on the seed's behalf has no
@@ -142,11 +241,11 @@ class JobManager:
                 piece_length=req.piece_length,
                 tag=req.tag,
                 application=req.application,
-                host_id=seed.host_id,
+                host_id=seed_host_id,
                 headers=headers,
             )
             if not ok:
-                failures[task_id] = "seed trigger queue full"
+                failures[task_id] = "seed trigger rejected (queue full, no seed hosts, or scheduler unreachable)"
         # Enqueueing triggers is not a warm cluster: the job stays PENDING
         # until `get()` observes every task SUCCEEDED on its scheduler
         # (machinery group semantics — the reference's preheat e2e polls
@@ -169,10 +268,14 @@ class JobManager:
         MANAGER layer merges `announced_hosts` into its peers table
         (manager/service.py create_job — it owns the database and the
         upsert idiom); this stays a pure data collection."""
-        return {
-            name: {**s.counts(), "announced_hosts": s.list_hosts()}
-            for name, s in self.schedulers.items()
-        }
+        out = {}
+        for name, s in self.schedulers.items():
+            if isinstance(s, RemoteScheduler):
+                counts, hosts = s.info()  # one round trip, not two
+            else:
+                counts, hosts = s.counts(), s.list_hosts()
+            out[name] = {**counts, "announced_hosts": hosts}
+        return out
 
     def get(self, job_id: str) -> JobResult | None:
         """Job state recomputed from LIVE task progress: a preheat is

@@ -237,6 +237,56 @@ class TriggerSeedRequest:
     headers: dict = dataclasses.field(default_factory=dict)
 
 
+# ------------------------------------------------------ manager job edge
+
+@dataclasses.dataclass
+class JobTriggerSeedRequest:
+    """Manager -> scheduler: enqueue a preheat seed trigger (the
+    machinery preheat job hop, manager/job/preheat.go:90-286 ->
+    scheduler/job.go:152). host_id empty = the scheduler round-robins
+    its own announced seed hosts."""
+
+    task_id: str
+    url: str
+    piece_length: int = 4 << 20
+    tag: str = ""
+    application: str = ""
+    host_id: str = ""
+    headers: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class JobTriggerSeedResponse:
+    ok: bool
+    description: str = ""
+
+
+@dataclasses.dataclass
+class TaskStatesRequest:
+    """Manager -> scheduler: poll task FSM states for job progress
+    (the machinery group-state poll, internal/job/job.go:53-87)."""
+
+    task_ids: list[str]
+
+
+@dataclasses.dataclass
+class TaskStatesResponse:
+    # state int per requested task id; -1 = unknown to this scheduler
+    states: list[int]
+
+
+@dataclasses.dataclass
+class SchedulerInfoRequest:
+    """Manager -> scheduler: entity counts + announced hosts (the
+    sync_peers job's per-scheduler collection, scheduler/job/job.go:224)."""
+
+
+@dataclasses.dataclass
+class SchedulerInfoResponse:
+    counts: dict
+    hosts: list
+
+
 # ----------------------------------------------------------------- stat
 
 @dataclasses.dataclass

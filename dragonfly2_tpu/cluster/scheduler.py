@@ -150,6 +150,18 @@ class SchedulerService:
         # sweep — an instant host sweep would reap a freshly announced
         # idle host before its first peer registers).
         self._last_peer_gc = self._last_task_gc = self._last_host_gc = time.time()
+        # Serving-graph accumulator: (child_host_slot, parent_host_slot)
+        # -> [throughput_sum, piece_count], fed by every piece report.
+        # The GNN ranker's quality signal travels on graph EDGES (training
+        # builds edge_feats = log1p(mean throughput) from download traces,
+        # records/features.py downloads_to_ranking_dataset) — serving
+        # embeddings computed over an empty graph sever exactly that
+        # signal, which measurably dropped the ml evaluator BELOW the rule
+        # blend in the loop A/B. serving_graph_arrays() rebuilds the same
+        # schema from the scheduler's own observations so MLEvaluator
+        # refreshes see what the trainer saw.
+        self._serving_edges: dict[tuple[int, int], list[float]] = {}
+        self._serving_edge_cap = 1 << 20
 
     # ============================================================ messages
 
@@ -262,9 +274,28 @@ class SchedulerService:
                 )
             return None
 
-        peer_idx = self.state.add_peer(req.peer_id, task_idx, host_idx)
+        # Slot allocation BEFORE any state mutation: a full task DAG (hot
+        # task, every slot held by a live peer) degrades to a refusal the
+        # daemon answers with back-to-source — not a crashed register
+        # leaving a half-created peer.
         dag = self._task_dag(req.task_id)
         slot = self._alloc_dag_slot(req.task_id, req.peer_id, dag)
+        if slot < 0:
+            return msg.ScheduleFailure(
+                req.peer_id, "ResourceExhausted",
+                f"task {req.task_id} peer DAG full ({dag.capacity})",
+            )
+        try:
+            peer_idx = self.state.add_peer(req.peer_id, task_idx, host_idx)
+        except Exception:
+            # peer-table overflow (state.CapacityError) must not leak the
+            # just-allocated DAG slot: nothing references it yet (no
+            # _peer_meta), so _leave_peer could never reclaim it
+            dag.delete_vertex(slot)
+            self._dag_slot_peer.get(req.task_id, {}).pop(slot, None)
+            return msg.ScheduleFailure(
+                req.peer_id, "ResourceExhausted", "peer table full"
+            )
         self._peer_meta[req.peer_id] = _PeerMeta(
             peer_id=req.peer_id,
             task_id=req.task_id,
@@ -331,6 +362,14 @@ class SchedulerService:
                 stats["bytes"] += req.length
                 host_idx = self.state.peer_host[pidx]
                 self.state.host_upload_count[host_idx] += 1
+                if req.cost_ns > 0:
+                    key = (int(self.state.peer_host[idx]), int(host_idx))
+                    acc = self._serving_edges.get(key)
+                    if acc is None and len(self._serving_edges) < self._serving_edge_cap:
+                        acc = self._serving_edges[key] = [0.0, 0]
+                    if acc is not None:
+                        acc[0] += req.length / (req.cost_ns / 1e9)
+                        acc[1] += 1
         return None
 
     def piece_failed(self, req: msg.DownloadPieceFailedRequest):
@@ -426,11 +465,14 @@ class SchedulerService:
         with self.mu:
             if len(self.seed_triggers) >= 1024:
                 return False
-            if not host_id:
-                if not self._seed_hosts:
-                    return False
+            if not host_id and self._seed_hosts:
                 host_id = self._seed_hosts[self._seed_rr % len(self._seed_hosts)]
                 self._seed_rr += 1
+            # No announced seed yet (preheat racing the seed daemon's
+            # first announce): the trigger queues with an empty host_id —
+            # the RPC drain routes it to ANY connected seed and keeps
+            # retrying until the delivery TTL, so the job fails only if
+            # no seed appears within the window, not if it is merely late.
             # An explicitly named seed may not have announced yet (preheat
             # right after a seed restart): the trigger is queued anyway —
             # the RPC drain re-routes to any connected seed or keeps
@@ -851,13 +893,15 @@ class SchedulerService:
         return dag
 
     def _alloc_dag_slot(self, task_id: str, peer_id: str, dag: TaskDAG) -> int:
+        """Next free vertex slot, or -1 when every slot is held by a live
+        peer (register_peer refuses the peer; the daemon back-sources)."""
         slots = self._dag_slot_peer.setdefault(task_id, {})
         for slot in range(dag.capacity):
             if not dag.present[slot]:
                 dag.ensure_vertex(slot)
                 slots[slot] = peer_id
                 return slot
-        raise RuntimeError(f"task {task_id} peer DAG full ({dag.capacity})")
+        return -1
 
     def _leave_peer(self, peer_id: str) -> None:
         meta = self._peer_meta.get(peer_id)
@@ -1064,6 +1108,75 @@ class SchedulerService:
         c["pending"] = len(self._pending)
         c["tasks_with_dag"] = len(self._dags)
         return c
+
+    def serving_graph_arrays(self) -> dict:
+        """Host graph for MLEvaluator.refresh_embeddings, built from this
+        scheduler's OWN piece reports in the trainer's edge schema
+        (records/features.py downloads_to_ranking_dataset: directions
+        merged, edge_feats = [log1p(mean tput), log1p(count)] /
+        EDGE_FEATURE_SCALE). The GNN was TRAINED with host quality
+        arriving through these edges, so serving embeddings must carry
+        the same signal — an empty graph demotes the ml evaluator to
+        node-features-only, measurably below the rule blend."""
+        from dragonfly2_tpu.records.features import EDGE_FEATURE_SCALE
+
+        with self.mu:
+            alive_mask = np.asarray(self.state.host_alive, bool)
+            alive = np.nonzero(alive_mask)[0]
+            used = int(alive.max()) + 1 if alive.size else 1
+            merged: dict[tuple[int, int], list[float]] = {}
+            dead_keys = []
+            for (a, b), (tput_sum, count) in self._serving_edges.items():
+                # Only edges between CURRENTLY-alive hosts: a GC'd host's
+                # slot may exceed `used` (out-of-range for the padded
+                # node array) or be recycled by a different host. Dead
+                # endpoints also evict the accumulator entry so a
+                # recycled slot restarts its history instead of
+                # inheriting the previous occupant's throughput.
+                if (a >= alive_mask.size or b >= alive_mask.size
+                        or not alive_mask[a] or not alive_mask[b]):
+                    dead_keys.append((a, b))
+                    continue
+                for key in ((a, b), (b, a)):
+                    acc = merged.setdefault(key, [0.0, 0])
+                    acc[0] += tput_sum
+                    acc[1] += count
+            for key in dead_keys:
+                del self._serving_edges[key]
+        if merged:
+            keys = list(merged.keys())
+            edge_src = np.asarray([k[0] for k in keys], np.int32)
+            edge_dst = np.asarray([k[1] for k in keys], np.int32)
+            edge_feats = np.asarray(
+                [[np.log1p(s / c), np.log1p(c)] for s, c in merged.values()],
+                np.float32,
+            ) / EDGE_FEATURE_SCALE
+        else:
+            edge_src = np.zeros(0, np.int32)
+            edge_dst = np.zeros(0, np.int32)
+            edge_feats = np.zeros((0, 2), np.float32)
+        # Pad node and edge counts to power-of-two buckets so periodic
+        # refreshes hit the jit cache instead of recompiling the embed
+        # program for every new edge count. The last padded node row is a
+        # zero-feature SINK that absorbs the padding self-edges — only
+        # the sink's (never-gathered) embedding sees them.
+        padded_n = max(64, 1 << int(np.ceil(np.log2(used + 1))))
+        node_feats = np.zeros((padded_n, self.state.host_numeric.shape[1]), np.float32)
+        node_feats[:used] = self.state.host_numeric[:used]
+        sink = padded_n - 1
+        e = edge_src.shape[0]
+        padded_e = max(64, 1 << int(np.ceil(np.log2(max(e, 1)))))
+        if padded_e != e:
+            pad = padded_e - e
+            edge_src = np.concatenate([edge_src, np.full(pad, sink, np.int32)])
+            edge_dst = np.concatenate([edge_dst, np.full(pad, sink, np.int32)])
+            edge_feats = np.concatenate([edge_feats, np.zeros((pad, 2), np.float32)])
+        return {
+            "node_feats": node_feats,
+            "edge_src": edge_src,
+            "edge_dst": edge_dst,
+            "edge_feats": edge_feats,
+        }
 
     def task_states(self, task_ids: list[str]) -> list[int | None]:
         """Locked snapshot of per-task FSM states for cross-thread pollers

@@ -40,6 +40,13 @@ class SimStats:
     # filtered/rejected for retry_back_to_source_limit straight ticks
     back_to_source_starved: int = 0
     back_to_source_with_parents: int = 0
+    # Sum of simulated piece-download costs (rtt + parent-quality service
+    # time, the synth latent model). The replay clock does not advance on
+    # piece cost, so this is a PURE selection-quality signal: a scheduler
+    # that picks closer/faster parents accumulates less cost for the same
+    # pieces — the measurable payoff an evaluator is supposed to buy
+    # (the VERDICT r4 missing-#2 A/B compares it across algorithms).
+    piece_cost_ns_total: int = 0
 
 
 class ClusterSimulator:
@@ -203,6 +210,7 @@ class ClusterSimulator:
                 )
             )
             self.stats.pieces += 1
+            self.stats.piece_cost_ns_total += cost
         self.scheduler.peer_finished(
             msg.DownloadPeerFinishedRequest(
                 peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces

@@ -141,7 +141,13 @@ async def _serve_scheduler(args) -> int:
     host, port = await server.start()
     import socket
 
-    hostname = socket.gethostname()
+    # Overridable identity (the reference's server.host config,
+    # scheduler/config/config.go ServerConfig): the manager dedupes
+    # scheduler registrations on (host_name, ip, cluster), so two
+    # schedulers on one machine MUST register distinct names or the
+    # second silently overwrites the first row and the manager's job
+    # ring diverges from the daemons' scheduler set.
+    hostname = args.hostname or socket.gethostname()
     # ONE identity everywhere: the id the announce loop streams under is
     # the id the trainer publishes models under, which must be the id the
     # serving side looks up — two different defaults would mean training
@@ -180,6 +186,44 @@ async def _serve_scheduler(args) -> int:
         )
         await infer_server.start()
     bg_tasks: list[asyncio.Task] = []
+    if args.registry_dir and config.evaluator.algorithm == "ml":
+        # Actually wire the ml evaluator into the serving tick (the path
+        # the reference leaves dead, evaluator.go:84-86): score parents
+        # with the registry's active GNN, falling back to the rule blend
+        # until a version activates. A background loop refreshes (a) the
+        # served params when the registry's active version flips and (b)
+        # the host embeddings from the scheduler's OWN observed download
+        # graph (serving_graph_arrays — the quality signal rides those
+        # edges, matching what the trainer trained on).
+        from dragonfly2_tpu.registry import MLEvaluator
+
+        ml_eval = MLEvaluator(servers[GNN_MODEL_NAME])
+        service.ml_evaluator = ml_eval
+        log_ml = logging.getLogger("dragonfly2.cmd")
+
+        async def ml_refresh_loop():
+            while True:
+                try:
+                    changed = await asyncio.to_thread(
+                        servers[GNN_MODEL_NAME].refresh
+                    )
+                    if servers[GNN_MODEL_NAME].ready:
+                        graph = await asyncio.to_thread(
+                            service.serving_graph_arrays
+                        )
+                        await asyncio.to_thread(
+                            ml_eval.refresh_embeddings, graph
+                        )
+                        if changed:
+                            log_ml.info(
+                                "ml evaluator serving model version %s",
+                                servers[GNN_MODEL_NAME].version,
+                            )
+                except Exception:  # noqa: BLE001 - keep refreshing
+                    log_ml.exception("ml refresh failed")
+                await asyncio.sleep(args.ml_refresh_interval)
+
+        bg_tasks.append(asyncio.create_task(ml_refresh_loop()))
     if args.manager:
         # register with the manager + keepalive until shutdown (the
         # scheduler bootstrap's manager edge, scheduler.go:110-299 +
@@ -365,9 +409,35 @@ async def _serve_manager(args) -> int:
 
     registry = open_registry(args.registry_dir) if args.registry_dir else None
     _wire_otlp(args, "manager")
+    db = Database(args.db)
+
+    # Cross-process job edge (manager/job/preheat.go + internal/job): the
+    # launched manager fans preheat triggers out to its registered ACTIVE
+    # schedulers over their wire RPC (RemoteScheduler), resolved fresh
+    # from the DB before every job operation — schedulers register and
+    # depart at runtime, and a restarted manager re-adopts durable job
+    # records through the same resolver.
+    from dragonfly2_tpu.cluster.jobs import JobManager, RemoteScheduler
+
+    tls_sched_client_ctx = await _tls_context(args, "manager", server=False)
+
+    def resolve_schedulers():
+        out = {}
+        for row in db.list("schedulers"):
+            if row.get("state") != "active":
+                continue
+            host, port = row.get("ip"), int(row.get("port") or 0)
+            if not host or not port:
+                continue
+            out[f"{host}:{port}"] = RemoteScheduler(
+                host, port, ssl_context=tls_sched_client_ctx
+            )
+        return out
+
     service = ManagerService(
-        db=Database(args.db), registry=registry, cert_dir=args.cert_dir,
+        db=db, registry=registry, cert_dir=args.cert_dir,
         enrollment_token=args.tls_enrollment_token or None,
+        jobs=JobManager({}), jobs_resolver=resolve_schedulers,
     )
     rest = ManagerREST(service, host=args.host, port=args.port)
     host, port = rest.start()
@@ -492,6 +562,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="registry host id the trainer published under "
                    "(default: host-id-v2 of this scheduler's ip+hostname, "
                    "utils/idgen.host_id_v2 — printed at startup)")
+    s.add_argument("--hostname", default=None,
+                   help="identity registered with the manager (default: "
+                   "socket.gethostname(); MUST differ between schedulers "
+                   "sharing one machine — registrations dedupe on "
+                   "hostname+ip+cluster)")
+    s.add_argument("--ml-refresh-interval", type=float, default=30.0,
+                   help="seconds between ml-evaluator refreshes (active "
+                   "model version + host embeddings from the observed "
+                   "download graph); used with --algorithm ml")
     s.add_argument("--metrics-port", type=int, default=None,
                    help="observability HTTP: /metrics /debug/stacks /debug/profile")
     s.add_argument("--manager", default="",

@@ -367,3 +367,69 @@ class TrainerClient:
             return response
         finally:
             writer.close()
+
+
+class SyncSchedulerClient:
+    """Blocking request/response client over the scheduler wire protocol
+    for NON-asyncio callers — the manager's REST worker threads driving
+    the cross-process job edge (JobTriggerSeed / TaskStates /
+    SchedulerInfo; the machinery hops the reference runs through Redis +
+    asynq, manager/job + internal/job). One short-lived request at a time
+    per client; the connection is dialed lazily and redialed after any
+    error, so a scheduler restart costs one failed call, not a stuck
+    manager."""
+
+    def __init__(self, host: str, port: int, ssl_context=None, timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.ssl_context = ssl_context
+        self.timeout = timeout
+        self._sock = None
+        self._mu = threading.Lock()
+
+    def _connect(self):
+        import socket as _socket
+
+        sock = _socket.create_connection((self.host, self.port), timeout=self.timeout)
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(sock, server_hostname=self.host)
+        return sock
+
+    def call(self, request):
+        """Send one frame, read one frame. Raises ConnectionError on any
+        transport failure (after closing the cached socket). The socket is
+        snapshotted into a local: a concurrent close() (update_schedulers
+        dropping a departed scheduler) nulls self._sock without taking
+        _mu — closing the fd mid-recv surfaces as OSError below, never as
+        an AttributeError on None escaping the error mapping."""
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                sock = self._sock
+                # wire.encode already length-prefixes the frame
+                sock.sendall(wire.encode(request))
+                header = self._recv_exact(sock, 4)
+                return wire.decode(
+                    self._recv_exact(sock, int.from_bytes(header, "big"))
+                )
+            except (OSError, ConnectionError, ValueError) as e:
+                self.close()
+                raise ConnectionError(f"scheduler rpc {self.host}:{self.port}: {e}") from e
+
+    def _recv_exact(self, sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("scheduler closed the connection")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

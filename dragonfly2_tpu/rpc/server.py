@@ -315,6 +315,27 @@ class SchedulerRPCServer:
             return self._stat_peer(request.peer_id)
         if isinstance(request, msg.StatTaskRequest):
             return self._stat_task(request.task_id)
+        # manager job edge (cross-process preheat/sync_peers; the
+        # machinery hops manager/job/preheat.go:90-286 + job.go:224)
+        if isinstance(request, msg.JobTriggerSeedRequest):
+            ok = svc.trigger_seed_download(
+                task_id=request.task_id, url=request.url,
+                piece_length=request.piece_length, tag=request.tag,
+                application=request.application, host_id=request.host_id,
+                headers=request.headers or None,
+            )
+            return msg.JobTriggerSeedResponse(
+                ok=ok, description="" if ok else "trigger queue full or no seed hosts"
+            )
+        if isinstance(request, msg.TaskStatesRequest):
+            return msg.TaskStatesResponse(states=[
+                -1 if s is None else int(s)
+                for s in svc.task_states(request.task_ids)
+            ])
+        if isinstance(request, msg.SchedulerInfoRequest):
+            return msg.SchedulerInfoResponse(
+                counts=svc.counts(), hosts=svc.list_hosts()
+            )
         if isinstance(request, sv1.V1_REQUEST_TYPES):
             return self._dispatch_v1(request, owned_peers)
         # announce-stream oneof (routing already recorded on-loop)

@@ -33,11 +33,21 @@ def test_preheat_fans_out_by_hash_ring():
     assert result2.task_ids == result.task_ids
 
 
-def test_preheat_without_seeds_fails():
-    jm = JobManager({"s1": SchedulerService()}, [])
+def test_preheat_without_seeds_queues_for_late_seed():
+    """No announced seed at enqueue time is NOT a failure: the trigger
+    queues with an empty host_id and the RPC drain delivers it to the
+    first seed that connects (within the delivery TTL) — a preheat racing
+    the seed daemon's first announce must not fail the job (r5; the prior
+    behavior failed it instantly). The job stays PENDING until a seed
+    downloads the task."""
+    svc = SchedulerService()
+    jm = JobManager({"s1": svc}, [])
     result = jm.create_preheat(PreheatRequest(urls=["https://e.com/x"]))
-    assert result.state == JobState.FAILURE
+    assert result.state == JobState.PENDING
     assert jm.get(result.job_id) is result
+    # the trigger is queued on the scheduler, addressed to "any seed"
+    assert len(svc.seed_triggers) == 1
+    assert svc.seed_triggers[0].host_id == ""
 
 
 def test_preheat_task_id_matches_daemon_derivation():

@@ -708,6 +708,159 @@ def test_manager_restart_durability(tmp_path):
             _stop(manager)
 
 
+def test_preheat_survives_manager_kill_and_restart(tmp_path):
+    """Cross-process preheat + control-plane recovery (VERDICT r4 next
+    #6): TWO launched schedulers registered with one launched manager, a
+    seed daemon serving both, a REST preheat job fanned out over the
+    RemoteScheduler job edge (the reference's machinery bus hop,
+    manager/job/preheat.go -> internal/job) — then the manager is KILLED
+    mid-preheat and restarted on the same --db and RPC port. The durable
+    job record must converge to SUCCESS on the new process: it re-adopts
+    the task list and polls live task states from the schedulers, which
+    kept downloading while the manager was gone."""
+    import json
+    import socket
+    import time as _time
+    import urllib.request
+
+    from dragonfly2_tpu.client.daemon import Daemon
+
+    payload = os.urandom(1 << 20)
+
+    class _SlowOrigin(_Origin):
+        pass
+
+    origin = _SlowOrigin(payload)
+    base_handler = origin.srv.RequestHandlerClass
+    orig_get = base_handler.do_GET
+
+    def slow_get(handler):
+        _time.sleep(0.1)  # keep seed downloads in flight at kill time
+        orig_get(handler)
+
+    base_handler.do_GET = slow_get
+
+    # fixed manager RPC port so schedulers reconnect to the RESTARTED
+    # manager (their --manager flag pins host:port)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    m_rpc_port = s.getsockname()[1]
+    s.close()
+
+    db = tmp_path / "preheat.db"
+    manager, m_host, m_port = _spawn(
+        ["manager", "--db", str(db), "--rpc-port", str(m_rpc_port)], tmp_path
+    )
+    scheds = []
+    for i in (1, 2):
+        sched, s_host, s_port = _spawn(
+            ["scheduler", "--data-dir", str(tmp_path / f"s{i}-data"),
+             "--manager", f"{m_host}:{m_rpc_port}",
+             "--hostname", f"preheat-sched-{i}",
+             "--keepalive-interval", "0.3"],
+            tmp_path,
+        )
+        scheds.append((sched, s_host, s_port))
+
+    def api(port, token, path, data=None, method=None):
+        req = urllib.request.Request(
+            f"http://{m_host}:{port}{path}",
+            data=json.dumps(data).encode() if data is not None else None,
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {token}"},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = resp.read()
+            return json.loads(body) if body else None
+
+    def signin(port):
+        req = urllib.request.Request(
+            f"http://{m_host}:{port}/api/v1/users/signin",
+            data=json.dumps({"name": "root", "password": "dragonfly"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())["token"]
+
+    async def run_seed_daemon(stop_event):
+        daemon = Daemon(
+            tmp_path / "seed", [(h, p) for _, h, p in scheds],
+            hostname="seed-1", host_type="super",
+        )
+        await daemon.start()
+        try:
+            await stop_event.wait()
+        finally:
+            await daemon.stop()
+
+    loop_holder = {}
+    seed_thread = None
+    try:
+        # seed daemon on its own loop thread, announcing to BOTH schedulers
+        def seed_main():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            loop_holder["loop"] = loop
+            loop_holder["stop"] = asyncio.Event()
+            loop.run_until_complete(run_seed_daemon(loop_holder["stop"]))
+
+        seed_thread = threading.Thread(target=seed_main, daemon=True)
+        seed_thread.start()
+        deadline = _time.monotonic() + 10
+        while "stop" not in loop_holder and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+
+        token = signin(m_port)
+        # wait for both schedulers to register active (keepalive cadence)
+        deadline = _time.monotonic() + 15
+        while _time.monotonic() < deadline:
+            rows = api(m_port, token, "/api/v1/schedulers")
+            if len([r for r in rows if r.get("state") == "active"]) >= 2:
+                break
+            _time.sleep(0.3)
+
+        urls = [f"http://127.0.0.1:{origin.port}/blob-{i}.bin" for i in range(4)]
+        job = api(m_port, token, "/api/v1/jobs",
+                  {"type": "preheat", "args": {"urls": urls}})
+        assert job["state"] in ("PENDING", "SUCCESS"), job
+        record_id = job["id"]
+
+        # kill the manager MID-preheat (throttled origin keeps the seed
+        # downloads in flight); the schedulers and seed keep working
+        manager.kill()
+        manager.wait(timeout=10)
+
+        manager2, _, m2_port = _spawn(
+            ["manager", "--db", str(db), "--rpc-port", str(m_rpc_port)],
+            tmp_path,
+        )
+        try:
+            token2 = signin(m2_port)
+            got = None
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                got = api(m2_port, token2, f"/api/v1/jobs/{record_id}")
+                if got["state"] == "SUCCESS":
+                    break
+                _time.sleep(0.5)
+            assert got and got["state"] == "SUCCESS", got
+            # the origin actually served the seed fetches
+            assert origin.gets >= 4, origin.gets
+        finally:
+            _stop(manager2)
+    finally:
+        base_handler.do_GET = orig_get
+        if seed_thread is not None and "stop" in loop_holder:
+            loop_holder["loop"].call_soon_threadsafe(loop_holder["stop"].set)
+            seed_thread.join(timeout=10)
+        for sched, _, _ in scheds:
+            _stop(sched)
+        if manager.poll() is None:
+            _stop(manager)
+        origin.close()
+
+
 def test_mtls_launchers_end_to_end(tmp_path):
     """Launcher-level mTLS (VERDICT r1 item 4): manager issues the cluster
     CA, scheduler certifies + serves mutual TLS, a dfget download rides the
