@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import time
 import uuid
 
 from dragonfly2_tpu.cluster import image_preheat
@@ -61,6 +62,17 @@ class JobResult:
     state: JobState
     task_ids: list[str]
     detail: dict = dataclasses.field(default_factory=dict)
+    # monotonic enqueue (or adoption) time: bounds how long a preheat may
+    # sit with NO task ever observed before it expires — the seed-trigger
+    # delivery TTL is 60s, so a job whose tasks never appeared by then is
+    # undeliverable (no seed daemon exists), not merely late
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+# How long a preheat may sit with no task ever observed before it is
+# declared undeliverable — longer than the RPC drain's 60s trigger TTL
+# (rpc/server.SEED_TRIGGER_TTL_S) so a late-but-delivered seed still wins.
+SEED_START_TTL_S = 90.0
 
 
 class RemoteScheduler:
@@ -91,22 +103,23 @@ class RemoteScheduler:
         return isinstance(resp, msg.JobTriggerSeedResponse) and resp.ok
 
     def task_states(self, task_ids: list[str]) -> list[int | None]:
-        try:
-            resp = self._client.call(msg.TaskStatesRequest(task_ids=task_ids))
-        except ConnectionError:
-            return [None] * len(task_ids)
+        """None means 'this scheduler does not know the task' — a REAL
+        answer. Transport failure RAISES ConnectionError instead: mapping
+        it to None would read as 'scheduler forgot the task' and flip a
+        healthy in-flight job to EXPIRED during a restart window."""
+        resp = self._client.call(msg.TaskStatesRequest(task_ids=task_ids))
         if not isinstance(resp, msg.TaskStatesResponse):
-            return [None] * len(task_ids)
+            raise ConnectionError(f"bad TaskStates reply from {self.address}")
         return [None if s < 0 else s for s in resp.states]
 
     def info(self) -> tuple[dict, list]:
-        """(counts, hosts) in ONE round trip — the response carries both."""
-        try:
-            resp = self._client.call(msg.SchedulerInfoRequest())
-        except ConnectionError:
-            return {}, []
+        """(counts, hosts) in ONE round trip — the response carries both.
+        Raises ConnectionError when the scheduler is unreachable so
+        callers can surface the failure instead of reporting a healthy
+        empty scheduler."""
+        resp = self._client.call(msg.SchedulerInfoRequest())
         if not isinstance(resp, msg.SchedulerInfoResponse):
-            return {}, []
+            raise ConnectionError(f"bad SchedulerInfo reply from {self.address}")
         return resp.counts, resp.hosts
 
     def counts(self) -> dict:
@@ -270,10 +283,17 @@ class JobManager:
         upsert idiom); this stays a pure data collection."""
         out = {}
         for name, s in self.schedulers.items():
-            if isinstance(s, RemoteScheduler):
-                counts, hosts = s.info()  # one round trip, not two
-            else:
-                counts, hosts = s.counts(), s.list_hosts()
+            try:
+                if isinstance(s, RemoteScheduler):
+                    counts, hosts = s.info()  # one round trip, not two
+                else:
+                    counts, hosts = s.counts(), s.list_hosts()
+            except ConnectionError as e:
+                # an unreachable scheduler must not masquerade as a
+                # healthy EMPTY one — the peer-table merge and operators
+                # need to tell the two apart
+                out[name] = {"unreachable": str(e), "announced_hosts": []}
+                continue
             out[name] = {**counts, "announced_hosts": hosts}
         return out
 
@@ -307,18 +327,48 @@ class JobManager:
         # but now unknown WITHOUT a latched outcome is indeterminate and
         # expires the job.
         done, seen = self._latches.setdefault(result.job_id, ({}, {}))
+        # One batched TaskStates call per owning scheduler (the wire
+        # message takes a list): per-task round trips made a 50-URL poll
+        # pay 50 dials — minutes against a briefly-down scheduler.
+        by_owner: dict[str, list[str]] = {}
+        to_poll = [t for t in result.task_ids if not done.get(t)]
+        for task_id in to_poll:
+            name = self.ring.pick(task_id)
+            if name is not None:
+                by_owner.setdefault(name, []).append(task_id)
+        polled: dict[str, int | None] = {}
+        unreachable = False
+        for name, tids in by_owner.items():
+            svc = self.schedulers.get(name)
+            if svc is None:
+                continue
+            try:
+                # Locked snapshot: this runs on manager REST threads while
+                # the scheduler event loop mutates task state.
+                for tid, raw in zip(tids, svc.task_states(tids)):
+                    polled[tid] = raw
+            except ConnectionError:
+                # transport failure is NOT "scheduler forgot the task":
+                # skip these tasks this round (last observations stand)
+                # rather than expiring a healthy in-flight job
+                unreachable = True
         states = []
         expired = False
+        never_seen = True
         for task_id in result.task_ids:
             if done.get(task_id):
                 states.append(TaskState.SUCCEEDED)
+                never_seen = False
                 continue
-            name = self.ring.pick(task_id)
-            svc = self.schedulers.get(name) if name else None
-            # Locked snapshot: this runs on manager REST threads while the
-            # scheduler event loop mutates task state.
-            raw = svc.task_states([task_id])[0] if svc else None
-            if raw is None:
+            raw = polled.get(task_id)
+            if seen.get(task_id) is not None:
+                never_seen = False
+            if task_id not in polled:
+                # unreachable scheduler (or no owner): hold position
+                states.append(TaskState(seen[task_id])
+                              if seen.get(task_id) is not None
+                              else TaskState.PENDING)
+            elif raw is None:
                 if seen.get(task_id) == int(TaskState.FAILED):
                     # last observation before the task vanished was FAILED
                     # and no recovery was ever seen: the observation
@@ -333,9 +383,21 @@ class JobManager:
             else:
                 state = TaskState(raw)
                 seen[task_id] = int(state)
+                never_seen = False
                 if state == TaskState.SUCCEEDED:
                     done[task_id] = True
                 states.append(state)
+        # A job whose tasks NEVER appeared on any reachable scheduler past
+        # the trigger-delivery TTL is undeliverable (no seed daemon ever
+        # connected): the triggers were dropped after SEED_TRIGGER_TTL_S
+        # with only a log line, so without this the job pends forever.
+        if (never_seen and not unreachable
+                and time.monotonic() - result.created_at > SEED_START_TTL_S):
+            result.state = JobState.EXPIRED
+            result.detail["expired_reason"] = (
+                "no seed daemon picked up any task within the delivery TTL"
+            )
+            return result
         if any(s == TaskState.FAILED for s in states):
             result.state = JobState.FAILURE
             result.detail["task_states"] = [s.name for s in states]

@@ -160,8 +160,12 @@ class SchedulerService:
         # blend in the loop A/B. serving_graph_arrays() rebuilds the same
         # schema from the scheduler's own observations so MLEvaluator
         # refreshes see what the trainer saw.
-        self._serving_edges: dict[tuple[int, int], list[float]] = {}
+        # keyed (child_slot, child_gen, parent_slot, parent_gen): the gens
+        # come from _slot_gen so a recycled slot starts fresh history
+        self._serving_edges: dict[tuple[int, int, int, int], list[float]] = {}
         self._serving_edge_cap = 1 << 20
+        self._slot_owner: dict[int, str] = {}
+        self._slot_gen: dict[int, int] = {}
 
     # ============================================================ messages
 
@@ -198,7 +202,7 @@ class SchedulerService:
         if host.host_type != "normal" and host.host_id not in self._seed_hosts:
             self._seed_hosts.append(host.host_id)
         rec = self._host_record(host)
-        return self.state.upsert_host(
+        slot = self.state.upsert_host(
             host.host_id,
             id_hash=stable_hash64(host.host_id),
             host_type=HostType.from_name(host.host_type),
@@ -209,6 +213,15 @@ class SchedulerService:
             upload_failed=host.upload_failed_count,
             numeric=host_numeric_features(rec),
         )
+        # Slot GENERATION bump on owner change: serving-edge accumulator
+        # entries are keyed (slot, gen) so a slot recycled between
+        # embedding refreshes cannot hand its previous occupant's
+        # throughput history to the new host (the read-time alive filter
+        # only catches slots observed dead AT refresh time).
+        if self._slot_owner.get(slot) != host.host_id:
+            self._slot_owner[slot] = host.host_id
+            self._slot_gen[slot] = self._slot_gen.get(slot, 0) + 1
+        return slot
 
     def leave_host(self, host_id: str) -> None:
         """LeaveHost: drop the host and every peer on it (service_v2)."""
@@ -363,7 +376,9 @@ class SchedulerService:
                 host_idx = self.state.peer_host[pidx]
                 self.state.host_upload_count[host_idx] += 1
                 if req.cost_ns > 0:
-                    key = (int(self.state.peer_host[idx]), int(host_idx))
+                    c_slot, p_slot = int(self.state.peer_host[idx]), int(host_idx)
+                    key = (c_slot, self._slot_gen.get(c_slot, 0),
+                           p_slot, self._slot_gen.get(p_slot, 0))
                     acc = self._serving_edges.get(key)
                     if acc is None and len(self._serving_edges) < self._serving_edge_cap:
                         acc = self._serving_edges[key] = [0.0, 0]
@@ -1126,16 +1141,18 @@ class SchedulerService:
             used = int(alive.max()) + 1 if alive.size else 1
             merged: dict[tuple[int, int], list[float]] = {}
             dead_keys = []
-            for (a, b), (tput_sum, count) in self._serving_edges.items():
-                # Only edges between CURRENTLY-alive hosts: a GC'd host's
-                # slot may exceed `used` (out-of-range for the padded
-                # node array) or be recycled by a different host. Dead
-                # endpoints also evict the accumulator entry so a
-                # recycled slot restarts its history instead of
-                # inheriting the previous occupant's throughput.
+            for full_key, (tput_sum, count) in self._serving_edges.items():
+                a, gen_a, b, gen_b = full_key
+                # Only edges between CURRENTLY-alive hosts in their
+                # CURRENT generation: a GC'd host's slot may exceed
+                # `used` (out-of-range for the padded node array), and a
+                # recycled slot's old-generation entries belong to the
+                # previous occupant — both are dropped and evicted.
                 if (a >= alive_mask.size or b >= alive_mask.size
-                        or not alive_mask[a] or not alive_mask[b]):
-                    dead_keys.append((a, b))
+                        or not alive_mask[a] or not alive_mask[b]
+                        or gen_a != self._slot_gen.get(a, 0)
+                        or gen_b != self._slot_gen.get(b, 0)):
+                    dead_keys.append(full_key)
                     continue
                 for key in ((a, b), (b, a)):
                     acc = merged.setdefault(key, [0.0, 0])

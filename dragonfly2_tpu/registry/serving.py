@@ -283,6 +283,38 @@ def _loc_match_fraction(parent_loc, child_loc):
     return prefix.sum(-1).astype(jnp.float32) / CONSTANTS.MAX_LOCATION_ELEMENTS
 
 
+# The served model REFINES the rule blend instead of replacing it: final
+# score = blend + ALPHA * z(gnn) * max(std(blend_row), STD_FLOOR). The
+# learned logits are z-scored within each candidate row (scale-free), then
+# bounded by the row's own blend spread, so the model can reorder
+# candidates the blend finds comparable but can never promote one the
+# blend rules out — and a cold/weak model degrades to the blend, not to
+# noise. (Full-scale A/B, BENCH r5 loop leg: the pure-model scorer landed
+# between random and the blend; the residual form is how the learned
+# signal adds to the engineered priors rather than competing with them.
+# The reference never reached this question — its ml path is dead code,
+# evaluator.go:84-86.)
+ML_RESIDUAL_ALPHA = 0.5
+ML_RESIDUAL_STD_FLOOR = 0.02
+
+
+def _ensemble_scores(feats: dict, gnn_logits: jax.Array) -> jax.Array:
+    valid = feats["valid"].astype(jnp.float32)
+    cnt = jnp.maximum(valid.sum(-1, keepdims=True), 1.0)
+
+    def _masked_moments(x):
+        mean = (x * valid).sum(-1, keepdims=True) / cnt
+        var = (((x - mean) ** 2) * valid).sum(-1, keepdims=True) / cnt
+        return mean, var
+
+    blend = ev.evaluate(feats, "default")
+    g_mean, g_var = _masked_moments(gnn_logits)
+    z = (gnn_logits - g_mean) * jax.lax.rsqrt(g_var + 1e-6)
+    _, b_var = _masked_moments(blend)
+    scale = jnp.maximum(jnp.sqrt(b_var), ML_RESIDUAL_STD_FLOOR)
+    return blend + ML_RESIDUAL_ALPHA * z * scale
+
+
 @functools.partial(jax.jit, static_argnames=("model", "limit"))
 def _ml_schedule(
     model, params, host_emb, child_host, cand_host, feats,
@@ -298,7 +330,9 @@ def _ml_schedule(
         ],
         axis=-1,
     )
-    scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+    scores = _ensemble_scores(
+        feats, gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+    )
     return ev.select_with_scores(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
     )
@@ -318,7 +352,9 @@ def _ml_schedule_packed(
         ],
         axis=-1,
     )
-    scores = gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+    scores = _ensemble_scores(
+        feats, gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+    )
     return ev.select_with_scores_packed(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
     )
@@ -341,9 +377,9 @@ def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit)
         ],
         axis=-1,
     )
-    scores = gnn_score(
+    scores = _ensemble_scores(f, gnn_score(
         model, params, host_emb, f["child_host_slot"], f["cand_host_slot"], pair_feats
-    )
+    ))
     return ev.select_with_scores_packed(
         f, scores, f["blocklist"], f["in_degree"], f["can_add_edge"], limit=limit
     )

@@ -427,9 +427,12 @@ class SyncSchedulerClient:
         return buf
 
     def close(self) -> None:
-        if self._sock is not None:
+        # snapshot-swap: two racing closers (a failing call()'s error path
+        # and update_schedulers dropping the scheduler) must not leave one
+        # of them calling close() on None
+        sock, self._sock = self._sock, None
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
-            self._sock = None

@@ -224,6 +224,165 @@ def test_piece_accounting_matrix():
     assert "parent-1" in svc._pending["child-1"].blocklist
 
 
+# -------------------------------------- no-FSM-event handlers x states
+#
+# piece_finished / piece_failed / reschedule fire NO peer FSM event
+# (service_v1.go:1159-1282 handlePieceSuccess/Failure mutate accounting
+# only): for a known peer they must succeed from EVERY live pre-state
+# and leave the FSM state exactly as they found it.
+
+NO_EVENT_REQUESTS = [
+    ("piece_finished", lambda: msg.DownloadPieceFinishedRequest(
+        peer_id="p-1", piece_number=0, length=1 << 20, cost_ns=1_000_000)),
+    ("piece_failed", lambda: msg.DownloadPieceFailedRequest(
+        peer_id="p-1", parent_peer_id="ghost-parent")),
+    ("reschedule", lambda: msg.RescheduleRequest(
+        peer_id="p-1", candidate_parent_ids=["ghost-parent"])),
+]
+
+
+@pytest.mark.parametrize("name,make", NO_EVENT_REQUESTS, ids=[n for n, _ in NO_EVENT_REQUESTS])
+@pytest.mark.parametrize("pre", PRE_STATES, ids=[s.name for s in PRE_STATES])
+def test_no_event_handler_against_every_peer_state(name, make, pre):
+    svc = SchedulerService()
+    register(svc, "p-1")
+    idx = svc.state.peer_index("p-1")
+    svc.state.peer_state[idx] = int(pre)
+    response = svc.handle(make())
+    assert not isinstance(response, msg.ScheduleFailure), (name, pre, response)
+    assert svc.state.peer_state[idx] == int(pre), (name, pre)
+    if name == "reschedule":
+        # re-queued with the parent blocklisted, whatever the state
+        assert "ghost-parent" in svc._pending["p-1"].blocklist
+
+
+@pytest.mark.parametrize("pre", PRE_STATES, ids=[s.name for s in PRE_STATES])
+def test_leave_peer_from_every_state(pre):
+    """LeavePeer frees the SoA row from EVERY live state (resource
+    peer manager delete; service_v1.go:457 LeaveTask): the peer id
+    resolves to nothing afterwards and the row count drops."""
+    svc = SchedulerService()
+    register(svc, "p-1")
+    idx = svc.state.peer_index("p-1")
+    svc.state.peer_state[idx] = int(pre)
+    svc.leave_peer("p-1")  # the RPC edge routes LeavePeerRequest here
+    assert svc.state.peer_index("p-1") is None, pre
+    assert svc.state.counts()["peers"] == 0, pre
+    # idempotent: leaving again is a no-op, not a crash
+    svc.leave_peer("p-1")
+
+
+# ------------------------------------------------- task FSM product
+
+TASK_B2S_CASES = [
+    # (pre task state, request, expected post task state)
+    (TaskState.RUNNING, msg.DownloadPeerBackToSourceFinishedRequest, TaskState.SUCCEEDED),
+    (TaskState.FAILED, msg.DownloadPeerBackToSourceFinishedRequest, TaskState.SUCCEEDED),
+    (TaskState.SUCCEEDED, msg.DownloadPeerBackToSourceFinishedRequest, TaskState.SUCCEEDED),
+    (TaskState.RUNNING, msg.DownloadPeerBackToSourceFailedRequest, TaskState.FAILED),
+    (TaskState.SUCCEEDED, msg.DownloadPeerBackToSourceFailedRequest, TaskState.SUCCEEDED),
+    (TaskState.FAILED, msg.DownloadPeerBackToSourceFailedRequest, TaskState.FAILED),
+]
+
+
+@pytest.mark.parametrize(
+    "pre,req_cls,post", TASK_B2S_CASES,
+    ids=[f"{p.name}-{c.__name__}" for p, c, _ in TASK_B2S_CASES],
+)
+def test_back_to_source_drives_task_fsm(pre, req_cls, post):
+    """Back-to-source outcomes drive the TASK FSM: a landed origin fetch
+    proves content exists (SUCCEEDED, recovering FAILED tasks); a failed
+    one fails a RUNNING task but never regresses a SUCCEEDED one
+    (service_v2 handleDownloadPeerBackToSource* + fsm.py transitions)."""
+    svc = SchedulerService()
+    register(svc, "p-1")
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id="p-1"))
+    tidx = svc.state.task_index("t-1")
+    svc.state.task_state[tidx] = int(pre)
+    svc.handle(req_cls(peer_id="p-1"))
+    assert svc.state.task_state[tidx] == int(post), (pre, req_cls.__name__)
+
+
+# ------------------------------------ trace-record content assertions
+#
+# service_v1_test.go pins the CONTENT of the Download records the
+# handlers emit, not just that they emit; these do the same for the
+# success, peer-failure, and back-to-source-failure paths.
+
+def _svc_with_storage(tmp_path):
+    from dragonfly2_tpu.records.storage import TraceStorage
+
+    storage = TraceStorage(tmp_path / "matrix-data")
+    return SchedulerService(storage=storage), storage
+
+
+def test_peer_finished_record_content(tmp_path):
+    svc, storage = _svc_with_storage(tmp_path)
+    svc.announce_host(host(1))
+    svc.announce_host(host(2))
+    register(svc, "parent-1", i=1, tag="mt", application="ma",
+             content_length=4 << 20, piece_length=1 << 20, total_piece_count=4)
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id="parent-1"))
+    svc.handle(msg.DownloadPeerBackToSourceFinishedRequest(
+        peer_id="parent-1", piece_count=4, content_length=4 << 20))
+    register(svc, "child-1", i=2, tag="mt", application="ma",
+             content_length=4 << 20, piece_length=1 << 20, total_piece_count=4)
+    assert any(isinstance(r, msg.NormalTaskResponse) for r in svc.tick())
+    for piece in range(3):
+        svc.handle(msg.DownloadPieceFinishedRequest(
+            peer_id="child-1", piece_number=piece, length=1 << 20,
+            cost_ns=7_000_000, parent_peer_id="parent-1"))
+    svc.handle(msg.DownloadPeerFinishedRequest(peer_id="child-1"))
+    storage.flush()
+    records = {r.id: r for r in storage.list_downloads()}
+    rec = records["child-1"]
+    assert rec.state == "Succeeded"
+    assert rec.tag == "mt" and rec.application == "ma"
+    assert rec.finished_piece_count == 3
+    assert rec.cost > 0
+    assert rec.task.id == "t-1"
+    assert rec.task.total_piece_count == 4
+    # the child's register re-entered the task FSM Running; the b2s
+    # completion had marked it Succeeded before that
+    assert rec.task.state in ("Running", "Succeeded")
+    # the serving parent rides along with its piece history
+    parents = {p.id: p for p in rec.parents}
+    assert "parent-1" in parents
+    p = parents["parent-1"]
+    assert p.upload_piece_count == 3
+    assert len(p.pieces) == 3
+    assert all(piece.cost == 7_000_000 for piece in p.pieces)
+    assert p.host.id == "mh-1"
+
+
+def test_peer_failed_record_content(tmp_path):
+    svc, storage = _svc_with_storage(tmp_path)
+    svc.announce_host(host(1))
+    register(svc, "p-f", i=1)
+    svc.handle(msg.DownloadPeerFailedRequest(peer_id="p-f"))
+    storage.flush()
+    rec = {r.id: r for r in storage.list_downloads()}["p-f"]
+    assert rec.state == "Failed"
+    assert rec.finished_piece_count == 0
+    assert rec.host.id == "mh-1"
+    # peer FSM reflects the failure too
+    assert svc.state.peer_state[svc.state.peer_index("p-f")] == int(PeerState.FAILED)
+
+
+def test_back_to_source_failed_record_content(tmp_path):
+    svc, storage = _svc_with_storage(tmp_path)
+    svc.announce_host(host(1))
+    register(svc, "p-b", i=1)
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id="p-b"))
+    svc.handle(msg.DownloadPeerBackToSourceFailedRequest(peer_id="p-b"))
+    storage.flush()
+    rec = {r.id: r for r in storage.list_downloads()}["p-b"]
+    assert rec.state == "Failed"
+    assert rec.task.state == "Failed"  # origin fetch failure fails the task
+    # back-to-source attempt was counted on the task record
+    assert rec.task.back_to_source_peer_count == 1
+
+
 def test_register_idempotence_across_states():
     """Re-register of a known peer is load-not-create for every live
     state (service_v2 handleResource): no FSM event fires, no duplicate
