@@ -338,9 +338,21 @@ class JobManager:
                 by_owner.setdefault(name, []).append(task_id)
         polled: dict[str, int | None] = {}
         unreachable = False
+        for task_id in to_poll:
+            if self.ring.pick(task_id) is None:
+                # no owner at all (empty ring): the task is gone-for-good
+                # as far as this manager can tell — same semantics as a
+                # reachable scheduler answering "unknown task"
+                polled[task_id] = None
         for name, tids in by_owner.items():
             svc = self.schedulers.get(name)
             if svc is None:
+                # owner departed between the ring pick and the lookup:
+                # permanently-unknown, NOT a transient transport failure —
+                # holding position forever would leave the job PENDING
+                # after a decommission (review r5)
+                for tid in tids:
+                    polled[tid] = None
                 continue
             try:
                 # Locked snapshot: this runs on manager REST threads while
@@ -354,15 +366,11 @@ class JobManager:
                 unreachable = True
         states = []
         expired = False
-        never_seen = True
         for task_id in result.task_ids:
             if done.get(task_id):
                 states.append(TaskState.SUCCEEDED)
-                never_seen = False
                 continue
             raw = polled.get(task_id)
-            if seen.get(task_id) is not None:
-                never_seen = False
             if task_id not in polled:
                 # unreachable scheduler (or no owner): hold position
                 states.append(TaskState(seen[task_id])
@@ -383,20 +391,26 @@ class JobManager:
             else:
                 state = TaskState(raw)
                 seen[task_id] = int(state)
-                never_seen = False
                 if state == TaskState.SUCCEEDED:
                     done[task_id] = True
                 states.append(state)
-        # A job whose tasks NEVER appeared on any reachable scheduler past
-        # the trigger-delivery TTL is undeliverable (no seed daemon ever
-        # connected): the triggers were dropped after SEED_TRIGGER_TTL_S
-        # with only a log line, so without this the job pends forever.
-        if (never_seen and not unreachable
+        # PER-TASK undelivered check: any task that NEVER appeared on a
+        # reachable scheduler past the trigger-delivery TTL is
+        # undeliverable (its trigger was dropped after SEED_TRIGGER_TTL_S
+        # with only a log line) — a job-global flag would let one
+        # delivered task mask a dropped sibling and pend the job forever.
+        undelivered = [
+            t for t in result.task_ids
+            if not done.get(t) and seen.get(t) is None
+        ]
+        if (undelivered and not unreachable
                 and time.monotonic() - result.created_at > SEED_START_TTL_S):
             result.state = JobState.EXPIRED
             result.detail["expired_reason"] = (
-                "no seed daemon picked up any task within the delivery TTL"
+                f"{len(undelivered)} task(s) never picked up by any seed "
+                "daemon within the delivery TTL"
             )
+            result.detail["undelivered_task_ids"] = undelivered[:20]
             return result
         if any(s == TaskState.FAILED for s in states):
             result.state = JobState.FAILURE

@@ -188,6 +188,12 @@ class MLEvaluator:
     def __init__(self, server: ModelServer, fallback_algorithm: str = "default"):
         self.server = server
         self.fallback = fallback_algorithm
+        # the ensemble's residual base: the same rule blend the fallback
+        # path uses ("plugin" has no in-jit blend, so it bases on default)
+        self._base_alg = (
+            fallback_algorithm if fallback_algorithm in ("default", "nt")
+            else "default"
+        )
         self._host_emb: jax.Array | None = None
 
     def refresh_embeddings(self, graph_arrays: dict) -> None:
@@ -223,6 +229,7 @@ class MLEvaluator:
                 in_degree,
                 can_add_edge,
                 limit,
+                algorithm=self._base_alg,
             )
         return ev.schedule_candidate_parents(
             feats, blocklist, in_degree, can_add_edge, algorithm=self.fallback, limit=limit
@@ -253,6 +260,7 @@ class MLEvaluator:
                 in_degree,
                 can_add_edge,
                 limit,
+                algorithm=self._base_alg,
             )
         return ev.schedule_candidate_parents_packed(
             feats, blocklist, in_degree, can_add_edge, algorithm=self.fallback, limit=limit
@@ -268,7 +276,7 @@ class MLEvaluator:
         if self.server.ready and self._host_emb is not None:
             return _ml_schedule_from_packed(
                 self.server.model, self.server.params, self._host_emb,
-                buf, b, k, c, l, n, limit,
+                buf, b, k, c, l, n, limit, algorithm=self._base_alg,
             )
         return ev.schedule_from_packed(
             buf, b, k, c, l, n, algorithm=self.fallback, limit=limit
@@ -298,7 +306,8 @@ ML_RESIDUAL_ALPHA = 0.5
 ML_RESIDUAL_STD_FLOOR = 0.02
 
 
-def _ensemble_scores(feats: dict, gnn_logits: jax.Array) -> jax.Array:
+def _ensemble_scores(feats: dict, gnn_logits: jax.Array,
+                     algorithm: str = "default") -> jax.Array:
     valid = feats["valid"].astype(jnp.float32)
     cnt = jnp.maximum(valid.sum(-1, keepdims=True), 1.0)
 
@@ -307,7 +316,10 @@ def _ensemble_scores(feats: dict, gnn_logits: jax.Array) -> jax.Array:
         var = (((x - mean) ** 2) * valid).sum(-1, keepdims=True) / cnt
         return mean, var
 
-    blend = ev.evaluate(feats, "default")
+    # the residual base is the CONFIGURED rule blend (the evaluator's
+    # fallback algorithm), not a hardcoded "default": an nt cluster must
+    # keep its probe/RTT prior when the model comes online
+    blend = ev.evaluate(feats, algorithm)
     g_mean, g_var = _masked_moments(gnn_logits)
     z = (gnn_logits - g_mean) * jax.lax.rsqrt(g_var + 1e-6)
     _, b_var = _masked_moments(blend)
@@ -315,10 +327,10 @@ def _ensemble_scores(feats: dict, gnn_logits: jax.Array) -> jax.Array:
     return blend + ML_RESIDUAL_ALPHA * z * scale
 
 
-@functools.partial(jax.jit, static_argnames=("model", "limit"))
+@functools.partial(jax.jit, static_argnames=("model", "limit", "algorithm"))
 def _ml_schedule(
     model, params, host_emb, child_host, cand_host, feats,
-    blocklist, in_degree, can_add_edge, limit,
+    blocklist, in_degree, can_add_edge, limit, algorithm="default",
 ):
     """Fused ml-path schedule: everything from raw candidate features to
     the selected parents in one compiled program."""
@@ -331,17 +343,19 @@ def _ml_schedule(
         axis=-1,
     )
     scores = _ensemble_scores(
-        feats, gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+        feats,
+        gnn_score(model, params, host_emb, child_host, cand_host, pair_feats),
+        algorithm,
     )
     return ev.select_with_scores(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
     )
 
 
-@functools.partial(jax.jit, static_argnames=("model", "limit"))
+@functools.partial(jax.jit, static_argnames=("model", "limit", "algorithm"))
 def _ml_schedule_packed(
     model, params, host_emb, child_host, cand_host, feats,
-    blocklist, in_degree, can_add_edge, limit,
+    blocklist, in_degree, can_add_edge, limit, algorithm="default",
 ):
     """`_ml_schedule` with the packed single-output selection contract."""
     child_idc = feats["child_idc"][..., None]
@@ -353,7 +367,9 @@ def _ml_schedule_packed(
         axis=-1,
     )
     scores = _ensemble_scores(
-        feats, gnn_score(model, params, host_emb, child_host, cand_host, pair_feats)
+        feats,
+        gnn_score(model, params, host_emb, child_host, cand_host, pair_feats),
+        algorithm,
     )
     return ev.select_with_scores_packed(
         feats, scores, blocklist, in_degree, can_add_edge, limit=limit
@@ -361,9 +377,10 @@ def _ml_schedule_packed(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "b", "k", "c", "l", "n", "limit")
+    jax.jit, static_argnames=("model", "b", "k", "c", "l", "n", "limit", "algorithm")
 )
-def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit):
+def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit,
+                             algorithm="default"):
     """`_ml_schedule_packed` over the single-buffer transport
     (ops/evaluator.pack_eval_batch): the whole ml tick is one H2D + one
     dispatch + one D2H like the linear-blend path — only the (device-
@@ -379,7 +396,7 @@ def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit)
     )
     scores = _ensemble_scores(f, gnn_score(
         model, params, host_emb, f["child_host_slot"], f["cand_host_slot"], pair_feats
-    ))
+    ), algorithm)
     return ev.select_with_scores_packed(
         f, scores, f["blocklist"], f["in_degree"], f["can_add_edge"], limit=limit
     )

@@ -234,3 +234,37 @@ def test_preheat_expires_when_unfinished_task_vanishes():
     # never-seen tasks keep PENDING (seed may simply not have started)
     result2 = jm.create_preheat(PreheatRequest(urls=["https://e.com/c"]))
     assert jm.get(result2.job_id).state == JobState.PENDING
+
+
+def test_partially_undelivered_preheat_expires():
+    """One delivered task must NOT mask a dropped sibling trigger: the
+    per-task undelivered check expires the job once the start TTL passes
+    with a task that no seed ever picked up (review r5 — a job-global
+    flag pended these forever)."""
+    import time as _time
+
+    from dragonfly2_tpu.cluster import messages as msg
+    from dragonfly2_tpu.cluster.jobs import JobState
+
+    svc = SchedulerService()
+    jm = JobManager({"s1": svc}, [seed_host(0)])
+    result = jm.create_preheat(
+        PreheatRequest(urls=["https://e.com/a", "https://e.com/b"])
+    )
+    # seed completes ONLY the first task (second trigger "dropped")
+    trig = svc.seed_triggers[0]
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="seed-p", task_id=trig.task_id, host=seed_host(0),
+        url=trig.url, content_length=8 << 20, piece_length=4 << 20,
+        total_piece_count=2, priority=1,
+    ))
+    svc.back_to_source_started(
+        msg.DownloadPeerBackToSourceStartedRequest(peer_id="seed-p"))
+    svc.back_to_source_finished(msg.DownloadPeerBackToSourceFinishedRequest(
+        peer_id="seed-p", content_length=8 << 20, piece_count=2))
+
+    assert jm.get(result.job_id).state == JobState.PENDING
+    result.created_at = _time.monotonic() - 1000  # start TTL long past
+    got = jm.get(result.job_id)
+    assert got.state == JobState.EXPIRED
+    assert len(got.detail["undelivered_task_ids"]) == 1
