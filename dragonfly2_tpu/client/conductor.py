@@ -217,14 +217,54 @@ class PeerTaskConductor:
             for number in self._needed & available:
                 self.dispatcher.put(number, parent_id)
 
-        workers = [
-            asyncio.create_task(self._piece_worker(ts)) for _ in range(self.workers)
-        ]
-        await asyncio.gather(*workers)
+        # Push-style piece announcements (the reference's per-parent
+        # SyncPieceTasks stream, peertask_piecetask_synchronizer.go):
+        # every IN-PROGRESS parent gets a subscriber task long-polling
+        # its /pieces endpoint — new pieces land in the dispatcher as
+        # the parent commits them, instead of waiting for the next
+        # whole-wave re-poll. Workers stay alive while any subscription
+        # might still produce work.
+        self._refreshers = {
+            asyncio.create_task(self._piece_refresher(p))
+            for p in live
+            if not (self._parent_pieces.get(p.peer_id) or {}).get("done")
+        }
+        try:
+            workers = [
+                asyncio.create_task(self._piece_worker(ts)) for _ in range(self.workers)
+            ]
+            await asyncio.gather(*workers)
+        finally:
+            for r in self._refreshers:
+                r.cancel()
+            await asyncio.gather(*self._refreshers, return_exceptions=True)
+            self._refreshers = set()
         if not self._needed:
             ts.mark_done(content_length, total_pieces)
             return True
         return False
+
+    async def _piece_refresher(self, parent: msg.CandidateParent) -> None:
+        """Subscribe to one in-progress parent: long-poll its /pieces with
+        wait_after = what we already know, feeding each newly announced
+        piece into the dispatcher. Ends when the parent completes, fails,
+        or nothing is needed anymore."""
+        pid = parent.peer_id
+        while self._needed and pid not in self._failed_parents:
+            doc = self._parent_pieces.get(pid) or {}
+            if doc.get("done"):
+                return
+            known = len(doc.get("pieces", []))
+            new_doc = await asyncio.to_thread(
+                self._fetch_piece_doc, parent, known, 5.0
+            )
+            if new_doc is None:
+                self._failed_parents.add(pid)
+                return
+            self._parent_pieces[pid] = new_doc
+            available = {p["number"] for p in new_doc.get("pieces", [])}
+            for number in self._needed & available:
+                self.dispatcher.put(number, pid)
 
     def _fetch_piece_doc(self, parent: msg.CandidateParent) -> dict | None:
         url = f"http://{parent.ip}:{parent.download_port}/pieces/{self.task_id}"

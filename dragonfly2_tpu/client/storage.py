@@ -59,6 +59,12 @@ class TaskStorage:
         self.pieces_path = self.dir / "pieces.jsonl"
         self.meta = meta
         self._lock = threading.RLock()
+        # notified on every piece commit and on mark_done: the upload
+        # server's long-poll piece listing (GET /pieces/<task>?wait_after=N)
+        # blocks on it so children learn about new pieces push-style — the
+        # role the reference's per-parent SyncPieceTasks stream plays
+        # (client/daemon/peertask_piecetask_synchronizer.go)
+        self.piece_cond = threading.Condition(self._lock)
         self._bitset = Bitset()
         for n in meta.pieces:
             self._bitset.set(n)
@@ -95,6 +101,7 @@ class TaskStorage:
             # rewriting every accumulated entry (which is O(n^2) per task).
             with open(self.pieces_path, "a") as f:
                 f.write(json.dumps(dataclasses.asdict(piece)) + "\n")
+            self.piece_cond.notify_all()
             return piece
 
     def read_piece(self, number: int) -> bytes:
@@ -129,6 +136,22 @@ class TaskStorage:
             if total_pieces is not None:
                 self.meta.total_pieces = total_pieces
             self._flush_meta()
+            self.piece_cond.notify_all()
+
+    def wait_for_pieces(self, known_count: int, timeout: float) -> bool:
+        """Block until this task holds MORE than `known_count` pieces or
+        is done (True), or the timeout passes (False) — the long-poll
+        primitive behind push-style piece announcements."""
+        deadline = time.monotonic() + timeout
+        with self.piece_cond:
+            while (
+                len(self.meta.pieces) <= known_count and not self.meta.done
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.piece_cond.wait(remaining)
+            return True
 
     def size_on_disk(self) -> int:
         try:

@@ -9,6 +9,19 @@ peer-to-peer data path — piece bytes move as HTTP range responses, SURVEY
                                             the GetPieceTasks/SyncPieceTasks
                                             equivalent children use to learn
                                             what a parent can serve
+  GET /pieces/{task_id}?wait_after=N[&timeout=S]
+                                         -> LONG-POLL: block until the task
+                                            holds MORE than N pieces (or is
+                                            done, or S seconds pass), then
+                                            answer with the current listing.
+                                            This is the push half of piece
+                                            announcements: a child subscribes
+                                            to an in-progress parent and
+                                            learns each new piece within one
+                                            notification instead of one
+                                            re-poll round trip per wave
+                                            (peertask_piecetask_synchronizer
+                                            .go's per-parent sync stream)
   GET /healthy                           -> liveness
 Headers carry the piece digest so children can verify before commit.
 """
@@ -40,7 +53,14 @@ class UploadServer:
                     self._reply(200, b"ok")
                     return
                 if parts.path.startswith("/pieces/"):
-                    self._serve_piece_list(parts.path[len("/pieces/") :])
+                    q = urllib.parse.parse_qs(parts.query)
+                    wait_after = (
+                        int(q["wait_after"][0]) if "wait_after" in q else None
+                    )
+                    timeout = float(q.get("timeout", ["10.0"])[0])
+                    self._serve_piece_list(
+                        parts.path[len("/pieces/") :], wait_after, timeout
+                    )
                     return
                 if not parts.path.startswith("/download/"):
                     self._reply(404, b"not found")
@@ -56,11 +76,20 @@ class UploadServer:
                 else:
                     self._serve_file(ts)
 
-            def _serve_piece_list(self, task_id: str):
+            def _serve_piece_list(
+                self, task_id: str, wait_after: int | None = None,
+                timeout: float = 10.0,
+            ):
                 ts = manager.storage.get(task_id)
                 if ts is None:
                     self._reply(404, b"task not stored")
                     return
+                if wait_after is not None:
+                    # long-poll: parks THIS handler thread on the task's
+                    # piece condition (bounded by the capped timeout) —
+                    # ThreadingHTTPServer spawns per-connection threads,
+                    # so parked subscribers do not block other uploads
+                    ts.wait_for_pieces(wait_after, min(timeout, 30.0))
                 meta = ts.meta
                 body = json.dumps(
                     {
