@@ -366,15 +366,12 @@ def run(
 
 
 def _phase_p50(svc, control_ms: list[float] | None = None) -> dict:
-    """p50 of each tick phase recorded by SchedulerService.tick, plus the
-    per-tick trivial-dispatch control when one was timed."""
-    if not svc.tick_phases:
-        return {}
-    keys = set().union(*svc.tick_phases)
-    out = {
-        k: round(statistics.median([p.get(k, 0.0) for p in svc.tick_phases]), 3)
-        for k in sorted(keys)
-    }
+    """Per-phase p50s read from the service's own flight recorder
+    (telemetry/flight.PhaseRecorder — the same ring that feeds the
+    Prometheus phase histogram, so bench numbers and production metrics
+    cannot diverge), plus the per-tick trivial-dispatch control when one
+    was timed."""
+    out = svc.recorder.phase_p50s()
     if control_ms:
         out["control_dispatch"] = round(statistics.median(control_ms), 3)
     return out

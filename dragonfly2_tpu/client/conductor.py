@@ -27,6 +27,7 @@ from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc.client import SchedulerConnection
 from dragonfly2_tpu.telemetry import default_registry
 from dragonfly2_tpu.telemetry.series import daemon_series
+from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.utils import dferrors
 
 logger = logging.getLogger(__name__)
@@ -162,7 +163,10 @@ class PeerTaskConductor:
                 )
                 return
             if isinstance(response, msg.NormalTaskResponse):
-                done = await self._download_from_parents(ts, response.candidate_parents)
+                done = await self._download_from_parents(
+                    ts, response.candidate_parents,
+                    trace_context=getattr(response, "trace_context", None),
+                )
                 if done:
                     await self._finish(ts)
                     return
@@ -178,10 +182,23 @@ class PeerTaskConductor:
     # ------------------------------------------------------------- parents
 
     async def _download_from_parents(
-        self, ts: TaskStorage, parents: list[msg.CandidateParent]
+        self, ts: TaskStorage, parents: list[msg.CandidateParent],
+        trace_context: dict | None = None,
     ) -> bool:
         """Pull every needed piece from the given parents; True if the task
-        completed."""
+        completed. `trace_context` is the scheduling response's propagated
+        context (rpc/wire.py envelope): the download span continues the
+        SCHEDULER TICK's trace, so one trace id covers the tick and the
+        piece downloads it caused."""
+        with default_tracer().span(
+            "dfdaemon.download_pieces", remote_parent=trace_context,
+            task_id=self.task_id, parents=len(parents),
+        ):
+            return await self._download_from_parents_inner(ts, parents)
+
+    async def _download_from_parents_inner(
+        self, ts: TaskStorage, parents: list[msg.CandidateParent]
+    ) -> bool:
         for parent in parents:
             self._parents[parent.peer_id] = parent
         live = [p for p in parents if p.peer_id not in self._failed_parents]

@@ -60,17 +60,24 @@ class Announcer:
     def announce_to_trainer(self) -> None:
         """Stream download.csv (mlp) + networktopology.csv (gnn) in chunks;
         abort clears the trainer's partial files (announcer.go:142-235 +
-        trainer error path service_v1.go:117-131)."""
-        try:
-            for chunk in _chunks(self.storage.open_download(), self.chunk_bytes):
-                self.trainer.train_mlp_chunk(self.host_id, chunk)
-            for chunk in _chunks(self.storage.open_network_topology(), self.chunk_bytes):
-                self.trainer.train_gnn_chunk(self.host_id, chunk)
-            self.trainer.train_finish(self.host_id)
-            self.uploads += 1
-        except Exception:
-            self.trainer.train_abort(self.host_id)
-            raise
+        trainer error path service_v1.go:117-131). The upload span's
+        context rides any wire-backed sink's frames (rpc/wire.py), so the
+        trainer's ingestion shares this trace id."""
+        from dragonfly2_tpu.telemetry.tracing import default_tracer
+
+        with default_tracer().span(
+            "scheduler.announce_to_trainer", host_id=self.host_id
+        ):
+            try:
+                for chunk in _chunks(self.storage.open_download(), self.chunk_bytes):
+                    self.trainer.train_mlp_chunk(self.host_id, chunk)
+                for chunk in _chunks(self.storage.open_network_topology(), self.chunk_bytes):
+                    self.trainer.train_gnn_chunk(self.host_id, chunk)
+                self.trainer.train_finish(self.host_id)
+                self.uploads += 1
+            except Exception:
+                self.trainer.train_abort(self.host_id)
+                raise
 
     def keepalive_once(self) -> None:
         if self.keepalive is not None:

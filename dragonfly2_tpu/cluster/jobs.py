@@ -128,6 +128,16 @@ class RemoteScheduler:
     def list_hosts(self) -> list[dict]:
         return self.info()[1]
 
+    def flight_recorder(self, last_n: int = 64) -> dict:
+        """The remote scheduler's flight-recorder dump (last-N tick phase
+        breakdowns + jit compile counters + open spans). Raises
+        ConnectionError when unreachable so the manager surfaces the
+        failure instead of an empty-but-healthy-looking dump."""
+        resp = self._client.call(msg.FlightRecorderRequest(last_n=last_n))
+        if not isinstance(resp, msg.FlightRecorderResponse):
+            raise ConnectionError(f"bad FlightRecorder reply from {self.address}")
+        return resp.dump
+
     def close(self) -> None:
         self._client.close()
 

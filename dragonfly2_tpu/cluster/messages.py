@@ -287,6 +287,20 @@ class SchedulerInfoResponse:
     hosts: list
 
 
+@dataclasses.dataclass
+class FlightRecorderRequest:
+    """Manager/operator -> scheduler: dump the in-product flight recorder
+    (telemetry/flight.py — last-N tick phase breakdowns, jit compile/
+    retrace counters, spans currently open)."""
+
+    last_n: int = 64
+
+
+@dataclasses.dataclass
+class FlightRecorderResponse:
+    dump: dict = dataclasses.field(default_factory=dict)
+
+
 # ----------------------------------------------------------------- stat
 
 @dataclasses.dataclass

@@ -33,6 +33,7 @@ OBJECTS = (
     "users", "roles", "permissions", "oauth", "clusters", "scheduler-clusters",
     "schedulers", "seed-peer-clusters", "seed-peers", "peers", "buckets",
     "configs", "jobs", "applications", "models", "personal-access-tokens",
+    "flight-recorder",
 )
 
 _PBKDF2_ITERS = 100_000

@@ -186,6 +186,17 @@ class ManagerREST:
             return 200, [{"object": o, "actions": ["read", "*"]} for o in auth.OBJECTS]
         if group == "jobs":
             return self._jobs(req)
+        if group == "flight-recorder":
+            # JWT-authenticated ("flight-recorder" read permission, granted
+            # to guest+root by init_policies): the dump fans one RPC out to
+            # every scheduler, so anonymous callers must not drive it
+            if method != "GET" or parts:
+                return 405, {"error": "method not allowed"}
+            try:
+                last_n = min(max(int(req.query.get("last_n", 64) or 64), 1), 4096)
+            except ValueError:
+                return 400, {"error": "last_n must be an integer"}
+            return 200, self.service.flight_recorder(last_n)
         if group == "models" and method == "PATCH" and len(parts) == 1:
             return self._update_model(req)
         if group == "personal-access-tokens":
@@ -477,6 +488,13 @@ def openapi_spec() -> dict:
     paths["/api/v1/jobs"] = {
         "get": op("list jobs", "jobs"),
         "post": op("create job (preheat / sync_peers)", "jobs", body=True),
+    }
+    paths["/api/v1/flight-recorder"] = {
+        "get": op(
+            "flight-recorder dump: last-N scheduler tick phase breakdowns, "
+            "jit compile/retrace counters, open spans (?last_n=64)",
+            "flight-recorder",
+        )
     }
     paths["/api/v1/jobs/{id}"] = {"get": op("get job", "jobs", params=("id",))}
     paths["/api/v1/personal-access-tokens"] = {

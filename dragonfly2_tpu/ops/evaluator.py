@@ -490,6 +490,17 @@ def schedule_from_packed(
     return _pack_selection(values, indices, valid)
 
 
+# Flight-recorder instrumentation on the serving entry point (the tick's
+# ONE device call): compile/retrace counts per (B, K, ...) signature plus
+# the dispatch-vs-device time split (telemetry/flight.py). The wrapper
+# forwards attributes, so `.lower()`/warmup callers are unaffected.
+from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  # noqa: E402
+
+schedule_from_packed = _instrument_jit(
+    schedule_from_packed, "evaluator.schedule_from_packed", service="scheduler"
+)
+
+
 @functools.partial(jax.jit, static_argnames=("algorithm",))
 def find_success_parent(
     feats: dict,

@@ -400,3 +400,15 @@ def _ml_schedule_from_packed(model, params, host_emb, buf, b, k, c, l, n, limit,
     return ev.select_with_scores_packed(
         f, scores, f["blocklist"], f["in_degree"], f["can_add_edge"], limit=limit
     )
+
+
+# Flight-recorder instrumentation (telemetry/flight.py) on the ml serving
+# entry points: the fused ml tick call and the embedding refresh — the two
+# programs whose silent retraces used to be invisible until a 35 s compile
+# landed mid-tick.
+from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  # noqa: E402
+
+_ml_schedule_from_packed = _instrument_jit(
+    _ml_schedule_from_packed, "ml.schedule_from_packed", service="scheduler"
+)
+_gnn_embed = _instrument_jit(_gnn_embed, "ml.embed_hosts", service="scheduler")

@@ -16,6 +16,7 @@ import threading
 
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.utils.hashring import HashRing
 
 wire.register_module(msg)
@@ -326,6 +327,18 @@ class TrainerClient:
         """`datasets` maps name -> bytes OR an iterable of bytes parts
         (e.g. one per CSV rotation file), so callers can stream a large
         trace history without materializing it all at once."""
+        with default_tracer().span(
+            "scheduler.train_upload", host_id=host_id, datasets=len(datasets),
+        ):
+            return await self._train(host_id, ip, hostname, datasets, chunk_size)
+
+    async def _train(
+        self, host_id: str, ip: str, hostname: str, datasets: dict,
+        chunk_size: int,
+    ) -> msg.TrainResponse:
+        # Every frame below inherits the upload span's context through the
+        # wire envelope, so the trainer's train_ingest span continues this
+        # trace (one trace id across the announce->train edge).
         reader, writer = await asyncio.open_connection(
             self.host, self.port, ssl=self.ssl_context
         )

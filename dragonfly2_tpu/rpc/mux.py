@@ -9,9 +9,11 @@ whose first byte is 0x00 for any frame under 16 MiB — the two are
 disjoint, so a 4-byte peek routes with no ambiguity (frames ≥16 MiB only
 occur on the trainer upload path, which never fronts a mux).
 
-HTTP side serves `/healthz` (liveness — the health RPC's HTTP twin) and
-`/metrics` (Prometheus text). The wire side also answers
-`HealthCheckRequest` → SERVING on every server that registers it.
+HTTP side serves `/healthz` (liveness — the health RPC's HTTP twin),
+`/metrics` (Prometheus text), and — when a `flight_source` is wired —
+`/debug/flight` (the flight-recorder dump, telemetry/flight.py). The wire
+side also answers `HealthCheckRequest` → SERVING on every server that
+registers it.
 """
 
 from __future__ import annotations
@@ -111,6 +113,7 @@ class MuxServer:
         metrics_registry=None,
         health_check=None,  # () -> bool; liveness beyond "process is up"
         ssl_context=None,
+        flight_source=None,  # () -> dict; /debug/flight JSON body
     ):
         self.rpc_handler = rpc_handler
         self.ssl_context = ssl_context
@@ -118,6 +121,15 @@ class MuxServer:
         self.port = port
         self.metrics_registry = metrics_registry
         self.health_check = health_check
+        # Flight-recorder dump for the same port daemons already scrape:
+        # an explicit source (e.g. SchedulerService.flight_dump) wins;
+        # otherwise the process-global dump serves, matching the
+        # --metrics-port monitor endpoint (telemetry/metrics.py).
+        if flight_source is None:
+            from dragonfly2_tpu.telemetry import flight
+
+            flight_source = flight.dump
+        self.flight_source = flight_source
         self._server: asyncio.AbstractServer | None = None
         self._tracker = ConnTracker()
 
@@ -208,6 +220,10 @@ class MuxServer:
                 status, body = (200, b"ok") if ok else (503, b"not serving")
             elif path == "/metrics" and self.metrics_registry is not None:
                 status, body = 200, self.metrics_registry.expose().encode()
+            elif path == "/debug/flight":
+                import json
+
+                status, body = 200, json.dumps(self.flight_source()).encode()
             else:
                 status, body = 404, b"not found"
             reason = {200: "OK", 404: "Not Found", 503: "Service Unavailable"}[status]

@@ -42,6 +42,17 @@ logger = logging.getLogger(__name__)
 # is declared undeliverable (preheat racing the seed's announce).
 SEED_TRIGGER_TTL_S = 60.0
 
+# Per-PIECE report types arrive at the cluster's aggregate piece rate —
+# orders of magnitude above every other message. A handler span per piece
+# report (token_hex + exporter fan-out) buys no diagnostic value, so these
+# keep their wire trace context but are never span-wrapped server-side.
+_UNTRACED_RPC_TYPES = (
+    msg.DownloadPieceFinishedRequest,
+    msg.DownloadPieceFailedRequest,
+    msg.ProbeFinishedRequest,
+    sv1.V1PieceResult,
+)
+
 
 class SchedulerRPCServer:
     def __init__(self, service, host: str = "127.0.0.1", port: int = 0,
@@ -287,9 +298,26 @@ class SchedulerRPCServer:
                 self._peer_conn[peer_id] = writer
                 owned_peers.add(peer_id)
 
+        # wire-propagated trace context (rpc/wire.py envelope): the
+        # handler span continues the CALLER's trace. Untraced traffic and
+        # per-piece report types pay nothing — no span, no token_hex, no
+        # exporter fan-out. The span wraps service.mu (its duration shows
+        # lock contention) and exporters fire only AFTER the lock drops.
+        remote_ctx = getattr(request, "trace_context", None)
+        traced = remote_ctx is not None and not isinstance(
+            request, _UNTRACED_RPC_TYPES
+        )
+
         def run():
-            with self.service.mu:
-                return self._dispatch(request, owned_peers)
+            if not traced:
+                with self.service.mu:
+                    return self._dispatch(request, owned_peers)
+            with default_tracer().span(
+                f"scheduler.rpc.{type(request).__name__}",
+                remote_parent=remote_ctx,
+            ):
+                with self.service.mu:
+                    return self._dispatch(request, owned_peers)
 
         return await asyncio.to_thread(run)
 
@@ -335,6 +363,10 @@ class SchedulerRPCServer:
         if isinstance(request, msg.SchedulerInfoRequest):
             return msg.SchedulerInfoResponse(
                 counts=svc.counts(), hosts=svc.list_hosts()
+            )
+        if isinstance(request, msg.FlightRecorderRequest):
+            return msg.FlightRecorderResponse(
+                dump=svc.flight_dump(last_n=request.last_n)
             )
         if isinstance(request, sv1.V1_REQUEST_TYPES):
             return self._dispatch_v1(request, owned_peers)
@@ -585,20 +617,24 @@ class SchedulerRPCServer:
                 return out
 
         # The device call blocks; run it off-loop so streams stay live.
-        last_phases = svc.tick_phases[-1] if svc.tick_phases else None
-        with default_tracer().span("scheduler.tick", pending=pending):
+        # (The per-phase histogram is observed by the service's own
+        # PhaseRecorder inside tick() — telemetry/flight.py — so the
+        # server no longer re-derives it from the ring.)
+        with default_tracer().span("scheduler.tick", pending=pending) as tick_span:
             responses = await asyncio.to_thread(run)
         self._m_tick.labels().observe(time.perf_counter() - t0)
         self._m_batch.labels().observe(pending)
-        # identity check, not length: a tick with no device work appends
-        # nothing (and the deque's length saturates at its maxlen), so a
-        # length guard would double-count or go silent
-        if svc.tick_phases and svc.tick_phases[-1] is not last_phases:
-            for phase, ms in svc.tick_phases[-1].items():
-                self.metrics.schedule_phase.labels(phase).observe(ms / 1e3)
-        await self._send_responses(responses)
+        # Responses carry the tick span's context so the client's piece
+        # downloads continue the scheduling trace (one trace id from the
+        # tick through the daemon's downloads).
+        await self._send_responses(
+            responses,
+            trace_context={
+                "trace_id": tick_span.trace_id, "span_id": tick_span.span_id,
+            },
+        )
 
-    async def _send_responses(self, responses) -> None:
+    async def _send_responses(self, responses, trace_context=None) -> None:
         # v1 responses arrive here already converted to V1PeerPacket (the
         # conversion runs in the tick thread under service.mu — ADVICE r4
         # low); a packet routes by its src_pid.
@@ -611,7 +647,7 @@ class SchedulerRPCServer:
             if writer is None:
                 continue
             try:
-                wire.write_frame(writer, response)
+                wire.write_frame(writer, response, trace_context=trace_context)
                 await writer.drain()
             except (ConnectionError, RuntimeError):
                 async with self._lock:
@@ -667,6 +703,10 @@ class TrainerRPCServer:
     async def _serve_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._writers.add(writer)
         host_id = None
+        # trace context from the upload stream's frames (rpc/wire.py): the
+        # training run parents on the announcer/scheduler span that sent
+        # the datasets — one trace id across the announce->train edge
+        remote_ctx = None
         try:
             committed = False
             while True:
@@ -676,6 +716,8 @@ class TrainerRPCServer:
                     # connection tore (read_frame folds ConnectionError into
                     # None) — never train on a possibly-truncated dataset.
                     break
+                if remote_ctx is None:
+                    remote_ctx = getattr(request, "trace_context", None)
                 health = mux.handle_health_request(request, self.health_check)
                 if health is not None:
                     wire.write_frame(writer, health)
@@ -713,7 +755,11 @@ class TrainerRPCServer:
                 return
             # commit -> train both models off-loop (service_v1.go:155 goroutine)
             try:
-                outcome = await asyncio.to_thread(self.service.train_finish, host_id)
+                with default_tracer().span(
+                    "trainer.train_ingest", remote_parent=remote_ctx,
+                    host_id=host_id,
+                ):
+                    outcome = await asyncio.to_thread(self.service.train_finish, host_id)
                 self._m_trains.labels("succeeded").inc()
                 self.metrics.training.labels().inc()
                 parts = []

@@ -20,6 +20,8 @@ import typing
 
 import msgpack
 
+from dragonfly2_tpu.telemetry import tracing as _tracing
+
 _REGISTRY: dict[str, type] = {}
 
 _LEN = struct.Struct(">I")
@@ -80,11 +82,23 @@ def _instantiate(cls: type, fields: dict):
     return cls(**kwargs)
 
 
-def encode(message) -> bytes:
+def encode(message, trace_context: dict | None = None) -> bytes:
+    """Frame one message. Trace context ({"trace_id", "span_id"}) rides
+    the envelope — the explicit argument wins, else the ambient span's
+    context (telemetry/tracing.current_context) is injected so a span
+    opened on one side of the wire continues on the other. No active
+    span, no extra bytes."""
     name = type(message).__name__
     if name not in _REGISTRY:
         raise TypeError(f"message type {name} not registered")
-    payload = msgpack.packb({"t": name, "d": _to_plain(message)}, use_bin_type=True)
+    env: dict = {"t": name, "d": _to_plain(message)}
+    tc = trace_context if trace_context is not None else _tracing.current_context()
+    if tc and tc.get("trace_id"):
+        env["tc"] = {
+            "trace_id": str(tc["trace_id"]),
+            "span_id": str(tc.get("span_id") or ""),
+        }
+    payload = msgpack.packb(env, use_bin_type=True)
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame too large: {len(payload)}")
     return _LEN.pack(len(payload)) + payload
@@ -95,7 +109,16 @@ def decode(payload: bytes):
     cls = _REGISTRY.get(obj.get("t"))
     if cls is None:
         raise TypeError(f"unknown message type {obj.get('t')!r}")
-    return _instantiate(cls, obj.get("d", {}))
+    message = _instantiate(cls, obj.get("d", {}))
+    tc = obj.get("tc")
+    if tc:
+        try:
+            # non-field attribute: dataclass __eq__/asdict ignore it, so
+            # the codec's roundtrip contract (test_wire_property) holds
+            object.__setattr__(message, "trace_context", dict(tc))
+        except AttributeError:
+            pass  # slotted message types simply drop the context
+    return message
 
 
 async def read_frame(reader: asyncio.StreamReader) -> object | None:
@@ -111,5 +134,5 @@ async def read_frame(reader: asyncio.StreamReader) -> object | None:
     return decode(payload)
 
 
-def write_frame(writer, message) -> None:
-    writer.write(encode(message))
+def write_frame(writer, message, trace_context: dict | None = None) -> None:
+    writer.write(encode(message, trace_context=trace_context))

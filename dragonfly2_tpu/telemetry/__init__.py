@@ -2,8 +2,18 @@ from dragonfly2_tpu.telemetry.metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    MonitorServer,
     Registry,
     default_registry,
     serve_metrics,
 )
-from dragonfly2_tpu.telemetry.tracing import Span, Tracer, default_tracer  # noqa: F401
+from dragonfly2_tpu.telemetry.tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    current_context,
+    default_tracer,
+)
+from dragonfly2_tpu.telemetry.flight import (  # noqa: F401
+    PhaseRecorder,
+    instrument_jit,
+)

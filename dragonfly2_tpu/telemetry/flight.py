@@ -1,0 +1,336 @@
+"""Hot-loop flight recorder: in-product phase timing + XLA compile/retrace
+accounting.
+
+The product itself owns the numbers the benches used to hand-roll
+(bench.py's per-tick `time.perf_counter()` timers): `PhaseRecorder` lives
+inside the scheduler's tick (cluster/scheduler.py) keeping a ring of the
+last-N per-phase wall-time breakdowns AND feeding the Prometheus phase
+histogram, so bench artifacts and production metrics read the same
+source. `instrument_jit` wraps the jitted entry points (evaluator
+scoring, GNN embed refresh, trainer epoch step) to count compiles/
+retraces per call signature and split host-dispatch from device time via
+`block_until_ready` deltas. `dump()` assembles the operator-facing
+flight-recorder snapshot (last-N ticks + compile counters + spans
+currently open) served over the scheduler wire RPC
+(FlightRecorderRequest), the manager REST surface
+(GET /api/v1/flight-recorder), and the mux/monitor HTTP debug routes.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+import weakref
+from collections import deque
+
+from dragonfly2_tpu.telemetry import metrics as _metrics
+from dragonfly2_tpu.telemetry import series as _series
+from dragonfly2_tpu.telemetry.tracing import default_tracer
+
+# module-level binding: mark() runs up to 7x per tick in the scheduler's
+# hot loop; the attribute chain lookup is measurable at that cadence
+_perf = time.perf_counter
+
+# ------------------------------------------------------------ phase timing
+
+
+class PhaseRecorder:
+    """Low-overhead per-tick phase recorder.
+
+    One `begin()` per tick, `mark(name)` after each phase (marks
+    accumulate, so a phase touched once per chunk sums across chunks),
+    one `commit()` when the tick did real work. Commit appends the
+    {phase: ms} dict to a bounded ring and observes the (label-cached)
+    histogram children. A disabled recorder no-ops every call — the
+    overhead budget is <=1% of tick p50, asserted by the tier-1
+    micro-check (tests/test_flight_recorder.py)."""
+
+    __slots__ = ("ring", "ticks", "enabled", "_histogram", "_children",
+                 "_phases", "_t0", "_open", "__weakref__")
+
+    def __init__(self, histogram=None, maxlen: int = 4096,
+                 enabled: bool = True, name: str | None = None):
+        self.ring: deque = deque(maxlen=maxlen)
+        self.ticks = 0  # total commits, beyond what the ring retains
+        self.enabled = enabled
+        self._histogram = histogram
+        self._children: dict = {}
+        self._phases: dict[str, float] = {}
+        self._t0 = 0.0
+        self._open = False
+        if name is not None:
+            register_recorder(name, self)
+
+    def begin(self) -> None:
+        if not self.enabled:
+            return
+        self._phases = {}
+        self._t0 = _perf()
+        self._open = True
+
+    def mark(self, name: str) -> None:
+        if not self._open:
+            return
+        now = _perf()
+        phases = self._phases
+        phases[name] = phases.get(name, 0.0) + (now - self._t0) * 1e3
+        self._t0 = now
+
+    def commit(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        phases = self._phases
+        self.ring.append(phases)
+        self.ticks += 1
+        h = self._histogram
+        if h is not None:
+            children = self._children
+            for phase, ms in phases.items():
+                child = children.get(phase)
+                if child is None:
+                    child = children[phase] = h.labels(phase)
+                child.observe(ms / 1e3)
+
+    # ------------------------------------------------------------- reading
+
+    def snapshot(self, last_n: int | None = None) -> list[dict]:
+        # dump readers (manager REST / wire RPC threads) race the tick
+        # thread's append; deque iteration then raises RuntimeError —
+        # retry instead of locking the hot path
+        ticks: list[dict] = []
+        for _ in range(4):
+            try:
+                ticks = list(self.ring)
+                break
+            except RuntimeError:
+                continue
+        return ticks if last_n is None else ticks[-last_n:]
+
+    def phase_p50s(self, last_n: int | None = None) -> dict[str, float]:
+        """Per-phase p50 ms over the retained ticks — the exact numbers
+        the loop bench publishes (bench_loop.py), now computed from the
+        recorder so bench and production metrics cannot diverge."""
+        ticks = self.snapshot(last_n)
+        if not ticks:
+            return {}
+        keys = set().union(*ticks)
+        return {
+            k: round(statistics.median([p.get(k, 0.0) for p in ticks]), 3)
+            for k in sorted(keys)
+        }
+
+    def dump(self, last_n: int = 64) -> dict:
+        # p50 over the SAME window as "last": an operator asking for the
+        # last 8 ticks is diagnosing now — a median over 4096 mostly-
+        # healthy historical ticks would mask the very regression the
+        # endpoint exists to surface
+        return {
+            "ticks_total": self.ticks,
+            "p50_ms": self.phase_p50s(last_n),
+            "last": self.snapshot(last_n),
+        }
+
+
+# Named recorders for the process-wide dump (the monitor HTTP endpoint has
+# no handle on the scheduler object). Weak refs: test suites and bench A/B
+# arms create many short-lived services; registration must not keep their
+# 4096-tick rings alive. Last registration wins per name — a live process
+# runs one scheduler.
+_RECORDERS: dict[str, "weakref.ref[PhaseRecorder]"] = {}
+_recorders_mu = threading.Lock()
+
+
+def register_recorder(name: str, recorder: PhaseRecorder) -> None:
+    with _recorders_mu:
+        _RECORDERS[name] = weakref.ref(recorder)
+
+
+def _live_recorders() -> dict[str, PhaseRecorder]:
+    out = {}
+    with _recorders_mu:
+        for name, ref in list(_RECORDERS.items()):
+            rec = ref()
+            if rec is None:
+                del _RECORDERS[name]
+            else:
+                out[name] = rec
+    return out
+
+
+# -------------------------------------------------------- jit entry points
+
+
+# Weak refs, like _RECORDERS: the trainer creates a wrapper per training
+# run around a per-run jitted closure — a strong global reference would
+# pin that run's compile cache and device executables for the process
+# lifetime after training returns. Module-level wrappers (evaluator,
+# serving) stay alive through their module globals regardless.
+_WRAPPERS: dict[str, "weakref.ref[JitWrapper]"] = {}
+_wrappers_mu = threading.Lock()
+
+
+def _sig_of(v):
+    """Hashable call-signature component: arrays collapse to (shape,
+    dtype) — the thing jit specializes on — containers recurse, hashable
+    statics ride as themselves, everything else degrades to its type."""
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(v, dict):
+        return ("dict", tuple((k, _sig_of(x)) for k, x in sorted(v.items())))
+    if isinstance(v, (list, tuple)):
+        return ("seq", tuple(_sig_of(x) for x in v))
+    try:
+        hash(v)
+    except TypeError:
+        return ("type", type(v).__name__)
+    return v
+
+
+class JitWrapper:
+    """Callable wrapper around a jitted entry point.
+
+    Per call: signature bookkeeping (new signature == a compile/retrace),
+    host-dispatch time (until the call returns), and — when `block` —
+    the device-completion wait (`jax.block_until_ready` delta). Unknown
+    attributes forward to the wrapped function so `.lower()` /
+    `._cache_size()` callers keep working."""
+
+    def __init__(self, fn, name: str, service: str = "scheduler",
+                 registry=None, block: bool = True):
+        self.__wrapped__ = fn
+        self.name = name
+        self.service = service
+        self._block = block
+        self._seen: set = set()
+        self._mu = threading.Lock()
+        reg = registry if registry is not None else _metrics.default_registry()
+        s = _series.jit_series(reg, service)
+        self._series = s
+        self._calls = s.calls.labels(name)
+        self._retraces = s.retraces.labels(name)
+        self._cache = s.cache_entries.labels(name)
+        self._dispatch = s.dispatch.labels(name)
+        self._device = s.device.labels(name)
+        with _wrappers_mu:
+            _WRAPPERS[f"{service}.{name}"] = weakref.ref(self)
+
+    def __call__(self, *args, **kwargs):
+        sig = (_sig_of(args), _sig_of(tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))))
+        with self._mu:
+            new = sig not in self._seen
+            if new:
+                self._seen.add(sig)
+        t0 = time.perf_counter()
+        out = self.__wrapped__(*args, **kwargs)
+        t1 = time.perf_counter()
+        self._dispatch.observe(t1 - t0)
+        if self._block:
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+            except Exception:  # noqa: BLE001 - non-array outputs stay legal
+                pass
+            self._device.observe(time.perf_counter() - t1)
+        self._calls.inc()
+        if new:
+            self._retraces.inc()
+            self._cache.set(self.cache_entries())
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.__wrapped__, item)
+
+    def cache_entries(self) -> int:
+        """The jit's own compile-cache size when it exposes one, else the
+        count of distinct signatures this wrapper has routed."""
+        try:
+            return int(self.__wrapped__._cache_size())
+        except Exception:  # noqa: BLE001 - plain callables have no cache
+            return len(self._seen)
+
+    def stats(self) -> dict:
+        return {
+            "calls": self._series.calls.value(self.name),
+            "retraces": self._series.retraces.value(self.name),
+            "signatures": len(self._seen),
+            "cache_entries": self.cache_entries(),
+        }
+
+
+def instrument_jit(fn, name: str, service: str = "scheduler",
+                   registry=None, block: bool = True) -> JitWrapper:
+    """Wrap a jitted entry point with compile/retrace counters and the
+    dispatch/device time split. Families land in `registry` (default:
+    the process default registry) under dragonfly_<service>_jit_*."""
+    return JitWrapper(fn, name, service=service, registry=registry, block=block)
+
+
+def jit_wrappers() -> dict[str, JitWrapper]:
+    out = {}
+    with _wrappers_mu:
+        for name, ref in list(_WRAPPERS.items()):
+            wrapper = ref()
+            if wrapper is None:
+                del _WRAPPERS[name]
+            else:
+                out[name] = wrapper
+    return out
+
+
+# ------------------------------------------------------------------- dump
+
+
+def _plain(value):
+    """msgpack/json-safe scalar: pass primitives, stringify the rest."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    return str(value)
+
+
+def _span_summary(span) -> dict:
+    return {
+        "name": span.name,
+        "trace_id": span.trace_id,
+        "span_id": span.span_id,
+        "parent_id": span.parent_id,
+        "start_ns": span.start_ns,
+        "age_ms": round((time.time_ns() - span.start_ns) / 1e6, 3),
+        "attributes": {k: _plain(v) for k, v in span.attributes.items()},
+    }
+
+
+def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
+         registry_fallback: bool = True) -> dict:
+    """The flight-recorder snapshot: last-N tick phase breakdowns, jit
+    compile/retrace counters, and spans currently open. Pure plain data
+    (dicts/lists/scalars) so it rides the wire codec and JSON as-is.
+    `registry_fallback=False` skips the process-global recorder lookup —
+    a service reporting about ITSELF (the manager's own section) must not
+    claim a co-located scheduler's tick ring as its own."""
+    if recorder is None and registry_fallback:
+        # the scheduler registers under this name; last registration wins,
+        # so a process-wide dump reads the live service's recorder
+        recorder = _live_recorders().get("scheduler.tick")
+    # shape-stable when no recorder exists: consumers index ["last"] /
+    # ["p50_ms"] without guarding a sometimes-empty dict
+    ticks = (
+        recorder.dump(last_n) if recorder is not None
+        else {"ticks_total": 0, "p50_ms": {}, "last": []}
+    )
+    spans = []
+    for span in default_tracer().active_spans():
+        try:
+            spans.append(_span_summary(span))
+        except RuntimeError:
+            continue  # owner thread mutated attributes mid-copy; skip it
+    return {
+        "generated_at_ns": time.time_ns(),
+        "ticks": ticks,
+        "jit": {name: w.stats() for name, w in sorted(jit_wrappers().items())},
+        "active_spans": spans,
+    }

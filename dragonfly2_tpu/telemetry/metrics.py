@@ -268,7 +268,25 @@ def _sample_stacks(seconds: float, interval_s: float = 0.01) -> str:
     return "\n".join(lines) + "\n"
 
 
-def serve_metrics(registry: Registry | None = None, port: int = 0) -> http.server.ThreadingHTTPServer:
+class MonitorServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer whose shutdown() is GRACEFUL: stop
+    serve_forever, join the serving thread, close the listening socket.
+    The base class leaves the acceptor thread and the bound socket behind
+    — every test/daemon that starts a monitor leaked a listener until the
+    process died."""
+
+    _serve_thread: threading.Thread | None = None
+
+    def shutdown(self) -> None:  # noqa: A003 - stdlib API name
+        super().shutdown()
+        thread = self._serve_thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+        self._serve_thread = None
+        self.server_close()
+
+
+def serve_metrics(registry: Registry | None = None, port: int = 0) -> MonitorServer:
     """Serve the per-service observability HTTP endpoint on a background
     thread (the reference starts a Prometheus `/metrics` server per
     service plus pprof/statsview via InitMonitor,
@@ -282,9 +300,11 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> http.serve
       return frames ranked by inclusive sample count (cProfile only sees
       the calling thread; sampling `sys._current_frames()` sees the whole
       process, like the pprof CPU profile does)
+    - `/debug/flight` — flight-recorder dump (telemetry/flight.py: last-N
+      tick phase breakdowns, jit compile counters, open spans) as JSON
 
     Returns the server (.server_address for the bound port, .shutdown()
-    to stop)."""
+    to stop — graceful: joins the serving thread and closes the socket)."""
     reg = registry or _DEFAULT
 
     class Handler(http.server.BaseHTTPRequestHandler):
@@ -314,6 +334,13 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> http.serve
                     return
                 seconds = min(max(seconds, 0.1), 30.0)
                 return self._send(_sample_stacks(seconds).encode())
+            if path == "/debug/flight":
+                import json
+
+                from dragonfly2_tpu.telemetry import flight
+
+                body = json.dumps(flight.dump()).encode()
+                return self._send(body, "application/json")
             self.send_error(404)
 
         def _send(self, body: bytes, ctype: str = "text/plain"):
@@ -326,8 +353,12 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> http.serve
         def log_message(self, *args):  # silence per-request stderr noise
             pass
 
-    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    server = MonitorServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="metrics-http", daemon=True
+    )
+    server._serve_thread = thread
+    thread.start()
     return server
 
 

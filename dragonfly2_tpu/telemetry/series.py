@@ -192,5 +192,41 @@ def trainer_series(reg) -> _Namespace:
     )
 
 
+def jit_series(reg, service: str) -> _Namespace:
+    """JAX entry-point instrumentation families (telemetry/flight.py
+    instrument_jit): per wrapped function, call/retrace totals, the
+    compile-cache size, and the host-dispatch vs device-completion time
+    split (the call returns at dispatch; block_until_ready bounds the
+    device side). `service` picks the metric namespace so the scheduler's
+    evaluator and the trainer's epoch step stay in their own families."""
+    return _Namespace(
+        calls=reg.counter(
+            f"dragonfly_{service}_jit_calls_total",
+            "calls into wrapped jitted entry points", ("fn",),
+        ),
+        retraces=reg.counter(
+            f"dragonfly_{service}_jit_retraces_total",
+            "compiles/retraces: calls whose signature (shapes/dtypes/statics) "
+            "was not seen before", ("fn",),
+        ),
+        cache_entries=reg.gauge(
+            f"dragonfly_{service}_jit_cache_entries",
+            "live compile-cache entries per wrapped jitted function", ("fn",),
+        ),
+        dispatch=reg.histogram(
+            f"dragonfly_{service}_jit_dispatch_seconds",
+            "host time until the jitted call returned (device may still run)",
+            ("fn",),
+            buckets=(.0001, .0005, .002, .01, .05, .2, 1.0, 5.0, 30.0),
+        ),
+        device=reg.histogram(
+            f"dragonfly_{service}_jit_device_seconds",
+            "block_until_ready wait after dispatch (device-side completion)",
+            ("fn",),
+            buckets=(.0001, .0005, .002, .01, .05, .2, 1.0, 5.0, 30.0),
+        ),
+    )
+
+
 def register_version(reg, service: str) -> None:
     _version.register_version_gauge(reg, service)

@@ -62,6 +62,10 @@ class Tracer:
         self.service = service
         self._exporters: list[Callable[[Span], None]] = []
         self._lock = threading.Lock()
+        # Spans started but not yet ended, keyed by span_id — the flight
+        # recorder dumps these so an operator can see what a slow tick is
+        # CURRENTLY inside of, not only what already finished.
+        self._active: dict[str, Span] = {}
 
     def add_exporter(self, fn: Callable[[Span], None]) -> None:
         with self._lock:
@@ -91,17 +95,30 @@ class Tracer:
         self.add_exporter(write)
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes):
+    def span(self, name: str, remote_parent: dict | None = None, **attributes):
+        """Open a span under the ambient parent, or — when `remote_parent`
+        is a wire-propagated context ({"trace_id", "span_id"}, rpc/wire.py
+        frame envelope) — continue the REMOTE trace: the explicit context
+        wins over the contextvar so a server-side handler parents on the
+        caller's span, not on whatever local span happens to be open."""
         parent = _current_span.get()
+        if remote_parent and remote_parent.get("trace_id"):
+            trace_id = str(remote_parent["trace_id"])
+            parent_id = str(remote_parent.get("span_id") or "") or None
+        else:
+            trace_id = parent.trace_id if parent else secrets.token_hex(16)
+            parent_id = parent.span_id if parent else None
         span = Span(
             name=name,
-            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            trace_id=trace_id,
             span_id=secrets.token_hex(8),
-            parent_id=parent.span_id if parent else None,
+            parent_id=parent_id,
             start_ns=time.time_ns(),
             attributes={"service": self.service, **attributes},
         )
         token = _current_span.set(span)
+        with self._lock:
+            self._active[span.span_id] = span
         try:
             yield span
         except BaseException as e:
@@ -111,6 +128,7 @@ class Tracer:
             span.end_ns = time.time_ns()
             _current_span.reset(token)
             with self._lock:
+                self._active.pop(span.span_id, None)
                 exporters = list(self._exporters)
             for fn in exporters:
                 try:
@@ -118,9 +136,24 @@ class Tracer:
                 except Exception:  # noqa: BLE001 - exporters must not break the traced path
                     pass
 
+    def active_spans(self) -> list[Span]:
+        """Snapshot of spans currently open (started, not ended)."""
+        with self._lock:
+            return list(self._active.values())
+
 
 def current_span() -> Span | None:
     return _current_span.get()
+
+
+def current_context() -> dict | None:
+    """Wire-propagatable context of the ambient span (None outside any
+    span). rpc/wire.encode stamps this into the frame envelope so a trace
+    started on one side of an RPC continues on the other."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return {"trace_id": span.trace_id, "span_id": span.span_id}
 
 
 # ------------------------------------------------------------------ OTLP

@@ -212,7 +212,12 @@ def _make_epoch(loss_fn: Callable, optimizer: optax.GradientTransformation):
         (params, opt_state), losses = jax.lax.scan(body, (params, opt_state), batches)
         return params, opt_state, losses
 
-    return run_epoch
+    # flight-recorder wrapper (telemetry/flight.py): compile/retrace count
+    # per batch-stack signature + dispatch/device time split; attribute
+    # access (.lower for _epoch_flops) forwards to the jitted fn
+    from dragonfly2_tpu.telemetry.flight import instrument_jit
+
+    return instrument_jit(run_epoch, "trainer.epoch", service="trainer")
 
 
 def _stack_batches(batches: list) -> object:
@@ -240,7 +245,12 @@ def _make_epoch_indexed(loss_fn: Callable, optimizer: optax.GradientTransformati
         (params, opt_state), losses = jax.lax.scan(body, (params, opt_state), idx)
         return params, opt_state, losses
 
-    return run_epoch
+    # flight-recorder wrapper: a retrace here means a new [S, B] index
+    # shape slipped into the epoch loop — exactly the regression the
+    # epoch-fusion divisor logic exists to prevent
+    from dragonfly2_tpu.telemetry.flight import instrument_jit
+
+    return instrument_jit(run_epoch, "trainer.epoch_indexed", service="trainer")
 
 
 def _index_epochs(

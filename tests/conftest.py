@@ -25,6 +25,10 @@ except RuntimeError:
     # Backend already initialized (a plugin touched jax before conftest) —
     # the env vars above were then read at init and did the same job.
     pass
+except AttributeError:
+    # Older jax without the jax_num_cpu_devices option: the XLA_FLAGS
+    # host-platform device-count flag above is the only mechanism.
+    pass
 
 import numpy as np
 import pytest

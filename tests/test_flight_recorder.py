@@ -1,0 +1,412 @@
+"""Flight recorder: in-product phase timing, jit compile/retrace counters,
+RPC trace propagation, and the operator-facing dump surfaces (ISSUE 1)."""
+
+import json
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry.flight import PhaseRecorder, instrument_jit
+from dragonfly2_tpu.telemetry.series import (
+    daemon_series,
+    jit_series,
+    manager_series,
+    register_version,
+    scheduler_series,
+    trainer_series,
+)
+from dragonfly2_tpu.telemetry.tracing import Tracer
+
+TICK_PHASES = (
+    "pre_schedule", "candidate_fill", "feature_gather", "pack",
+    "device_call", "apply_selection",
+)
+
+
+def _host(i, seed=False):
+    return msg.HostInfo(
+        host_id=f"fl-h{i}", hostname=f"fl-n{i}", ip=f"10.9.0.{i}",
+        host_type="super" if seed else "normal", idc="idc-a",
+        location="na|zone|rack",
+    )
+
+
+def _register(svc, peer_id, h, task_id="fl-task"):
+    return svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id=peer_id, task_id=task_id, host=h,
+            url="https://e.com/blob", content_length=4 * (4 << 20),
+            total_piece_count=4,
+        )
+    )
+
+
+def _seeded_service(registry):
+    svc = SchedulerService(metrics_registry=registry)
+    _register(svc, "fl-seed", _host(0, seed=True))
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(peer_id="fl-seed", piece_count=4))
+    svc.tick()  # pre_schedule-only tick: no device work, no committed phases
+    return svc
+
+
+def test_tick_phase_histograms_populated_by_normal_loop():
+    """Acceptance: after N working ticks each phase histogram reports N
+    observations and the flight-recorder dump returns the last-N
+    per-phase breakdown — no bench involved, just the service loop."""
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    n = 6
+    for i in range(n):
+        _register(svc, f"fl-child-{i}", _host(i + 1))
+        svc.tick()
+    assert svc.recorder.ticks == n
+    text = reg.expose()
+    for phase in TICK_PHASES:
+        line = (
+            f'dragonfly_scheduler_tick_phase_seconds_count{{phase="{phase}"}} {n}'
+        )
+        assert line in text, f"missing {line}"
+    dump = svc.flight_dump(last_n=4)
+    assert len(dump["ticks"]["last"]) == 4
+    for tick in dump["ticks"]["last"]:
+        assert set(TICK_PHASES) <= set(tick)
+    assert set(TICK_PHASES) <= set(dump["ticks"]["p50_ms"])
+    # the serving entry point is instrumented: its compile counter moved
+    ev_stats = dump["jit"]["scheduler.evaluator.schedule_from_packed"]
+    assert ev_stats["retraces"] >= 1 and ev_stats["calls"] >= n
+
+
+def test_phase_recorder_overhead_within_one_percent_of_tick():
+    """Acceptance micro-check: one full recorder cycle (begin + 6 marks +
+    commit, histogram attached) costs <= 1% of the measured tick p50."""
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    for i in range(8):
+        _register(svc, f"fl-ov-{i}", _host(i + 1))
+        t0 = time.perf_counter()
+        svc.tick()
+    tick_p50 = float(np.median([sum(p.values()) for p in svc.recorder.ring]))
+
+    rec = PhaseRecorder(histogram=scheduler_series(m.Registry()).schedule_phase)
+
+    def batch(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rec.begin()
+            for phase in TICK_PHASES:
+                rec.mark(phase)
+            rec.commit()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    batch(200)  # warm dict/label caches
+    # best-of-batches: a single long average is hostage to scheduler
+    # preemption when the whole suite runs in parallel — the minimum is
+    # the recorder's actual cost
+    cycle_ms = min(batch(300) for _ in range(10))
+    assert cycle_ms <= 0.01 * tick_p50, (
+        f"recorder cycle {cycle_ms:.4f} ms > 1% of tick p50 {tick_p50:.3f} ms"
+    )
+    # and a disabled recorder is a no-op that records nothing
+    off = PhaseRecorder(enabled=False)
+    off.begin()
+    off.mark("pre_schedule")
+    off.commit()
+    assert off.ticks == 0 and not off.ring
+
+
+def test_retrace_counter_increments_once_per_new_shape():
+    """Satellite: a new shape increments the compile counter exactly
+    once; a same-shape call does not."""
+    import jax
+
+    reg = m.Registry()
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    w = instrument_jit(f, "test.retrace", service="scheduler", registry=reg)
+    s = jit_series(reg, "scheduler")
+    w(np.zeros((2, 3), np.float32))
+    assert s.retraces.value("test.retrace") == 1
+    w(np.ones((2, 3), np.float32))  # same signature: no increment
+    assert s.retraces.value("test.retrace") == 1
+    w(np.zeros((5, 3), np.float32))  # new shape: exactly one increment
+    assert s.retraces.value("test.retrace") == 2
+    w(np.zeros((5, 3), np.float32))
+    assert s.retraces.value("test.retrace") == 2
+    w(np.zeros((2, 3), np.float64))  # new dtype is a new signature too
+    assert s.retraces.value("test.retrace") == 3
+    assert s.calls.value("test.retrace") == 5
+    # the gauge prefers jit's OWN cache size; without x64 the float64
+    # input downcasts, so jax may fold it into the float32 entry
+    assert 2 <= s.cache_entries.value("test.retrace") <= 3
+    # dispatch/device time split is populated per call
+    text = reg.expose()
+    assert 'dragonfly_scheduler_jit_dispatch_seconds_count{fn="test.retrace"} 5' in text
+    assert 'dragonfly_scheduler_jit_device_seconds_count{fn="test.retrace"} 5' in text
+
+
+def test_trace_context_round_trips_through_wire_framing():
+    """Satellite: a span opened scheduler-side keeps its trace_id and
+    yields the correct parent_id after a wire round trip, including the
+    error/record_exception path."""
+    from dragonfly2_tpu.rpc import wire
+
+    wire.register_module(msg)
+    tracer = Tracer("scheduler")
+    spans = tracer.export_to_memory()
+
+    with tracer.span("scheduler.tick") as parent:
+        frame = wire.encode(msg.StatPeerRequest(peer_id="p1"))
+    decoded = wire.decode(frame[4:])
+    assert decoded == msg.StatPeerRequest(peer_id="p1")  # payload untouched
+    assert decoded.trace_context == {
+        "trace_id": parent.trace_id, "span_id": parent.span_id,
+    }
+
+    with pytest.raises(RuntimeError):
+        with tracer.span(
+            "scheduler.rpc.StatPeerRequest", remote_parent=decoded.trace_context
+        ):
+            raise RuntimeError("boom")
+    child = next(s for s in spans if s.name == "scheduler.rpc.StatPeerRequest")
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.span_id
+    assert child.status == "ERROR"
+    assert child.events[0]["type"] == "RuntimeError"
+
+    # no ambient span -> the envelope carries no context at all
+    bare = wire.decode(wire.encode(msg.StatPeerRequest(peer_id="p2"))[4:])
+    assert not hasattr(bare, "trace_context")
+
+    # explicit context (the tick->response path) wins over the ambient one
+    with tracer.span("other"):
+        framed = wire.encode(
+            msg.StatPeerRequest(peer_id="p3"),
+            trace_context={"trace_id": "a" * 32, "span_id": "b" * 16},
+        )
+    assert wire.decode(framed[4:]).trace_context["trace_id"] == "a" * 32
+
+
+def test_metric_naming_convention_registry_walk():
+    """Satellite CI sweep: every registered family matches the
+    dragonfly_<service>_ naming convention, has HELP text, and
+    re-registration is idempotent (returns the existing collector)."""
+    reg = m.Registry()
+    scheduler_series(reg)
+    daemon_series(reg)
+    manager_series(reg)
+    trainer_series(reg)
+    jit_series(reg, "scheduler")
+    jit_series(reg, "trainer")
+    for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
+        register_version(reg, svc)
+    # "client" metrics live under the reference's service name, dfdaemon
+    pattern = re.compile(
+        r"^dragonfly_(scheduler|dfdaemon|manager|trainer)_[a-z0-9_]+$"
+    )
+    assert reg._metrics, "registry walk found nothing"
+    for name, metric in reg._metrics.items():
+        assert pattern.match(name), f"{name} violates the naming convention"
+        assert metric.help.strip(), f"{name} has no HELP text"
+    # idempotent: the factory hands back the SAME collector object
+    assert scheduler_series(reg).announce_peer is scheduler_series(reg).announce_peer
+    first = reg._metrics["dragonfly_scheduler_announce_peer_total"]
+    again = reg.counter(
+        "dragonfly_scheduler_announce_peer_total", "stream messages", ("type",)
+    )
+    assert again is first
+    # each family appears exactly once in exposition (registered once)
+    text = reg.expose()
+    for name in reg._metrics:
+        assert text.count(f"# TYPE {name} ") == 1, name
+
+
+def test_metrics_server_graceful_shutdown():
+    """Satellite: shutdown() joins the serving thread and closes the
+    listening socket — tests and daemons stop leaking listeners."""
+    import threading
+
+    reg = m.Registry()
+    reg.counter("dragonfly_manager_flight_smoke_total", "smoke").inc()
+    server = m.serve_metrics(reg, port=0)
+    port = server.server_address[1]
+    body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+    assert "dragonfly_manager_flight_smoke_total" in body
+    assert any(t.name == "metrics-http" for t in threading.enumerate())
+    server.shutdown()
+    assert server.socket.fileno() == -1, "listening socket not closed"
+    assert not any(t.name == "metrics-http" for t in threading.enumerate())
+    with pytest.raises(OSError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=1)
+    server.shutdown()  # idempotent
+
+
+def test_manager_rest_serves_flight_recorder_dump():
+    """The operator route: GET /api/v1/flight-recorder (JWT-authenticated
+    — it fans RPCs out to every scheduler, so anonymous callers are 401)
+    aggregates the manager's own dump plus every known scheduler's
+    (in-proc here; the RemoteScheduler wire edge is covered below)."""
+    from dragonfly2_tpu.cluster.jobs import JobManager
+    from dragonfly2_tpu.manager.rest import ManagerREST, openapi_spec
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    _register(svc, "fl-rest-child", _host(1))
+    svc.tick()
+    mgr = ManagerService(jobs=JobManager({"sched-1": svc}))
+    rest = ManagerREST(mgr)
+    host, port = rest.start()
+    base = f"http://{host}:{port}/api/v1"
+
+    def get(path, token=None):
+        req = urllib.request.Request(f"{base}{path}")
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        return json.loads(urllib.request.urlopen(req).read())
+
+    try:
+        # anonymous is rejected — this route drives cluster-wide RPCs
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/flight-recorder")
+        assert e.value.code == 401
+        token = json.loads(
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{base}/users/signin",
+                    data=json.dumps(
+                        {"name": "root", "password": "dragonfly"}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+            ).read()
+        )["token"]
+        body = get("/flight-recorder?last_n=8", token)
+        assert set(body) == {"manager", "schedulers"}
+        sched = body["schedulers"]["sched-1"]
+        assert sched["ticks"]["last"], "no tick breakdowns in the dump"
+        assert set(TICK_PHASES) <= set(sched["ticks"]["last"][-1])
+        assert "scheduler.evaluator.schedule_from_packed" in sched["jit"]
+        # the manager's OWN section must not claim the co-located
+        # scheduler's ring (that data lives under schedulers.sched-1),
+        # and the empty shape stays indexable
+        assert body["manager"]["ticks"]["last"] == []
+        assert body["manager"]["ticks"]["ticks_total"] == 0
+        # bad input is a 400, not a 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/flight-recorder?last_n=x", token)
+        assert e.value.code == 400
+    finally:
+        rest.stop()
+    assert "/api/v1/flight-recorder" in openapi_spec()["paths"]
+
+
+def test_mux_serves_flight_recorder_debug_route():
+    """/debug/flight on the mux port defaults to the process-global dump
+    and honours an explicit flight_source."""
+    import asyncio
+
+    from dragonfly2_tpu.rpc.mux import MuxServer
+
+    async def run():
+        async def rpc_handler(reader, writer):
+            writer.close()
+
+        srv = MuxServer(rpc_handler, flight_source=lambda: {"ok": True})
+        host, port = await srv.start()
+        try:
+            body = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/flight"
+                ).read()
+            )
+            assert json.loads(body) == {"ok": True}
+        finally:
+            await srv.stop()
+        default = MuxServer(rpc_handler)
+        host, port = await default.start()
+        try:
+            body = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/flight"
+                ).read()
+            )
+            dump = json.loads(body)
+            assert {"ticks", "jit", "active_spans"} <= set(dump)
+        finally:
+            await default.stop()
+
+    asyncio.run(run())
+
+
+def test_flight_recorder_over_the_wire_and_tick_trace_to_client(tmp_path):
+    """Live RPC edge: (1) the scheduler answers FlightRecorderRequest with
+    a populated dump; (2) the daemon's piece-download span continues the
+    scheduler TICK's trace — same trace_id, parented on the tick span —
+    proving context crosses the wire in the response direction."""
+    import asyncio
+
+    from test_minicluster import _CountingFileServer, _scheduler_service
+    from dragonfly2_tpu.client.daemon import Daemon
+    from dragonfly2_tpu.rpc.client import SyncSchedulerClient
+    from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+    from dragonfly2_tpu.telemetry.tracing import default_tracer
+
+    captured = []
+    exporter = captured.append
+    tracer = default_tracer()
+    tracer.add_exporter(exporter)
+    origin = _CountingFileServer(bytes(i % 256 for i in range(120_000)))
+
+    async def run():
+        service = _scheduler_service(tmp_path)
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            # peer 1 back-sources (empty mesh); peer 2 then downloads FROM
+            # peer 1 — the NormalTaskResponse path that carries the tick's
+            # trace context down to the piece downloads
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="fl-d1")
+            await d1.start()
+            await d1.download(origin.url(), piece_length=32 * 1024)
+            d2 = Daemon(tmp_path / "d2", [(host, port)], hostname="fl-d2")
+            await d2.start()
+            await d2.download(origin.url(), piece_length=32 * 1024)
+            await d2.stop()
+            await d1.stop()
+            client = SyncSchedulerClient(host, port)
+            resp = await asyncio.to_thread(
+                client.call, msg.FlightRecorderRequest(last_n=16)
+            )
+            client.close()
+            return resp
+        finally:
+            await server.stop()
+            origin.stop()
+
+    try:
+        resp = asyncio.run(run())
+    finally:
+        tracer.remove_exporter(exporter)
+
+    assert isinstance(resp, msg.FlightRecorderResponse)
+    assert resp.dump["ticks"]["last"], "wire dump has no tick breakdowns"
+    assert "scheduler.evaluator.schedule_from_packed" in resp.dump["jit"]
+
+    ticks = [s for s in captured if s.name == "scheduler.tick"]
+    downloads = [s for s in captured if s.name == "dfdaemon.download_pieces"]
+    assert ticks and downloads, {s.name for s in captured}
+    tick_ids = {s.span_id for s in ticks}
+    linked = [d for d in downloads if d.parent_id in tick_ids]
+    assert linked, "no download span parented on a tick span"
+    tick_by_id = {s.span_id: s for s in ticks}
+    for d in linked:
+        assert d.trace_id == tick_by_id[d.parent_id].trace_id
