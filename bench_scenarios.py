@@ -1,0 +1,122 @@
+"""Scenario-matrix A/B bench: where does the learned evaluator win?
+
+Runs {default, ml, random} (optionally nt) evaluators across the
+scenario grid (homogeneous control, bandwidth-skewed racks/spine/NICs,
+churn, flaky parents, hotspot Zipf — scenarios/spec.builtin_scenarios)
+with PAIRED seeds, and writes `BENCH_scenarios.json`: per-scenario
+`ml_vs_default` piece-cost ratios with 95% confidence intervals, per-arm
+injected-fault counts, and the flight-recorder per-phase tick timings.
+The ml arm serves a GNN trained on traces from a scenario-driven replay
+(the full schedule→trace→train→serve loop, scenarios/ab.py).
+
+ml_vs_default > 1 means the served model picks cheaper parents than the
+rule blend in that scenario; `resolvable` means the CI excludes 1.0 —
+a measured gap in either direction, not a guaranteed win.
+
+Prints one JSON line per scenario plus a final compact summary line.
+
+Usage: python bench_scenarios.py [--quick] [--hosts N] [--pieces N]
+       [--tasks N] [--seeds 11,12,13] [--evaluators default,ml,random]
+       [--scenarios name1,name2] [--out BENCH_scenarios.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=800)
+    ap.add_argument("--tasks", type=int, default=16)
+    ap.add_argument("--pieces", type=int, default=20_000)
+    ap.add_argument("--downloads-per-round", type=int, default=48)
+    ap.add_argument("--seeds", default="11,12,13,14,15")
+    ap.add_argument("--evaluators", default="default,ml,random")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated builtin names (default: all)")
+    ap.add_argument("--train-pieces", type=int, default=30_000)
+    ap.add_argument("--trainer-epochs", type=int, default=4)
+    ap.add_argument("--hidden-dim", type=int, default=32)
+    ap.add_argument("--out", default="BENCH_scenarios.json")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="small smoke configuration (CI-sized)")
+    args = ap.parse_args()
+    if args.quick:
+        args.hosts, args.tasks, args.pieces = 128, 8, 2500
+        args.train_pieces, args.trainer_epochs = 4000, 2
+        args.seeds = "11,12"
+
+    from dragonfly2_tpu.scenarios import builtin_scenarios
+    from dragonfly2_tpu.scenarios.ab import MatrixConfig, run_matrix
+
+    scenarios = builtin_scenarios()
+    if args.scenarios:
+        keep = {s.strip() for s in args.scenarios.split(",") if s.strip()}
+        unknown = keep - set(scenarios)
+        if unknown:
+            raise SystemExit(f"unknown scenarios: {sorted(unknown)}")
+        scenarios = {k: v for k, v in scenarios.items() if k in keep}
+
+    cfg = MatrixConfig(
+        hosts=args.hosts,
+        tasks=args.tasks,
+        target_pieces=args.pieces,
+        downloads_per_round=args.downloads_per_round,
+        seeds=tuple(int(s) for s in args.seeds.split(",")),
+        evaluators=tuple(e.strip() for e in args.evaluators.split(",")),
+        train_pieces=args.train_pieces,
+        trainer_epochs=args.trainer_epochs,
+        hidden_dim=args.hidden_dim,
+    )
+
+    t0 = time.perf_counter()
+    result = run_matrix(scenarios, cfg, workdir=args.workdir,
+                        log=lambda line: print(f"# {line}", file=sys.stderr))
+    result["bench_wall_s"] = round(time.perf_counter() - t0, 1)
+
+    pathlib.Path(args.out).write_text(json.dumps(result, indent=1, sort_keys=True))
+
+    # one JSON line per scenario (driver-friendly), then a compact summary
+    for name, s in result["scenarios"].items():
+        line = {
+            "metric": "scenario_ab",
+            "scenario": name,
+            "mean_piece_cost_ms": s["mean_piece_cost_ms"],
+        }
+        for key in ("ml_vs_default", "default_vs_random", "nt_vs_default"):
+            if key in s:
+                line[key] = {k: s[key][k] for k in ("mean", "ci95", "resolvable")}
+        print(json.dumps(line))
+    summary = {
+        "metric": "scenario_matrix",
+        "scenarios": len(result["scenarios"]),
+        "evaluators": list(cfg.evaluators),
+        "seeds": list(cfg.seeds),
+        "ml_vs_default": {
+            name: s["ml_vs_default"]["mean"]
+            for name, s in result["scenarios"].items()
+            if "ml_vs_default" in s
+        },
+        "resolvable": sorted(
+            name
+            for name, s in result["scenarios"].items()
+            if any(
+                s.get(k, {}).get("resolvable")
+                for k in ("ml_vs_default", "default_vs_random", "nt_vs_default")
+            )
+        ),
+        "out": args.out,
+        "wall_s": result["bench_wall_s"],
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

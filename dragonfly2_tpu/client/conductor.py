@@ -72,6 +72,7 @@ class PeerTaskConductor:
         self._needed: set[int] = set()
         self._inflight: set[int] = set()
         self._failed_parents: set[str] = set()
+        self._refreshers: set[asyncio.Task] = set()
         self._done = asyncio.Event()
         self._error: Exception | None = None
 
@@ -267,6 +268,7 @@ class PeerTaskConductor:
         piece into the dispatcher. Ends when the parent completes, fails,
         or nothing is needed anymore."""
         pid = parent.peer_id
+        idle_polls = 0
         while self._needed and pid not in self._failed_parents:
             doc = self._parent_pieces.get(pid) or {}
             if doc.get("done"):
@@ -278,25 +280,60 @@ class PeerTaskConductor:
             if new_doc is None:
                 self._failed_parents.add(pid)
                 return
+            if len(new_doc.get("pieces", [])) <= known and not new_doc.get("done"):
+                # timed-out long-poll: the parent is alive but idle — not
+                # a failure. Give up the subscription after a few idle
+                # rounds so a stalled parent ends the wave (and the
+                # conductor reschedules) instead of pinning it forever.
+                idle_polls += 1
+                if idle_polls >= 3:
+                    return
+                continue
+            idle_polls = 0
             self._parent_pieces[pid] = new_doc
             available = {p["number"] for p in new_doc.get("pieces", [])}
             for number in self._needed & available:
                 self.dispatcher.put(number, pid)
 
-    def _fetch_piece_doc(self, parent: msg.CandidateParent) -> dict | None:
+    def _fetch_piece_doc(
+        self, parent: msg.CandidateParent,
+        wait_after: int | None = None, timeout: float | None = None,
+    ) -> dict | None:
+        """GET the parent's /pieces listing. With `wait_after`, long-poll:
+        the parent blocks until it holds MORE than that many pieces (or
+        completes, or `timeout` seconds pass) and then answers with its
+        current listing — the push half of piece announcements
+        (upload.py's wait_after route). The transport timeout is the
+        long-poll timeout plus slack, so an idle parent's timed-out
+        long-poll comes back as "no new pieces yet" (the unchanged
+        listing), NOT as a failed parent."""
         url = f"http://{parent.ip}:{parent.download_port}/pieces/{self.task_id}"
+        request_timeout = 5.0
+        if wait_after is not None:
+            poll = 10.0 if timeout is None else timeout
+            url += f"?wait_after={int(wait_after)}&timeout={poll:g}"
+            request_timeout = poll + 5.0
         try:
-            with urllib.request.urlopen(url, timeout=5.0) as resp:
+            with urllib.request.urlopen(url, timeout=request_timeout) as resp:
                 return json.load(resp)
         except Exception:  # noqa: BLE001 - any failure marks the parent bad
             return None
 
     async def _piece_worker(self, ts: TaskStorage) -> None:
-        """downloadPieceWorker: pop jobs until the queue drains."""
+        """downloadPieceWorker: pop jobs until the queue drains AND no
+        piece subscription can still announce more work. Returning on the
+        first empty poll would orphan the refreshers' pieces in the
+        dispatcher — the wave would end with the task incomplete even
+        though an in-progress parent was still committing pieces."""
         while True:
             job = self.dispatcher.get()
             if job is None:
-                return
+                if not self._needed:
+                    return
+                if not any(not r.done() for r in self._refreshers):
+                    return  # no subscription left to produce work
+                await asyncio.sleep(0.05)
+                continue
             number, parent_id = job
             if number not in self._needed or number in self._inflight:
                 continue

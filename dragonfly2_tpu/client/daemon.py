@@ -55,6 +55,7 @@ class Daemon:
         ssl_context=None,
         manager_address: tuple[str, int] | None = None,
         dynconfig_interval: float = 60.0,
+        fault_injector=None,
     ):
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
@@ -67,7 +68,9 @@ class Daemon:
         self.metrics = daemon_series(reg)
         register_version(reg, "dfdaemon")
         self.storage = StorageManager(data_dir)
-        self.upload = UploadServer(self.storage, host=ip)
+        # scenario-lab flaky-parent injection (scenarios/engine.py): this
+        # daemon's piece serving errors/stalls per the injected schedule
+        self.upload = UploadServer(self.storage, host=ip, fault_injector=fault_injector)
         self.pool = SchedulerClientPool(scheduler_addresses, ssl_context=ssl_context)
         self.shaper = TrafficShaper(total_rate_bps, mode="sampling" if total_rate_bps else "plain")
         self.gc = GC()

@@ -31,14 +31,24 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import time
 import urllib.parse
 
 from dragonfly2_tpu.client.storage import StorageManager
 
 
 class UploadServer:
-    def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0,
+                 fault_injector=None):
         self.storage = storage
+        # Scenario-lab hook (scenarios/engine.FaultInjector): when set,
+        # piece serving consults it per (task, piece, attempt) and may
+        # answer 503 or stall before serving — faults injected at the
+        # PARENT so the child daemon exercises its real retry path
+        # (piece failure -> DownloadPieceFailed -> reschedule), not a
+        # simulator-only shortcut. None (production) costs one attribute
+        # read per piece request.
+        self.fault_injector = fault_injector
         manager = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -119,6 +129,14 @@ class UploadServer:
                 if not ts.has_piece(number):
                     self._reply(404, b"piece not stored")
                     return
+                injector = manager.fault_injector
+                if injector is not None:
+                    verdict = injector.piece_fault(ts.meta.task_id, number)
+                    if verdict == "error":
+                        self._reply(503, b"injected fault")
+                        return
+                    if verdict == "stall":
+                        time.sleep(injector.stall_seconds)
                 piece = ts.meta.pieces[number]
                 data = ts.read_piece(number)
                 self.send_response(200)

@@ -30,6 +30,44 @@ from dragonfly2_tpu.records.schema import (
 )
 
 
+def warm_from_link_model(store: "ProbeStore", slotted_hosts, rtt_fn,
+                         pairs_per_src: int = 4) -> int:
+    """Seed a probe store from a scenario link model (scenarios/engine
+    ``ScenarioEngine.rtt_ns``) before a replay starts.
+
+    A cold ProbeStore scores every candidate's probe term at MIN until
+    enough probe cycles ran — for a short A/B arm the nt evaluator would
+    spend most of its wall time effectively running the base blend, and
+    the comparison would measure warmup, not the algorithm. One warm pass
+    enqueues ``pairs_per_src`` measurements per source host drawn from
+    the scenario's link model (deterministic: pair choice is slot-order,
+    jitter is keyed on the pair), the same distribution the probe loop
+    itself would converge to.
+
+    ``slotted_hosts`` is a list of (host, slot) pairs; ``rtt_fn(src, dst,
+    key)`` returns ns. Returns measurements enqueued.
+    """
+    n = len(slotted_hosts)
+    if n < 2:
+        return 0
+    total = 0
+    srcs, dsts, rtts = [], [], []
+    for i, (src, src_slot) in enumerate(slotted_hosts):
+        for j in range(1, min(pairs_per_src, n - 1) + 1):
+            dst, dst_slot = slotted_hosts[(i + j) % n]
+            srcs.append(src_slot)
+            dsts.append(dst_slot)
+            rtts.append(float(rtt_fn(src, dst, ("warm", j))))
+        if len(srcs) >= 1024:  # bound each device scatter batch
+            store.enqueue(np.asarray(srcs), np.asarray(dsts), np.asarray(rtts, np.float32))
+            total += len(srcs)
+            srcs, dsts, rtts = [], [], []
+    if srcs:
+        store.enqueue(np.asarray(srcs), np.asarray(dsts), np.asarray(rtts, np.float32))
+        total += len(srcs)
+    return total
+
+
 def _network_stat(info: dict) -> NetworkStat:
     return NetworkStat(
         tcp_connection_count=info.get("tcp_connection_count", 0),

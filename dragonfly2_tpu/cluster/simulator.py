@@ -31,6 +31,15 @@ class SimStats:
     failed: int = 0
     pieces: int = 0
     schedule_failures: int = 0
+    # scenario-injected events (scenarios/engine.py): piece errors that
+    # aborted a wave through the reschedule path, stalls folded into
+    # piece cost, children crashed mid-download, hosts dropped off the
+    # announce plane, and waves beyond a peer's first (its retries)
+    injected_piece_failures: int = 0
+    injected_stalls: int = 0
+    injected_crashes: int = 0
+    injected_host_leaves: int = 0
+    retry_waves: int = 0
     # seed daemons fetching origin on a TriggerSeedRequest (ObtainSeeds) —
     # origin traffic by design, not a P2P miss
     seed_downloads: int = 0
@@ -57,12 +66,31 @@ class ClusterSimulator:
         num_tasks: int = 16,
         seed: int = 0,
         piece_length: int = 4 << 20,
+        scenario=None,
     ):
         self.scheduler = scheduler
         self.cluster = synth.make_cluster(num_hosts, seed=seed)
         self.rng = self.cluster.rng
         self.piece_length = piece_length
         self.stats = SimStats()
+        # Scenario lab (scenarios/): a ScenarioSpec turns on the
+        # deterministic heterogeneity/fault engine — piece costs from the
+        # scenario link model, churn, flaky parents, Zipf popularity.
+        # None keeps the legacy homogeneous replay bit-for-bit.
+        self.engine = None
+        self._task_weights = None
+        if scenario is not None:
+            from dragonfly2_tpu.scenarios.engine import ScenarioEngine
+
+            self.engine = ScenarioEngine(scenario, self.cluster.hosts, seed=seed)
+            self._task_weights = self.engine.task_weights(num_tasks)
+        self._round = 0
+        self._probe_seq = 0
+        self._reg_index = 0
+        self._offline: set[str] = set()
+        self._peer_reg: dict[str, int] = {}
+        self._peer_have: dict[str, set[int]] = {}
+        self._peer_waves: dict[str, int] = {}
         self._host_info: dict[str, msg.HostInfo] = {}
         self._tasks = []
         for t in range(num_tasks):
@@ -74,6 +102,7 @@ class ClusterSimulator:
                     "task_id": idgen.task_id_v2(url, tag="sim", piece_length=piece_length),
                     "pieces": pieces,
                     "content_length": pieces * piece_length,
+                    "index": t,
                 }
             )
         for h in self.cluster.hosts:
@@ -97,9 +126,22 @@ class ClusterSimulator:
     # ------------------------------------------------------------- driving
 
     def start_download(self, host=None, task=None) -> str:
-        host = host or self.rng.choice(self.cluster.hosts)
-        task = task or self.rng.choice(self._tasks)
+        if host is None:
+            if self._offline:
+                online = [h for h in self.cluster.hosts if h.id not in self._offline]
+                host = self.rng.choice(online or self.cluster.hosts)
+            else:
+                host = self.rng.choice(self.cluster.hosts)
+        if task is None:
+            if self._task_weights is not None:
+                # hotspot skew: Zipf draw over task ranks (scenarios/spec
+                # SkewSpec) — a few blobs get downloaded cluster-wide
+                task = self.rng.choices(self._tasks, weights=self._task_weights)[0]
+            else:
+                task = self.rng.choice(self._tasks)
         peer_id = str(uuid.uuid4())
+        self._peer_reg[peer_id] = self._reg_index
+        self._reg_index += 1
         self._peer_host[peer_id] = host.id
         self.scheduler.register_peer(
             msg.RegisterPeerRequest(
@@ -121,6 +163,9 @@ class ClusterSimulator:
     def run_round(self, new_downloads: int = 8) -> list:
         """One simulation round: start downloads, tick the scheduler, act on
         every response like a dfdaemon would."""
+        self._round += 1
+        if self.engine is not None:
+            self._apply_host_churn()
         for _ in range(new_downloads):
             self.start_download()
         self.consume_seed_triggers()
@@ -176,6 +221,22 @@ class ClusterSimulator:
             self.stats.seed_downloads += 1
         return len(triggers)
 
+    def _apply_host_churn(self) -> None:
+        """Scenario churn: flap hosts off/onto the announce plane. A host
+        going offline LEAVES (LeaveHost drops its peers mid-download —
+        the reference's host-GC/leave path); a returning host re-announces
+        and rejoins scheduling with fresh per-connection state."""
+        offline_now = self.engine.offline_hosts(self._round)
+        for host_id in offline_now - self._offline:
+            if host_id in self._host_info:
+                self.scheduler.leave_host(host_id)
+                self.stats.injected_host_leaves += 1
+        for host_id in self._offline - offline_now:
+            info = self._host_info.get(host_id)
+            if info is not None:
+                self.scheduler.announce_host(info)
+        self._offline = offline_now
+
     def _act(self, resp) -> None:
         if isinstance(resp, msg.NormalTaskResponse):
             self._download_from_parents(resp)
@@ -194,12 +255,62 @@ class ClusterSimulator:
         task = self._task_of[peer_id]
         n_pieces = task["pieces"]
         parents = resp.candidate_parents
+        if self.engine is None:
+            # legacy homogeneous replay: latent host quality + IDC RTT
+            for piece in range(n_pieces):
+                parent = parents[piece % len(parents)]
+                parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
+                rtt = self.cluster.rtt_ns(child_host, parent_host)
+                service_ms = self.piece_length / (max(parent_host.quality, 0.05) * 100e6) * 1e3
+                cost = int(rtt + service_ms * self.rng.lognormvariate(0.0, 0.25) * 1e6)
+                self.scheduler.piece_finished(
+                    msg.DownloadPieceFinishedRequest(
+                        peer_id=peer_id,
+                        piece_number=piece,
+                        length=self.piece_length,
+                        cost_ns=cost,
+                        parent_peer_id=parent.peer_id,
+                    )
+                )
+                self.stats.pieces += 1
+                self.stats.piece_cost_ns_total += cost
+            self.scheduler.peer_finished(
+                msg.DownloadPeerFinishedRequest(
+                    peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces
+                )
+            )
+            self.stats.completed += 1
+            return
+        # ---- scenario path: per-peer progress across waves, piece costs
+        # from the scenario link model, deterministic faults. An injected
+        # piece error reports DownloadPieceFailed (the real protocol edge)
+        # and ABORTS the wave — the scheduler blocklists that parent and
+        # the peer retries from its kept progress on a later tick.
+        have = self._peer_have.setdefault(peer_id, set())
+        wave = self._peer_waves.get(peer_id, 0) + 1
+        self._peer_waves[peer_id] = wave
+        if wave > 1:
+            self.stats.retry_waves += 1
+        crash_after = self.engine.crash_point(self._peer_reg.get(peer_id, 0), n_pieces)
         for piece in range(n_pieces):
+            if piece in have:
+                continue
             parent = parents[piece % len(parents)]
             parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
-            rtt = self.cluster.rtt_ns(child_host, parent_host)
-            service_ms = self.piece_length / (max(parent_host.quality, 0.05) * 100e6) * 1e3
-            cost = int(rtt + service_ms * self.rng.lognormvariate(0.0, 0.25) * 1e6)
+            cost, fault = self.engine.piece_cost_ns(
+                child_host, parent_host, self.piece_length,
+                task["index"], piece, wave,
+            )
+            if fault == "error":
+                self.stats.injected_piece_failures += 1
+                self.scheduler.piece_failed(
+                    msg.DownloadPieceFailedRequest(
+                        peer_id=peer_id, parent_peer_id=parent.peer_id
+                    )
+                )
+                return
+            if fault == "stall":
+                self.stats.injected_stalls += 1
             self.scheduler.piece_finished(
                 msg.DownloadPieceFinishedRequest(
                     peer_id=peer_id,
@@ -209,8 +320,17 @@ class ClusterSimulator:
                     parent_peer_id=parent.peer_id,
                 )
             )
+            have.add(piece)
             self.stats.pieces += 1
             self.stats.piece_cost_ns_total += cost
+            if crash_after is not None and len(have) >= crash_after:
+                self.stats.injected_crashes += 1
+                self.scheduler.peer_failed(
+                    msg.DownloadPeerFailedRequest(
+                        peer_id=peer_id, description="scenario churn: crashed"
+                    )
+                )
+                return
         self.scheduler.peer_finished(
             msg.DownloadPeerFinishedRequest(
                 peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces
@@ -279,8 +399,18 @@ class ClusterSimulator:
                     continue
                 srcs.append(src_slot)
                 dsts.append(int(t))
-                rtts.append(float(self.cluster.rtt_ns(src, dst)))
+                rtts.append(float(self._probe_rtt_ns(src, dst)))
             if srcs:
                 probes.enqueue(np.asarray(srcs), np.asarray(dsts), np.asarray(rtts))
                 n += len(srcs)
         return n
+
+    def _probe_rtt_ns(self, src, dst) -> int:
+        """One probe measurement: scenario link model when a scenario is
+        active (the probe loop MEASURES the injected topology — the
+        NetworkTopology traces it snapshots then carry scenario structure
+        into training data), else the latent synth model."""
+        if self.engine is not None:
+            self._probe_seq += 1
+            return self.engine.rtt_ns(src, dst, key=("probe", self._probe_seq))
+        return self.cluster.rtt_ns(src, dst)

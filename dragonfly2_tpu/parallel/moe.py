@@ -26,6 +26,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -98,7 +100,7 @@ def sharded_moe_ffn(mesh, x, gate_w, w1, b1, w2, b2, capacity: int) -> jax.Array
     """shard_map wrapper: tokens over `ep` (the token shard IS the ep
     axis — dp composes on top via the leading batch dim), experts'
     weights sharded on their leading expert dim."""
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(moe_ffn, capacity=capacity, axis_name=EP_AXIS),
         mesh=mesh,
         in_specs=(

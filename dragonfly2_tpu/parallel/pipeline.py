@@ -18,6 +18,8 @@ import functools
 from typing import Callable
 
 import jax
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -75,7 +77,7 @@ def sharded_pipeline_apply(mesh, stage_fn, stage_params, x):
     """shard_map wrapper: stage_params leaves are [pp, ...] (stage i's
     params at index i), x is [M, ...] microbatched input; both global.
     Returns [M, ...] outputs equal to applying the stages sequentially."""
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(pipeline_apply, stage_fn, axis_name=PP_AXIS),
         mesh=mesh,
         in_specs=(

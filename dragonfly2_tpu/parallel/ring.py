@@ -18,6 +18,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -153,7 +155,7 @@ def sharded_ring_attention(mesh, q, k, v, kv_mask, use_flash: bool = False) -> j
     block computation for the pallas partials kernel (forward-only)."""
     qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
     mask_spec = P(DP_AXIS, SP_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=SP_AXIS, use_flash=use_flash),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
@@ -209,7 +211,7 @@ def sharded_causal_ring_attention(mesh, q, k, v, kv_mask) -> jax.Array:
         return ring_attention(qb, kb, vb, mb, axis_name=SP_AXIS,
                               q_pos=pos, k_pos=pos)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, pos_spec),

@@ -19,6 +19,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -40,7 +42,7 @@ def sharded_tp_ffn(mesh, x, w1, b1, w2, b2) -> jax.Array:
     """shard_map wrapper: batch over dp, hidden over tp. Weights come in
     at global shape (W1 [F, H], W2 [H, F]) and are sharded on their
     hidden dim; x/output are batch-sharded and tp-replicated."""
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(tp_ffn, axis_name=TP_AXIS),
         mesh=mesh,
         in_specs=(

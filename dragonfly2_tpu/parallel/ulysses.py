@@ -23,6 +23,8 @@ import functools
 from typing import Callable
 
 import jax
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -71,7 +73,7 @@ def sharded_ulysses_attention(
     two strategies are drop-in swaps for each other."""
     qkv_spec = P(DP_AXIS, None, SP_AXIS, None)
     mask_spec = P(DP_AXIS, SP_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             ulysses_attention, axis_name=SP_AXIS, inner=inner, causal=causal
         ),

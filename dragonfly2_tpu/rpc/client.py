@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import wire
@@ -392,11 +393,20 @@ class SyncSchedulerClient:
     error, so a scheduler restart costs one failed call, not a stuck
     manager."""
 
-    def __init__(self, host: str, port: int, ssl_context=None, timeout: float = 5.0):
+    def __init__(self, host: str, port: int, ssl_context=None, timeout: float = 5.0,
+                 dial_failure_ttl: float = 5.0):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
         self.timeout = timeout
+        # After a failed DIAL (not a mid-call transport error), fail fast
+        # for this long instead of re-dialing: a preheat fans one trigger
+        # per task to the owning scheduler, and without the marker a dead
+        # (blackholed) scheduler costs one full connect timeout PER TASK —
+        # minutes for a 50-URL job. The TTL matches the dial timeout, so
+        # one create_preheat round pays the ~5s timeout exactly once.
+        self.dial_failure_ttl = dial_failure_ttl
+        self._dial_failed_at = 0.0  # monotonic; 0 = no cached failure
         self._sock = None
         self._mu = threading.Lock()
 
@@ -418,7 +428,21 @@ class SyncSchedulerClient:
         with self._mu:
             try:
                 if self._sock is None:
-                    self._sock = self._connect()
+                    if (
+                        self._dial_failed_at
+                        and time.monotonic() - self._dial_failed_at < self.dial_failure_ttl
+                    ):
+                        raise ConnectionError(
+                            f"dial failed "
+                            f"{time.monotonic() - self._dial_failed_at:.1f}s ago; "
+                            f"fast-failing for {self.dial_failure_ttl:.0f}s"
+                        )  # the outer handler adds the host:port prefix
+                    try:
+                        self._sock = self._connect()
+                    except OSError:
+                        self._dial_failed_at = time.monotonic()
+                        raise
+                    self._dial_failed_at = 0.0
                 sock = self._sock
                 # wire.encode already length-prefixes the frame
                 sock.sendall(wire.encode(request))

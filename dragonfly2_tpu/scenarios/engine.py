@@ -1,0 +1,252 @@
+"""Deterministic scenario engine: (spec, seed) → heterogeneity + faults.
+
+Every stochastic decision is COUNTER-BASED, not stream-based: a decision
+is ``u = blake2b(seed, kind, *key) / 2^64`` over a semantic key (host
+ids, task index, piece number, attempt number) rather than a draw from a
+shared RNG stream. That makes the injected fault schedule a pure function
+of (spec, seed, event identity): two runs of the same replay produce the
+same schedule even though the surrounding code allocates uuids, runs GC
+off wall clocks, or interleaves differently — the determinism contract
+the scenario A/B test pins (no ``Date.now``-style nondeterminism can leak
+in, because no decision reads a clock or an ordered stream).
+
+The engine serves three consumers:
+
+- ``cluster/simulator.py``: piece costs from the link model, churn and
+  flaky-parent events, Zipf task popularity, probe RTTs;
+- ``client/upload.py`` via ``FaultInjector``: piece-serving errors and
+  stalls injected at a REAL parent daemon, so a child's conductor
+  exercises its genuine retry path (DownloadPieceFailedRequest →
+  reschedule → blocklist → back-to-source). Verdicts are per-attempt
+  deterministic; bit-exact schedule replay additionally needs a
+  deterministic serve order (see FaultInjector's docstring);
+- ``scenarios/ab.py``: schedule digests for the determinism check.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import statistics
+import threading
+
+from dragonfly2_tpu.scenarios.spec import ScenarioSpec
+
+_U64 = float(1 << 64)
+_NORM = statistics.NormalDist()
+
+
+def _u(seed: int, kind: str, *key) -> float:
+    """Deterministic uniform in [0, 1) from (seed, kind, key...)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update((f"{seed}:{kind}:" + ":".join(str(k) for k in key)).encode())
+    return int.from_bytes(h.digest(), "big") / _U64
+
+
+def _lognorm(u: float, sigma: float) -> float:
+    """Deterministic lognormal(0, sigma) sample from one uniform via the
+    inverse-CDF transform (stdlib NormalDist probit)."""
+    u = min(max(u, 1e-12), 1.0 - 1e-12)
+    return math.exp(sigma * _NORM.inv_cdf(u))
+
+
+NS_PER_MS = 1_000_000
+
+
+class ScenarioEngine:
+    """Deterministic sampler for one (spec, seed, host population)."""
+
+    def __init__(self, spec: ScenarioSpec, hosts, seed: int = 0):
+        """`hosts` is any sequence of objects with ``.id``, ``.idc``,
+        ``.location`` (records/synth.SynthHost or equivalents)."""
+        self.spec = spec
+        self.seed = seed
+        self.hosts = list(hosts)
+        self._schedule = hashlib.blake2b(digest_size=16)
+        self._schedule_events = 0
+        link = spec.link
+
+        # ---- per-host assignments: deterministic in host ID, not order
+        self.bandwidth: dict[str, float] = {}
+        self.flaky_hosts: set[str] = set()
+        self._rack: dict[str, str] = {}
+        self._region: dict[str, str] = {}
+        self._idc: dict[str, str] = {}
+        for h in self.hosts:
+            bw = link.base_bandwidth_bps
+            if link.slow_fraction > 0 and _u(seed, "slow_mode", h.id) < link.slow_fraction:
+                bw *= link.slow_multiplier
+            self.bandwidth[h.id] = bw
+            if (
+                spec.flaky.parent_fraction > 0
+                and _u(seed, "flaky_host", h.id) < spec.flaky.parent_fraction
+            ):
+                self.flaky_hosts.add(h.id)
+            loc = h.location.split("|")
+            self._region[h.id] = loc[0] if loc else ""
+            self._rack[h.id] = h.location  # full zone|rack path = the rack key
+            self._idc[h.id] = h.idc
+        # "one slow NIC": the k hosts with the smallest assignment hash —
+        # a deterministic choice independent of host-list order
+        if link.slow_nic_count > 0 and self.hosts:
+            ranked = sorted(self.hosts, key=lambda h: _u(seed, "slow_nic", h.id))
+            for h in ranked[: link.slow_nic_count]:
+                self.bandwidth[h.id] = (
+                    link.base_bandwidth_bps * link.slow_nic_multiplier
+                )
+
+    # -------------------------------------------------------- link model
+
+    def rtt_ns(self, src, dst, key=()) -> int:
+        """IDC/rack-structured RTT with deterministic jitter. `key`
+        disambiguates repeated samples of the same pair (probe sequence
+        numbers, piece attempts)."""
+        link = self.spec.link
+        if self._rack.get(src.id) == self._rack.get(dst.id) and src.id != dst.id:
+            base = link.same_rack_rtt_ms
+        elif self._idc.get(src.id) == self._idc.get(dst.id):
+            base = link.same_idc_rtt_ms
+        elif self._region.get(src.id) == self._region.get(dst.id):
+            base = link.same_region_rtt_ms
+        else:
+            base = link.cross_region_rtt_ms
+        jitter = _lognorm(
+            _u(self.seed, "rtt", src.id, dst.id, *key), link.rtt_jitter_sigma
+        )
+        return max(1, int(base * jitter * NS_PER_MS))
+
+    def pair_bandwidth(self, child, parent) -> float:
+        """Effective parent→child bandwidth: the parent NIC's capacity,
+        divided by the spine oversubscription when the path crosses
+        racks."""
+        link = self.spec.link
+        bw = self.bandwidth.get(parent.id, link.base_bandwidth_bps)
+        if (
+            link.spine_oversubscription > 1.0
+            and self._rack.get(child.id) != self._rack.get(parent.id)
+        ):
+            bw /= link.spine_oversubscription
+        return max(bw, 1.0)
+
+    def piece_cost_ns(
+        self, child, parent, piece_length: int, task_idx: int,
+        piece: int, attempt: int,
+    ) -> tuple[int, str | None]:
+        """(cost_ns, fault) for one piece transfer. fault ∈ {None,
+        "error", "stall"}: an error aborts the transfer through the retry
+        path; a stall completes but carries the stall in its cost."""
+        key = (task_idx, piece, attempt)
+        rtt = self.rtt_ns(child, parent, key=key)
+        bw = self.pair_bandwidth(child, parent)
+        service_s = piece_length / bw
+        jitter = _lognorm(
+            _u(self.seed, "svc", child.id, parent.id, *key),
+            self.spec.link.bandwidth_jitter_sigma,
+        )
+        cost = rtt + int(service_s * jitter * 1e9)
+        fault = None
+        flaky = self.spec.flaky
+        if parent.id in self.flaky_hosts:
+            roll = _u(self.seed, "flake", child.id, parent.id, *key)
+            if roll < flaky.piece_error_rate:
+                fault = "error"
+            elif roll < flaky.piece_error_rate + flaky.piece_stall_rate:
+                fault = "stall"
+                cost += int(flaky.stall_seconds * 1e9)
+            if fault is not None:
+                self._record(fault, parent.id, *key)
+        return cost, fault
+
+    # ------------------------------------------------------------- churn
+
+    def crash_point(self, registration_index: int, n_pieces: int) -> int | None:
+        """Piece count after which this download crashes, or None. Keyed
+        on the simulator's deterministic registration counter (peer uuids
+        are process-random and MUST NOT key schedule decisions)."""
+        churn = self.spec.churn
+        if churn.peer_crash_rate <= 0:
+            return None
+        if _u(self.seed, "crash", registration_index) >= churn.peer_crash_rate:
+            return None
+        self._record("crash", registration_index)
+        return max(1, int(n_pieces * churn.crash_progress))
+
+    def offline_hosts(self, round_idx: int) -> set[str]:
+        """Host ids off the announce plane during this round's epoch.
+        Membership re-rolls per epoch so hosts flap rather than die."""
+        churn = self.spec.churn
+        if churn.host_leave_rate <= 0:
+            return set()
+        epoch = round_idx // max(churn.leave_epoch_rounds, 1)
+        out = {
+            h.id
+            for h in self.hosts
+            if _u(self.seed, "leave", epoch, h.id) < churn.host_leave_rate
+        }
+        return out
+
+    # ------------------------------------------------------------- skew
+
+    def task_weights(self, n_tasks: int) -> list[float] | None:
+        """Zipf popularity weights over task indices (None = uniform)."""
+        alpha = self.spec.skew.zipf_alpha
+        if alpha <= 0:
+            return None
+        w = [1.0 / (rank + 1) ** alpha for rank in range(n_tasks)]
+        total = sum(w)
+        return [x / total for x in w]
+
+    # --------------------------------------------------------- schedule
+
+    def _record(self, kind: str, *key) -> None:
+        self._schedule.update(f"{kind}:{':'.join(str(k) for k in key)};".encode())
+        self._schedule_events += 1
+
+    def schedule_digest(self) -> str:
+        """Hash over every fault/churn event decided so far — two runs of
+        the same (spec, seed, replay) must produce identical digests."""
+        return f"{self._schedule_events}:{self._schedule.copy().hexdigest()}"
+
+    def fault_injector(self) -> "FaultInjector":
+        return FaultInjector(self.spec, seed=self.seed)
+
+
+class FaultInjector:
+    """Piece-serving fault decisions for a REAL parent daemon's upload
+    server (client/upload.py): the verdict is a pure function of (task,
+    piece, serve-attempt NUMBER), so the first fetch of a piece may error
+    while the retry succeeds — and the retry path actually recovers.
+
+    Determinism scope: the bit-exact same-schedule guarantee holds when
+    the serve ORDER is itself deterministic (one child per task, or the
+    in-proc simulator/matrix path, whose events are counter-hashed).
+    With multiple children racing fetches of the same piece over real
+    sockets, which request lands attempt 0 vs 1 follows socket timing —
+    per-attempt verdicts stay reproducible, attempt attribution does
+    not. Attach to a daemon to make it the flaky parent (the engine's
+    per-host flaky split does not apply here: the injector IS the flaky
+    parent)."""
+
+    def __init__(self, spec: ScenarioSpec, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.stall_seconds = spec.flaky.stall_seconds
+        self._mu = threading.Lock()
+        self._attempts: dict[tuple[str, int], int] = {}
+        self.injected: dict[str, int] = {"error": 0, "stall": 0}
+
+    def piece_fault(self, task_id: str, piece: int) -> str | None:
+        with self._mu:
+            attempt = self._attempts.get((task_id, piece), 0)
+            self._attempts[(task_id, piece)] = attempt + 1
+        flaky = self.spec.flaky
+        roll = _u(self.seed, "inj", task_id, piece, attempt)
+        if roll < flaky.piece_error_rate:
+            verdict = "error"
+        elif roll < flaky.piece_error_rate + flaky.piece_stall_rate:
+            verdict = "stall"
+        else:
+            return None
+        with self._mu:
+            self.injected[verdict] += 1
+        return verdict

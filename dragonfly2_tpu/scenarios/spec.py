@@ -1,0 +1,218 @@
+"""Declarative scenario specs.
+
+A scenario is a plain dataclass tree — serializable, diffable, loadable
+from TOML or JSON — that fully determines (together with an integer seed)
+the heterogeneity and faults injected into a run. The spec carries NO
+randomness itself; all sampling lives in ``engine.ScenarioEngine`` so the
+same spec document can drive the pure simulator, the A/B harness, and the
+multiprocess e2e loop identically.
+
+Knob ↔ reference semantics (see PARITY.md "Scenario lab"):
+
+- ``LinkSpec`` RTT tiers mirror the networktopology probe structure the
+  reference snapshots (same-IDC / same-region / cross-region RTT bands,
+  scheduler/networktopology) — the scenario's link model is what the
+  probe loop *measures*;
+- ``FlakySpec`` models parents whose piece serving errors or stalls —
+  exercised through the child's real retry path
+  (DownloadPieceFailedRequest → reschedule → blocklist), not simulated
+  around it;
+- ``ChurnSpec`` models peers leaving/crashing mid-download and hosts
+  dropping off the announce plane (LeaveHost) and returning;
+- ``SkewSpec`` models hotspot task popularity (Zipf), the regime where a
+  few blobs are downloaded cluster-wide and swarms get deep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any
+
+
+@dataclasses.dataclass
+class LinkSpec:
+    """Per-link RTT/bandwidth model.
+
+    RTT tiers (ms) follow the synthetic IDC structure records/synth.py
+    plants; bandwidth is per-HOST NIC capacity (bytes/s) with a bimodal
+    fast/slow split, an optional oversubscribed spine penalty applied to
+    cross-rack transfers, and an optional handful of pathologically slow
+    NICs (the tail the rule blend cannot see until piece costs pile up).
+    """
+
+    same_rack_rtt_ms: float = 0.2
+    same_idc_rtt_ms: float = 0.5
+    same_region_rtt_ms: float = 5.0
+    cross_region_rtt_ms: float = 60.0
+    rtt_jitter_sigma: float = 0.3
+
+    base_bandwidth_bps: float = 100e6  # bytes/s of a healthy NIC
+    bandwidth_jitter_sigma: float = 0.25
+    slow_fraction: float = 0.0         # fraction of hosts in the slow mode
+    slow_multiplier: float = 1.0       # slow-mode bandwidth = base * this
+    spine_oversubscription: float = 1.0  # cross-rack bandwidth divisor
+    slow_nic_count: int = 0            # hosts with a pathological NIC
+    slow_nic_multiplier: float = 0.05
+
+
+@dataclasses.dataclass
+class ChurnSpec:
+    peer_crash_rate: float = 0.0   # P(a child crashes mid-download)
+    crash_progress: float = 0.5    # crash lands after this piece fraction
+    host_leave_rate: float = 0.0   # P(host offline in a given epoch)
+    leave_epoch_rounds: int = 20   # offline membership re-rolls every N rounds
+
+
+@dataclasses.dataclass
+class FlakySpec:
+    parent_fraction: float = 0.0   # fraction of hosts that serve flakily
+    piece_error_rate: float = 0.0  # P(piece from a flaky parent errors)
+    piece_stall_rate: float = 0.0  # P(piece from a flaky parent stalls)
+    stall_seconds: float = 1.0     # injected stall duration
+
+
+@dataclasses.dataclass
+class SkewSpec:
+    zipf_alpha: float = 0.0        # 0 = uniform task popularity
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    name: str = "homogeneous"
+    description: str = ""
+    link: LinkSpec = dataclasses.field(default_factory=LinkSpec)
+    churn: ChurnSpec = dataclasses.field(default_factory=ChurnSpec)
+    flaky: FlakySpec = dataclasses.field(default_factory=FlakySpec)
+    skew: SkewSpec = dataclasses.field(default_factory=SkewSpec)
+
+    # ------------------------------------------------------------- codecs
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ScenarioSpec":
+        spec = cls()
+        for key, value in (data or {}).items():
+            if not hasattr(spec, key):
+                raise ValueError(f"unknown scenario field {key!r}")
+            current = getattr(spec, key)
+            if dataclasses.is_dataclass(current) and isinstance(value, dict):
+                for k, v in value.items():
+                    if not hasattr(current, k):
+                        raise ValueError(f"unknown scenario field {key}.{k}")
+                    setattr(current, k, type(getattr(current, k))(v))
+            else:
+                setattr(spec, key, value)
+        return spec
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+def load_scenario(path: str | pathlib.Path) -> ScenarioSpec:
+    """Load a spec from a ``.toml`` or ``.json`` file. TOML uses stdlib
+    ``tomllib`` where available (3.11+); on older interpreters a minimal
+    flat ``[section] key = value`` parser covers the spec grammar."""
+    path = pathlib.Path(path)
+    text = path.read_text()
+    if path.suffix == ".toml":
+        return ScenarioSpec.from_dict(_parse_toml(text))
+    return ScenarioSpec.from_dict(json.loads(text))
+
+
+def _parse_toml(text: str) -> dict:
+    try:
+        import tomllib  # py311+
+
+        return tomllib.loads(text)
+    except ImportError:
+        pass
+    root: dict[str, Any] = {}
+    section = root
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = root.setdefault(line[1:-1].strip(), {})
+            continue
+        key, _, value = line.partition("=")
+        section[key.strip()] = _coerce(value.strip())
+    return root
+
+
+def _coerce(value: str) -> Any:
+    if value.startswith(("'", '"')) and value.endswith(("'", '"')):
+        return value[1:-1]
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    return value
+
+
+# --------------------------------------------------------------- builtins
+
+
+def builtin_scenarios() -> dict[str, ScenarioSpec]:
+    """The scenario grid BENCH_scenarios.json covers: a homogeneous
+    control plus the structured adversarial conditions the learned
+    evaluator exists for. Severity is deliberately strong — the point is
+    exploitable structure, not realism tuning."""
+    return {
+        "homogeneous": ScenarioSpec(
+            name="homogeneous",
+            description="control: uniform NICs, no faults, uniform popularity",
+        ),
+        "bandwidth_skew": ScenarioSpec(
+            name="bandwidth_skew",
+            description=(
+                "bimodal rack NICs (40% at 15% speed), 4x oversubscribed "
+                "spine on cross-rack paths, plus 2 pathological slow NICs"
+            ),
+            link=LinkSpec(
+                slow_fraction=0.4,
+                slow_multiplier=0.15,
+                spine_oversubscription=4.0,
+                slow_nic_count=2,
+                slow_nic_multiplier=0.02,
+            ),
+        ),
+        "churn": ScenarioSpec(
+            name="churn",
+            description=(
+                "15% of children crash mid-download; 10% of hosts flap "
+                "off the announce plane each epoch"
+            ),
+            churn=ChurnSpec(
+                peer_crash_rate=0.15,
+                crash_progress=0.5,
+                host_leave_rate=0.10,
+                leave_epoch_rounds=15,
+            ),
+        ),
+        "flaky_parent": ScenarioSpec(
+            name="flaky_parent",
+            description=(
+                "30% of hosts serve flakily: 25% piece error rate, 10% "
+                "stall rate — exercised through the real retry path"
+            ),
+            flaky=FlakySpec(
+                parent_fraction=0.30,
+                piece_error_rate=0.25,
+                piece_stall_rate=0.10,
+                stall_seconds=0.5,
+            ),
+        ),
+        "hotspot": ScenarioSpec(
+            name="hotspot",
+            description="Zipf(1.2) task popularity: a few blobs go cluster-wide",
+            skew=SkewSpec(zipf_alpha=1.2),
+        ),
+    }

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from dragonfly2_tpu.utils.jaxcompat import shard_map
 
 from dragonfly2_tpu.config.config import TrainerConfig
 from dragonfly2_tpu.models.graphsage import GraphSAGERanker, RankBatch, listwise_rank_loss
@@ -131,6 +131,134 @@ def analytic_gnn_flops_per_sample(
     fwd += 2.0 * rows * (hidden // 2)
     step = 3.0 * fwd  # value_and_grad ~ fwd + 2x fwd for the backward
     return step / max(batch, 1)
+
+
+def gnn_roofline_bound(
+    n_nodes: int,
+    node_feat_dim: int,
+    edge_feat_dim: int,
+    hidden: int,
+    batch: int,
+    parents: int,
+    pair_feat_dim: int,
+    num_layers: int = 2,
+    dense_adj: bool = True,
+    peak_flops: float = 197.0e12,   # TPU v5e bf16 per chip
+    hbm_bytes_per_s: float = 819.0e9,  # TPU v5e HBM bandwidth
+    compute_bytes: int = 2,         # bf16 activations/weights
+) -> dict:
+    """Per-train-step roofline for the GraphSAGERanker: which stages are
+    compute- vs memory-bound, and the MFU CEILING their byte traffic
+    imposes (VERDICT r5 next #3 — the number the bench publishes so
+    'GNN at 24.6% MFU' stops being folklore).
+
+    Per-stage: matmul FLOPs + the HBM bytes its operands/results move;
+    time lower bound = max(flops/peak, bytes/bw) per stage, summed
+    (stages are data-dependent, so no overlap credit); ceiling =
+    total_flops / (peak * Σ time_lb). Backward counted as 2× forward for
+    both FLOPs and bytes (grad matmuls re-read activations at the same
+    shapes). Elementwise ops, the optimizer, and XLA fusion wins are NOT
+    modeled — real MFU lands below this ceiling, never above it.
+
+    The structural story the numbers tell: the layer-0 adjacency matmul
+    is [N,N]@[N,F] with F = node_feat_dim (~12) — arithmetic intensity
+    2·F FLOPs per adjacency byte, far under the v5e ridge
+    (peak/bw ≈ 240 FLOPs/byte), so the biggest FLOP consumer of the
+    embed runs memory-bound; the segment_sum/scatter serving path is
+    worse (≈0 matmul FLOPs per byte — pure bandwidth)."""
+    ridge = peak_flops / hbm_bytes_per_s
+    stages: list[dict] = []
+
+    def stage(name: str, flops: float, nbytes: float) -> None:
+        t = max(flops / peak_flops, nbytes / hbm_bytes_per_s)
+        stages.append({
+            "stage": name,
+            "gflops": round(flops / 1e9, 2),
+            "mbytes": round(nbytes / 1e6, 2),
+            "ai_flops_per_byte": round(flops / max(nbytes, 1.0), 1),
+            "bound": "compute" if flops / max(nbytes, 1.0) >= ridge else "memory",
+            "time_us_lb": round(t * 1e6, 2),
+        })
+
+    f_in = node_feat_dim
+    for layer in range(num_layers):
+        if dense_adj:
+            stage(
+                f"sage_{layer}.adj_matmul",
+                2.0 * n_nodes * n_nodes * f_in,
+                # adjacency + input nodes + aggregated output
+                compute_bytes * (n_nodes * n_nodes + 2.0 * n_nodes * f_in),
+            )
+        else:
+            # gather + segment-sum path: ~zero matmul FLOPs, pure traffic
+            stage(
+                f"sage_{layer}.segment_sum",
+                0.0,
+                compute_bytes * 3.0 * n_nodes * f_in,  # gather+scatter+out
+            )
+        stage(
+            f"sage_{layer}.dense",
+            2.0 * n_nodes * f_in * hidden * 2        # W_self + W_neigh
+            + 2.0 * n_nodes * edge_feat_dim * hidden,  # W_edge
+            compute_bytes * (
+                n_nodes * (2.0 * f_in + edge_feat_dim + hidden)
+                + (2.0 * f_in + edge_feat_dim) * hidden
+            ),
+        )
+        f_in = hidden
+    rows = float(batch) * parents
+    head_in = 2 * hidden + pair_feat_dim
+    stage(
+        "emb_gather",
+        0.0,
+        compute_bytes * (batch * hidden + rows * hidden),
+    )
+    stage(
+        "score_head",
+        2.0 * rows * (head_in * hidden + hidden * (hidden // 2) + (hidden // 2)),
+        compute_bytes * (
+            rows * (head_in + hidden + hidden // 2 + 1)
+            + head_in * hidden + hidden * (hidden // 2) + hidden // 2
+        ),
+    )
+
+    fwd_flops = sum(s["gflops"] for s in stages) * 1e9
+    fwd_time = sum(s["time_us_lb"] for s in stages) / 1e6
+    step_flops = 3.0 * fwd_flops          # fwd + ~2x fwd backward
+    step_time_lb = 3.0 * fwd_time
+    ceiling = 100.0 * step_flops / (peak_flops * max(step_time_lb, 1e-12))
+    mem_stages = [s["stage"] for s in stages if s["bound"] == "memory"]
+    out = {
+        "peak_tflops": peak_flops / 1e12,
+        "hbm_gbps": hbm_bytes_per_s / 1e9,
+        "ridge_flops_per_byte": round(ridge, 1),
+        "stages": stages,
+        "step_gflops": round(step_flops / 1e9, 2),
+        "step_time_us_lb": round(step_time_lb * 1e6, 2),
+        "mfu_ceiling_pct": round(ceiling, 1),
+        "memory_bound_stages": mem_stages,
+        "method": (
+            "per-stage max(flops/peak, bytes/bw), summed (no overlap "
+            "credit); bwd = 2x fwd; elementwise/optimizer unmodeled, so "
+            "achieved MFU must land BELOW the ceiling"
+        ),
+    }
+    # name the actual dominant memory-bound stage (the adjacency matmul
+    # on the dense path, segment_sum on the serving path) rather than
+    # assuming stage order
+    dominant = max(
+        (s for s in stages if s["bound"] == "memory"),
+        key=lambda s: s["time_us_lb"],
+        default=stages[0],
+    )
+    out["statement"] = (
+        f"matmul roofline ceiling {out['mfu_ceiling_pct']}% MFU at this "
+        f"shape (ridge {out['ridge_flops_per_byte']} FLOPs/B): "
+        f"{len(mem_stages)}/{len(stages)} stages memory-bound, led by "
+        f"{dominant['stage']} (AI ~{dominant['ai_flops_per_byte']}); "
+        "the scatter/segment_sum serving path is pure bandwidth (AI ~0)"
+    )
+    return out
 
 
 def analytic_mlp_flops_per_sample(

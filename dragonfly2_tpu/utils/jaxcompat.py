@@ -1,0 +1,33 @@
+"""Version-tolerant imports for jax API that moved between releases.
+
+The parallel strategies and the trainer target the modern spelling
+(``jax.shard_map``, promoted to the top-level namespace in 2024), but the
+toolchain this repo must also run under pins jax 0.4.x where the same
+function lives at ``jax.experimental.shard_map.shard_map``. One resolver
+here keeps every call site on a single import instead of five scattered
+try/except blocks.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # jax <= 0.4.x: pre-promotion home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    def shard_map(*args, **kwargs):
+        # pre-rename jax calls the replication check `check_rep`
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

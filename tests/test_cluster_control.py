@@ -335,14 +335,19 @@ def test_tick_bucketing_schedules_all_pending():
 
 
 def test_trigger_seed_download_named_vs_roundrobin():
-    """A preheat may name a seed before that daemon has announced: the
-    trigger is queued for later delivery, and the unannounced host must
-    NOT leak into the round-robin seed set used for other tasks."""
+    """A preheat may race the seed daemons' first announce: with no seed
+    announced yet, BOTH the unnamed and the named trigger QUEUE (the
+    unnamed one with an empty host_id — the RPC drain routes it to any
+    seed that connects within the delivery TTL, so the job fails only if
+    no seed ever appears, not if it is merely late). Neither may leak an
+    unannounced host into the round-robin seed set used for other
+    tasks."""
     svc = SchedulerService()
-    # no seeds at all: unnamed trigger is refused, named trigger is queued
-    assert not svc.trigger_seed_download("t-a", "http://o/f")
+    # no seeds at all: both queue — unnamed with host_id="" for late
+    # routing, named with the (not-yet-announced) requested host
+    assert svc.trigger_seed_download("t-a", "http://o/f")
     assert svc.trigger_seed_download("t-b", "http://o/f", host_id="seed-not-yet")
-    assert [t.host_id for t in svc.seed_triggers] == ["seed-not-yet"]
+    assert [t.host_id for t in svc.seed_triggers] == ["", "seed-not-yet"]
     assert svc._seed_hosts == []
 
     # once a real seed announces, round-robin only ever picks it

@@ -268,3 +268,51 @@ def test_partially_undelivered_preheat_expires():
     got = jm.get(result.job_id)
     assert got.state == JobState.EXPIRED
     assert len(got.detail["undelivered_task_ids"]) == 1
+
+
+def test_sync_client_caches_dial_failure_for_one_round(monkeypatch):
+    """A dead scheduler must cost ONE dial timeout per preheat round, not
+    one per task: after a failed dial, SyncSchedulerClient fast-fails
+    without re-dialing until the failure marker expires."""
+    import pytest
+
+    from dragonfly2_tpu.rpc.client import SyncSchedulerClient
+
+    client = SyncSchedulerClient("198.51.100.1", 9, timeout=0.1,
+                                 dial_failure_ttl=30.0)
+    dials = []
+
+    def failing_connect():
+        dials.append(1)
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(client, "_connect", failing_connect)
+    with pytest.raises(ConnectionError):
+        client.call(msg.TaskStatesRequest(task_ids=["t"]))
+    assert len(dials) == 1
+    # the whole rest of the fan-out round fast-fails on the cached marker
+    for _ in range(20):
+        with pytest.raises(ConnectionError, match="fast-failing"):
+            client.call(msg.TaskStatesRequest(task_ids=["t"]))
+    assert len(dials) == 1
+
+    # marker expiry re-dials (simulate the TTL passing)
+    client._dial_failed_at -= 31.0
+    with pytest.raises(ConnectionError):
+        client.call(msg.TaskStatesRequest(task_ids=["t"]))
+    assert len(dials) == 2
+
+    # a SUCCESSFUL dial clears the marker so mid-call errors keep their
+    # existing redial-on-next-call semantics
+    class _Sock:
+        def sendall(self, *a):
+            raise OSError("broken pipe")
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(client, "_connect", lambda: _Sock())
+    client._dial_failed_at -= 31.0
+    with pytest.raises(ConnectionError):
+        client.call(msg.TaskStatesRequest(task_ids=["t"]))
+    assert client._dial_failed_at == 0.0  # mid-call error, not a dial failure

@@ -1,0 +1,147 @@
+"""Push-style piece announcements: the conductor's per-parent /pieces
+long-poll subscription (client/conductor.py _piece_refresher against
+upload.py's wait_after route — the reference's per-parent SyncPieceTasks
+stream, peertask_piecetask_synchronizer.go).
+
+Round 5 wired both halves but the refresher crashed on its first call
+(_fetch_piece_doc took no wait_after/timeout args) and the crash was
+swallowed by gather(return_exceptions=True) — functionally the client
+had only wave polling. These tests pin the repaired path: a child learns
+a piece the parent committed AFTER the child's initial /pieces fetch,
+without a reschedule round-trip."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.conductor import PeerTaskConductor
+from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata
+from dragonfly2_tpu.client.upload import UploadServer
+from dragonfly2_tpu.cluster import messages as msg
+
+PIECE = 8 * 1024
+
+
+def _payload(n_pieces: int) -> bytes:
+    return bytes(i % 251 for i in range(PIECE * n_pieces))
+
+
+class _FakeConn:
+    """Collects conductor->scheduler messages; no responses needed for a
+    directly driven _download_from_parents wave."""
+
+    def __init__(self):
+        self.sent = []
+
+    async def send(self, request):
+        self.sent.append(request)
+
+    def subscribe(self, peer_id):
+        return asyncio.Queue()
+
+    def unsubscribe(self, peer_id):
+        pass
+
+
+@pytest.fixture
+def parent_rig(tmp_path):
+    """A parent daemon's storage + upload server holding pieces 0..1 of a
+    3-piece task that is still in progress."""
+    payload = _payload(3)
+    storage = StorageManager(tmp_path / "parent")
+    ts = storage.register_task(
+        TaskMetadata(task_id="t-push", peer_id="parent-peer",
+                     content_length=len(payload), piece_length=PIECE)
+    )
+    for n in range(2):
+        ts.write_piece(n, n * PIECE, payload[n * PIECE: (n + 1) * PIECE])
+    server = UploadServer(storage, host="127.0.0.1")
+    server.start()
+    yield server, ts, payload
+    server.stop()
+
+
+def _parent_for(server) -> msg.CandidateParent:
+    return msg.CandidateParent(
+        peer_id="parent-peer", host_id="parent-host",
+        ip=server.host, port=server.port, download_port=server.port,
+        state="Running", score=1.0,
+    )
+
+
+def test_long_poll_fetch_piece_doc(parent_rig):
+    """_fetch_piece_doc(wait_after=N) blocks until the parent commits
+    piece N+1 — and a timed-out long-poll on an idle parent answers with
+    the unchanged listing, not None (None would fail the parent)."""
+    server, ts, payload = parent_rig
+    conductor = PeerTaskConductor(
+        conn=_FakeConn(), storage=None, host=None,
+        peer_id="child", task_id="t-push", url="http://unused/",
+        piece_length=PIECE,
+    )
+    parent = _parent_for(server)
+
+    # idle parent: the long-poll times out and reads as "no new pieces"
+    t0 = time.perf_counter()
+    doc = conductor._fetch_piece_doc(parent, wait_after=2, timeout=0.3)
+    assert doc is not None and len(doc["pieces"]) == 2
+    assert time.perf_counter() - t0 >= 0.25
+
+    # piece 2 commits while a long-poll is parked: it returns early with
+    # the new piece in the listing
+    def commit():
+        time.sleep(0.2)
+        ts.write_piece(2, 2 * PIECE, payload[2 * PIECE:])
+        ts.mark_done(len(payload), 3)
+
+    threading.Thread(target=commit, daemon=True).start()
+    doc = conductor._fetch_piece_doc(parent, wait_after=2, timeout=5.0)
+    assert doc is not None
+    assert {p["number"] for p in doc["pieces"]} == {0, 1, 2}
+    assert doc["done"]
+
+
+def test_child_learns_piece_committed_after_initial_fetch(tmp_path, parent_rig):
+    """Full wave through _download_from_parents: the child's initial
+    /pieces sync sees pieces {0,1}; the parent commits piece 2 afterwards;
+    the piece-refresher subscription must deliver it to the dispatcher and
+    the wave must complete WITHOUT a reschedule (the parents-exhausted
+    path would show up as a RescheduleRequest on the conn)."""
+    server, parent_ts, payload = parent_rig
+    child_storage = StorageManager(tmp_path / "child")
+    conn = _FakeConn()
+    conductor = PeerTaskConductor(
+        conn=conn, storage=child_storage,
+        host=msg.HostInfo(host_id="child-host", hostname="c", ip="127.0.0.1"),
+        peer_id="child", task_id="t-push", url="http://unused/",
+        piece_length=PIECE, workers=2,
+    )
+    child_ts = child_storage.register_task(
+        TaskMetadata(task_id="t-push", peer_id="child",
+                     content_length=len(payload), piece_length=PIECE,
+                     total_pieces=3)
+    )
+
+    def commit():
+        time.sleep(0.4)  # well after the initial sync
+        parent_ts.write_piece(2, 2 * PIECE, payload[2 * PIECE:])
+        parent_ts.mark_done(len(payload), 3)
+
+    threading.Thread(target=commit, daemon=True).start()
+
+    async def run():
+        return await asyncio.wait_for(
+            conductor._download_from_parents(child_ts, [_parent_for(server)]),
+            timeout=30.0,
+        )
+
+    assert asyncio.run(run()) is True
+    assert child_ts.meta.done
+    assert sorted(child_ts.meta.pieces) == [0, 1, 2]
+    with open(child_ts.data_path, "rb") as f:
+        assert f.read() == payload
+    finished = [m for m in conn.sent if isinstance(m, msg.DownloadPieceFinishedRequest)]
+    assert {m.piece_number for m in finished} == {0, 1, 2}
+    assert not any(isinstance(m, msg.RescheduleRequest) for m in conn.sent)

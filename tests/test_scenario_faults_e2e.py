@@ -1,0 +1,158 @@
+"""Scenario fault injection through the REAL client retry path.
+
+A flaky parent (scenarios/engine.FaultInjector attached to its daemon's
+upload server) answers piece fetches with injected 503s; the child's
+conductor must take its genuine error path — piece fetch raises, the
+parent is failed, DownloadPieceFailedRequest reaches the scheduler, the
+scheduler blocklists the parent on reschedule, and the child eventually
+escalates to back-to-source — ending with correct bytes. This is the
+acceptance gate that injected faults are NOT a simulator-only shortcut.
+"""
+
+import asyncio
+import hashlib
+import http.server
+import threading
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.cluster.probes import ProbeStore
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.records.storage import TraceStorage
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+from dragonfly2_tpu.scenarios import FaultInjector, ScenarioSpec
+from dragonfly2_tpu.scenarios.spec import FlakySpec
+
+
+class _Origin:
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.get_count = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(outer.payload)))
+                self.end_headers()
+
+            def do_GET(self):
+                outer.get_count += 1
+                data = outer.payload
+                range_header = self.headers.get("Range")
+                status = 200
+                if range_header and range_header.startswith("bytes="):
+                    spec = range_header[len("bytes="):].split("-")
+                    start = int(spec[0]) if spec[0] else 0
+                    end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
+                    data = data[start:end + 1]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/blob.bin"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def origin():
+    server = _Origin(bytes(i % 256 for i in range(200_000)))
+    yield server
+    server.stop()
+
+
+def test_flaky_parent_drives_real_retry_path(tmp_path, origin):
+    """Every piece fetch from the flaky parent 503s (piece_error_rate=1):
+    the child reports the piece failure, the scheduler counts it against
+    the parent host and blocklists it, and the child recovers via
+    back-to-source — injected faults exercised end to end."""
+    spec = ScenarioSpec(
+        name="flaky-e2e",
+        flaky=FlakySpec(parent_fraction=1.0, piece_error_rate=1.0),
+    )
+    injector = FaultInjector(spec, seed=7)
+
+    async def run():
+        cfg = Config()
+        cfg.scheduler.max_hosts = 64
+        cfg.scheduler.max_tasks = 64
+        service = SchedulerService(
+            config=cfg,
+            storage=TraceStorage(tmp_path / "traces"),
+            probes=ProbeStore(max_pairs=1024, max_hosts=64),
+        )
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        daemons = []
+        try:
+            # parent: back-sources the blob, then serves pieces FLAKILY
+            d1 = Daemon(tmp_path / "d1", [(host, port)], hostname="host-1",
+                        fault_injector=injector)
+            await d1.start()
+            daemons.append(d1)
+            ts1 = await d1.download(origin.url(), piece_length=32 * 1024)
+            assert ts1.meta.done
+            gets_after_seed = origin.get_count
+
+            # child: scheduled onto the flaky parent; every piece fetch
+            # 503s, so it must recover THROUGH the retry path
+            d2 = Daemon(tmp_path / "d2", [(host, port)], hostname="host-2")
+            await d2.start()
+            daemons.append(d2)
+            ts2 = await d2.download(origin.url(), piece_length=32 * 1024)
+
+            sha = hashlib.sha256(origin.payload).hexdigest()
+            with open(ts2.data_path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == sha
+
+            # the faults really fired at the parent...
+            assert injector.injected["error"] >= 1
+            # ...the child reported them on the announce stream
+            # (DownloadPieceFailed -> host upload-failure accounting)...
+            parent_host_idx = service.state.host_index(d1.host_id)
+            assert parent_host_idx is not None
+            assert int(service.state.host_upload_failed[parent_host_idx]) >= 1
+            # ...and recovery went back to source (origin saw new GETs)
+            assert origin.get_count > gets_after_seed
+        finally:
+            for d in daemons:
+                await d.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fault_injector_is_deterministic_and_retry_aware():
+    """Same (spec, seed) -> identical fault verdict sequence; a piece's
+    verdict is keyed on its serve ATTEMPT, so a deterministic schedule can
+    still let retries succeed."""
+    spec = ScenarioSpec(
+        flaky=FlakySpec(parent_fraction=1.0, piece_error_rate=0.5,
+                        piece_stall_rate=0.2, stall_seconds=0.01),
+    )
+    a, b = FaultInjector(spec, seed=3), FaultInjector(spec, seed=3)
+    seq_a = [a.piece_fault("task-x", n % 4) for n in range(40)]
+    seq_b = [b.piece_fault("task-x", n % 4) for n in range(40)]
+    assert seq_a == seq_b
+    assert any(v == "error" for v in seq_a)
+    assert a.injected == b.injected
+    # a different seed gives a different schedule
+    c = FaultInjector(spec, seed=4)
+    assert [c.piece_fault("task-x", n % 4) for n in range(40)] != seq_a

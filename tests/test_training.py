@@ -197,3 +197,40 @@ def test_trainer_service_checkpoint_lifecycle(tmp_path):
     outcome2 = svc.train_finish("h1")
     assert outcome2.gnn is not None and outcome2.gnn_result.steps > 0
     assert outcome2.gnn.version == outcome.gnn.version + 1
+
+
+def test_gnn_roofline_bound_structure():
+    """The MFU bound analysis the bench artifact publishes (gnn_bound):
+    internally consistent roofline — the thin-feature layer-0 adjacency
+    matmul and the embedding gathers are memory-bound, the ceiling is a
+    real bound (< 100%), and a pure-bandwidth stage can never be labeled
+    compute-bound."""
+    from dragonfly2_tpu.training.train import gnn_roofline_bound
+
+    b = gnn_roofline_bound(
+        n_nodes=10_000, node_feat_dim=12, edge_feat_dim=2,
+        hidden=256, batch=4096, parents=20, pair_feat_dim=2,
+    )
+    assert 0 < b["mfu_ceiling_pct"] < 100
+    assert abs(b["ridge_flops_per_byte"] - 197e12 / 819e9) < 1
+    by_name = {s["stage"]: s for s in b["stages"]}
+    # AI = 2*F flops per adjacency byte with F=12 -> deeply memory-bound
+    assert by_name["sage_0.adj_matmul"]["bound"] == "memory"
+    assert by_name["sage_0.adj_matmul"]["ai_flops_per_byte"] < 30
+    assert by_name["emb_gather"]["bound"] == "memory"
+    assert by_name["emb_gather"]["gflops"] == 0.0
+    # every stage's time bound respects its own flops and bytes
+    for s in b["stages"]:
+        t_flops = s["gflops"] * 1e9 / 197e12 * 1e6
+        t_bytes = s["mbytes"] * 1e6 / 819e9 * 1e6
+        assert s["time_us_lb"] >= max(t_flops, t_bytes) - 0.1
+    # the segment-sum (serving) path is pure bandwidth: zero-flop stages
+    seg = gnn_roofline_bound(
+        n_nodes=10_000, node_feat_dim=12, edge_feat_dim=2,
+        hidden=256, batch=4096, parents=20, pair_feat_dim=2, dense_adj=False,
+    )
+    seg_stages = {s["stage"]: s for s in seg["stages"]}
+    assert seg_stages["sage_0.segment_sum"]["gflops"] == 0.0
+    assert seg_stages["sage_0.segment_sum"]["bound"] == "memory"
+    assert seg["mfu_ceiling_pct"] < 100
+    assert "statement" in b and "memory-bound" in b["statement"]
