@@ -95,6 +95,12 @@ class PeerTaskConductor:
             # blocking HEAD off-loop: a blackholed origin must not freeze
             # every other conductor/proxy on this daemon
             content_length = await asyncio.to_thread(self._probe_content_length)
+            # Mid-task re-announce: pieces already on disk (a previous
+            # attempt before scheduler failover/restart) ride the register
+            # so the scheduler ADOPTS the partial download — it resumes
+            # piece state instead of treating this as a brand-new peer
+            # (cluster/scheduler.py register_peer adoption).
+            kept = sorted(ts.finished_pieces())
             await self.conn.send(
                 msg.RegisterPeerRequest(
                     peer_id=self.peer_id,
@@ -103,6 +109,8 @@ class PeerTaskConductor:
                     url=self.url,
                     content_length=content_length,
                     piece_length=self.piece_length,
+                    total_piece_count=max(ts.meta.total_pieces, 0),
+                    finished_pieces=kept or None,
                 )
             )
             if self.shaper is not None:

@@ -21,8 +21,10 @@ from dragonfly2_tpu.client.dispatcher import TrafficShaper
 from dragonfly2_tpu.client.storage import StorageManager, TaskStorage
 from dragonfly2_tpu.client.upload import UploadServer
 from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.rpc import resilience
 from dragonfly2_tpu.rpc.client import SchedulerClientPool
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.flight import PhaseRecorder
 from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import daemon_series, register_version
 from dragonfly2_tpu.utils import dferrors, hoststat, idgen
@@ -116,6 +118,14 @@ class Daemon:
         self.manager_address = manager_address
         self.dynconfig_interval = dynconfig_interval
         self.dynconfig = None
+        # Failover flight recorder (telemetry/flight.py): one committed
+        # entry per scheduler-failover recovery with the phase split
+        # {backoff, redial, reannounce} in ms — time-to-recover is their
+        # sum, served through the same /debug/flight + wire dump as the
+        # scheduler's tick phases. Registered under a stable name so the
+        # chaos harness reads recovery time from flight data, not from
+        # stopwatches around the test.
+        self.failover_recorder = PhaseRecorder(maxlen=256, name="dfdaemon.failover")
         self._dynconfig_task: asyncio.Task | None = None
         self._probe_task: asyncio.Task | None = None
         self._seed_tasks: list[asyncio.Task] = []
@@ -282,13 +292,44 @@ class Daemon:
             piece_length=piece_length,
         ) as span:
             last_err: BaseException | None = None
-            for attempt in range(2):
+            # One attempt per distinct ring node plus one retry of the
+            # (possibly rebinding) primary: each attempt's for_task already
+            # fails over across breaker-open/dial-dead candidates, so this
+            # outer loop only restarts after MID-STREAM death — the
+            # announce stream died while a download was in flight. Sized
+            # by the RING (the configured scheduler set), not by how many
+            # connections happen to be open right now.
+            attempts = min(self.pool.size() + 1, 4)
+            for attempt in range(max(attempts, 2)):
+                recovering = attempt > 0
                 try:
+                    # Recovery phases are measured locally and committed in
+                    # one call: a scheduler crash severs EVERY stream at
+                    # once, so many downloads recover concurrently and a
+                    # shared begin/mark cursor would clobber itself
+                    # (PhaseRecorder.commit_phases).
+                    phases: dict[str, float] = {}
+                    t0 = time.perf_counter()
+                    if recovering:
+                        # scheduler failover recovery, phase-timed into the
+                        # flight recorder: backoff -> redial (ring failover
+                        # inside for_task) -> reannounce (fresh scheduler
+                        # state). The conductor then resumes its kept
+                        # pieces via the finished_pieces re-announce.
+                        await asyncio.sleep(0.5)  # let a restarting scheduler rebind
+                        phases["backoff"] = (time.perf_counter() - t0) * 1e3
+                        t0 = time.perf_counter()
                     # dial + announce INSIDE the retried region: during a
                     # scheduler restart the redial itself is what fails
                     # (ConnectionRefusedError while the port rebinds)
                     conn = await self.pool.for_task(task_id)
+                    if recovering:
+                        phases["redial"] = (time.perf_counter() - t0) * 1e3
+                        t0 = time.perf_counter()
                     await self._ensure_announced(conn)
+                    if recovering:
+                        phases["reannounce"] = (time.perf_counter() - t0) * 1e3
+                        span.attributes["failover_target"] = f"{conn.host}:{conn.port}"
                     conductor = PeerTaskConductor(
                         conn=conn,
                         storage=self.storage,
@@ -310,15 +351,23 @@ class Daemon:
                     asyncio.TimeoutError,  # bounded pool dial
                     dferrors.Unavailable,
                 ) as e:
-                    # the announce stream died mid-task (scheduler restart
-                    # or network cut): the pool evicts the dead connection
-                    # on the next for_task, so retry ONCE as a fresh peer —
-                    # already-written pieces resume from the task storage
-                    # (the reference rides gRPC channel reconnect here)
+                    # the announce stream died mid-task (scheduler crash,
+                    # restart, network cut): the pool evicts the dead
+                    # connection and the next for_task fails over along
+                    # the hashring — already-written pieces resume from
+                    # the task storage and ride the re-announce
                     last_err = e
                     span.attributes["retried"] = True
-                    await asyncio.sleep(0.5)  # let the scheduler rebind
                     continue
+                if recovering:
+                    # committed only HERE, after the recovered attempt
+                    # actually finished: a flapping scheduler that dies
+                    # again mid-stream must not count as a recovery, and
+                    # a download that ultimately fails must leave no
+                    # time-to-recover entry (the chaos harness reads
+                    # these as successes)
+                    self.failover_recorder.commit_phases(phases)
+                    self.metrics.scheduler_failover.labels().inc()
                 span.attributes["pieces"] = len(ts.meta.pieces)
                 return ts
             assert last_err is not None
@@ -362,20 +411,66 @@ class Daemon:
                     logger.info("seed loop for %s:%d ending: scheduler "
                                 "left the active set", host, port)
                     return
-                except (OSError, asyncio.TimeoutError):
-                    await asyncio.sleep(2.0)  # scheduler still down
+                except (OSError, asyncio.TimeoutError, resilience.BreakerOpen):
+                    # down or breaker-open: the sleep is the retry cadence,
+                    # the breaker keeps each failed probe cheap
+                    await asyncio.sleep(2.0)
                     continue
             try:
                 trigger = await asyncio.wait_for(conn.seed_triggers.get(), timeout=2.0)
             except asyncio.TimeoutError:
                 continue  # periodic liveness recheck
-            task = asyncio.create_task(self._obtain_seed(trigger))
+            task = asyncio.create_task(self._obtain_seed(trigger, conn))
             self._seed_downloads.add(task)
             task.add_done_callback(self._seed_downloads.discard)
 
-    async def _obtain_seed(self, trigger) -> None:
+    async def _announce_completed(self, conn, ts: TaskStorage, trigger) -> None:
+        """Re-announce a COMPLETED task to the scheduler that asked for it
+        (failover path: a scheduler that just inherited a task's peers has
+        never heard of this seed's copy). The register carries every
+        finished piece, so the scheduler adopts the seed as a Succeeded
+        parent without a byte moving — the cluster regains a parent at
+        announce cost instead of a second origin fetch."""
+        await conn.send(msg.RegisterPeerRequest(
+            peer_id=idgen.peer_id_v2(),
+            task_id=ts.meta.task_id,
+            host=self.host_info(),
+            url=trigger.url,
+            content_length=max(ts.meta.content_length, 0),
+            piece_length=ts.meta.piece_length,
+            total_piece_count=max(ts.meta.total_pieces, 0),
+            priority=1,  # a seed must not re-trigger a seed
+            tag=trigger.tag,
+            application=trigger.application,
+            finished_pieces=sorted(ts.finished_pieces()),
+        ))
+        self.metrics.seed_task_reannounce.labels().inc()
+
+    async def _obtain_seed(self, trigger, conn=None) -> None:
+        held = self.storage.find_completed_task(trigger.task_id)
+        if held is not None and conn is not None and not conn.is_closed:
+            # already on disk: the triggering scheduler only lacks the
+            # ANNOUNCEMENT (it restarted, or the task failed over to it) —
+            # re-announce instead of re-downloading
+            try:
+                await self._announce_completed(conn, held, trigger)
+                return
+            except (OSError, ConnectionError):
+                # the conn died between the is_closed check and the send;
+                # a dropped announce leaves the scheduler's waiting peers
+                # parentless (the first-peer trigger guard won't re-fire),
+                # so retry ONCE over a fresh connection before giving up
+                try:
+                    fresh = await self.pool.for_address(conn.host, conn.port)
+                    await self._ensure_announced(fresh)
+                    await self._announce_completed(fresh, held, trigger)
+                except (LookupError, OSError, ConnectionError,
+                        asyncio.TimeoutError, dferrors.Unavailable):
+                    logger.warning("completed-task re-announce for %s failed",
+                                   trigger.task_id)
+                return
         self.metrics.seed_peer_download.labels().inc()
-        already_held = self.storage.find_completed_task(trigger.task_id) is not None
+        already_held = held is not None
         try:
             # the trigger's task id is authoritative: the requesting peer
             # may have derived it with filtered query params the raw URL
@@ -394,6 +489,20 @@ class Daemon:
                 self.metrics.seed_peer_download_traffic.labels("back_to_source").inc(
                     max(ts.meta.content_length, 0)
                 )
+            # The download's conductor registered on the task's hashring
+            # pick, which need not be the scheduler that sent THIS trigger
+            # (failover skew). Make sure the triggering scheduler learns
+            # this seed holds the task, or its waiting peers starve.
+            if (
+                conn is not None and not conn.is_closed
+                and self.pool.primary_for_task(trigger.task_id)
+                != f"{conn.host}:{conn.port}"
+            ):
+                try:
+                    await self._announce_completed(conn, ts, trigger)
+                except (OSError, ConnectionError):
+                    logger.warning("post-seed re-announce for %s failed",
+                                   trigger.task_id)
             logger.info("seeded task %s from %s", trigger.task_id, trigger.url)
         except Exception:  # noqa: BLE001 - a failed seed must not kill the loop
             self.metrics.seed_peer_download_failure.labels().inc()
@@ -411,6 +520,7 @@ class Daemon:
         import dataclasses
 
         from dragonfly2_tpu.manager.rpc import GetSchedulersRequest, ManagerClient
+        from dragonfly2_tpu.utils import retry
 
         host, port = self.manager_address
 
@@ -427,7 +537,14 @@ class Daemon:
             finally:
                 await client.close()
 
-        return asyncio.run(go())
+        # jittered retry absorbs one transient manager blip per refresh
+        # instead of skipping a whole dynconfig interval; non-retryable
+        # DFErrors (Unauthenticated — a bad cert won't heal on retry)
+        # abort straight to the Dynconfig disk-cache fallback
+        return retry.run(
+            lambda: asyncio.run(go()),
+            init_backoff=0.2, max_backoff=1.0, max_attempts=2,
+        )
 
     def _apply_scheduler_list(self, data: dict) -> None:
         """Dynconfig observer: feed the ACTIVE schedulers into the pool's
@@ -462,20 +579,28 @@ class Daemon:
             except Exception:  # noqa: BLE001 - probe failures never kill the daemon
                 logger.exception("probe cycle failed")
 
+    # One probe round's whole budget: dial + ProbeStarted + N TCP RTT
+    # measurements + the finished report. The scope makes every frame
+    # carry its remaining budget, so a scheduler digging a stale
+    # ProbeStarted out of a backlog sheds it (rpc/server.py) instead of
+    # computing probe targets nobody is waiting for.
+    PROBE_ROUND_BUDGET_S = 30.0
+
     async def sync_probes_once(self, count: int = 10) -> int:
-        conn = await self.pool.for_task(self.host_id)
-        await self._ensure_announced(conn)
-        targets = await conn.sync_probes(self.host_id, count=count)
-        if not targets:
-            return 0
-        results = []
-        for target in targets:
-            rtt = await asyncio.to_thread(self._tcp_rtt_ns, target.ip, target.port)
-            results.append(
-                msg.ProbeResult(host_id=target.host_id, rtt_ns=rtt or 0, ok=rtt is not None)
-            )
-        await conn.send(msg.ProbeFinishedRequest(host_id=self.host_id, results=results))
-        return len(results)
+        with resilience.deadline(self.PROBE_ROUND_BUDGET_S):
+            conn = await self.pool.for_task(self.host_id)
+            await self._ensure_announced(conn)
+            targets = await conn.sync_probes(self.host_id, count=count)
+            if not targets:
+                return 0
+            results = []
+            for target in targets:
+                rtt = await asyncio.to_thread(self._tcp_rtt_ns, target.ip, target.port)
+                results.append(
+                    msg.ProbeResult(host_id=target.host_id, rtt_ns=rtt or 0, ok=rtt is not None)
+                )
+            await conn.send(msg.ProbeFinishedRequest(host_id=self.host_id, results=results))
+            return len(results)
 
     @staticmethod
     def _tcp_rtt_ns(ip: str, port: int, timeout: float = 1.0) -> int | None:

@@ -22,8 +22,9 @@ import uuid
 from dragonfly2_tpu.cluster import image_preheat
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.rpc import resilience
+from dragonfly2_tpu.utils import dferrors, idgen
 from dragonfly2_tpu.utils.hashring import HashRing
-from dragonfly2_tpu.utils import idgen
 
 
 class JobState(str, enum.Enum):
@@ -83,6 +84,13 @@ class RemoteScheduler:
     job.go:53-87). Degrades per-call: an unreachable scheduler fails THIS
     trigger/poll, not the manager."""
 
+    # Every job-edge op runs under a deadline scope (rpc/resilience.py):
+    # the frame carries the remaining budget, so a scheduler that digs a
+    # stale trigger/poll out of a backlog SHEDS it instead of doing work
+    # the manager's REST thread stopped waiting for. One budget covers
+    # dial + call.
+    OP_BUDGET_S = 10.0
+
     def __init__(self, host: str, port: int, ssl_context=None):
         from dragonfly2_tpu.rpc.client import SyncSchedulerClient
 
@@ -93,12 +101,13 @@ class RemoteScheduler:
                               tag="", application="", host_id="",
                               headers=None) -> bool:
         try:
-            resp = self._client.call(msg.JobTriggerSeedRequest(
-                task_id=task_id, url=url, piece_length=piece_length,
-                tag=tag, application=application, host_id=host_id,
-                headers=headers or {},
-            ))
-        except ConnectionError:
+            with resilience.deadline(self.OP_BUDGET_S):
+                resp = self._client.call(msg.JobTriggerSeedRequest(
+                    task_id=task_id, url=url, piece_length=piece_length,
+                    tag=tag, application=application, host_id=host_id,
+                    headers=headers or {},
+                ))
+        except (ConnectionError, dferrors.DeadlineExceeded):
             return False
         return isinstance(resp, msg.JobTriggerSeedResponse) and resp.ok
 
@@ -107,7 +116,8 @@ class RemoteScheduler:
         answer. Transport failure RAISES ConnectionError instead: mapping
         it to None would read as 'scheduler forgot the task' and flip a
         healthy in-flight job to EXPIRED during a restart window."""
-        resp = self._client.call(msg.TaskStatesRequest(task_ids=task_ids))
+        with resilience.deadline(self.OP_BUDGET_S):
+            resp = self._client.call(msg.TaskStatesRequest(task_ids=task_ids))
         if not isinstance(resp, msg.TaskStatesResponse):
             raise ConnectionError(f"bad TaskStates reply from {self.address}")
         return [None if s < 0 else s for s in resp.states]
@@ -117,7 +127,8 @@ class RemoteScheduler:
         Raises ConnectionError when the scheduler is unreachable so
         callers can surface the failure instead of reporting a healthy
         empty scheduler."""
-        resp = self._client.call(msg.SchedulerInfoRequest())
+        with resilience.deadline(self.OP_BUDGET_S):
+            resp = self._client.call(msg.SchedulerInfoRequest())
         if not isinstance(resp, msg.SchedulerInfoResponse):
             raise ConnectionError(f"bad SchedulerInfo reply from {self.address}")
         return resp.counts, resp.hosts
@@ -133,7 +144,8 @@ class RemoteScheduler:
         breakdowns + jit compile counters + open spans). Raises
         ConnectionError when unreachable so the manager surfaces the
         failure instead of an empty-but-healthy-looking dump."""
-        resp = self._client.call(msg.FlightRecorderRequest(last_n=last_n))
+        with resilience.deadline(self.OP_BUDGET_S):
+            resp = self._client.call(msg.FlightRecorderRequest(last_n=last_n))
         if not isinstance(resp, msg.FlightRecorderResponse):
             raise ConnectionError(f"bad FlightRecorder reply from {self.address}")
         return resp.dump

@@ -73,6 +73,14 @@ class RegisterPeerRequest:
     priority: int = 0
     tag: str = ""
     application: str = ""
+    # Mid-task re-announce (failure-domain failover): pieces this peer
+    # ALREADY holds on disk. A daemon that failed over to another
+    # scheduler — or re-dialed a restarted one — announces its kept
+    # progress so the scheduler adopts the partial download instead of
+    # treating it as a brand-new peer; a seed answering a trigger for a
+    # task it has fully cached announces all pieces, becoming a parent
+    # without moving a byte.
+    finished_pieces: list[int] | None = None
 
 
 @dataclasses.dataclass

@@ -291,8 +291,13 @@ class SchedulerService:
 
         # Re-register of a known peer is load-not-create (service_v2
         # handleResource): keep its FSM/DAG state, just leave it queued.
+        # A mid-task re-announce may carry pieces the peer fetched while
+        # this scheduler wasn't listening (failover round-trip) — adopt
+        # them instead of scheduling them again.
         if self.state.peer_index(req.peer_id) is not None:
             idx = self.state.peer_index(req.peer_id)
+            if req.finished_pieces:
+                self.state.adopt_pieces(idx, req.finished_pieces)
             if self.state.peer_state[idx] == int(PeerState.RUNNING):
                 self._pending.setdefault(
                     req.peer_id, _Pending(peer_id=req.peer_id, blocklist=set())
@@ -349,6 +354,26 @@ class SchedulerService:
         else:
             self.state.peer_event(peer_idx, PeerEvent.REGISTER_NORMAL)
         self.state.peer_event(peer_idx, PeerEvent.DOWNLOAD)
+        # Mid-task re-announce adoption (failure-domain failover): the
+        # peer's kept progress becomes scheduler state — it will only be
+        # scheduled for the pieces it misses, and its held pieces make it
+        # a servable parent immediately. A fire-and-forget announce
+        # (priority 1: a seed answering a trigger for a task it has
+        # cached, daemon _announce_completed) holding EVERY piece goes
+        # straight to Succeeded — it is a parent, not a download, and
+        # nobody is waiting for a response. A priority-0 register stays
+        # queued even when complete: its conductor blocks on the response
+        # stream, so silence here would strand it for schedule_timeout.
+        if req.finished_pieces:
+            self.state.adopt_pieces(peer_idx, req.finished_pieces)
+            total = self.state.task_total_pieces[task_idx]
+            if (
+                req.priority == 1
+                and total > 0
+                and self.state.peer_finished_count[peer_idx] >= total
+            ):
+                self.state.peer_event(peer_idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+                return None  # nothing to schedule; it serves, not fetches
         self._pending[req.peer_id] = _Pending(peer_id=req.peer_id, blocklist=set())
         return None  # response arrives from tick()
 

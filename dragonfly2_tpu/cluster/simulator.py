@@ -39,6 +39,13 @@ class SimStats:
     injected_stalls: int = 0
     injected_crashes: int = 0
     injected_host_leaves: int = 0
+    # control-plane chaos (scenarios/spec ControlPlaneSpec): scheduler
+    # crashes that wiped state and forced every in-flight peer through the
+    # re-announce/adoption path, peers recovered that way, and scheduling
+    # responses lost to a silent host<->scheduler partition
+    injected_scheduler_crashes: int = 0
+    crash_reannounced_peers: int = 0
+    injected_partition_drops: int = 0
     retry_waves: int = 0
     # seed daemons fetching origin on a TriggerSeedRequest (ObtainSeeds) —
     # origin traffic by design, not a P2P miss
@@ -88,6 +95,10 @@ class ClusterSimulator:
         self._probe_seq = 0
         self._reg_index = 0
         self._offline: set[str] = set()
+        self._partitioned: set[str] = set()
+        # peers whose scheduling response was lost to a partition: they
+        # re-announce (register is load-not-create) once their host heals
+        self._partition_stalled: set[str] = set()
         self._peer_reg: dict[str, int] = {}
         self._peer_have: dict[str, set[int]] = {}
         self._peer_waves: dict[str, int] = {}
@@ -127,8 +138,9 @@ class ClusterSimulator:
 
     def start_download(self, host=None, task=None) -> str:
         if host is None:
-            if self._offline:
-                online = [h for h in self.cluster.hosts if h.id not in self._offline]
+            unavailable = self._offline | self._partitioned
+            if unavailable:
+                online = [h for h in self.cluster.hosts if h.id not in unavailable]
                 host = self.rng.choice(online or self.cluster.hosts)
             else:
                 host = self.rng.choice(self.cluster.hosts)
@@ -166,13 +178,95 @@ class ClusterSimulator:
         self._round += 1
         if self.engine is not None:
             self._apply_host_churn()
+            if self.engine.scheduler_crashed(self._round):
+                self._apply_scheduler_crash()
+            self._apply_partitions()
         for _ in range(new_downloads):
             self.start_download()
         self.consume_seed_triggers()
         responses = self.scheduler.tick()
         for resp in responses:
+            peer_id = getattr(resp, "peer_id", "")
+            if self._peer_host.get(peer_id) in self._partitioned:
+                # silent partition: the response never reaches the daemon —
+                # the peer stalls until the partition heals and it
+                # re-announces (no LeaveHost, no error, just loss)
+                self.stats.injected_partition_drops += 1
+                self._partition_stalled.add(peer_id)
+                continue
             self._act(resp)
         return responses
+
+    def _apply_scheduler_crash(self) -> None:
+        """Scheduler crash: in-memory scheduler state is wiped and every
+        announce stream dies at once. Every incomplete peer then does what
+        a real daemon does after failover/restart — re-announces with the
+        pieces it kept, and the scheduler ADOPTS the partial download
+        (register_peer finished_pieces) instead of starting it over."""
+        self.stats.injected_scheduler_crashes += 1
+        svc = self.scheduler
+        # Every in-flight peer loses its scheduler state: the pending
+        # queue AND peers whose response was lost to a partition (their
+        # registration is wiped too — they re-register with kept pieces
+        # when their partition heals, via the same adoption path).
+        victims = [
+            pid for pid in list(svc._pending)
+            if pid in self._task_of
+        ]
+        for pid in list(self._partition_stalled):
+            if pid in self._task_of and pid not in svc._pending:
+                svc.leave_peer(pid)
+        for pid in victims:
+            svc.leave_peer(pid)
+        for pid in victims:
+            task = self._task_of[pid]
+            host_id = self._peer_host.get(pid)
+            info = self._host_info.get(host_id)
+            if info is None:
+                continue
+            svc.register_peer(msg.RegisterPeerRequest(
+                peer_id=pid,
+                task_id=task["task_id"],
+                host=info,
+                url=task["url"],
+                content_length=task["content_length"],
+                piece_length=self.piece_length,
+                total_piece_count=task["pieces"],
+                tag="sim",
+                application="simulator",
+                finished_pieces=sorted(self._peer_have.get(pid, ())) or None,
+            ))
+            self.stats.crash_reannounced_peers += 1
+
+    def _apply_partitions(self) -> None:
+        """Epoch re-roll of silently partitioned hosts; healed peers whose
+        scheduling response was lost re-announce and re-enter the queue."""
+        partitioned_now = self.engine.partitioned_hosts(self._round)
+        healed = self._partitioned - partitioned_now
+        self._partitioned = partitioned_now
+        if not healed:
+            return
+        for pid in list(self._partition_stalled):
+            host_id = self._peer_host.get(pid)
+            if host_id not in healed:
+                continue
+            self._partition_stalled.discard(pid)
+            task = self._task_of.get(pid)
+            info = self._host_info.get(host_id)
+            if task is None or info is None:
+                continue
+            self.scheduler.register_peer(msg.RegisterPeerRequest(
+                peer_id=pid,
+                task_id=task["task_id"],
+                host=info,
+                url=task["url"],
+                content_length=task["content_length"],
+                piece_length=self.piece_length,
+                total_piece_count=task["pieces"],
+                tag="sim",
+                application="simulator",
+                finished_pieces=sorted(self._peer_have.get(pid, ())) or None,
+            ))
 
     def consume_seed_triggers(self) -> int:
         """Act as the seed daemons: drain the TriggerSeedRequests the

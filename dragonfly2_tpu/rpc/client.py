@@ -13,11 +13,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-import time
 
 from dragonfly2_tpu.cluster import messages as msg
-from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc import mux, resilience, wire
 from dragonfly2_tpu.telemetry.tracing import default_tracer
+from dragonfly2_tpu.utils import dferrors
 from dragonfly2_tpu.utils.hashring import HashRing
 
 wire.register_module(msg)
@@ -25,19 +25,47 @@ wire.register_module(msg)
 logger = logging.getLogger(__name__)
 
 
+async def _bounded_wait(awaitable, timeout: float | None, what: str, metrics=None):
+    """await with the caller's timeout bounded by the ambient deadline
+    budget (rpc/resilience.py). A timeout that was BUDGET-bound surfaces
+    as DeadlineExceeded (and counts in the deadline family), a plain
+    per-call timeout stays asyncio.TimeoutError — callers distinguish
+    'the budget ran out' from 'this one call was slow'."""
+    effective = resilience.bound_timeout(timeout)
+    if effective is not None and effective <= 0:
+        if metrics is not None:
+            metrics.deadline_exceeded.labels().inc()
+        raise dferrors.DeadlineExceeded(f"{what}: deadline budget exhausted")
+    try:
+        return await asyncio.wait_for(awaitable, effective)
+    except asyncio.TimeoutError:
+        if effective is not None and (timeout is None or effective < timeout):
+            if metrics is not None:
+                metrics.deadline_exceeded.labels().inc()
+            raise dferrors.DeadlineExceeded(
+                f"{what}: deadline budget exhausted after {effective:.3f}s"
+            ) from None
+        raise
+
+
 class SchedulerConnection:
     """One long-lived announce stream to a scheduler (AnnouncePeer
     semantics: requests flow up, scheduling responses flow back async)."""
 
-    def __init__(self, host: str, port: int, ssl_context=None):
+    def __init__(self, host: str, port: int, ssl_context=None,
+                 resilience_metrics=None):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context  # ssl.SSLContext for mTLS, None = plaintext
+        # resilience_series namespace for the deadline_exceeded counter
+        # (the pool passes its board's; a bare connection counts nothing)
+        self._res_metrics = resilience_metrics
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._responses: dict[str, asyncio.Queue] = {}
         self._stats: asyncio.Queue = asyncio.Queue()
         self._probe_targets: asyncio.Queue = asyncio.Queue()
+        self._health: asyncio.Queue = asyncio.Queue()
         self.seed_triggers: asyncio.Queue = asyncio.Queue()
         self._reader_task: asyncio.Task | None = None
         self._send_lock = asyncio.Lock()
@@ -116,6 +144,8 @@ class SchedulerConnection:
                 return
             if isinstance(response, msg.StatResponse):
                 self._stats.put_nowait(response)
+            elif isinstance(response, mux.HealthCheckResponse):
+                self._health.put_nowait(response)
             elif isinstance(response, msg.ProbeTargetsResponse):
                 self._probe_targets.put_nowait(response)
             elif isinstance(response, msg.TriggerSeedRequest):
@@ -141,28 +171,56 @@ class SchedulerConnection:
         self._responses.pop(peer_id, None)
 
     # ---------------------------------------------------- request/response
+    # Per-call deadline enforcement: the caller's own timeout is bounded by
+    # the ambient deadline budget (rpc/resilience.py), and the request
+    # frame carries the remaining budget for the server's shed check.
+
+    def _check(self, what: str) -> None:
+        try:
+            resilience.check(what)
+        except dferrors.DeadlineExceeded:
+            if self._res_metrics is not None:
+                self._res_metrics.deadline_exceeded.labels().inc()
+            raise
 
     async def stat_peer(self, peer_id: str, timeout: float = 5.0) -> msg.StatResponse:
+        self._check("stat_peer")
         await self.send(msg.StatPeerRequest(peer_id=peer_id))
-        return await asyncio.wait_for(self._stats.get(), timeout)
+        return await _bounded_wait(self._stats.get(), timeout, "stat_peer",
+                                   metrics=self._res_metrics)
 
     async def stat_task(self, task_id: str, timeout: float = 5.0) -> msg.StatResponse:
+        self._check("stat_task")
         await self.send(msg.StatTaskRequest(task_id=task_id))
-        return await asyncio.wait_for(self._stats.get(), timeout)
+        return await _bounded_wait(self._stats.get(), timeout, "stat_task",
+                                   metrics=self._res_metrics)
 
     async def sync_probes(
         self, host_id: str, count: int = 10, timeout: float = 5.0
     ) -> list[msg.ProbeTarget]:
+        self._check("sync_probes")
         await self.send(msg.ProbeStartedRequest(host_id=host_id, count=count))
-        response = await asyncio.wait_for(self._probe_targets.get(), timeout)
+        response = await _bounded_wait(self._probe_targets.get(), timeout,
+                                       "sync_probes", metrics=self._res_metrics)
         return response.targets
+
+    async def health(self, timeout: float = 2.0) -> bool:
+        """One HealthCheck round trip on the live stream (pkg/rpc/health) —
+        the half-open breaker probe rides this instead of inventing a new
+        message."""
+        await self.send(mux.HealthCheckRequest(service="scheduler"))
+        response = await _bounded_wait(self._health.get(), timeout, "health",
+                                       metrics=self._res_metrics)
+        return response.status == mux.SERVING
 
 
 class SchedulerClientPool:
     """Task-affine scheduler selection over a scheduler set (the
     consistent-hashing balancer + resolver pair)."""
 
-    def __init__(self, addresses: list[tuple[str, int]], ssl_context=None):
+    def __init__(self, addresses: list[tuple[str, int]], ssl_context=None,
+                 breaker_failure_threshold: int = 2,
+                 breaker_open_ttl: float = 5.0):
         if not addresses:
             raise ValueError("need at least one scheduler address")
         self.ssl_context = ssl_context
@@ -173,6 +231,13 @@ class SchedulerClientPool:
         self._state: tuple[HashRing, dict] = (
             HashRing([f"{h}:{p}" for h, p in addresses]),
             {f"{h}:{p}": (h, p) for h, p in addresses},
+        )
+        # Per-target dial breakers (rpc/resilience.py): a blackholed
+        # scheduler costs `failure_threshold` dial timeouts, then every
+        # later dial fast-fails until the open_ttl probe window.
+        self.breakers = resilience.BreakerBoard(
+            "dfdaemon", failure_threshold=breaker_failure_threshold,
+            open_ttl=breaker_open_ttl,
         )
         self._conns: dict[str, SchedulerConnection] = {}
         # (connection, parked_at): closed by for_task only after a grace
@@ -212,13 +277,56 @@ class SchedulerClientPool:
                 if conn is not None:
                     with self._stale_mu:
                         self._stale_conns.append((conn, _time.monotonic()))
+        # breakers follow ring membership: a decommissioned scheduler's
+        # breaker must not linger as a stuck-open gauge
+        for target in self.breakers.targets():
+            if target not in addr:
+                self.breakers.drop(target)
 
     async def for_task(self, task_id: str) -> SchedulerConnection:
+        """Live connection for a task: the hashring PRIMARY when it is
+        healthy, else ring-order failover — breaker-open or dial-dead
+        candidates are skipped and the task lands on the next ring node
+        (where it would also land if the primary left the ring, so the
+        failed-over task keeps scheduler affinity through the outage).
+        The happy path pays one O(log n) pick; the full successor walk
+        (nodes x replicas) is built only after the primary failed."""
         ring, addr = self._state
-        key = ring.pick(task_id)
-        if key is None:
+        primary = ring.pick(task_id)
+        if primary is None:
             raise RuntimeError("scheduler ring is empty")
-        return await self._get(key, addr)
+        try:
+            # _get returns a live cached connection without consulting the
+            # breaker (it guards DIALS, not established streams), so the
+            # healthy-primary fast path costs one dict lookup
+            return await self._get(primary, addr)
+        except (resilience.BreakerOpen, OSError, asyncio.TimeoutError) as e:
+            last_err: Exception = e
+        failed = primary
+        for key in ring.successors(task_id):
+            if key == primary:
+                continue
+            logger.warning(
+                "scheduler %s unavailable (%s); failing over to next "
+                "ring node", failed, type(last_err).__name__,
+            )
+            try:
+                return await self._get(key, addr)
+            except (resilience.BreakerOpen, OSError, asyncio.TimeoutError) as e:
+                last_err = e
+                failed = key
+                continue
+        raise last_err
+
+    def primary_for_task(self, task_id: str) -> str | None:
+        """The hashring owner of `task_id` (chaos tests and operators ask
+        'which scheduler should this task be on')."""
+        return self._state[0].pick(task_id)
+
+    def size(self) -> int:
+        """Configured scheduler count (the ring membership, not how many
+        connections happen to be open)."""
+        return len(self._state[1])
 
     DIAL_TIMEOUT_S = 5.0
 
@@ -266,19 +374,35 @@ class SchedulerClientPool:
         # Dial OUTSIDE the pool lock, bounded: one blackholed scheduler
         # (SYN drop after its connection died) must not stall every
         # download to the healthy ones behind this lock for the kernel's
-        # multi-minute connect timeout.
+        # multi-minute connect timeout. The dial runs under the target's
+        # circuit breaker: an open breaker raises BreakerOpen in
+        # microseconds instead of paying the timeout again, and the first
+        # dial after open_ttl runs as the half-open probe — verified with
+        # a HealthCheck round trip before the breaker closes.
+        breaker_state = self.breakers.acquire(key)
         host, port = addr[key]
-        fresh = SchedulerConnection(host, port, ssl_context=self.ssl_context)
+        fresh = SchedulerConnection(
+            host, port, ssl_context=self.ssl_context,
+            resilience_metrics=self.breakers.metrics,
+        )
         try:
             await asyncio.wait_for(fresh.connect(), timeout=self.DIAL_TIMEOUT_S)
-        except BaseException:
-            # a timed-out/cancelled dial must not abandon the half-open
-            # socket (ADVICE r4 low)
+            if breaker_state == resilience.HALF_OPEN:
+                if not await fresh.health():
+                    raise ConnectionError(f"{key}: half-open probe NOT_SERVING")
+        except BaseException as e:
+            # Only a refusal/timeout is evidence against the TARGET; a
+            # caller-side cancellation says nothing about its health and
+            # must neither open the breaker nor wedge the half-open probe
+            # slot (record_outcome classifies). Either way the half-open
+            # socket must not leak (ADVICE r4 low).
+            self.breakers.record_outcome(key, e)
             try:
                 await fresh.close()
             except Exception:  # noqa: BLE001 - teardown of a dead dial
                 pass
             raise
+        self.breakers.record_outcome(key, None)
         async with self._lock:
             raced = self._conns.get(key)
             if raced is not None and not raced.is_closed:
@@ -294,16 +418,15 @@ class SchedulerClientPool:
     async def connect_all(self) -> list[SchedulerConnection]:
         """Open a connection to every reachable scheduler (seed daemons
         must be reachable for triggers before any task touches them). Dead
-        schedulers are skipped — the lazy per-task path retries them."""
+        schedulers are skipped — the lazy per-task path retries them.
+        Dials go through _get so they share the per-target breakers."""
+        _, addr = self._state
+        for key in list(addr):
+            try:
+                await self._get(key, addr)
+            except (OSError, asyncio.TimeoutError, resilience.BreakerOpen) as e:
+                logger.warning("scheduler %s unreachable: %s", key, e)
         async with self._lock:
-            for key, (host, port) in self._addr.items():
-                if key not in self._conns:
-                    try:
-                        self._conns[key] = await SchedulerConnection(
-                            host, port, ssl_context=self.ssl_context
-                        ).connect()
-                    except OSError as e:
-                        logger.warning("scheduler %s unreachable: %s", key, e)
             return list(self._conns.values())
 
     async def close(self) -> None:
@@ -316,10 +439,16 @@ class SchedulerClientPool:
 class TrainerClient:
     """Client-streaming dataset upload (trainerv1.Trainer/Train)."""
 
+    DIAL_TIMEOUT_S = 5.0
+
     def __init__(self, host: str, port: int, ssl_context=None):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
+        # the upload runs on the scheduler's announce cadence: a blackholed
+        # trainer must cost one bounded dial per open_ttl, not a full
+        # kernel connect timeout per cadence tick
+        self.breakers = resilience.BreakerBoard("scheduler")
 
     async def train(
         self, host_id: str, ip: str, hostname: str, datasets: dict,
@@ -340,9 +469,38 @@ class TrainerClient:
         # Every frame below inherits the upload span's context through the
         # wire envelope, so the trainer's train_ingest span continues this
         # trace (one trace id across the announce->train edge).
-        reader, writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self.ssl_context
-        )
+        target = f"{self.host}:{self.port}"
+        breaker_state = self.breakers.acquire(target)
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(
+                    self.host, self.port, ssl=self.ssl_context
+                ),
+                timeout=self.DIAL_TIMEOUT_S,
+            )
+            if breaker_state == resilience.HALF_OPEN:
+                # probe the half-open breaker with the trainer's health
+                # handler before streaming megabytes at a maybe-dead server
+                try:
+                    wire.write_frame(writer, mux.HealthCheckRequest(service="trainer"))
+                    await writer.drain()
+                    probe = await asyncio.wait_for(wire.read_frame(reader), timeout=2.0)
+                    if not (
+                        isinstance(probe, mux.HealthCheckResponse)
+                        and probe.status == mux.SERVING
+                    ):
+                        raise ConnectionError(f"{target}: half-open probe NOT_SERVING")
+                except BaseException:
+                    # a failed/timed-out/cancelled probe must not leak the
+                    # just-dialed socket (the fd-per-retry leak shape)
+                    writer.close()
+                    raise
+        except BaseException as e:
+            # record_outcome classifies: transport failure opens/advances
+            # the breaker, cancellation just frees the probe slot
+            self.breakers.record_outcome(target, e)
+            raise
+        self.breakers.record_outcome(target, None)
         try:
             try:
                 for dataset, value in datasets.items():
@@ -399,60 +557,111 @@ class SyncSchedulerClient:
         self.port = port
         self.ssl_context = ssl_context
         self.timeout = timeout
-        # After a failed DIAL (not a mid-call transport error), fail fast
-        # for this long instead of re-dialing: a preheat fans one trigger
-        # per task to the owning scheduler, and without the marker a dead
-        # (blackholed) scheduler costs one full connect timeout PER TASK —
-        # minutes for a 50-URL job. The TTL matches the dial timeout, so
-        # one create_preheat round pays the ~5s timeout exactly once.
-        self.dial_failure_ttl = dial_failure_ttl
-        self._dial_failed_at = 0.0  # monotonic; 0 = no cached failure
+        # Per-target circuit breaker (rpc/resilience.py), generalizing the
+        # old ad-hoc dial-failure TTL cache: a preheat fans one trigger per
+        # task to the owning scheduler, and without it a dead (blackholed)
+        # scheduler costs one full connect timeout PER TASK — minutes for a
+        # 50-URL job. failure_threshold=1 keeps the old contract (one
+        # failed dial → fast-fail), open_ttl=dial_failure_ttl keeps the
+        # probe cadence, and the half-open probe now runs the health
+        # request before the breaker closes.
+        self.breakers = resilience.BreakerBoard(
+            "manager", failure_threshold=1, open_ttl=dial_failure_ttl,
+        )
+        self._target = f"{host}:{port}"
         self._sock = None
         self._mu = threading.Lock()
 
     def _connect(self):
         import socket as _socket
 
-        sock = _socket.create_connection((self.host, self.port), timeout=self.timeout)
+        timeout = resilience.bound_timeout(self.timeout)
+        sock = _socket.create_connection((self.host, self.port), timeout=timeout)
         if self.ssl_context is not None:
             sock = self.ssl_context.wrap_socket(sock, server_hostname=self.host)
         return sock
 
+    def _dial(self) -> None:
+        """Dial under the breaker; half-open dials are verified with one
+        HealthCheck round trip before the breaker closes (pkg/rpc/health —
+        the probe the reference's balancer gets from grpc healthchecks)."""
+        breaker_state = self.breakers.acquire(self._target)  # BreakerOpen -> Unavailable
+        try:
+            self._sock = self._connect()
+            if breaker_state == resilience.HALF_OPEN:
+                self._sock.sendall(wire.encode(mux.HealthCheckRequest()))
+                header = self._recv_exact(self._sock, 4)
+                probe = wire.decode(
+                    self._recv_exact(self._sock, int.from_bytes(header, "big"))
+                )
+                if not (
+                    isinstance(probe, mux.HealthCheckResponse)
+                    and probe.status == mux.SERVING
+                ):
+                    raise ConnectionError("half-open probe NOT_SERVING")
+        except BaseException as e:
+            # BaseException, not just (OSError, ConnectionError): a codec
+            # error from a garbled probe reply (wire.decode TypeError)
+            # must still settle the acquire — record_outcome classifies it
+            # as release-not-failure — or the probe slot wedges and this
+            # target becomes permanently unreachable
+            self.breakers.record_outcome(self._target, e)
+            sock, self._sock = self._sock, None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise
+        self.breakers.record_outcome(self._target, None)
+
     def call(self, request):
         """Send one frame, read one frame. Raises ConnectionError on any
-        transport failure (after closing the cached socket). The socket is
-        snapshotted into a local: a concurrent close() (update_schedulers
-        dropping a departed scheduler) nulls self._sock without taking
-        _mu — closing the fd mid-recv surfaces as OSError below, never as
-        an AttributeError on None escaping the error mapping."""
+        transport failure (after closing the cached socket), Unavailable
+        when the breaker is open, DeadlineExceeded when the ambient budget
+        is already spent. The socket is snapshotted into a local: a
+        concurrent close() (update_schedulers dropping a departed
+        scheduler) nulls self._sock without taking _mu — closing the fd
+        mid-recv surfaces as OSError below, never as an AttributeError on
+        None escaping the error mapping."""
         with self._mu:
+            remaining = resilience.remaining()
+            if remaining is not None and remaining <= 0:
+                self.breakers.metrics.deadline_exceeded.labels().inc()
+                raise dferrors.DeadlineExceeded(
+                    f"scheduler rpc {self._target}: deadline budget exhausted"
+                )
             try:
                 if self._sock is None:
-                    if (
-                        self._dial_failed_at
-                        and time.monotonic() - self._dial_failed_at < self.dial_failure_ttl
-                    ):
-                        raise ConnectionError(
-                            f"dial failed "
-                            f"{time.monotonic() - self._dial_failed_at:.1f}s ago; "
-                            f"fast-failing for {self.dial_failure_ttl:.0f}s"
-                        )  # the outer handler adds the host:port prefix
-                    try:
-                        self._sock = self._connect()
-                    except OSError:
-                        self._dial_failed_at = time.monotonic()
-                        raise
-                    self._dial_failed_at = 0.0
+                    self._dial()
                 sock = self._sock
+                if remaining is not None:
+                    # the recv timeout shrinks to the budget; the request
+                    # frame itself carries the remaining budget (wire
+                    # encode reads the ambient scope) for the server shed
+                    sock.settimeout(min(self.timeout, remaining))
                 # wire.encode already length-prefixes the frame
                 sock.sendall(wire.encode(request))
                 header = self._recv_exact(sock, 4)
                 return wire.decode(
                     self._recv_exact(sock, int.from_bytes(header, "big"))
                 )
+            except resilience.BreakerOpen:
+                raise  # already Unavailable with the open-state detail
             except (OSError, ConnectionError, ValueError) as e:
                 self.close()
                 raise ConnectionError(f"scheduler rpc {self.host}:{self.port}: {e}") from e
+            finally:
+                # snapshot, never re-read: a concurrent close() nulls
+                # self._sock and an AttributeError out of a finally would
+                # replace the in-flight exception and break the
+                # ConnectionError contract this method documents
+                sock = self._sock
+                if sock is not None and remaining is not None:
+                    try:
+                        sock.settimeout(self.timeout)
+                    except OSError:
+                        pass
 
     def _recv_exact(self, sock, n: int) -> bytes:
         buf = b""

@@ -17,7 +17,7 @@ import threading
 import time
 
 from dragonfly2_tpu.cluster import messages as msg
-from dragonfly2_tpu.rpc import mux, wire
+from dragonfly2_tpu.rpc import mux, resilience, wire
 from dragonfly2_tpu.telemetry import default_registry
 from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import (
@@ -26,6 +26,7 @@ from dragonfly2_tpu.telemetry.series import (
     TRAFFIC_BACK_TO_SOURCE,
     TRAFFIC_P2P,
     register_version,
+    resilience_series,
     scheduler_series,
     trainer_series,
 )
@@ -52,6 +53,30 @@ _UNTRACED_RPC_TYPES = (
     msg.ProbeFinishedRequest,
     sv1.V1PieceResult,
 )
+
+# Requests eligible for deadline shedding: work someone is WAITING on,
+# where a caller past its budget has stopped listening. Lifecycle
+# mutations (Leave*/AnnounceHost) and progress reports are NEVER shed —
+# dropping a LeavePeer because its frame arrived late would leak peer
+# state, which is strictly worse than doing cheap work nobody awaits.
+_SHEDDABLE_RPC_TYPES = (
+    msg.RegisterPeerRequest,
+    msg.RescheduleRequest,
+    msg.StatPeerRequest,
+    msg.StatTaskRequest,
+    msg.ProbeStartedRequest,
+    msg.JobTriggerSeedRequest,
+    msg.TaskStatesRequest,
+    msg.SchedulerInfoRequest,
+    msg.FlightRecorderRequest,
+)
+
+# Of those, the types whose callers expect a per-peer scheduling verdict:
+# they get an explicit DeadlineExceeded ScheduleFailure so the conductor
+# fails fast instead of waiting out its schedule timeout. Stat/info
+# droppers get silence — their caller aborts on its own expired budget,
+# and a ScheduleFailure would be misrouted into the peer's response queue.
+_SHED_WITH_FAILURE_TYPES = (msg.RegisterPeerRequest, msg.RescheduleRequest)
 
 
 class SchedulerRPCServer:
@@ -99,6 +124,7 @@ class SchedulerRPCServer:
         self._v1_peers: set[str] = set()
         reg = default_registry()
         self.metrics = scheduler_series(reg)
+        self.resilience_metrics = resilience_series(reg, "scheduler")
         register_version(reg, "scheduler")
         self._m_requests = self.metrics.announce_peer
         self._m_tick = self.metrics.schedule_tick
@@ -178,8 +204,45 @@ class SchedulerRPCServer:
                     async with self._lock:
                         self._host_conn[request.host.host_id] = writer
                         owned_hosts.add(request.host.host_id)
+                # Propagated deadline budget (rpc/wire.py "dl"): awaited
+                # work whose budget is already spent is SHED before it
+                # touches the service — the caller stopped waiting, so
+                # scheduling it only burns tick capacity (the grpc-timeout
+                # contract the reference inherits from its interceptors).
+                # Only _SHEDDABLE_RPC_TYPES qualify; lifecycle mutations
+                # always execute.
+                budget = getattr(request, "deadline_s", None)
+                if (
+                    budget is not None and budget <= 0
+                    and isinstance(request, _SHEDDABLE_RPC_TYPES)
+                ):
+                    self.resilience_metrics.deadline_shed.labels(
+                        type(request).__name__
+                    ).inc()
+                    if isinstance(request, _SHED_WITH_FAILURE_TYPES):
+                        wire.write_frame(writer, msg.ScheduleFailure(
+                            peer_id=request.peer_id, code="DeadlineExceeded",
+                            description="deadline expired before dispatch",
+                        ))
+                        await writer.drain()
+                    continue
                 was_empty = not self.service._pending
-                response = await self._dispatch_locked(request, writer, owned_peers)
+                if budget is not None:
+                    # re-anchor the remaining budget on this host's clock:
+                    # dispatch time decrements it, and any frame the handler
+                    # sends onward carries what is left (wire.encode reads
+                    # the ambient scope)
+                    with resilience.deadline(budget):
+                        response = await self._dispatch_locked(
+                            request, writer, owned_peers
+                        )
+                        if response is not None and resilience.expired():
+                            self.resilience_metrics.deadline_shed.labels(
+                                type(request).__name__
+                            ).inc()
+                            response = None  # nobody is waiting for this
+                else:
+                    response = await self._dispatch_locked(request, writer, owned_peers)
                 if response is not None:
                     wire.write_frame(writer, response)
                     await writer.drain()

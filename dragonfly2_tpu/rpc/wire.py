@@ -16,10 +16,12 @@ import asyncio
 import dataclasses
 import enum
 import struct
+import types
 import typing
 
 import msgpack
 
+from dragonfly2_tpu.rpc import resilience as _resilience
 from dragonfly2_tpu.telemetry import tracing as _tracing
 
 _REGISTRY: dict[str, type] = {}
@@ -60,7 +62,8 @@ def _from_plain(hint, value):
         (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
         seq = [_from_plain(inner, v) for v in value]
         return seq if origin is list else tuple(seq)
-    if origin is typing.Union:  # Optional[X]
+    if origin is typing.Union or origin is getattr(types, "UnionType", ()):
+        # Optional[X] / X | None (PEP 604 unions report types.UnionType)
         args = [a for a in typing.get_args(hint) if a is not type(None)]
         if value is None or not args:
             return value
@@ -82,12 +85,20 @@ def _instantiate(cls: type, fields: dict):
     return cls(**kwargs)
 
 
-def encode(message, trace_context: dict | None = None) -> bytes:
+def encode(message, trace_context: dict | None = None,
+           deadline_s: float | None = None) -> bytes:
     """Frame one message. Trace context ({"trace_id", "span_id"}) rides
     the envelope — the explicit argument wins, else the ambient span's
     context (telemetry/tracing.current_context) is injected so a span
     opened on one side of the wire continues on the other. No active
-    span, no extra bytes."""
+    span, no extra bytes.
+
+    The deadline budget rides the same way (rpc/resilience.py): an
+    explicit `deadline_s` wins, else the ambient deadline scope's
+    REMAINING budget is stamped into `"dl"` as relative seconds — the
+    receiver re-anchors it on its own monotonic clock, so the time this
+    hop already spent is what decrements the budget across hops. No
+    active scope, no extra bytes."""
     name = type(message).__name__
     if name not in _REGISTRY:
         raise TypeError(f"message type {name} not registered")
@@ -98,6 +109,9 @@ def encode(message, trace_context: dict | None = None) -> bytes:
             "trace_id": str(tc["trace_id"]),
             "span_id": str(tc.get("span_id") or ""),
         }
+    dl = deadline_s if deadline_s is not None else _resilience.remaining()
+    if dl is not None:
+        env["dl"] = max(float(dl), 0.0)
     payload = msgpack.packb(env, use_bin_type=True)
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame too large: {len(payload)}")
@@ -118,6 +132,14 @@ def decode(payload: bytes):
             object.__setattr__(message, "trace_context", dict(tc))
         except AttributeError:
             pass  # slotted message types simply drop the context
+    dl = obj.get("dl")
+    if dl is not None:
+        try:
+            # remaining budget in seconds at SEND time; receivers re-anchor
+            # it on their own clock (rpc/server.py shed + deadline scope)
+            object.__setattr__(message, "deadline_s", float(dl))
+        except AttributeError:
+            pass
     return message
 
 

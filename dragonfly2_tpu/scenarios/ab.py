@@ -162,6 +162,9 @@ def _run_arm(
             "stalls": st.injected_stalls,
             "crashes": st.injected_crashes,
             "host_leaves": st.injected_host_leaves,
+            "scheduler_crashes": st.injected_scheduler_crashes,
+            "crash_reannounced_peers": st.crash_reannounced_peers,
+            "partition_drops": st.injected_partition_drops,
         },
         "retry_waves": st.retry_waves,
         "rounds": rounds,

@@ -185,6 +185,53 @@ class ScenarioEngine:
         }
         return out
 
+    # ----------------------------------------------------- control plane
+
+    def scheduler_crashed(self, round_idx: int) -> bool:
+        """True exactly on the FIRST round of an epoch whose crash roll
+        hit: the scheduler loses its in-memory state and every announce
+        stream at once. Deterministic in (spec, seed, epoch) — replays
+        crash at identical rounds."""
+        control = self.spec.control
+        if control.scheduler_crash_rate <= 0:
+            return False
+        epoch_len = max(control.crash_epoch_rounds, 1)
+        if round_idx % epoch_len != 0 or round_idx == 0:
+            return False
+        epoch = round_idx // epoch_len
+        if _u(self.seed, "sched_crash", epoch) >= control.scheduler_crash_rate:
+            return False
+        self._record("sched_crash", epoch)
+        return True
+
+    def scheduler_crash_point(self, task_idx: int, n_pieces: int) -> int | None:
+        """Real-socket chaos e2e: the piece count after which the task's
+        hashring-primary scheduler is killed, or None when this task's
+        crash roll missed. Keyed on the task index so the same (spec,
+        seed, task) always kills at the same progress point."""
+        control = self.spec.control
+        if control.scheduler_crash_rate <= 0:
+            return None
+        if _u(self.seed, "sched_crash_task", task_idx) >= control.scheduler_crash_rate:
+            return None
+        self._record("sched_crash_task", task_idx)
+        return max(1, min(n_pieces - 1, int(n_pieces * control.crash_progress)))
+
+    def partitioned_hosts(self, round_idx: int) -> set[str]:
+        """Hosts whose announce-plane link is silently blackholed this
+        epoch: unlike churn's leave/rejoin, the scheduler receives no
+        LeaveHost — their requests and its responses just vanish, the
+        shape a stateful-firewall drop or asymmetric route takes."""
+        control = self.spec.control
+        if control.partition_rate <= 0:
+            return set()
+        epoch = round_idx // max(control.partition_epoch_rounds, 1)
+        return {
+            h.id
+            for h in self.hosts
+            if _u(self.seed, "partition", epoch, h.id) < control.partition_rate
+        }
+
     # ------------------------------------------------------------- skew
 
     def task_weights(self, n_tasks: int) -> list[float] | None:

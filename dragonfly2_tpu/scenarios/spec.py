@@ -79,6 +79,22 @@ class SkewSpec:
 
 
 @dataclasses.dataclass
+class ControlPlaneSpec:
+    """Control-plane fault events (the failure-domain resilience layer's
+    adversary): scheduler crashes that sever every announce stream at
+    once, and host↔scheduler partitions that silently blackhole the
+    announce plane (no FIN — requests vanish). Like every other spec
+    knob, the EVENTS are sampled deterministically by the engine from
+    (spec, seed, event identity); these fields only set the rates."""
+
+    scheduler_crash_rate: float = 0.0   # P(the scheduler crashes in an epoch)
+    crash_epoch_rounds: int = 25        # crash opportunity every N rounds
+    crash_progress: float = 0.5         # e2e: kill after this piece fraction
+    partition_rate: float = 0.0         # P(a host is partitioned in an epoch)
+    partition_epoch_rounds: int = 20    # partition membership re-rolls every N
+
+
+@dataclasses.dataclass
 class ScenarioSpec:
     name: str = "homogeneous"
     description: str = ""
@@ -86,6 +102,7 @@ class ScenarioSpec:
     churn: ChurnSpec = dataclasses.field(default_factory=ChurnSpec)
     flaky: FlakySpec = dataclasses.field(default_factory=FlakySpec)
     skew: SkewSpec = dataclasses.field(default_factory=SkewSpec)
+    control: ControlPlaneSpec = dataclasses.field(default_factory=ControlPlaneSpec)
 
     # ------------------------------------------------------------- codecs
 
@@ -214,5 +231,30 @@ def builtin_scenarios() -> dict[str, ScenarioSpec]:
             name="hotspot",
             description="Zipf(1.2) task popularity: a few blobs go cluster-wide",
             skew=SkewSpec(zipf_alpha=1.2),
+        ),
+        "chaos": ScenarioSpec(
+            name="chaos",
+            description=(
+                "control-plane chaos: scheduler crashes sever every "
+                "announce stream (in-flight peers re-announce their kept "
+                "pieces and the scheduler adopts them), 10% of hosts "
+                "silently partitioned per epoch, plus peer churn and "
+                "enough flaky serving that downloads span rounds — the "
+                "failure-domain resilience gauntlet"
+            ),
+            churn=ChurnSpec(peer_crash_rate=0.05, crash_progress=0.5),
+            # flaky parents keep downloads in flight across rounds, so
+            # crashes and partitions catch real partial progress instead
+            # of an empty pending queue
+            flaky=FlakySpec(
+                parent_fraction=0.25, piece_error_rate=0.15,
+                piece_stall_rate=0.05, stall_seconds=0.2,
+            ),
+            control=ControlPlaneSpec(
+                scheduler_crash_rate=0.6,
+                crash_epoch_rounds=20,
+                partition_rate=0.10,
+                partition_epoch_rounds=15,
+            ),
         ),
     }

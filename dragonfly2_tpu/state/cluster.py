@@ -283,6 +283,27 @@ class ClusterState:
         self.peer_updated_at[peer_idx] = time.time()
         self.touch_peer_host(peer_idx)
 
+    def adopt_pieces(self, peer_idx: int, piece_numbers) -> int:
+        """Mark pieces a re-announcing peer ALREADY holds (the failover
+        resume path, cluster/scheduler.py register_peer): bitset +
+        finished count only — no cost samples, because no transfer was
+        observed and zero-cost entries would poison the 3-sigma IsBadNode
+        window. Returns how many pieces were newly adopted."""
+        adopted = 0
+        for piece_number in piece_numbers:
+            word, bit = divmod(int(piece_number), 64)
+            if word >= self.piece_bitset_words:
+                continue
+            mask = np.uint64(1) << np.uint64(bit)
+            if not (self.peer_finished_bitset[peer_idx, word] & mask):
+                self.peer_finished_bitset[peer_idx, word] |= mask
+                self.peer_finished_count[peer_idx] += 1
+                adopted += 1
+        if adopted:
+            self.peer_updated_at[peer_idx] = time.time()
+            self.touch_peer_host(peer_idx)
+        return adopted
+
     def peer_piece_costs_ordered(self, peer_idx: int) -> np.ndarray:
         """Costs oldest->newest (ring unrolled) for the 3-sigma rule."""
         count = int(self.peer_piece_cost_count[peer_idx])

@@ -81,7 +81,19 @@ class PhaseRecorder:
         if not self._open:
             return
         self._open = False
-        phases = self._phases
+        self._commit_dict(self._phases)
+
+    def commit_phases(self, phases: dict[str, float]) -> None:
+        """Append one externally-measured {phase: ms} entry atomically —
+        for concurrent producers (e.g. several downloads recovering from
+        one scheduler crash at once, client/daemon.py failover) that
+        cannot share the single begin/mark/commit cursor without
+        clobbering each other's in-progress entry."""
+        if not self.enabled:
+            return
+        self._commit_dict(dict(phases))
+
+    def _commit_dict(self, phases: dict[str, float]) -> None:
         self.ring.append(phases)
         self.ticks += 1
         h = self._histogram

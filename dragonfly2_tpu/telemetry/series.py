@@ -153,6 +153,16 @@ def daemon_series(reg) -> _Namespace:
         peer_task_cache_hit=c(
             "dragonfly_dfdaemon_peer_task_cache_hit_total", "local reuse hits"
         ),
+        scheduler_failover=c(
+            "dragonfly_dfdaemon_scheduler_failover_total",
+            "downloads recovered by failing over to another scheduler "
+            "after the announce stream died",
+        ),
+        seed_task_reannounce=c(
+            "dragonfly_dfdaemon_seed_task_reannounce_total",
+            "completed tasks re-announced to a scheduler that triggered a "
+            "seed download this daemon already holds",
+        ),
     )
 
 
@@ -189,6 +199,42 @@ def trainer_series(reg) -> _Namespace:
             "dragonfly_trainer_train_chunks_total", "dataset chunks", ("dataset",)
         ),
         train_runs=c("dragonfly_trainer_train_total", "train runs", ("state",)),
+    )
+
+
+def resilience_series(reg, service: str) -> _Namespace:
+    """Failure-domain resilience families (rpc/resilience.py): per-target
+    circuit-breaker state/transition/fast-fail series for every dial site,
+    and the deadline-budget outcome counters — client calls aborted because
+    the propagated budget ran out, and server-side work shed on arrival
+    because its deadline had already expired. `service` picks the metric
+    namespace, so the daemon's pool, the manager's job edge, and the
+    scheduler's trainer uploads each report under their own name."""
+    return _Namespace(
+        breaker_state=reg.gauge(
+            f"dragonfly_{service}_rpc_breaker_state",
+            "per-target circuit breaker state (0=closed, 1=half_open, 2=open)",
+            ("target",),
+        ),
+        breaker_transitions=reg.counter(
+            f"dragonfly_{service}_rpc_breaker_transitions_total",
+            "circuit breaker state transitions", ("target", "to"),
+        ),
+        breaker_fast_fail=reg.counter(
+            f"dragonfly_{service}_rpc_breaker_fast_fail_total",
+            "calls short-circuited by an open breaker instead of dialing",
+            ("target",),
+        ),
+        deadline_exceeded=reg.counter(
+            f"dragonfly_{service}_rpc_deadline_exceeded_total",
+            "client calls aborted because the propagated deadline budget "
+            "was exhausted",
+        ),
+        deadline_shed=reg.counter(
+            f"dragonfly_{service}_rpc_deadline_shed_total",
+            "requests shed on arrival because their propagated deadline "
+            "had already expired", ("type",),
+        ),
     )
 
 

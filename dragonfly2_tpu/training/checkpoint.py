@@ -6,11 +6,14 @@ artifacts in the registry (registry/).
 
 from __future__ import annotations
 
+import logging
 import pathlib
 import shutil
 from typing import Any
 
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
 
 
 class TrainCheckpointer:
@@ -32,13 +35,40 @@ class TrainCheckpointer:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
     def restore(self, step: int | None = None, template: Any = None) -> Any:
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return None
-        if template is not None:
-            return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
-        return self._mngr.restore(step)
+        """Restore `step`, or — with no step given — the NEWEST checkpoint
+        that actually loads. A save interrupted mid-write (trainer crash,
+        SIGKILL between array files and the commit) can leave a step
+        directory that lists but does not restore; falling back to the
+        previous intact step is what makes `save` crash-safe end to end,
+        mirroring how the data plane reloads only verified pieces. An
+        EXPLICIT step still raises on corruption — the caller asked for
+        that exact state, and silently handing back an older one would
+        corrupt whatever invariant they were restoring under."""
+        if step is not None:
+            if template is not None:
+                return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+            return self._mngr.restore(step)
+        last_err: Exception | None = None
+        for candidate in sorted(self._mngr.all_steps(), reverse=True):
+            try:
+                return self.restore(candidate, template=template)
+            except Exception as e:  # noqa: BLE001 - torn checkpoint, try older
+                last_err = e
+                logger.warning(
+                    "checkpoint step %d failed to restore (%s); "
+                    "falling back to the previous step", candidate, e,
+                )
+        if last_err is not None:
+            # checkpoints EXIST but none restores: that is a systematic
+            # problem (template/pytree mismatch, format skew), not a torn
+            # write — swallowing it into a None 'no checkpoint' would
+            # silently restart an expensive run from step 0
+            raise last_err
+        return None  # genuinely nothing saved yet
 
     def close(self) -> None:
         if not self._closed:

@@ -57,6 +57,28 @@ class HashRing:
         idx = bisect.bisect(self._ring, h) % len(self._ring)
         return self._members[self._ring[idx]]
 
+    def successors(self, key: str, limit: int | None = None) -> list[str]:
+        """Distinct nodes in ring order starting at `key`'s owner — the
+        failover order: when the primary is down (breaker open, dial
+        refused), the task moves to the NEXT ring node, which is also
+        where it lands permanently if the primary leaves the ring, so a
+        failed-over task keeps its affinity across the outage."""
+        if not self._ring:
+            return []
+        want = len(self._nodes) if limit is None else min(limit, len(self._nodes))
+        h = _hash(key)
+        start = bisect.bisect(self._ring, h) % len(self._ring)
+        out: list[str] = []
+        seen: set[str] = set()
+        for i in range(len(self._ring)):
+            node = self._members[self._ring[(start + i) % len(self._ring)]]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+                if len(out) >= want:
+                    break
+        return out
+
     def pick_many(self, keys: list[str]) -> list[str | None]:
         """Batch pick (native ring lookup when available) — the trace
         replay / preheat fan-out path."""

@@ -36,8 +36,94 @@ import pytest
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+    # chaos tests are tier-1 on purpose (NOT slow): failure-domain
+    # resilience must not rot behind an opt-in marker
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection resilience tests (tier-1)"
+    )
 
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ----------------------------------------------------- resource leak guard
+
+# Thread names whole subsystems own for the process lifetime: runtime pools
+# (jax/XLA, orbax async machinery, grpc pollers, asyncio's default executor)
+# plus the few intentionally-immortal daemons in this tree. Anything else
+# alive after the last test is a leak the suite must fail on — resilience
+# tests juggle servers and sockets, and a silently leaked listener turns
+# every later run flaky.
+_THREAD_ALLOWLIST_PREFIXES = (
+    "MainThread", "pytest", "asyncio_", "ThreadPoolExecutor", "jax_",
+    "orbax", "ocdbt", "ts_", "grpc", "eval-warmup", "Dummy",
+    "watchdog", "QueueFeederThread",
+)
+
+
+def _listening_socket_inodes() -> set[str]:
+    """Inodes of LISTEN-state TCP sockets owned by this process — derived
+    from /proc so no extra dependency; empty off-Linux (guard no-ops)."""
+    import os
+    import re
+
+    listen_inodes = set()
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                for line in f.readlines()[1:]:
+                    parts = line.split()
+                    if len(parts) > 9 and parts[3] == "0A":  # TCP_LISTEN
+                        listen_inodes.add(parts[9])
+        except OSError:
+            return set()
+    owned = set()
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                target = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                continue
+            m = re.match(r"socket:\[(\d+)\]", target)
+            if m and m.group(1) in listen_inodes:
+                owned.add(m.group(1))
+    except OSError:
+        return set()
+    return owned
+
+
+@pytest.fixture(scope="session", autouse=True)
+def resource_leak_guard():
+    """Fail the suite when tests leak non-daemon threads or listening
+    sockets past their teardown (CI satellite of the failure-domain PR:
+    resilience tests must not regress into resource leaks)."""
+    import gc
+    import threading
+    import time
+
+    baseline_sockets = _listening_socket_inodes()
+    yield
+    gc.collect()
+    # grace for executors/handlers that are mid-teardown at session end
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        leaked_threads = [
+            t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon
+            and not t.name.startswith(_THREAD_ALLOWLIST_PREFIXES)
+        ]
+        leaked_sockets = _listening_socket_inodes() - baseline_sockets
+        if not leaked_threads and not leaked_sockets:
+            return
+        time.sleep(0.1)
+    problems = []
+    if leaked_threads:
+        problems.append(
+            "leaked non-daemon threads: "
+            + ", ".join(sorted(t.name for t in leaked_threads))
+        )
+    if leaked_sockets:
+        problems.append(f"leaked listening sockets (inodes): {sorted(leaked_sockets)}")
+    pytest.fail("resource leak after test-session teardown: " + "; ".join(problems))

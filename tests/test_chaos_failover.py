@@ -1,0 +1,333 @@
+"""Chaos proof for the failure-domain resilience layer (real sockets, not
+simulator-only): a seed-deterministic scheduler_crash event kills a task's
+hashring-primary scheduler mid-download, and the download must complete
+via hashring failover — re-announce on the surviving scheduler, piece
+state resumed, no back-to-source — with time-to-recover reported from the
+daemon's failover flight recorder. Plus the resource-shaped regressions
+that guard it: fd-stable pool eviction, and the manager-driven
+scheduler-list shrink dropping ring nodes and breakers."""
+
+import asyncio
+import hashlib
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.rpc.client import SchedulerClientPool
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+from dragonfly2_tpu.scenarios import ScenarioSpec
+from dragonfly2_tpu.scenarios.engine import FaultInjector, ScenarioEngine
+from dragonfly2_tpu.scenarios.spec import ControlPlaneSpec, FlakySpec
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import daemon_series
+from dragonfly2_tpu.utils import idgen
+
+
+class _Origin:
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.get_count = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(outer.payload)))
+                self.end_headers()
+
+            def do_GET(self):
+                outer.get_count += 1
+                data = outer.payload
+                range_header = self.headers.get("Range")
+                status = 200
+                if range_header and range_header.startswith("bytes="):
+                    spec = range_header[len("bytes="):].split("-")
+                    start = int(spec[0]) if spec[0] else 0
+                    end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
+                    data = data[start:end + 1]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/blob.bin"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def origin():
+    server = _Origin(bytes(i % 256 for i in range(15 * 32 * 1024)))
+    yield server
+    server.stop()
+
+
+def _chaos_config() -> Config:
+    cfg = Config()
+    cfg.scheduler.max_hosts = 64
+    cfg.scheduler.max_tasks = 64
+    # headroom for the re-announce round trip after failover: the child
+    # must NOT escalate to back-to-source while the surviving scheduler
+    # is still adopting the seed's re-announced copy
+    cfg.scheduler.retry_back_to_source_limit = 50
+    cfg.scheduler.retry_limit = 60
+    return cfg
+
+
+@pytest.mark.chaos
+def test_scheduler_crash_mid_download_completes_via_failover(tmp_path, origin):
+    """Acceptance gate: two schedulers up, a seed-deterministic
+    scheduler_crash kills the task's hashring primary mid-download. The
+    download completes via failover — the resumed task reuses its
+    already-fetched pieces (every piece crosses the wire exactly once),
+    no back-to-source happens — and time-to-recover is reported from the
+    flight recorder's failover phases."""
+    piece_length = 32 * 1024
+    n_pieces = len(origin.payload) // piece_length
+    # the chaos scenario decides WHEN the primary dies: a deterministic
+    # function of (spec, seed, task) — replaying the same seed kills at
+    # the same piece count
+    spec = ScenarioSpec(
+        name="chaos-e2e",
+        flaky=FlakySpec(piece_stall_rate=1.0, stall_seconds=0.05),
+        control=ControlPlaneSpec(scheduler_crash_rate=1.0, crash_progress=0.4),
+    )
+    engine = ScenarioEngine(spec, hosts=[], seed=11)
+    crash_after = engine.scheduler_crash_point(task_idx=0, n_pieces=n_pieces)
+    assert crash_after is not None and 1 <= crash_after < n_pieces
+    # the same injector slows the seed's piece serving (stalls, no errors)
+    # so the kill window is real, through the genuine upload path
+    injector = FaultInjector(spec, seed=11)
+
+    async def run():
+        cfg = _chaos_config()
+        servers = {}
+        s1 = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.02)
+        s2 = SchedulerRPCServer(SchedulerService(config=cfg), tick_interval=0.02)
+        addr1 = await s1.start()
+        addr2 = await s2.start()
+        servers[f"{addr1[0]}:{addr1[1]}"] = s1
+        servers[f"{addr2[0]}:{addr2[1]}"] = s2
+        daemons = []
+        metrics = daemon_series(default_registry())
+        try:
+            # seed holds the whole blob and serves both schedulers
+            seed = Daemon(tmp_path / "seed", [addr1, addr2], hostname="seed-1",
+                          host_type="super", fault_injector=injector)
+            await seed.start()
+            daemons.append(seed)
+            ts_seed = await seed.download(origin.url(), piece_length=piece_length)
+            assert ts_seed.meta.done
+            gets_after_seed = origin.get_count
+
+            child = Daemon(tmp_path / "child", [addr1, addr2], hostname="child-1")
+            await child.start()
+            daemons.append(child)
+
+            task_id = idgen.task_id_v1(origin.url())
+            primary = child.pool.primary_for_task(task_id)
+            primary_server = servers[primary]
+            backup = next(k for k in servers if k != primary)
+
+            pieces_before = metrics.piece_task.value()
+            failovers_before = metrics.scheduler_failover.value()
+            reannounce_before = metrics.seed_task_reannounce.value()
+
+            download = asyncio.ensure_future(
+                child.download(origin.url(), piece_length=piece_length, workers=2)
+            )
+            # kill the hashring primary exactly at the scenario's crash
+            # point: after `crash_after` pieces crossed the wire
+            killed_at = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                fetched = metrics.piece_task.value() - pieces_before
+                if fetched >= crash_after:
+                    killed_at = time.monotonic()
+                    await primary_server.stop()
+                    break
+                await asyncio.sleep(0.005)
+            assert killed_at is not None, "download never reached the crash point"
+            assert not download.done(), "crash landed after the download finished"
+
+            ts = await asyncio.wait_for(download, timeout=60)
+            recovered_s = time.monotonic() - killed_at
+
+            # correct bytes, via P2P all the way: the origin saw no new
+            # GETs after the seed's back-source fetch
+            with open(ts.data_path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == hashlib.sha256(
+                    origin.payload
+                ).hexdigest()
+            assert origin.get_count == gets_after_seed, (
+                "failover fell back to origin instead of the surviving scheduler"
+            )
+
+            # resume, not restart: every piece crossed the wire exactly
+            # once across both attempts
+            total_fetched = metrics.piece_task.value() - pieces_before
+            assert total_fetched == n_pieces, (
+                f"{total_fetched} piece transfers for {n_pieces} pieces — "
+                "failover refetched already-held pieces"
+            )
+
+            # the failover actually happened and the surviving scheduler
+            # adopted the seed's re-announced copy
+            assert metrics.scheduler_failover.value() == failovers_before + 1
+            assert metrics.seed_task_reannounce.value() > reannounce_before
+            assert child.pool.primary_for_task(task_id) == primary  # ring unchanged
+            backup_host, backup_port = backup.rsplit(":", 1)
+            assert servers[backup].service.state.task_index(task_id) is not None
+
+            # time-to-recover comes from the flight recorder, not the test
+            recovery_ticks = child.failover_recorder.snapshot()
+            assert recovery_ticks, "failover left no flight-recorder entry"
+            phases = recovery_ticks[-1]
+            assert {"backoff", "redial", "reannounce"} <= set(phases)
+            recover_ms = sum(phases.values())
+            assert 0 < recover_ms < recovered_s * 1e3 + 1e3
+            print(f"\nchaos failover: killed {primary} after {crash_after}/"
+                  f"{n_pieces} pieces; recovered via {backup} in "
+                  f"{recover_ms:.0f}ms (flight phases {phases})")
+        finally:
+            for d in daemons:
+                await d.stop()
+            for server in servers.values():
+                await server.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos
+def test_pool_eviction_is_fd_stable_across_forced_redials(tmp_path):
+    """Satellite regression: every dead-connection evict/redial path must
+    close the old socket (the fd-per-retry leak shape utils/vsock.py
+    documents). 25 forced redials may not grow /proc/self/fd."""
+
+    async def run():
+        server = SchedulerRPCServer(SchedulerService(), tick_interval=0.05)
+        addr = await server.start()
+        pool = SchedulerClientPool([addr])
+        try:
+            conn = await pool.for_task("fd-task")
+            baseline = len(os.listdir("/proc/self/fd"))
+            for _ in range(25):
+                # simulate the peer death the reference gets from gRPC
+                # channel breakage: kill the transport under the pool
+                conn._writer.close()
+                await asyncio.sleep(0)  # let the close land
+                conn = await pool.for_task("fd-task")
+                assert not conn.is_closed
+            await asyncio.sleep(0.05)  # drain CLOSE_WAIT handling
+            after = len(os.listdir("/proc/self/fd"))
+            assert after <= baseline + 3, (
+                f"fd count grew {baseline} -> {after} across forced redials"
+            )
+        finally:
+            await pool.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_keepalive_expiry_shrinks_ring_and_drops_breaker(tmp_path):
+    """Satellite: the manager-driven scheduler-list failure path. A
+    scheduler that stops keepaliving flips inactive (expire_keepalives),
+    the next dynconfig push shrinks the daemon's pool, and both the
+    hashring and the breaker board drop the node."""
+    from dragonfly2_tpu.manager.models import Database
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    mgr = ManagerService(Database())
+    mgr.create_cluster({"name": "c1"})
+    for i, port in enumerate((9101, 9102), start=1):
+        mgr.register_scheduler({
+            "host_name": f"sched-{i}", "ip": "127.0.0.1", "port": port,
+            "scheduler_cluster_id": 1,
+        })
+        mgr.keepalive("scheduler", f"sched-{i}", "127.0.0.1", 1)
+
+    daemon = Daemon(tmp_path / "d", [("127.0.0.1", 9101), ("127.0.0.1", 9102)],
+                    hostname="dyn-peer")
+
+    def push_from_manager():
+        daemon._apply_scheduler_list({
+            "schedulers": [
+                {"ip": e["ip"], "port": e["port"], "state": e["state"]}
+                for e in mgr.list_schedulers("127.0.0.1", "dyn-peer")
+            ]
+        })
+
+    push_from_manager()
+    assert daemon.pool._ring.nodes() == {"127.0.0.1:9101", "127.0.0.1:9102"}
+    # the dead scheduler had an open breaker from failed dials
+    daemon.pool.breakers.get("127.0.0.1:9102").record_failure()
+    assert "127.0.0.1:9102" in daemon.pool.breakers.targets()
+
+    # sched-2 goes silent; only sched-1 keeps its keepalive fresh
+    time.sleep(0.05)
+    mgr.keepalive("scheduler", "sched-1", "127.0.0.1", 1)
+    expired = mgr.expire_keepalives(timeout=0.04)
+    assert expired == 1
+
+    push_from_manager()
+    assert daemon.pool._ring.nodes() == {"127.0.0.1:9101"}, (
+        "inactive scheduler survived the dynconfig push"
+    )
+    assert "127.0.0.1:9102" not in daemon.pool.breakers.targets(), (
+        "breaker for the decommissioned scheduler was not dropped"
+    )
+    # the ring now routes every task to the survivor
+    assert daemon.pool.primary_for_task("any-task") == "127.0.0.1:9101"
+
+
+@pytest.mark.chaos
+def test_partition_event_is_deterministic():
+    """scenarios: partition/crash events are pure functions of
+    (spec, seed, identity) — the chaos e2e's kill point replays."""
+    spec = ScenarioSpec(
+        name="det",
+        control=ControlPlaneSpec(
+            scheduler_crash_rate=0.7, partition_rate=0.3,
+            crash_epoch_rounds=5, partition_epoch_rounds=4,
+        ),
+    )
+
+    class H:
+        def __init__(self, i):
+            self.id = f"h{i}"
+            self.idc = "idc"
+            self.location = "z|r"
+
+    hosts = [H(i) for i in range(32)]
+    a = ScenarioEngine(spec, hosts, seed=3)
+    b = ScenarioEngine(spec, hosts, seed=3)
+    assert [a.scheduler_crashed(r) for r in range(40)] == \
+           [b.scheduler_crashed(r) for r in range(40)]
+    assert [a.partitioned_hosts(r) for r in range(40)] == \
+           [b.partitioned_hosts(r) for r in range(40)]
+    assert a.scheduler_crash_point(0, 20) == b.scheduler_crash_point(0, 20)
+    assert a.schedule_digest() == b.schedule_digest()
+    c = ScenarioEngine(spec, hosts, seed=4)
+    assert [c.partitioned_hosts(r) for r in range(40)] != \
+           [a.partitioned_hosts(r) for r in range(40)]

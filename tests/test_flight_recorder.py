@@ -18,6 +18,7 @@ from dragonfly2_tpu.telemetry.series import (
     jit_series,
     manager_series,
     register_version,
+    resilience_series,
     scheduler_series,
     trainer_series,
 )
@@ -208,6 +209,7 @@ def test_metric_naming_convention_registry_walk():
     jit_series(reg, "trainer")
     for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
         register_version(reg, svc)
+        resilience_series(reg, svc)  # breaker-state + deadline families
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
         r"^dragonfly_(scheduler|dfdaemon|manager|trainer)_[a-z0-9_]+$"

@@ -273,7 +273,8 @@ def test_partially_undelivered_preheat_expires():
 def test_sync_client_caches_dial_failure_for_one_round(monkeypatch):
     """A dead scheduler must cost ONE dial timeout per preheat round, not
     one per task: after a failed dial, SyncSchedulerClient fast-fails
-    without re-dialing until the failure marker expires."""
+    without re-dialing until its circuit breaker (which generalized the
+    old dial-failure TTL marker) half-opens for a probe."""
     import pytest
 
     from dragonfly2_tpu.rpc.client import SyncSchedulerClient
@@ -290,29 +291,47 @@ def test_sync_client_caches_dial_failure_for_one_round(monkeypatch):
     with pytest.raises(ConnectionError):
         client.call(msg.TaskStatesRequest(task_ids=["t"]))
     assert len(dials) == 1
-    # the whole rest of the fan-out round fast-fails on the cached marker
+    # the whole rest of the fan-out round fast-fails on the open breaker
     for _ in range(20):
-        with pytest.raises(ConnectionError, match="fast-failing"):
+        with pytest.raises(ConnectionError, match="circuit open"):
             client.call(msg.TaskStatesRequest(task_ids=["t"]))
     assert len(dials) == 1
 
-    # marker expiry re-dials (simulate the TTL passing)
-    client._dial_failed_at -= 31.0
+    # breaker ttl expiry half-opens and re-dials (simulate the TTL passing)
+    client.breakers.get(client._target)._opened_at -= 31.0
     with pytest.raises(ConnectionError):
         client.call(msg.TaskStatesRequest(task_ids=["t"]))
     assert len(dials) == 2
 
-    # a SUCCESSFUL dial clears the marker so mid-call errors keep their
-    # existing redial-on-next-call semantics
+    # a SUCCESSFUL dial (half-open probe answered SERVING) closes the
+    # breaker, so mid-call errors keep their existing redial-on-next-call
+    # semantics instead of opening it
+    from dragonfly2_tpu.rpc import mux, resilience, wire
+
     class _Sock:
-        def sendall(self, *a):
+        """Answers the half-open health probe, then breaks mid-call."""
+
+        def __init__(self):
+            self._probe_reply = b""
+            self._sent = 0
+
+        def sendall(self, data):
+            self._sent += 1
+            if self._sent == 1:  # the health probe
+                self._probe_reply = wire.encode(mux.HealthCheckResponse())
+                return
             raise OSError("broken pipe")
+
+        def recv(self, n):
+            chunk, self._probe_reply = self._probe_reply[:n], self._probe_reply[n:]
+            return chunk
 
         def close(self):
             pass
 
     monkeypatch.setattr(client, "_connect", lambda: _Sock())
-    client._dial_failed_at -= 31.0
-    with pytest.raises(ConnectionError):
+    client.breakers.get(client._target)._opened_at -= 31.0
+    with pytest.raises(ConnectionError, match="broken pipe"):
         client.call(msg.TaskStatesRequest(task_ids=["t"]))
-    assert client._dial_failed_at == 0.0  # mid-call error, not a dial failure
+    # mid-call error, not a dial failure: the breaker stays closed
+    assert client.breakers.get(client._target).state == resilience.CLOSED

@@ -1,0 +1,526 @@
+"""Failure-domain resilience: deadline budgets, circuit breakers, and the
+hashring failover order (rpc/resilience.py + the clients/servers that wire
+it). The chaos e2e lives in tests/test_chaos_failover.py; these pin the
+primitives and the acceptance bound that a blackholed scheduler costs
+bounded time."""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.rpc import resilience, wire
+from dragonfly2_tpu.rpc.client import (
+    SchedulerClientPool,
+    SyncSchedulerClient,
+)
+from dragonfly2_tpu.rpc.server import SchedulerRPCServer
+from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry.series import resilience_series
+from dragonfly2_tpu.utils import dferrors, retry
+from dragonfly2_tpu.utils.hashring import HashRing
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_deadline_scope_nests_to_the_minimum():
+    assert resilience.remaining() is None
+    with resilience.deadline(10.0):
+        outer = resilience.remaining()
+        assert outer is not None and 9.0 < outer <= 10.0
+        with resilience.deadline(1.0):
+            inner = resilience.remaining()
+            assert inner is not None and inner <= 1.0
+            # a callee can only SHRINK the budget it was handed
+            with resilience.deadline(60.0):
+                assert resilience.remaining() <= 1.0
+        assert resilience.remaining() > 1.0  # inner scope popped
+    assert resilience.remaining() is None
+
+
+def test_deadline_check_and_bound_timeout():
+    with resilience.deadline(-1.0):  # already expired
+        assert resilience.expired()
+        with pytest.raises(dferrors.DeadlineExceeded):
+            resilience.check("unit")
+    with resilience.deadline(0.5):
+        assert resilience.bound_timeout(5.0) <= 0.5
+        assert resilience.bound_timeout(0.1) <= 0.1
+    assert resilience.bound_timeout(5.0) == 5.0
+    assert resilience.bound_timeout(None) is None
+
+
+def test_wire_envelope_carries_remaining_budget():
+    wire.register_messages(msg.StatTaskRequest)
+    # no ambient scope, no extra bytes -> no attribute after decode
+    framed = wire.encode(msg.StatTaskRequest(task_id="t"))
+    assert not hasattr(wire.decode(framed[4:]), "deadline_s")
+    with resilience.deadline(2.0):
+        framed = wire.encode(msg.StatTaskRequest(task_id="t"))
+    decoded = wire.decode(framed[4:])
+    assert 0.0 < decoded.deadline_s <= 2.0
+    # explicit argument wins over the ambient scope, and is clamped at 0
+    with resilience.deadline(30.0):
+        framed = wire.encode(msg.StatTaskRequest(task_id="t"), deadline_s=-3.0)
+    assert wire.decode(framed[4:]).deadline_s == 0.0
+
+
+def test_deadline_budget_decrements_across_hops():
+    """Receiver re-anchors the relative budget; time spent inside the hop
+    is gone from the budget its onward frames carry."""
+    wire.register_messages(msg.StatTaskRequest)
+    with resilience.deadline(0.5):
+        hop1 = wire.decode(wire.encode(msg.StatTaskRequest(task_id="t"))[4:])
+    with resilience.deadline(hop1.deadline_s):
+        time.sleep(0.1)  # the hop "works" for 100ms
+        hop2 = wire.decode(wire.encode(msg.StatTaskRequest(task_id="t"))[4:])
+    assert hop2.deadline_s < hop1.deadline_s - 0.05
+
+
+def test_server_sheds_expired_work_and_counts_it(tmp_path):
+    """A sheddable frame arriving with a spent budget never reaches the
+    service: scheduling requests get a DeadlineExceeded ScheduleFailure,
+    stats are silently dropped, lifecycle mutations (LeavePeer) are NEVER
+    shed, and dragonfly_scheduler_rpc_deadline_shed_total counts every
+    shed (the tier-1 naming sweep covers the family itself)."""
+
+    async def run():
+        service = SchedulerService()
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        shed_metric = server.resilience_metrics.deadline_shed
+        resched_before = shed_metric.value("RescheduleRequest")
+        stat_before = shed_metric.value("StatPeerRequest")
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            # expired budget + scheduling request -> shed with an
+            # explicit failure so the conductor fails fast
+            writer.write(wire.encode(
+                msg.RescheduleRequest(peer_id="peer-x"), deadline_s=0.0
+            ))
+            await writer.drain()
+            response = await asyncio.wait_for(wire.read_frame(reader), 5)
+            assert isinstance(response, msg.ScheduleFailure)
+            assert response.code == "DeadlineExceeded"
+            assert shed_metric.value("RescheduleRequest") == resched_before + 1
+            # expired stat -> silently dropped (the caller's own budget
+            # enforcement already aborted), but counted
+            writer.write(wire.encode(
+                msg.StatPeerRequest(peer_id="peer-x"), deadline_s=0.0
+            ))
+            await writer.drain()
+            # expired LeavePeer -> NOT shed: lifecycle mutations execute
+            # regardless of budget (dropping a leave would leak state)
+            writer.write(wire.encode(
+                msg.LeavePeerRequest(peer_id="peer-x"), deadline_s=0.0
+            ))
+            await writer.drain()
+            # live budget -> dispatched normally (also proves the two
+            # frames above were consumed in order without a reply)
+            writer.write(wire.encode(
+                msg.StatPeerRequest(peer_id="peer-x"), deadline_s=5.0
+            ))
+            await writer.drain()
+            response = await asyncio.wait_for(wire.read_frame(reader), 5)
+            assert isinstance(response, msg.StatResponse)
+            assert shed_metric.value("StatPeerRequest") == stat_before + 1
+            assert shed_metric.value("LeavePeerRequest") == 0
+            writer.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_sync_client_enforces_ambient_deadline():
+    client = SyncSchedulerClient("127.0.0.1", 1)  # never dialed
+    with resilience.deadline(-1.0):
+        with pytest.raises(dferrors.DeadlineExceeded):
+            client.call(msg.StatTaskRequest(task_id="t"))
+
+
+# -------------------------------------------------------------- breakers
+
+
+def test_breaker_state_machine():
+    transitions = []
+    b = resilience.CircuitBreaker(
+        "t:1", failure_threshold=2, open_ttl=0.05,
+        on_transition=lambda target, state: transitions.append(state),
+    )
+    assert b.state == resilience.CLOSED
+    assert b.acquire() == resilience.CLOSED
+    b.record_failure()
+    assert b.state == resilience.CLOSED  # below threshold
+    b.record_failure()
+    assert b.state == resilience.OPEN
+    with pytest.raises(resilience.BreakerOpen):
+        b.acquire()
+    # BreakerOpen doubles as ConnectionError AND Unavailable for callers
+    with pytest.raises(ConnectionError):
+        b.acquire()
+    time.sleep(0.06)
+    assert b.state == resilience.HALF_OPEN
+    assert b.acquire() == resilience.HALF_OPEN  # the single probe slot
+    with pytest.raises(resilience.BreakerOpen):
+        b.acquire()  # second caller does not get a probe
+    b.record_failure()  # probe failed -> re-open
+    assert b.state == resilience.OPEN
+    time.sleep(0.06)
+    assert b.acquire() == resilience.HALF_OPEN
+    b.record_success()
+    assert b.state == resilience.CLOSED
+    assert transitions == ["open", "half_open", "open", "half_open", "closed"]
+
+
+def test_breaker_board_metrics_and_drop():
+    board = resilience.BreakerBoard("manager", failure_threshold=1, open_ttl=9)
+    b = board.get("10.0.0.9:8002")
+    b.record_failure()
+    assert board.metrics.breaker_state.value("10.0.0.9:8002") == 2.0
+    with pytest.raises(resilience.BreakerOpen):
+        board.acquire("10.0.0.9:8002")
+    assert board.metrics.breaker_fast_fail.value("10.0.0.9:8002") == 1
+    board.drop("10.0.0.9:8002")
+    assert "10.0.0.9:8002" not in board.targets()
+    assert board.metrics.breaker_state.value("10.0.0.9:8002") == 0.0
+
+
+def _blackhole_listener():
+    """A listener whose accept queue is full: connects hang in the SYN/
+    accept backlog — the closest a unit test gets to a blackholed host."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(0)
+    fillers = []
+    for _ in range(2):  # saturate the tiny backlog
+        s = socket.socket()
+        s.setblocking(False)
+        try:
+            s.connect_ex(srv.getsockname())
+        except OSError:
+            pass
+        fillers.append(s)
+    time.sleep(0.05)
+    return srv, fillers
+
+
+def test_blackholed_scheduler_costs_bounded_time():
+    """Acceptance bound: once the breaker is open, 50 consecutive calls
+    finish in under 2x ONE dial timeout total — against ~50 full dial
+    timeouts without the breaker."""
+    srv, fillers = _blackhole_listener()
+    host, port = srv.getsockname()
+    dial_timeout = 0.5
+    client = SyncSchedulerClient(host, port, timeout=dial_timeout,
+                                 dial_failure_ttl=30.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            client.call(msg.StatTaskRequest(task_id="t"))  # pays the dial
+        first_cost = time.monotonic() - t0
+        assert client.breakers.get(f"{host}:{port}").state == resilience.OPEN
+        t0 = time.monotonic()
+        for _ in range(50):
+            with pytest.raises(ConnectionError):
+                client.call(msg.StatTaskRequest(task_id="t"))
+        fifty_cost = time.monotonic() - t0
+        assert fifty_cost < 2 * dial_timeout, (
+            f"50 calls took {fifty_cost:.2f}s with the breaker open "
+            f"(first dial cost {first_cost:.2f}s)"
+        )
+    finally:
+        client.close()
+        for s in fillers:
+            s.close()
+        srv.close()
+
+
+def test_sync_client_half_open_probe_uses_health_request(tmp_path):
+    """After open_ttl the first call runs as the half-open probe: it must
+    send HealthCheckRequest on the fresh socket and only then the real
+    call — a recovered scheduler closes the breaker, and the real request
+    still succeeds on the same connection."""
+
+    async def run():
+        service = SchedulerService()
+        server = SchedulerRPCServer(service, tick_interval=0.01)
+        host, port = await server.start()
+        try:
+            client = SyncSchedulerClient(host, port, timeout=2.0,
+                                         dial_failure_ttl=0.05)
+            breaker = client.breakers.get(f"{host}:{port}")
+            breaker.record_failure()  # threshold=1 -> open
+            assert breaker.state == resilience.OPEN
+            with pytest.raises(ConnectionError):
+                await asyncio.to_thread(
+                    client.call, msg.StatTaskRequest(task_id="t")
+                )
+            await asyncio.sleep(0.06)  # open_ttl elapses -> half-open
+            response = await asyncio.to_thread(
+                client.call, msg.StatTaskRequest(task_id="t")
+            )
+            assert isinstance(response, msg.StatResponse)
+            assert breaker.state == resilience.CLOSED
+            client.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------------- hashring failover
+
+
+def test_hashring_successors_order_and_coverage():
+    ring = HashRing([f"10.0.0.{i}:8002" for i in range(5)])
+    order = ring.successors("task-abc")
+    assert order[0] == ring.pick("task-abc")
+    assert sorted(order) == sorted(ring.nodes())  # all nodes, no dupes
+    assert order == ring.successors("task-abc")  # deterministic
+    assert ring.successors("task-abc", limit=2) == order[:2]
+    # removing the primary promotes the old second — failover lands where
+    # the task would live anyway after the primary leaves the ring
+    primary, second = order[0], order[1]
+    ring.remove(primary)
+    assert ring.pick("task-abc") == second
+    assert HashRing([]).successors("x") == []
+
+
+def test_pool_for_task_fails_over_to_next_ring_node():
+    """Primary dead -> for_task returns a connection to the next ring
+    node; the primary's breaker opens so later calls skip its dial."""
+
+    async def run():
+        s1 = SchedulerRPCServer(SchedulerService(), tick_interval=0.05)
+        s2 = SchedulerRPCServer(SchedulerService(), tick_interval=0.05)
+        addr1 = await s1.start()
+        addr2 = await s2.start()
+        pool = SchedulerClientPool([addr1, addr2],
+                                   breaker_failure_threshold=1)
+        task_id = "task-failover-unit"
+        primary = pool.primary_for_task(task_id)
+        primary_server, backup_addr = (
+            (s1, addr2) if primary == f"{addr1[0]}:{addr1[1]}" else (s2, addr1)
+        )
+        try:
+            await primary_server.stop()  # kill the primary BEFORE any dial
+            conn = await pool.for_task(task_id)
+            assert f"{conn.host}:{conn.port}" == f"{backup_addr[0]}:{backup_addr[1]}"
+            assert pool.breakers.get(primary).state == resilience.OPEN
+            # with the breaker open the failover is skip-cost: 50 calls
+            # must not pay 50 dial attempts
+            t0 = time.monotonic()
+            for _ in range(50):
+                conn = await pool.for_task(task_id)
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            await pool.close()
+            await s1.stop()
+            await s2.stop()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- retry satellites
+
+
+def test_retry_full_jitter_spreads_backoff():
+    import random
+
+    sleeps: list[float] = []
+
+    def always_fail():
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        retry.run(always_fail, init_backoff=1.0, max_backoff=8.0,
+                  max_attempts=5, sleep=sleeps.append,
+                  rng=random.Random(7))
+    assert len(sleeps) == 4
+    caps = [1.0, 2.0, 4.0, 8.0]
+    assert all(0.0 <= s <= cap for s, cap in zip(sleeps, caps))
+    # full jitter: draws are not the deterministic ladder
+    assert sleeps != caps
+    other: list[float] = []
+    with pytest.raises(OSError):
+        retry.run(always_fail, init_backoff=1.0, max_backoff=8.0,
+                  max_attempts=5, sleep=other.append,
+                  rng=random.Random(8))
+    assert other != sleeps
+
+
+def test_retry_aborts_on_non_retryable_dferrors():
+    calls = {"n": 0}
+
+    def bad_request():
+        calls["n"] += 1
+        raise dferrors.InvalidArgument("malformed")
+
+    with pytest.raises(dferrors.InvalidArgument):
+        retry.run(bad_request, init_backoff=0.001, max_attempts=5)
+    assert calls["n"] == 1  # no attempts burned on a caller bug
+
+    calls["n"] = 0
+
+    def unauthenticated():
+        calls["n"] += 1
+        raise dferrors.Unauthenticated("bad cert")
+
+    with pytest.raises(dferrors.Unauthenticated):
+        retry.run(unauthenticated, init_backoff=0.001, max_attempts=5)
+    assert calls["n"] == 1
+
+    # retryable DFErrors (Unavailable) still burn attempts
+    calls["n"] = 0
+
+    def unavailable():
+        calls["n"] += 1
+        raise dferrors.Unavailable("down")
+
+    with pytest.raises(dferrors.Unavailable):
+        retry.run(unavailable, init_backoff=0.001, max_attempts=3)
+    assert calls["n"] == 3
+
+    # the Cancel contract survives the predicate
+    def cancelled():
+        raise retry.Cancel(ValueError("fatal"))
+
+    with pytest.raises(ValueError, match="fatal"):
+        retry.run(cancelled, init_backoff=0.001, max_attempts=5)
+
+
+def test_breaker_release_frees_probe_without_verdict():
+    """A cancelled dial is not evidence against the target: release()
+    must free the half-open probe slot without opening the breaker, and
+    must not reset the failure count a real refusal would add to."""
+    b = resilience.CircuitBreaker("t:1", failure_threshold=2, open_ttl=0.05)
+    b.acquire()
+    b.release()  # cancelled while CLOSED: state untouched
+    assert b.state == resilience.CLOSED
+    b.record_failure()
+    b.record_failure()
+    time.sleep(0.06)
+    assert b.acquire() == resilience.HALF_OPEN
+    b.release()  # probe cancelled: slot freed, breaker NOT re-opened
+    assert b.acquire() == resilience.HALF_OPEN  # next caller can probe
+    b.record_success()
+    assert b.state == resilience.CLOSED
+
+
+def test_record_outcome_classification_and_sync_probe_wedge():
+    """record_outcome is the single shared classifier for all three dial
+    sites: transport failures advance the breaker, anything else only
+    frees the probe slot. In particular a garbled half-open probe reply
+    (wire.decode TypeError) must not wedge SyncSchedulerClient's breaker
+    in HALF_OPEN-with-held-probe forever."""
+    board = resilience.BreakerBoard("manager", failure_threshold=1, open_ttl=0.05)
+    board.get("t:9").acquire()
+    board.record_outcome("t:9", TypeError("garbled frame"))
+    assert board.get("t:9").state == resilience.CLOSED  # not a failure
+    board.record_outcome("t:9", ConnectionRefusedError())
+    assert board.get("t:9").state == resilience.OPEN
+    time.sleep(0.06)
+    assert board.get("t:9").acquire() == resilience.HALF_OPEN
+    # probe outcome is a codec error -> slot freed, breaker NOT stuck
+    board.record_outcome("t:9", TypeError("garbled frame"))
+    assert board.get("t:9").acquire() == resilience.HALF_OPEN
+    board.record_outcome("t:9", None)
+    assert board.get("t:9").state == resilience.CLOSED
+
+    # end to end: a server answering the half-open probe with garbage
+    # must leave the sync client able to retry (no permanent BreakerOpen)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def garbled_server():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                conn.recv(4096)  # the probe frame
+                conn.sendall((999999).to_bytes(4, "big") * 2)  # bad frame
+                conn.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=garbled_server, daemon=True)
+    t.start()
+    host, port = srv.getsockname()
+    client = SyncSchedulerClient(host, port, timeout=1.0, dial_failure_ttl=0.05)
+    breaker = client.breakers.get(f"{host}:{port}")
+    breaker.record_failure()  # open (threshold 1)
+    time.sleep(0.06)  # -> half-open
+    with pytest.raises(ConnectionError):
+        client.call(msg.StatTaskRequest(task_id="t"))  # probe gets garbage
+    # the probe settled: the slot is free, the NEXT ttl window can probe
+    # again instead of BreakerOpen-forever
+    assert breaker.state in (resilience.OPEN, resilience.HALF_OPEN, resilience.CLOSED)
+    assert breaker.allows() or breaker.state == resilience.OPEN
+    client.close()
+    srv.close()
+
+
+def test_register_adoption_priority_contract():
+    """Mid-task re-announce adoption: a priority-0 conductor carrying
+    every piece stays QUEUED (its conductor blocks on the response
+    stream — silence would strand it for schedule_timeout), while a
+    priority-1 fire-and-forget announce of a fully-cached task goes
+    straight to Succeeded and is never scheduled."""
+    from dragonfly2_tpu.state.fsm import PeerState
+
+    svc = SchedulerService()
+    host = msg.HostInfo(host_id="h-1", hostname="n", ip="10.0.0.1")
+    pieces = list(range(4))
+    # priority 1: the seed's completed-task announce -> adopted parent
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="seed-peer", task_id="t-1", host=host, url="http://o/x",
+        content_length=4 * (4 << 20), total_piece_count=4,
+        priority=1, finished_pieces=pieces,
+    ))
+    idx = svc.state.peer_index("seed-peer")
+    assert int(svc.state.peer_state[idx]) == int(PeerState.SUCCEEDED)
+    assert int(svc.state.peer_finished_count[idx]) == 4
+    assert "seed-peer" not in svc._pending
+    # priority 0: a conductor re-announcing all pieces after failover
+    # must still get a response from the tick, so it stays pending
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="child-peer", task_id="t-1", host=host, url="http://o/x",
+        content_length=4 * (4 << 20), total_piece_count=4,
+        finished_pieces=pieces,
+    ))
+    cidx = svc.state.peer_index("child-peer")
+    assert int(svc.state.peer_finished_count[cidx]) == 4  # adopted
+    assert "child-peer" in svc._pending  # but not silently finalized
+    # partial re-announce: adopted pieces recorded, peer queued
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="partial-peer", task_id="t-1", host=host, url="http://o/x",
+        content_length=4 * (4 << 20), total_piece_count=4,
+        finished_pieces=[0, 2],
+    ))
+    pidx = svc.state.peer_index("partial-peer")
+    assert int(svc.state.peer_finished_count[pidx]) == 2
+    assert "partial-peer" in svc._pending
+
+
+def test_resilience_series_passes_naming_convention():
+    """The new families ride the same tier-1 sweep as every other series
+    (test_flight_recorder.test_metric_naming_convention_registry_walk
+    walks them too); this pins idempotent re-registration."""
+    from dragonfly2_tpu.telemetry import metrics as m
+
+    reg = m.Registry()
+    first = resilience_series(reg, "dfdaemon")
+    again = resilience_series(reg, "dfdaemon")
+    assert first.breaker_state is again.breaker_state
+    for name, metric in reg._metrics.items():
+        assert name.startswith("dragonfly_dfdaemon_rpc_")
+        assert metric.help.strip()

@@ -23,6 +23,15 @@ from dragonfly2_tpu.utils import certs
 
 from test_minicluster import _CountingFileServer
 
+# Without the cryptography package every test here dies in
+# certs._require_crypto — and worse, the mTLS e2e used to die BEFORE its
+# try/finally, leaking its origin listener into the whole session (the
+# conftest resource-leak guard flags exactly that). Skip loudly instead.
+pytestmark = pytest.mark.skipif(
+    not certs._HAVE_CRYPTO,
+    reason="TLS tests need the 'cryptography' package",
+)
+
 
 def test_ca_csr_sign_roundtrip(tmp_path):
     ca_cert, ca_key = certs.generate_ca()

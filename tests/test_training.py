@@ -122,6 +122,44 @@ def test_checkpoint_roundtrip(tmp_path, mlp_data):
     ckpt.close()
 
 
+def test_checkpoint_restore_survives_interrupted_save(tmp_path):
+    """Crash-safety satellite: a save interrupted mid-write (SIGKILL
+    between the array files and the commit) leaves a torn step directory.
+    restore() must fall back to the previous INTACT step instead of
+    loading — or dying on — the half-written one; asking for the torn
+    step EXPLICITLY still raises."""
+    import pathlib
+
+    import numpy as np
+
+    ckpt = TrainCheckpointer(tmp_path / "ckpt", max_to_keep=5)
+    state1 = {"params": {"w": np.arange(8, dtype=np.float32)}, "step": 1}
+    state2 = {"params": {"w": np.arange(8, dtype=np.float32) * 2}, "step": 2}
+    ckpt.save(1, state1)
+    ckpt.save(2, state2)
+    assert ckpt.latest_step() == 2
+
+    # simulate the interrupt: gut step 2's payload files, keeping the
+    # directory so the manager still lists the step
+    step_dir = pathlib.Path(tmp_path / "ckpt" / "2")
+    assert step_dir.is_dir()
+    for path in sorted(step_dir.rglob("*"), reverse=True):
+        if path.is_file():
+            path.write_bytes(b"")  # torn write: zero-length payloads
+
+    restored = ckpt.restore(template=state1)
+    assert restored is not None, "restore() found no intact checkpoint"
+    assert int(restored["step"]) == 1, (
+        f"restore() returned step {restored['step']} from a torn checkpoint"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), state1["params"]["w"]
+    )
+    with pytest.raises(Exception):
+        ckpt.restore(step=2, template=state1)  # explicit step stays loud
+    ckpt.close()
+
+
 def test_train_resumes_from_checkpoint(tmp_path, mlp_data):
     """Kill-and-restart resume: a second train call with the same
     checkpointer picks up at the next epoch instead of restarting, and a
