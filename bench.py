@@ -623,6 +623,22 @@ def main() -> int:
             summary["loop_pieces_per_sec"] = leg.get("value")
         elif m == "full_loop_tick_p50_ms":
             summary["loop_tick_p50_ms"] = leg.get("value")
+            # pipelined-tick acceptance: host work overlapped with
+            # in-flight device calls, as a share of in-flight wall
+            overlap = (leg.get("phases_p50_ms") or {}).get("overlap_pct")
+            if overlap is not None:
+                summary["loop_overlap_pct"] = overlap
+        elif m == "full_loop_ml_tick_p50_ms":
+            # off-critical-path refresh acceptance: time refresh stalled
+            # the ml arm's serving (r05: 4.98 s) + ml/default throughput
+            # gap on identical selections (r05: 2.5x)
+            summary["embed_refresh_blocking_s"] = leg.get(
+                "embed_refresh_blocking_s"
+            )
+            # key spells the division out: default_pps / ml_pps, <= 1.5
+            # is the acceptance bar (the sibling ab_ml_vs_default_cost
+            # has the OPPOSITE polarity — >= 1 means ml better)
+            summary["pps_default_over_ml"] = leg.get("pieces_per_sec_vs_default")
         elif m == "full_loop_ab_piece_cost_ms":
             summary["ab_ml_vs_default_cost"] = leg.get("ml_vs_default")
         elif m == "full_loop_trainer_wall_s":

@@ -35,8 +35,8 @@ import numpy as np
 def _make_control():
     """Trivial jitted dispatch, timed by forced D2H like every other
     number here: its wall time is one link round-trip + negligible
-    compute, so alongside device_call it separates tunnel RTT from real
-    device work in the phase breakdown (VERDICT r4 next #5)."""
+    compute, so alongside dispatch + d2h_wait it separates tunnel RTT
+    from real device work in the phase breakdown (VERDICT r4 next #5)."""
     import jax
 
     control_in = jax.device_put(np.ones((8, 128), np.float32))
@@ -169,14 +169,17 @@ def run(
         "p95": round(sorted(tick_ms)[int(0.95 * len(tick_ms))], 3),
         "ticks": len(tick_ms),
         # Per-phase p50 breakdown (VERDICT r3 weak #5): host work vs the
-        # device conversation. device_call includes the H2D of the single
-        # packed buffer, the dispatch, and the D2H of the selection — on
-        # the tunneled dev TPU a degraded window puts a ~100 ms round-trip
-        # floor under it that no host-side work can remove. The
-        # control_dispatch phase (VERDICT r4 next #5) is a trivial jitted
-        # x+1 timed the same way each tick: it carries ONLY the link
-        # round-trip, so device_call − control_dispatch ≈ the tick
-        # kernel's real compute+transfer cost.
+        # device conversation. The pipelined tick (PR 4) splits the old
+        # device_call into `dispatch` (pack -> async device call issued)
+        # and `d2h_wait` (blocked on the packed selection's D2H) — on the
+        # tunneled dev TPU a degraded window puts a ~100 ms round-trip
+        # floor under d2h_wait that only OVERLAP can hide: multi-chunk
+        # ticks run chunk i's bookkeeping while chunk i+1 executes
+        # (`overlap` phase; `overlap_pct` summarizes the hidden share).
+        # The control_dispatch phase (VERDICT r4 next #5) is a trivial
+        # jitted x+1 timed the same way each tick: it carries ONLY the
+        # link round-trip, so (dispatch + d2h_wait) − control_dispatch ≈
+        # the tick kernel's real compute+transfer cost.
         "phases_p50_ms": _phase_p50(svc, control_ms),
     })
 
@@ -294,24 +297,36 @@ def run(
             svc_arm, num_hosts=args.hosts, num_tasks=ab_tasks, seed=2
         )
         on_round = None
-        refresh_s = [0.0]
         if ml_arm is not None:
             # Embeddings over THIS service's state and OBSERVED download
             # graph (serving_graph_arrays): the GNN's quality signal rides
             # the edges, so they refresh every few rounds as history
             # accumulates — the same maintenance the live launcher runs.
-            # The initial (edge-less) refresh warms the jit and lets ml
-            # serve from round 1.
-            def _refresh(svc=svc_arm, ml=ml_arm):
-                t = time.perf_counter()
-                ml.refresh_embeddings(svc.serving_graph_arrays())
-                refresh_s[0] += time.perf_counter() - t
+            # The initial (edge-less) refresh is synchronous so the jit is
+            # warm and ml serves from round 1; every periodic refresh runs
+            # on the evaluator's background worker (wait=False) — the
+            # replay loop only pays the enqueue, and the worker recomputes
+            # just the dirty hosts' neighborhoods when the frontier is
+            # small. r05 spent 4.98 s of the ml arm's 7.01 s wall blocked
+            # in these refreshes; embed_refresh_blocking_s is that number
+            # after the move off the critical path (expected ~0).
+            ml_arm.refresh_embeddings(svc_arm.serving_graph_arrays(), wait=True)
+            # the warm refresh above runs BEFORE the replay (like
+            # svc.warmup(): compile + first commit, nobody is being
+            # served yet) — blocking_s measures stalls DURING serving,
+            # and compute_s what the WORKER absorbed during it (the warm
+            # refresh's compile-heavy compute ran inline, on this thread)
+            ml_arm.refresh_blocking_s = 0.0
+            ml_arm.refresh_compute_s = 0.0
+            # counts reset with the timers: embed_refresh_count must
+            # cover the same refreshes the blocking/background seconds
+            # sum over, or per-refresh averages from the artifact skew
+            ml_arm.refresh_count = 0
+            ml_arm.incremental_refresh_count = 0
 
-            _refresh()
-
-            def on_round(r):
+            def on_round(r, svc=svc_arm, ml=ml_arm):
                 if r % 10 == 0:
-                    _refresh()
+                    ml.refresh_embeddings(svc.serving_graph_arrays())
 
         wall_arm, tick_arm, _, _ = replay(
             svc_arm, sim_arm, ab_target, args.downloads_per_round,
@@ -319,6 +334,19 @@ def run(
         )
         st = sim_arm.stats
         tick_by_arm[arm] = (svc_arm, tick_arm)
+        if ml_arm is not None:
+            # drain + join the worker BEFORE reading its stats: no
+            # refresh is mid-flight or silently dropped at capture time.
+            # NOTE the async refresh makes the ml arm's numbers timing-
+            # sensitive, not just ±1 on refresh_count: WHICH tick first
+            # serves a committed snapshot depends on worker scheduling,
+            # so ml selections (and this leg's ab_ml_vs_default_cost)
+            # can vary slightly run-to-run. That is the honest price of
+            # measuring the async path this bench exists to measure —
+            # embed_refresh_blocking_s ≈ 0 only holds with wait=False.
+            # The DETERMINISM-pinned ml-vs-rule artifact is the scenario
+            # matrix (scenarios/ab.py), which keeps wait=True.
+            ml_arm.close(drain=True)
         ab[arm] = {
             "mean_piece_cost_ms": round(
                 st.piece_cost_ns_total / max(st.pieces, 1) / 1e6, 3
@@ -329,10 +357,20 @@ def run(
             "back_to_source": st.back_to_source,
             "back_to_source_starved": st.back_to_source_starved,
             "back_to_source_with_parents": st.back_to_source_with_parents,
-            # wall INCLUDES the ml arm's periodic embedding refreshes (a
-            # live ml scheduler pays them); their cost is itemized
+            # wall still INCLUDES whatever refresh time stalled the replay
+            # thread; the blocking/background split below shows the
+            # background worker absorbed the compute
             "wall_s": round(wall_arm, 2),
-            **({"embed_refresh_s": round(refresh_s[0], 2)} if refresh_s[0] else {}),
+            **({
+                # time refresh_embeddings actually STALLED the replay
+                # thread (enqueue + the one synchronous warm refresh) vs
+                # the compute the background worker absorbed, and how many
+                # refreshes took the incremental dirty-frontier path
+                "embed_refresh_blocking_s": round(ml_arm.refresh_blocking_s, 3),
+                "embed_refresh_background_s": round(ml_arm.refresh_compute_s, 2),
+                "embed_refresh_count": ml_arm.refresh_count,
+                "embed_refresh_incremental": ml_arm.incremental_refresh_count,
+            } if ml_arm is not None else {}),
         }
 
     svc_ml2, tick_ml = tick_by_arm["ml"]
@@ -342,6 +380,16 @@ def run(
         "unit": "ms",
         "pieces_per_sec": ab["ml"]["pieces_per_sec"],
         "pieces": ab["ml"]["pieces"],
+        # ml vs default serving throughput on the same seeded workload —
+        # the acceptance ratio for the off-critical-path refresh (r05:
+        # 2.5x). Not exactly-identical selections: the ml arm's async
+        # refresh commit timing can shift which tick first serves a new
+        # snapshot (see the close(drain=True) note above).
+        "pieces_per_sec_vs_default": round(
+            ab["default"]["pieces_per_sec"]
+            / max(ab["ml"]["pieces_per_sec"], 1e-9), 3
+        ),
+        "embed_refresh_blocking_s": ab["ml"].get("embed_refresh_blocking_s"),
         "phases_p50_ms": _phase_p50(svc_ml2),
     })
     results.append({
@@ -370,8 +418,27 @@ def _phase_p50(svc, control_ms: list[float] | None = None) -> dict:
     (telemetry/flight.PhaseRecorder — the same ring that feeds the
     Prometheus phase histogram, so bench numbers and production metrics
     cannot diverge), plus the per-tick trivial-dispatch control when one
-    was timed."""
+    was timed.
+
+    The pipelined tick reports `dispatch` (pack -> async device call
+    issued) and `d2h_wait` (blocked on the packed selection) instead of
+    the old monolithic device_call; multi-chunk ticks also record
+    `overlap` — host work done inside the pipelined window, i.e. between
+    dispatching a chunk and blocking on it, where the pre-pipeline tick
+    would have sat in a D2H wait instead. (The dispatched call may
+    complete before the host work does — `overlap` measures time the
+    host spent NOT blocked, not device latency hidden; `d2h_wait` is the
+    residual blocking, so the two partition the pipelined window.)
+    `overlap_pct` = overlap / (overlap + d2h_wait): the share of that
+    window the host spent working rather than waiting. Computed over the
+    SUM across retained ticks (not a ratio of medians: overlap is zero
+    on single-chunk ticks, and the median would hide a bimodal mix)."""
     out = svc.recorder.phase_p50s()
+    ticks = svc.recorder.snapshot()
+    overlap = sum(t.get("overlap", 0.0) for t in ticks)
+    waited = sum(t.get("d2h_wait", 0.0) for t in ticks)
+    if overlap + waited > 0:
+        out["overlap_pct"] = round(100.0 * overlap / (overlap + waited), 2)
     if control_ms:
         out["control_dispatch"] = round(statistics.median(control_ms), 3)
     return out

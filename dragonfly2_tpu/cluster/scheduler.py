@@ -31,6 +31,7 @@ from dragonfly2_tpu.cluster.probes import ProbeStore
 from dragonfly2_tpu.config.config import Config
 from dragonfly2_tpu.graph.dag import TaskDAG
 from dragonfly2_tpu.ops import evaluator as ev
+from dragonfly2_tpu.ops.segment import pad_pow2
 from dragonfly2_tpu.records.features import (
     host_numeric_features,
     idc_code,
@@ -178,6 +179,17 @@ class SchedulerService:
         self._serving_edge_cap = 1 << 20
         self._slot_owner: dict[int, str] = {}
         self._slot_gen: dict[int, int] = {}
+        # Incremental-embed dirty frontier: host slots whose embedding
+        # INPUTS changed since the last serving_graph_arrays() read — an
+        # accumulated serving edge touches both endpoints, a host
+        # re-announce may change its numeric features. The consumer
+        # (MLEvaluator's background refresh) recomputes only these hosts'
+        # k-hop in-neighborhoods when the frontier is small; structural
+        # changes (host leave, slot generation bump) force a full sync
+        # because the departed host's neighbors change without appearing
+        # in any dirty set.
+        self._dirty_host_slots: set[int] = set()
+        self._serving_full_sync = True
 
     # ============================================================ messages
 
@@ -230,9 +242,20 @@ class SchedulerService:
         # embedding refreshes cannot hand its previous occupant's
         # throughput history to the new host (the read-time alive filter
         # only catches slots observed dead AT refresh time).
-        if self._slot_owner.get(slot) != host.host_id:
+        prev_owner = self._slot_owner.get(slot)
+        if prev_owner != host.host_id:
             self._slot_owner[slot] = host.host_id
             self._slot_gen[slot] = self._slot_gen.get(slot, 0) + 1
+            if prev_owner is not None:
+                # RECYCLED slot: its old-generation edges vanish from the
+                # serving graph, which silently changes its NEIGHBORS'
+                # aggregates too — incremental embed can't see that. A
+                # first-time slot has no such ghosts: its row is dirtied
+                # below, future edges dirty both endpoints, and a table
+                # GROWN for it is caught by the refresh's shape guard —
+                # so plain joins stay on the incremental path.
+                self._serving_full_sync = True
+        self._dirty_host_slots.add(int(slot))  # numeric features may change
         return slot
 
     def leave_host(self, host_id: str) -> None:
@@ -244,6 +267,8 @@ class SchedulerService:
         self._host_info.pop(host_id, None)
         if host_id in self._seed_hosts:
             self._seed_hosts.remove(host_id)
+        # its serving edges die with it; neighbors' aggregates change
+        self._serving_full_sync = True
 
     def register_peer(self, req: msg.RegisterPeerRequest):
         """handleRegisterPeerRequest (+ handleResource): upsert host/task/
@@ -422,6 +447,10 @@ class SchedulerService:
                     if acc is not None:
                         acc[0] += req.length / (req.cost_ns / 1e9)
                         acc[1] += 1
+                        # the edge update changes BOTH endpoints' embedding
+                        # inputs — mark them for the incremental refresh
+                        self._dirty_host_slots.add(c_slot)
+                        self._dirty_host_slots.add(p_slot)
         return None
 
     def piece_failed(self, req: msg.DownloadPieceFailedRequest):
@@ -692,9 +721,16 @@ class SchedulerService:
         loc_l = fd["parent_location"].shape[-1]
         num_n = fd["numeric"].shape[-1]
         use_ml = self.ml_evaluator is not None and self.algorithm == "ml"
-        packed_parts = []
-        for s in range(0, b, _EVAL_BUCKETS[-1]):
-            e = min(s + _EVAL_BUCKETS[-1], b)
+        # Pin ONE serving snapshot for the whole tick: the background
+        # refresh may commit between two chunks of the same batch, and
+        # peers of one tick must be ranked against one embedding table
+        # (pinning None keeps later chunks on the fallback path too).
+        ml_snap = self.ml_evaluator.serving_snapshot() if use_ml else None
+
+        def _dispatch_chunk(s: int, e: int):
+            """Pack rows [s:e) and dispatch their device call WITHOUT
+            blocking on the result (jax async dispatch): the returned
+            value is an in-flight device array the drain step reads."""
             bsz = _bucket_rows(e - s)
             if self.plugin_evaluator is not None:
                 # plugin scorers run host-side on the feature dict, so this
@@ -705,7 +741,7 @@ class SchedulerService:
                 ind = _pad_rows(in_degree[s:e], bsz)
                 cae = _pad_rows(can_add_edge[s:e], bsz)
                 recorder.mark("pack")
-                # the plugin's host-side scoring is device-call work for
+                # the plugin's host-side scoring is dispatch work for
                 # attribution purposes — it replaces the device scorer
                 scores = np.asarray(self.plugin_evaluator.evaluate(fd_c), np.float32)
                 packed = ev.select_with_scores_packed(
@@ -723,7 +759,8 @@ class SchedulerService:
                 recorder.mark("pack")
                 if use_ml:
                     packed = self.ml_evaluator.schedule_from_packed(
-                        buf, bsz, k, cost_c, loc_l, num_n, limit=limit
+                        buf, bsz, k, cost_c, loc_l, num_n, limit=limit,
+                        snap=ml_snap,
                     )
                 else:
                     algorithm = self.algorithm if self.algorithm in ("default", "nt") else "default"
@@ -731,37 +768,67 @@ class SchedulerService:
                         buf, bsz, k, cost_c, loc_l, num_n,
                         algorithm=algorithm, limit=limit,
                     )
-            # The packed (B, limit, 2) selection is the jit's ONLY output, so
-            # the tick pays exactly one D2H transfer per chunk — a blocking
-            # host read costs a full link round-trip on a tunneled device,
-            # and the old three-array output paid it three times.
-            packed_parts.append(np.asarray(packed)[: e - s])
-            # per-chunk: a multi-chunk batch must not attribute chunk i's
-            # dispatch+D2H to chunk i+1's "pack" phase
-            recorder.mark("device_call")
-        selected, selected_valid, selected_scores = ev.unpack_selection(
-            np.concatenate(packed_parts)
-        )
+            recorder.mark("dispatch")
+            return packed
 
-        for i, pending in enumerate(work):
-            meta = self._peer_meta[pending.peer_id]
-            parents = []
-            for j in range(limit):
-                if not selected_valid[i, j]:
-                    break
-                pid = cand_ids[i][selected[i, j]] if selected[i, j] < len(cand_ids[i]) else None
-                if pid is None:
-                    continue
-                parents.append((pid, float(selected_scores[i, j])))
-            if not parents:
-                pending.retries += 1
-                continue  # stays pending for the next tick (retry loop)
-            response = self._apply_selection(pending, meta, parents)
-            if response is None:
-                continue  # all selections DAG-rejected; stays pending
-            responses.append(response)
-            self._pending.pop(pending.peer_id, None)
-        recorder.mark("apply_selection")
+        def _drain_chunk(s: int, e: int, packed, overlapped: bool) -> None:
+            """Block on chunk [s:e)'s D2H, then apply its selections. The
+            packed (B, limit, 2) selection is the jit's ONLY output, so a
+            chunk pays exactly one D2H transfer; with `overlapped` the
+            host-side unpack+apply wall time is also credited to the
+            `overlap` phase — it ran while the NEXT chunk's device call
+            was in flight, which is the latency the pipeline hides."""
+            arr = np.asarray(packed)[: e - s]
+            recorder.mark("d2h_wait")
+            t0 = time.perf_counter()
+            selected, selected_valid, selected_scores = ev.unpack_selection(arr)
+            for row, i in enumerate(range(s, e)):
+                pending = work[i]
+                meta = self._peer_meta[pending.peer_id]
+                parents = []
+                for j in range(limit):
+                    if not selected_valid[row, j]:
+                        break
+                    pid = (
+                        cand_ids[i][selected[row, j]]
+                        if selected[row, j] < len(cand_ids[i]) else None
+                    )
+                    if pid is None:
+                        continue
+                    parents.append((pid, float(selected_scores[row, j])))
+                if not parents:
+                    pending.retries += 1
+                    continue  # stays pending for the next tick (retry loop)
+                response = self._apply_selection(pending, meta, parents)
+                if response is None:
+                    continue  # all selections DAG-rejected; stays pending
+                responses.append(response)
+                self._pending.pop(pending.peer_id, None)
+            recorder.mark("apply_selection")
+            if overlapped:
+                recorder.add("overlap", (time.perf_counter() - t0) * 1e3)
+
+        # Double-buffered dispatch: chunk i+1's pack + device call are
+        # issued BEFORE blocking on chunk i's D2H, and chunk i's host-side
+        # DAG bookkeeping (apply_selection) runs while chunk i+1 executes
+        # on the device — at most two chunks in flight. On a tunneled
+        # device each chunk's D2H is a full link round-trip; pipelining
+        # overlaps round-trip i+1 with bookkeeping i instead of paying
+        # them serially (BENCH_r05: device_call 84.4 ms of the 97.5 ms
+        # tick was exactly this serial chain).
+        stride = _chunk_stride(b)
+        spans = [(s, min(s + stride, b)) for s in range(0, b, stride)]
+        in_flight: tuple | None = None
+        for s, e in spans:
+            t0 = time.perf_counter()
+            packed = _dispatch_chunk(s, e)
+            if in_flight is not None:
+                # this chunk's pack+dispatch ran while the previous
+                # chunk's device call was in flight — overlapped host work
+                recorder.add("overlap", (time.perf_counter() - t0) * 1e3)
+                _drain_chunk(*in_flight, overlapped=True)
+            in_flight = (s, e, packed)
+        _drain_chunk(*in_flight, overlapped=False)
         recorder.commit()
         return responses
 
@@ -1164,7 +1231,7 @@ class SchedulerService:
 
         return flight.dump(last_n=last_n, recorder=self.recorder)
 
-    def serving_graph_arrays(self) -> dict:
+    def serving_graph_arrays(self, consume_frontier: bool = True) -> dict:
         """Host graph for MLEvaluator.refresh_embeddings, built from this
         scheduler's OWN piece reports in the trainer's edge schema
         (records/features.py downloads_to_ranking_dataset: directions
@@ -1172,7 +1239,16 @@ class SchedulerService:
         EDGE_FEATURE_SCALE). The GNN was TRAINED with host quality
         arriving through these edges, so serving embeddings must carry
         the same signal — an empty graph demotes the ml evaluator to
-        node-features-only, measurably below the rule blend."""
+        node-features-only, measurably below the rule blend.
+
+        With `consume_frontier` (the refresh path's default) this is a
+        DESTRUCTIVE read: the dirty frontier and full-sync flag pop
+        exactly-once into the returned sideband. At most ONE caller per
+        service may consume — a second would silently steal the frontier
+        and leave its hosts stale until the next structural full sync.
+        Inspection callers (debug dumps, tests, trainer exports) must
+        pass consume_frontier=False, which reports the pending sideband
+        without consuming it."""
         from dragonfly2_tpu.records.features import EDGE_FEATURE_SCALE
 
         with self.mu:
@@ -1200,6 +1276,20 @@ class SchedulerService:
                     acc[1] += count
             for key in dead_keys:
                 del self._serving_edges[key]
+            # Pop the dirty frontier atomically with the edge snapshot:
+            # the caller's refresh either covers these slots or falls back
+            # to a full recompute — either way they are consumed. A
+            # refresh that later FAILS must re-request a full sync
+            # (MLEvaluator handles that); the scheduler's contract is
+            # exactly-once delivery of the frontier.
+            dirty = np.fromiter(
+                self._dirty_host_slots, np.int32, len(self._dirty_host_slots)
+            )
+            dirty.sort()
+            full_sync = self._serving_full_sync
+            if consume_frontier:
+                self._dirty_host_slots.clear()
+                self._serving_full_sync = False
         if merged:
             keys = list(merged.keys())
             edge_src = np.asarray([k[0] for k in keys], np.int32)
@@ -1217,12 +1307,12 @@ class SchedulerService:
         # program for every new edge count. The last padded node row is a
         # zero-feature SINK that absorbs the padding self-edges — only
         # the sink's (never-gathered) embedding sees them.
-        padded_n = max(64, 1 << int(np.ceil(np.log2(used + 1))))
+        padded_n = pad_pow2(used + 1)
         node_feats = np.zeros((padded_n, self.state.host_numeric.shape[1]), np.float32)
         node_feats[:used] = self.state.host_numeric[:used]
         sink = padded_n - 1
         e = edge_src.shape[0]
-        padded_e = max(64, 1 << int(np.ceil(np.log2(max(e, 1)))))
+        padded_e = pad_pow2(e)
         if padded_e != e:
             pad = padded_e - e
             edge_src = np.concatenate([edge_src, np.full(pad, sink, np.int32)])
@@ -1233,6 +1323,13 @@ class SchedulerService:
             "edge_src": edge_src,
             "edge_dst": edge_dst,
             "edge_feats": edge_feats,
+            # Sideband for the incremental refresh (registry/serving.py
+            # strips these before any jitted embed call — their varying
+            # shapes must never become jit signature components):
+            # host slots whose embedding inputs changed since the last
+            # read, and whether structural changes force a full recompute.
+            "dirty_slots": dirty,
+            "full_sync": full_sync,
         }
 
     def task_states(self, task_ids: list[str]) -> list[int | None]:
@@ -1283,6 +1380,24 @@ _EVAL_BUCKETS = (64, 256, 1024)
 def _bucket_rows(n: int) -> int:
     for cap in _EVAL_BUCKETS:
         if n <= cap:
+            return cap
+    return _EVAL_BUCKETS[-1]
+
+
+def _chunk_stride(b: int) -> int:
+    """Chunk stride for the pipelined tick: the smallest bucket that cuts
+    the batch into at most 4 chunks — for batches up to 4x the largest
+    bucket (4096 rows); beyond that the stride stays at the largest
+    bucket and the chunk count grows with the batch (ceil(b/1024), the
+    pre-pipeline chunking). A batch that fits the smallest bucket stays
+    one chunk (nothing to overlap); anything larger splits so the double
+    buffer has at least two device calls to pipeline. Total padded rows
+    never exceed the single-big-bucket split (4 x 64 = 256, 4 x 256 =
+    1024), so compute cost is unchanged while per-chunk D2H round-trips
+    overlap. Every chunk still pads to one of the three fixed buckets —
+    the at-most-three-compiled-shapes contract holds."""
+    for cap in _EVAL_BUCKETS:
+        if -(-b // cap) <= 4:
             return cap
     return _EVAL_BUCKETS[-1]
 

@@ -211,8 +211,13 @@ async def _serve_scheduler(args) -> int:
                         graph = await asyncio.to_thread(
                             service.serving_graph_arrays
                         )
+                        # wait=True: this loop is already off the tick's
+                        # critical path; a completed refresh here keeps
+                        # the version log below accurate and avoids
+                        # double-buffering through BOTH this thread and
+                        # the evaluator's own worker
                         await asyncio.to_thread(
-                            ml_eval.refresh_embeddings, graph
+                            ml_eval.refresh_embeddings, graph, True
                         )
                         if changed:
                             log_ml.info(

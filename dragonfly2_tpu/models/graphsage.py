@@ -102,6 +102,29 @@ class GraphSAGERanker(nn.Module):
             h = layer(h, edge_src, edge_dst, edge_feats, n, adj=adj, edge_mean=edge_mean)
         return h
 
+    def embed_subset(
+        self,
+        node_feats,
+        edge_src,
+        edge_dst,
+        edge_feats,
+        table,
+        target_local,
+        target_global,
+    ):
+        """Incremental serving refresh: re-embed only a gathered subgraph
+        (ops/segment.gather_coo_subgraph — a dirty frontier's k-hop
+        in-neighborhood with LOCAL indices) and scatter the fresh rows
+        into the device-resident (H, D) embedding `table`. Same layers,
+        same params as `embed`, so a subset recompute is numerically a
+        full recompute restricted to the affected rows (summation order
+        inside segment_sum aside). Padding targets carry an out-of-range
+        global index and fall out of the scatter via mode='drop'."""
+        sub = self.embed(node_feats, edge_src, edge_dst, edge_feats)
+        return table.at[target_global].set(
+            sub[target_local].astype(table.dtype), mode="drop"
+        )
+
     def score(self, child_emb, parent_emb, pair_feats):
         """child_emb (B,D) + parent_emb (B,P,D) + pair_feats (B,P,F) -> (B,P)."""
         b, p, _ = parent_emb.shape

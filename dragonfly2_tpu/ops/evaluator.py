@@ -29,6 +29,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# The packed-transport jits donate their H2D staging buffer (reused for
+# outputs/scratch on devices that support donation). Backends without
+# donation (CPU) warn once per compiled shape — an expected no-op the
+# test conftest filters; no process-global filter here, other code's
+# donation warnings are real findings.
+
 from dragonfly2_tpu.config.constants import CONSTANTS
 from dragonfly2_tpu.ops.topk import masked_top_k
 from dragonfly2_tpu.state.fsm import BAD_NODE_STATES, PeerState
@@ -467,7 +473,14 @@ def unpack_eval_batch(buf, b: int, k: int, c: int, l: int, n: int) -> dict:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("b", "k", "c", "l", "n", "algorithm", "limit")
+    jax.jit, static_argnames=("b", "k", "c", "l", "n", "algorithm", "limit"),
+    # The packed H2D staging buffer is consumed exactly once (the tick
+    # packs a fresh buffer per chunk; warmup and the MLEvaluator fallback
+    # likewise pass a one-shot buffer), so XLA may reuse its device
+    # allocation for outputs/scratch instead of allocating per chunk.
+    # Callers always pass a host np.uint8 array, which donation leaves
+    # untouched — only the transient device copy is donated.
+    donate_argnums=(0,),
 )
 def schedule_from_packed(
     buf,
@@ -490,14 +503,19 @@ def schedule_from_packed(
     return _pack_selection(values, indices, valid)
 
 
-# Flight-recorder instrumentation on the serving entry point (the tick's
-# ONE device call): compile/retrace counts per (B, K, ...) signature plus
-# the dispatch-vs-device time split (telemetry/flight.py). The wrapper
-# forwards attributes, so `.lower()`/warmup callers are unaffected.
+# Flight-recorder instrumentation on the serving entry point: compile/
+# retrace counts per (B, K, ...) signature (telemetry/flight.py). The
+# wrapper forwards attributes, so `.lower()`/warmup callers are
+# unaffected. block=False: the pipelined tick (cluster/scheduler.py)
+# dispatches chunk i+1 BEFORE blocking on chunk i's D2H — a blocking
+# wrapper would serialize the chunks again and erase exactly the overlap
+# the pipeline buys; the dispatch/d2h_wait wall-time split now lives in
+# the tick's own phase ring instead of the jit histogram.
 from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  # noqa: E402
 
 schedule_from_packed = _instrument_jit(
-    schedule_from_packed, "evaluator.schedule_from_packed", service="scheduler"
+    schedule_from_packed, "evaluator.schedule_from_packed", service="scheduler",
+    block=False,
 )
 
 

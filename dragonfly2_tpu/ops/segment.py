@@ -40,3 +40,154 @@ def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> j
 def segment_count(segment_ids: jax.Array, num_segments: int) -> jax.Array:
     ones = jnp.ones(segment_ids.shape, dtype=jnp.int32)
     return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def pad_pow2(n: int, min_pad: int = 64) -> int:
+    """Min-`min_pad` power-of-two bucket for a count — THE serving-graph
+    padding policy. The producer (scheduler.serving_graph_arrays: node
+    and edge padding, whose last node row is the zero-feature sink) and
+    the consumer (gather_coo_subgraph below) must bucket identically or
+    the full-refresh and incremental jit caches silently diverge."""
+    import numpy as np
+
+    return max(min_pad, 1 << int(np.ceil(np.log2(max(n, 1)))))
+
+
+# ------------------------------------------------------- subgraph gathering
+#
+# Host-side companion to the segment reductions above: the incremental
+# serving-embedding refresh (registry/serving.py) recomputes only the
+# dirty hosts' k-hop in-neighborhoods. This helper cuts that neighborhood
+# out of the full COO arrays as a LOCALLY-indexed subgraph whose
+# node/edge/target counts are padded to power-of-two buckets, so the
+# jitted `GraphSAGERanker.embed_subset` program compiles once per bucket
+# instead of once per frontier.
+
+
+def gather_coo_subgraph(
+    edge_src,
+    edge_dst,
+    dirty,
+    num_nodes: int,
+    hops: int = 2,
+    max_frac: float = 0.25,
+    min_pad: int = 64,
+):
+    """Gather the subgraph needed to recompute `hops`-layer GNN embeddings
+    of every node whose embedding is affected by the `dirty` input nodes.
+
+    Aggregation for node v rides edges with src == v gathering dst
+    (SAGELayer), so v READS its out-neighbors: the TARGET set (nodes
+    whose embeddings change when `dirty` inputs change) expands
+    REVERSE (dst->src: dependents of the dirty nodes), while the
+    SUPPORT set (nodes whose features the recompute reads) expands
+    FORWARD (src->dst) from the targets. On the serving graph the two
+    coincide (serving_graph_arrays stores every edge in both
+    directions), but the directed semantics are what make this helper
+    correct for any COO graph. Every edge a target's layer-i value
+    consumes has src inside the forward-(k-1)-ball of the targets,
+    which the both-endpoints-in-support keep rule covers.
+
+    Precondition: row `num_nodes - 1` is a sacrificial sink (the serving
+    graph's zero-feature padding row, scheduler.serving_graph_arrays).
+    Padding nodes alias it and padding edges are self-loops on it, so
+    only the sink's (never-served) embedding absorbs the padding — a
+    graph whose last row were a real node would see that row's aggregate
+    polluted.
+
+    Returns None when the support set exceeds `max_frac` of the graph —
+    the caller falls back to a full recompute (the gather would not pay
+    for itself). Otherwise returns a dict of numpy arrays:
+      nodes         (Ns,) int32  global ids of subgraph nodes (padding
+                                 rows point at `num_nodes - 1`, the
+                                 serving graph's zero-feature sink)
+      edge_src/dst  (Es,) int32  LOCAL endpoint indices (padding edges
+                                 are sink self-loops)
+      edge_index    (Es,) int64  indices into the FULL edge arrays for
+                                 gathering edge features (padding -> 0,
+                                 masked by sink endpoints)
+      edge_pad      (Es,) bool   True on padding edges (zero their feats)
+      target_local  (Nt,) int32  local rows whose fresh embedding to keep
+      target_global (Nt,) int32  global rows to scatter them into
+                                 (padding -> num_nodes, dropped by the
+                                 out-of-bounds scatter mode)
+    """
+    import numpy as np
+
+    edge_src = np.asarray(edge_src, np.int64)
+    edge_dst = np.asarray(edge_dst, np.int64)
+    dirty = np.asarray(dirty, np.int64)
+    dirty = dirty[(dirty >= 0) & (dirty < num_nodes)]
+    if dirty.size == 0:
+        return None
+
+    mask = np.zeros(num_nodes, bool)
+    mask[dirty] = True
+
+    def _expand_fwd(m):
+        # what X reads: dst endpoints of edges leaving X
+        out = m.copy()
+        out[edge_dst[m[edge_src]]] = True
+        return out
+
+    def _expand_rev(m):
+        # what reads X: src endpoints of edges arriving in X
+        out = m.copy()
+        out[edge_src[m[edge_dst]]] = True
+        return out
+
+    for _ in range(hops):  # targets: reverse ball_k(dirty) — dependents
+        mask = _expand_rev(mask)
+    target_mask = mask.copy()
+    for _ in range(hops):  # support: forward ball_k(targets) — inputs
+        mask = _expand_fwd(mask)
+    support_count = int(mask.sum())
+    if support_count > max_frac * num_nodes:
+        return None
+
+    sink = num_nodes - 1
+    mask[sink] = True  # padding rows alias the zero-feature sink
+    nodes = np.nonzero(mask)[0].astype(np.int64)
+    local_of = np.full(num_nodes, -1, np.int64)
+    local_of[nodes] = np.arange(nodes.size)
+    local_sink = int(local_of[sink])
+
+    # keep every edge whose BOTH endpoints live in the support set; src
+    # of every edge a target's recompute actually consumes is inside the
+    # (2k-1)-ball subset of support, so this superset is always complete
+    keep = mask[edge_src] & mask[edge_dst]
+    edge_index = np.nonzero(keep)[0]
+    sub_src = local_of[edge_src[edge_index]]
+    sub_dst = local_of[edge_dst[edge_index]]
+
+    targets = np.nonzero(target_mask)[0].astype(np.int64)
+
+    def _pad_to(n: int) -> int:
+        return pad_pow2(n, min_pad)
+
+    ns = _pad_to(nodes.size)
+    nodes_p = np.full(ns, sink, np.int32)
+    nodes_p[: nodes.size] = nodes
+    es = _pad_to(edge_index.size)
+    src_p = np.full(es, local_sink, np.int32)
+    dst_p = np.full(es, local_sink, np.int32)
+    idx_p = np.zeros(es, np.int64)
+    pad_e = np.ones(es, bool)
+    src_p[: sub_src.size] = sub_src
+    dst_p[: sub_dst.size] = sub_dst
+    idx_p[: edge_index.size] = edge_index
+    pad_e[: edge_index.size] = False
+    nt = _pad_to(targets.size)
+    tloc_p = np.full(nt, local_sink, np.int32)
+    tglob_p = np.full(nt, num_nodes, np.int32)  # out of range -> dropped
+    tloc_p[: targets.size] = local_of[targets]
+    tglob_p[: targets.size] = targets
+    return {
+        "nodes": nodes_p,
+        "edge_src": src_p,
+        "edge_dst": dst_p,
+        "edge_index": idx_p,
+        "edge_pad": pad_e,
+        "target_local": tloc_p,
+        "target_global": tglob_p,
+    }

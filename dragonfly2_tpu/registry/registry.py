@@ -185,8 +185,15 @@ class ModelRegistry:
         if template is not None:
             return self._ckpt.restore(path, target=template)
         with ocp.PyTreeCheckpointer() as ckpt:
-            meta = ckpt.metadata(path).item_metadata
-            tree = meta.tree if hasattr(meta, "tree") else meta
+            # orbax API drift: newer releases wrap the tree in a
+            # CheckpointMetadata (.item_metadata, sometimes .tree below
+            # it); older ones (<= 0.7.x) return the metadata tree
+            # directly. Template-less restore must work on both — the
+            # scheduler launcher serves registries written by trainers on
+            # other topologies AND other orbax versions.
+            meta = ckpt.metadata(path)
+            meta = getattr(meta, "item_metadata", meta)
+            tree = getattr(meta, "tree", meta)
             restore_args = jax.tree_util.tree_map(
                 lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
             )

@@ -131,7 +131,12 @@ def _run_arm(
         def _refresh() -> None:
             nonlocal refresh_s
             t = time.perf_counter()
-            ml.refresh_embeddings(svc.serving_graph_arrays())
+            # wait=True: the matrix is a DETERMINISM-pinned artifact —
+            # every arm of a (scenario, seed) cell must see embeddings
+            # commit at the same round on every run, which the background
+            # worker's timing cannot guarantee. The async path is
+            # exercised by bench_loop and the refresh/serve race test.
+            ml.refresh_embeddings(svc.serving_graph_arrays(), wait=True)
             refresh_s += time.perf_counter() - t
 
         _refresh()  # edge-less warm refresh: ml serves from round 1

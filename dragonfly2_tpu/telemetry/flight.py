@@ -77,6 +77,18 @@ class PhaseRecorder:
         phases[name] = phases.get(name, 0.0) + (now - self._t0) * 1e3
         self._t0 = now
 
+    def add(self, name: str, ms: float) -> None:
+        """Accumulate an externally timed duration into the open tick
+        WITHOUT moving the phase cursor — for quantities that overlap
+        other phases and therefore must not be derived from the cursor
+        (the pipelined tick's `overlap` phase: host work done while a
+        device call is in flight, which wall-clock-coexists with the
+        `pack`/`apply_selection` marks that already cover it)."""
+        if not self._open:
+            return
+        phases = self._phases
+        phases[name] = phases.get(name, 0.0) + ms
+
     def commit(self) -> None:
         if not self._open:
             return

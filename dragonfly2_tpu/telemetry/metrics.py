@@ -258,7 +258,11 @@ def _sample_stacks(seconds: float, interval_s: float = 0.01) -> str:
                 continue
             while frame is not None:
                 code = frame.f_code
-                counts[f"{code.co_filename}:{frame.f_lineno} {code.co_qualname}"] += 1
+                # co_qualname needs 3.11; fall back to the bare name so
+                # the endpoint answers instead of killing the handler
+                # thread mid-response on 3.10
+                qualname = getattr(code, "co_qualname", code.co_name)
+                counts[f"{code.co_filename}:{frame.f_lineno} {qualname}"] += 1
                 frame = frame.f_back
         samples += 1
         _time.sleep(interval_s)

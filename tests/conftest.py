@@ -36,6 +36,12 @@ import pytest
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+    # the packed-transport jits donate their one-shot staging buffer;
+    # backends without donation support (CPU CI) warn once per compiled
+    # shape — expected no-op, not a finding (ops/evaluator.py)
+    config.addinivalue_line(
+        "filterwarnings", "ignore:Some donated buffers were not usable"
+    )
     # chaos tests are tier-1 on purpose (NOT slow): failure-domain
     # resilience must not rot behind an opt-in marker
     config.addinivalue_line(
@@ -60,6 +66,9 @@ _THREAD_ALLOWLIST_PREFIXES = (
     "MainThread", "pytest", "asyncio_", "ThreadPoolExecutor", "jax_",
     "orbax", "ocdbt", "ts_", "grpc", "eval-warmup", "Dummy",
     "watchdog", "QueueFeederThread",
+    # orbax/tensorstore checkpoint pools (0.7.x thread names): process-
+    # lifetime runtime pools like the jax_/grpc entries above
+    "base_pytree_ch", "metadata_store", "process_metadata_ch",
 )
 
 
@@ -127,3 +136,34 @@ def resource_leak_guard():
     if leaked_sockets:
         problems.append(f"leaked listening sockets (inodes): {sorted(leaked_sockets)}")
     pytest.fail("resource leak after test-session teardown: " + "; ".join(problems))
+
+
+@pytest.fixture(scope="session", autouse=True)
+def ml_refresh_worker_guard():
+    """The background embedding-refresh worker (registry/serving.py
+    MLEvaluator) is a daemon thread, so the non-daemon sweep above cannot
+    see it — this guard fails the suite if any `ml-embed-refresh` worker
+    outlives its evaluator. A collected evaluator's weakref finalizer
+    signals its worker to exit, so after a gc pass every worker whose
+    owner is gone must drain within the grace window; survivors mean a
+    strong reference leaked into the worker (exactly the daemon-thread
+    leak this fixture exists to catch)."""
+    import gc
+    import threading
+    import time
+
+    yield
+    gc.collect()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        workers = [
+            t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("ml-embed-refresh")
+        ]
+        if not workers:
+            return
+        time.sleep(0.1)
+    pytest.fail(
+        "ml-embed-refresh worker(s) outlived their evaluator: "
+        + ", ".join(sorted(t.name for t in workers))
+    )

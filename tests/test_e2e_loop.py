@@ -94,14 +94,14 @@ def test_full_loop(tmp_path):
     # must carry real child<->parent throughput edges in the trainer's
     # schema — the GNN's quality signal travels on those edges, and an
     # empty serving graph measurably demoted ml below the rule blend.
-    garrs = svc.serving_graph_arrays()
+    garrs = svc.serving_graph_arrays(consume_frontier=False)
     n_pad = garrs["node_feats"].shape[0]
     assert garrs["edge_src"].shape == garrs["edge_dst"].shape
     assert garrs["edge_feats"].shape == (garrs["edge_src"].shape[0], 2)
     real_edges = garrs["edge_feats"][:, 1] > 0  # log1p(count) > 0
     assert real_edges.any(), "replay produced no serving edges"
     assert (garrs["edge_src"] < n_pad).all() and (garrs["edge_dst"] < n_pad).all()
-    ml.refresh_embeddings(garrs)
+    ml.refresh_embeddings(garrs, wait=True)  # committed before serving below
 
     cfg = Config()
     cfg.evaluator.algorithm = "ml"
@@ -115,7 +115,10 @@ def test_full_loop(tmp_path):
             sim2._act(r)
     assert sim2.stats.completed > 5, sim2.stats
     # the ml arm's own replay also accumulates serving edges
-    assert svc_ml.serving_graph_arrays()["edge_feats"][:, 1].max() > 0
+    assert (
+        svc_ml.serving_graph_arrays(consume_frontier=False)
+        ["edge_feats"][:, 1].max() > 0
+    )
 
 
 def test_simulator_produces_balanced_traces(tmp_path):

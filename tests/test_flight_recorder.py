@@ -24,9 +24,14 @@ from dragonfly2_tpu.telemetry.series import (
 )
 from dragonfly2_tpu.telemetry.tracing import Tracer
 
+# The pipelined tick split the old monolithic device_call phase into
+# dispatch (pack -> async device call issued) and d2h_wait (blocking host
+# read of the packed selection), so chunk overlap is visible in the ring;
+# multi-chunk ticks additionally record an `overlap` phase (not listed:
+# single-chunk ticks legitimately omit it).
 TICK_PHASES = (
     "pre_schedule", "candidate_fill", "feature_gather", "pack",
-    "device_call", "apply_selection",
+    "dispatch", "d2h_wait", "apply_selection",
 )
 
 

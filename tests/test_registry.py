@@ -128,7 +128,7 @@ def test_ml_evaluator_fallback_and_served(tmp_path):
     mv = reg.create_model_version("ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
     reg.activate(mv.model_id, 1)
     assert server.refresh()
-    evaluator.refresh_embeddings(garrs)
+    evaluator.refresh_embeddings(garrs, wait=True)
     out_ml = evaluator.schedule(feats.as_dict(), child, cands)
     assert np.asarray(out_ml["selected_valid"]).any()
     # ml scores come from the net, not the rule blend

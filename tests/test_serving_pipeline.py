@@ -1,0 +1,493 @@
+"""Asynchronous serving pipeline (ISSUE 4): double-buffered tick
+dispatch, compile-shape stability under chunking, off-critical-path
+embedding refresh, and the incremental dirty-frontier embed."""
+
+import gc
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import (
+    _EVAL_BUCKETS,
+    SchedulerService,
+    _chunk_stride,
+)
+from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+from dragonfly2_tpu.models.graphsage import GraphSAGERanker
+from dragonfly2_tpu.ops import evaluator as ev
+from dragonfly2_tpu.ops.segment import gather_coo_subgraph
+from dragonfly2_tpu.registry import (
+    MLEvaluator,
+    ModelEvaluation,
+    ModelRegistry,
+    ModelServer,
+)
+from dragonfly2_tpu.registry.registry import MODEL_TYPE_GNN
+from dragonfly2_tpu.scenarios.spec import builtin_scenarios
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry.flight import jit_wrappers
+
+# ------------------------------------------------------------ tick helpers
+
+
+def _host(i: int, seed: bool = False) -> msg.HostInfo:
+    return msg.HostInfo(
+        host_id=f"sp-h{i}", hostname=f"sp-n{i}", ip=f"10.11.{i // 250}.{i % 250}",
+        host_type="super" if seed else "normal", idc="idc-a",
+        location="na|zone|rack",
+        # one seed must be able to parent a whole bucket's worth of
+        # children, or saturated-uploader filtering drains selections
+        concurrent_upload_limit=100_000,
+    )
+
+
+def _register(svc, peer_id, host, task_id):
+    return svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id=peer_id, task_id=task_id, host=host,
+            url="https://e.com/blob", content_length=4 * (4 << 20),
+            total_piece_count=4,
+        )
+    )
+
+
+def _pipeline_service(num_tasks: int = 16, num_hosts: int = 64):
+    """Service with one finished seed parent per task, so every child the
+    tick schedules has a rooted candidate."""
+    svc = SchedulerService(metrics_registry=m.Registry())
+    hosts = [_host(i) for i in range(num_hosts)]
+    for i in range(num_tasks):
+        seed_host = _host(1000 + i, seed=True)
+        _register(svc, f"sp-seed-{i}", seed_host, f"sp-task-{i}")
+        svc.peer_finished(
+            msg.DownloadPeerFinishedRequest(peer_id=f"sp-seed-{i}", piece_count=4)
+        )
+    svc.tick()  # drain the pre_schedule-only seed tick
+    return svc, hosts
+
+
+def test_chunk_stride_buckets_and_pipelining():
+    """The stride rule: single chunk only when the batch fits the smallest
+    bucket; otherwise the smallest bucket that keeps <= 4 chunks — total
+    padded rows never exceed the single-big-bucket split, and every chunk
+    pads to one of the three fixed buckets."""
+    for b in range(1, 5000, 37):
+        stride = _chunk_stride(b)
+        assert stride in _EVAL_BUCKETS
+        n_chunks = -(-b // stride)
+        if b > _EVAL_BUCKETS[0]:
+            assert n_chunks >= 2 or stride == _EVAL_BUCKETS[-1]
+        if stride != _EVAL_BUCKETS[-1]:
+            assert n_chunks <= 4
+    assert _chunk_stride(_EVAL_BUCKETS[0]) == _EVAL_BUCKETS[0]
+
+
+def test_tick_compile_shapes_stable_across_buckets():
+    """Satellite: ticks across all three _EVAL_BUCKETS sizes, twice each,
+    add at most one compile per (bucket, algorithm) — and none at all
+    beyond what warmup() already compiled. Pins the at-most-three-
+    compiled-shapes contract the pipelined chunking must not break."""
+    svc, hosts = _pipeline_service()
+    wrapper = jit_wrappers()["scheduler.evaluator.schedule_from_packed"]
+    before_warmup = wrapper.stats()["signatures"]
+    svc.warmup()
+    after_warmup = wrapper.stats()["signatures"]
+    # one compiled shape per bucket at most (0 when an earlier test in
+    # this process already warmed the same shapes)
+    assert after_warmup - before_warmup <= len(_EVAL_BUCKETS)
+
+    reg_counter = [0]
+
+    def _top_up(target: int) -> None:
+        while len(svc._pending) < target:
+            i = reg_counter[0]
+            reg_counter[0] += 1
+            _register(
+                svc, f"sp-child-{i}", hosts[i % len(hosts)],
+                f"sp-task-{i % 16}",
+            )
+
+    # one tick per bucket regime, twice: 64 -> single 64-chunk;
+    # 300 -> 256 + 64 chunks; 1025 -> 1024 + 64 chunks
+    for _ in range(2):
+        for target in (64, 300, 1025):
+            _top_up(target)
+            svc.tick()
+    assert wrapper.stats()["signatures"] == after_warmup, (
+        "tick chunking reached a (B, K) shape warmup never compiled"
+    )
+
+
+def test_pipelined_tick_overlaps_dispatch_and_apply():
+    """A multi-chunk tick records the split phases AND nonzero overlap:
+    host work (pack of chunk i+1, apply of chunk i) ran while a device
+    call was in flight."""
+    svc, hosts = _pipeline_service()
+    for i in range(200):  # > _EVAL_BUCKETS[0] -> at least two chunks
+        _register(svc, f"sp-ov-{i}", hosts[i % len(hosts)], f"sp-task-{i % 16}")
+    responses = svc.tick()
+    phases = list(svc.recorder.ring)[-1]
+    for name in ("pack", "dispatch", "d2h_wait", "apply_selection"):
+        assert name in phases, phases
+    assert "device_call" not in phases
+    assert phases.get("overlap", 0.0) > 0.0, phases
+    # the pipeline reordered the work, not the results: every scheduled
+    # child got rooted (seed) parents
+    assert responses
+    assert all(
+        isinstance(r, msg.NormalTaskResponse) and r.candidate_parents
+        for r in responses
+    )
+
+
+# --------------------------------------------------- incremental embedding
+
+
+def _ranker_params(model: GraphSAGERanker, graph: dict):
+    return model.init(
+        jax.random.key(0),
+        graph["node_feats"], graph["edge_src"], graph["edge_dst"],
+        graph["edge_feats"],
+        method="embed",
+    )
+
+
+def _embed(model, params, graph):
+    return np.asarray(model.apply(
+        params,
+        graph["node_feats"], graph["edge_src"], graph["edge_dst"],
+        graph["edge_feats"],
+        method="embed",
+    ))
+
+
+def test_new_host_join_stays_incremental():
+    """A brand-new host joining mid-serving must NOT force a full
+    embedding resync — its slot rides the dirty frontier (and a grown
+    table is separately caught by the refresh's shape guard). Only slot
+    RECYCLING and host departure carry invisible neighbor changes; in a
+    growing cluster a join-means-full-sync rule would silently defeat
+    the incremental path on every refresh interval containing a join."""
+    svc = SchedulerService(metrics_registry=m.Registry())
+    for i in range(8):
+        svc.announce_host(_host(i))
+    assert svc.serving_graph_arrays()["full_sync"]  # first read
+    new_slot = svc.announce_host(_host(99))
+    g = svc.serving_graph_arrays()
+    assert not g["full_sync"], "first-time join must stay incremental"
+    assert new_slot in g["dirty_slots"]
+    svc.leave_host(_host(3).host_id)
+    assert svc.serving_graph_arrays()["full_sync"]  # departure: full
+
+
+@pytest.mark.parametrize("scenario_name", ["bandwidth_skew", "hotspot"])
+def test_embed_subset_matches_full_on_dirty_frontier(scenario_name):
+    """Acceptance: `embed_subset` over a gathered dirty frontier matches
+    the full `embed` output on every dirty-reachable slot to fp32
+    tolerance, leaves every other slot bit-identical, and the frontier
+    covers every row the graph change actually moved — across two
+    scenario-lab topologies (both churn-free: a host leave would
+    legitimately force a full sync)."""
+    spec = builtin_scenarios()[scenario_name]
+    svc = SchedulerService(metrics_registry=m.Registry())
+    sim = ClusterSimulator(svc, num_hosts=48, num_tasks=6, seed=3, scenario=spec)
+    for _ in range(10):
+        sim.run_round(new_downloads=6)
+    g1 = svc.serving_graph_arrays()
+    assert g1["full_sync"]  # first read is always a full sync
+    for _ in range(4):
+        sim.run_round(new_downloads=4)
+    g2 = svc.serving_graph_arrays()
+    assert not g2["full_sync"]
+    dirty = g2["dirty_slots"]
+    assert dirty.size > 0
+    assert g2["node_feats"].shape == g1["node_feats"].shape
+
+    model = GraphSAGERanker(hidden_dim=32, compute_dtype=jnp.float32)
+    params = _ranker_params(model, g1)
+    table_old = _embed(model, params, g1)
+    full_new = _embed(model, params, g2)
+
+    n = g2["node_feats"].shape[0]
+    sub = gather_coo_subgraph(
+        g2["edge_src"], g2["edge_dst"], dirty,
+        num_nodes=n, hops=model.num_layers, max_frac=1.0,
+    )
+    assert sub is not None
+    edge_feats = np.where(
+        sub["edge_pad"][:, None], 0.0, g2["edge_feats"][sub["edge_index"]]
+    ).astype(np.float32)
+    updated = np.asarray(model.apply(
+        params,
+        g2["node_feats"][sub["nodes"]],
+        sub["edge_src"], sub["edge_dst"], edge_feats,
+        jnp.asarray(table_old), sub["target_local"], sub["target_global"],
+        method="embed_subset",
+    ))
+    targets = sub["target_global"]
+    targets = targets[targets < n]
+    # (a) recomputed rows match the full recompute (fp32: summation order
+    # inside segment_sum is the only difference)
+    np.testing.assert_allclose(
+        updated[targets], full_new[targets], rtol=1e-4, atol=1e-5
+    )
+    # (b) rows outside the frontier are untouched, bit for bit
+    outside = np.ones(n, bool)
+    outside[targets] = False
+    np.testing.assert_array_equal(updated[outside], table_old[outside])
+    # (c) the frontier is COMPLETE: every row the new edges actually
+    # moved is inside it — nothing outside changed between the reads
+    moved = ~np.isclose(full_new, table_old, rtol=1e-4, atol=1e-6).all(-1)
+    assert not moved[outside].any(), (
+        f"rows {np.nonzero(moved & outside)[0]} changed outside the frontier"
+    )
+
+
+def test_gather_coo_subgraph_fallback_and_empty():
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 3], np.int64)
+    assert gather_coo_subgraph(src, dst, np.array([], np.int64), 8) is None
+    # a frontier larger than max_frac of the graph declines the gather
+    assert gather_coo_subgraph(
+        src, dst, np.array([0]), num_nodes=8, hops=2, max_frac=0.1
+    ) is None
+    sub = gather_coo_subgraph(
+        src, dst, np.array([1]), num_nodes=8, hops=1, max_frac=1.0
+    )
+    assert sub is not None
+    n_real = (sub["target_global"] < 8).sum()
+    # directed semantics: node 1 dirty -> its dependents are itself and
+    # node 0 (edge 0->1 means 0 AGGREGATES 1); node 2 reads nothing
+    # from 1 and must stay outside the target set
+    assert set(sub["target_global"][:n_real].tolist()) == {0, 1}
+
+
+# ------------------------------------------- background refresh / serving
+
+
+def _served_evaluator(tmp_path, n_nodes=64, hidden=16, n_feats=12, edges=256,
+                      seed=0):
+    rng = np.random.default_rng(seed)
+    graph = {
+        "node_feats": rng.normal(size=(n_nodes, n_feats)).astype(np.float32),
+        "edge_src": rng.integers(0, n_nodes - 1, edges).astype(np.int32),
+        "edge_dst": rng.integers(0, n_nodes - 1, edges).astype(np.int32),
+        "edge_feats": rng.normal(size=(edges, 2)).astype(np.float32),
+    }
+    model = GraphSAGERanker(hidden_dim=hidden)
+    child = np.zeros(4, np.int32)
+    cands = np.arange(4 * 4, dtype=np.int32).reshape(4, 4) % n_nodes
+    pair = np.zeros((4, 4, 2), np.float32)
+    params = model.init(jax.random.key(0), graph, child, cands, pair)
+    reg = ModelRegistry(tmp_path)
+    server = ModelServer(reg, "ranker", "h", MODEL_TYPE_GNN, template_params=params)
+    mv = reg.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+        metadata={"hidden_dim": hidden},  # the trainer always records this
+    )
+    reg.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    return reg, server, MLEvaluator(server), graph, params
+
+
+def _packed_buf(b=64, k=8, n_hosts=64, seed=0):
+    from dragonfly2_tpu.records.features import CandidateFeatures
+    from dragonfly2_tpu.state.fsm import PeerState
+
+    rng = np.random.default_rng(seed)
+    feats = CandidateFeatures.zeros(b, k)
+    feats.valid[:] = True
+    feats.peer_state[:] = int(PeerState.SUCCEEDED)
+    feats.upload_limit[:] = 10
+    feats.parent_host_id[:] = np.arange(1, b * k + 1).reshape(b, k)
+    feats.child_host_id[:] = 0
+    fd = feats.as_dict()
+    child = rng.integers(0, n_hosts, b).astype(np.int32)
+    cands = rng.integers(0, n_hosts, (b, k)).astype(np.int32)
+    buf = ev.pack_eval_batch(fd, child_host_slot=child, cand_host_slot=cands)
+    c = fd["piece_costs"].shape[-1]
+    l = fd["parent_location"].shape[-1]
+    n = fd["numeric"].shape[-1]
+    return buf, (b, k, c, l, n)
+
+
+def test_async_refresh_commits_off_thread_and_worker_dies_with_evaluator(tmp_path):
+    _, server, evaluator, graph, _ = _served_evaluator(tmp_path)
+    assert evaluator._committed is None
+    evaluator.refresh_embeddings(dict(graph))  # wait=False: enqueue only
+    deadline = time.monotonic() + 60
+    while evaluator._committed is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    snap = evaluator._committed
+    assert snap is not None, "background refresh never committed"
+    assert snap.emb_version == 1 and snap.params_version == server.version
+    assert evaluator.committed_versions[-1] == (server.version, 1)
+    worker = evaluator._worker
+    assert worker is not None and worker.is_alive()
+    assert worker.name.startswith("ml-embed-refresh")
+
+    # close() joins the worker; the committed snapshot keeps serving
+    evaluator.close()
+    assert not worker.is_alive()
+    assert evaluator._committed is not None
+    # a closed evaluator must not resurrect a worker on a late enqueue,
+    # but must not silently strand the request either: it computes
+    # inline (the consumed dirty frontier would otherwise be lost)
+    evaluator.refresh_embeddings(dict(graph))
+    assert evaluator._worker is None
+    assert evaluator._request is None, "post-close refresh stranded"
+    assert evaluator._committed.emb_version == 2
+
+    # GC path: dropping the last reference signals the worker to exit
+    # even though nobody called close() (the conftest session guard
+    # enforces this globally; this pins the finalizer mechanism)
+    _, _, ev2, graph2, _ = _served_evaluator(tmp_path / "gc", seed=1)
+    ev2.refresh_embeddings(dict(graph2))
+    worker2 = ev2._worker
+    assert worker2 is not None
+    del ev2
+    gc.collect()
+    worker2.join(timeout=5)
+    assert not worker2.is_alive(), "worker outlived its GC'd evaluator"
+
+
+def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
+    """Satellite: hammer refresh_embeddings from a thread (with a params
+    activation flip mid-run) while schedule_from_packed serves in a loop.
+    Every tick must serve from a (params_version, emb_version) pair that
+    was committed as a unit, and no tick may block for anything close to
+    a full-graph refresh."""
+    # graph heavy enough that a full refresh costs visibly more than any
+    # scheduling call — the bound below must separate the two regimes
+    # even under CI scheduler noise
+    n_nodes, edges = 4096, 32768
+    reg, server, evaluator, graph, params = _served_evaluator(
+        tmp_path, n_nodes=n_nodes, hidden=128, edges=edges
+    )
+    rng = np.random.default_rng(7)
+    evaluator.refresh_embeddings(dict(graph), wait=True)  # commit + warm jit
+    # serial full-refresh cost = the stall each tick USED to pay
+    t_full = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        evaluator.refresh_embeddings(dict(graph, full_sync=True), wait=True)
+        t_full.append(time.perf_counter() - t0)
+    refresh_bound = max(min(t_full), 0.1)
+
+    buf, dims = _packed_buf(n_hosts=n_nodes)
+    np.asarray(evaluator.schedule_from_packed(buf, *dims))  # warm the ml jit
+    # blocking accumulated so far is the DELIBERATE synchronous phase
+    # (incl. the embed jit compile); the hammer below must add ~nothing
+    blocking_before_hammer = evaluator.refresh_blocking_s
+
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            g = dict(graph)
+            g["dirty_slots"] = rng.integers(0, n_nodes, 8).astype(np.int32)
+            g["full_sync"] = (i % 7 == 0)  # mix full recomputes in
+            evaluator.refresh_embeddings(g)  # async
+            time.sleep(0.001)
+
+    thread = threading.Thread(target=hammer, name="race-hammer")
+    thread.start()
+    try:
+        used_pairs = []
+        tick_s = []
+        flipped_at = 25
+        for i in range(50):
+            if i == flipped_at:
+                mv = reg.create_model_version(
+                    "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+                    metadata={"hidden_dim": 128},
+                )
+                reg.activate(mv.model_id, mv.version)
+                assert server.refresh()
+            t0 = time.perf_counter()
+            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            tick_s.append(time.perf_counter() - t0)
+            assert out.shape[-1] == 2
+            used_pairs.append(evaluator.last_used_versions)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    evaluator.close()
+
+    committed = set(evaluator.committed_versions)
+    assert all(pair in committed for pair in used_pairs), (
+        "a tick served from a (params_version, emb_version) pair that was "
+        "never committed together"
+    )
+    # Ticks never inherited a refresh (4.98 s of r05's 7.01 s ml wall was
+    # exactly that inheritance). On CPU the background refresh shares the
+    # XLA intra-op pool with serving, so a tick CAN wait out the tail of
+    # an in-flight embed program — the bound is therefore "well under a
+    # refresh" in the median and "never a full synchronous refresh cycle"
+    # at the max, not zero contention.
+    import statistics
+
+    assert statistics.median(tick_s) < 0.25 * refresh_bound, (
+        f"median tick {statistics.median(tick_s):.3f}s vs full-refresh "
+        f"bound {refresh_bound:.3f}s — serving is inheriting refresh work"
+    )
+    assert max(tick_s) < 2 * refresh_bound, (
+        f"tick blocked {max(tick_s):.3f}s >= 2x full-refresh bound "
+        f"{refresh_bound:.3f}s"
+    )
+    # the params flip eventually reached serving through a refresh commit
+    assert any(p and p[0] == server.version for p in used_pairs), (
+        "no tick ever served the activated params version"
+    )
+    # refreshes actually ran both paths under the hammer
+    assert evaluator.refresh_count > 2
+    # the async hammer (hundreds of refresh calls) stalled callers for
+    # ~enqueue cost only — the off-critical-path contract
+    assert evaluator.refresh_blocking_s - blocking_before_hammer < 0.5
+
+
+def test_mlevaluator_incremental_path_via_scheduler_frontier(tmp_path):
+    """End-to-end: scheduler dirty frontier -> MLEvaluator refresh takes
+    the incremental embed_subset path (params unchanged, no structural
+    sync) and falls back to full on a params flip."""
+    svc = SchedulerService(metrics_registry=m.Registry())
+    sim = ClusterSimulator(svc, num_hosts=32, num_tasks=4, seed=5)
+    for _ in range(8):
+        sim.run_round(new_downloads=6)
+    g1 = svc.serving_graph_arrays()
+    reg, server, evaluator, _, params = _served_evaluator(
+        tmp_path, n_nodes=g1["node_feats"].shape[0],
+        n_feats=g1["node_feats"].shape[1],
+    )
+    evaluator.INCREMENTAL_MAX_FRAC = 1.0  # tiny graph: always worth it
+    evaluator.refresh_embeddings(g1, wait=True)
+    assert (evaluator.refresh_count, evaluator.incremental_refresh_count) == (1, 0)
+    for _ in range(4):
+        sim.run_round(new_downloads=4)
+    g2 = svc.serving_graph_arrays()
+    if g2["node_feats"].shape != g1["node_feats"].shape:
+        pytest.skip("padded node bucket grew; incremental legitimately skipped")
+    evaluator.refresh_embeddings(g2, wait=True)
+    assert evaluator.incremental_refresh_count == 1
+    assert evaluator.committed_versions[-1][1] == 2  # emb_version bumped
+    # params flip forces the next refresh full even with a tiny frontier
+    mv = reg.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+        metadata={"hidden_dim": 16},
+    )
+    reg.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    for _ in range(2):
+        sim.run_round(new_downloads=4)
+    g3 = svc.serving_graph_arrays()
+    evaluator.refresh_embeddings(g3, wait=True)
+    assert evaluator.incremental_refresh_count == 1  # still 1: went full
+    assert evaluator._committed.params_version == server.version
+    evaluator.close()

@@ -216,7 +216,10 @@ def test_scheduler_metrics_populated_by_live_traffic(tmp_path):
             assert "dragonfly_scheduler_host_traffic{" in text
             assert "dragonfly_scheduler_download_peer_duration_milliseconds_count" in text
             assert "dragonfly_dfdaemon_peer_task_total" in text
-            assert 'dragonfly_scheduler_tick_phase_seconds_count{phase="device_call"}' in text
+            # pipelined tick: the old device_call phase is split into the
+            # async dispatch and the blocking D2H read
+            assert 'dragonfly_scheduler_tick_phase_seconds_count{phase="dispatch"}' in text
+            assert 'dragonfly_scheduler_tick_phase_seconds_count{phase="d2h_wait"}' in text
             await d1.stop()
         finally:
             await mux_srv.stop()
