@@ -2,8 +2,9 @@
 
 Runs {default, ml, random} (optionally nt) evaluators across the
 scenario grid (homogeneous control, bandwidth-skewed racks/spine/NICs,
-churn, flaky parents, hotspot Zipf, control-plane chaos —
-scenarios/spec.builtin_scenarios) with PAIRED seeds, and writes
+churn, flaky parents, corrupting parents (digest-verified -> quarantine),
+hotspot Zipf, control-plane chaos — scenarios/spec.builtin_scenarios)
+with PAIRED seeds, and writes
 `BENCH_scenarios.json`: per-scenario
 `ml_vs_default` piece-cost ratios with 95% confidence intervals, per-arm
 injected-fault counts, and the flight-recorder per-phase tick timings.

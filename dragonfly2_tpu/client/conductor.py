@@ -72,6 +72,21 @@ class PeerTaskConductor:
         self._needed: set[int] = set()
         self._inflight: set[int] = set()
         self._failed_parents: set[str] = set()
+        # parents whose corruption we already reported (piece_worker):
+        # concurrent in-flight failures collapse to ONE attribution
+        self._reported_corrupt: set[str] = set()
+        # mark_done integrity-recovery attempts (evict suspect pieces +
+        # re-fetch). Bounded: with no attested chain the eviction pass is
+        # blind (evicts everything), and an unbounded loop against a
+        # persistently lying parent would re-transfer the whole task
+        # forever.
+        self._integrity_recoveries = 0
+        # Scheduler-ATTESTED digest chain (NormalTaskResponse): per-piece
+        # md5s keyed by piece number + the whole-task sha256. First writer
+        # wins so a later response can never weaken a digest we already
+        # verified pieces against.
+        self._attested_digests: dict[int, str] = {}
+        self._attested_task_digest = ""
         self._refreshers: set[asyncio.Task] = set()
         self._done = asyncio.Event()
         self._error: Exception | None = None
@@ -90,6 +105,11 @@ class PeerTaskConductor:
         )
         if ts.meta.done:
             return ts  # local reuse, no network (taskManager dedup)
+        # reused storage keeps the PREVIOUS attempt's peer_id; this run
+        # registers under a fresh one, and rot self-reports must name a
+        # peer the scheduler knows or quarantine silently no-ops
+        if ts.meta.peer_id != self.peer_id:
+            ts.set_peer_id(self.peer_id)
         queue = self.conn.subscribe(self.peer_id)
         try:
             # blocking HEAD off-loop: a blackholed origin must not freeze
@@ -172,6 +192,10 @@ class PeerTaskConductor:
                 )
                 return
             if isinstance(response, msg.NormalTaskResponse):
+                for number, digest in (response.piece_digests or {}).items():
+                    self._attested_digests.setdefault(int(number), digest)
+                if response.task_digest and not self._attested_task_digest:
+                    self._attested_task_digest = response.task_digest
                 done = await self._download_from_parents(
                     ts, response.candidate_parents,
                     trace_context=getattr(response, "trace_context", None),
@@ -225,15 +249,21 @@ class PeerTaskConductor:
                 continue
             self._parent_pieces[parent.peer_id] = doc
             if doc.get("done") and doc.get("total_pieces", -1) >= 0:
-                total_pieces = doc["total_pieces"]
-                content_length = doc["content_length"]
+                # a parent's /pieces doc is SELF-attested: only fill
+                # metadata we don't already have authoritatively (from
+                # registration or the scheduler) — a lying done=true doc
+                # must not override known-good totals and push mark_done
+                # into a bogus integrity failure
+                if total_pieces is None or total_pieces < 0:
+                    total_pieces = doc["total_pieces"]
+                if content_length is None or content_length < 0:
+                    content_length = doc["content_length"]
         if total_pieces is None or total_pieces < 0:
             return False
         have = set(ts.finished_pieces())
         self._needed = set(range(total_pieces)) - have
         if not self._needed:
-            ts.mark_done(content_length, total_pieces)
-            return True
+            return await self._try_mark_done(ts, content_length, total_pieces)
 
         # queue (piece, parent) jobs for every needed piece a parent holds
         for parent_id, doc in self._parent_pieces.items():
@@ -266,9 +296,61 @@ class PeerTaskConductor:
             await asyncio.gather(*self._refreshers, return_exceptions=True)
             self._refreshers = set()
         if not self._needed:
-            ts.mark_done(content_length, total_pieces)
-            return True
+            return await self._try_mark_done(ts, content_length, total_pieces)
         return False
+
+    async def _try_mark_done(self, ts, content_length, total_pieces) -> bool:
+        """mark_done with recovery, off the event loop (it sha256-hashes
+        the whole data file — blocking here would stall every coroutine on
+        the daemon for the hash duration of a multi-GiB task).
+
+        A whole-task sha256 mismatch means some committed piece is corrupt
+        DESPITE per-piece checks — it was fetched under header-only
+        verification before the attested chain arrived (a consistent liar
+        slips the header check). Without recovery the task would wedge:
+        the corrupt piece sits in the finished set with a matching
+        recorded digest, every retry re-adopts it, and mark_done raises
+        forever. Evict every piece that is suspect under the NOW-complete
+        attested chain (stored digest disagrees with the attested md5, or
+        no attested entry to judge by) and return False — the evicted
+        pieces rejoin _needed on the next wave and are re-fetched under
+        full attestation. A TaskIntegrityError (hole / length mismatch:
+        the completion METADATA was wrong, e.g. a lying parent doc on a
+        task with no authoritative totals) gets the same eviction pass;
+        either way the download stays resumable instead of hard-failing
+        unattributed."""
+        try:
+            await asyncio.to_thread(
+                ts.mark_done, content_length, total_pieces,
+                expected_digest=self._attested_task_digest,
+            )
+            return True
+        except (dferrors.PieceCorrupted, dferrors.TaskIntegrityError) as e:
+            self._integrity_recoveries += 1
+            if self._integrity_recoveries > 2:
+                # two eviction+re-fetch rounds already failed: the
+                # attestation or the metadata source is persistently
+                # inconsistent — fail loudly rather than re-transfer the
+                # task forever
+                raise
+            # snapshot items(): a concurrent verify-on-serve eviction on
+            # an upload thread may pop entries while we scan
+            suspects = [
+                number for number, piece in list(ts.meta.pieces.items())
+                if self._attested_digests.get(number) != piece.digest
+            ]
+            evicted = ts.evict_pieces(suspects)
+            logger.warning(
+                "task %s failed integrity at mark_done (%s); evicted %d "
+                "suspect piece(s) for re-fetch (recovery %d/2)",
+                ts.meta.task_id, e, len(evicted), self._integrity_recoveries,
+            )
+            if not evicted:
+                # every piece matches the attested chain yet completion
+                # still fails: the attestation or claimed totals are
+                # themselves inconsistent — re-fetching cannot fix that
+                raise
+            return False
 
     async def _piece_refresher(self, parent: msg.CandidateParent) -> None:
         """Subscribe to one in-progress parent: long-poll its /pieces with
@@ -364,15 +446,31 @@ class PeerTaskConductor:
                 nbytes = await asyncio.to_thread(
                     self.piece_manager.download_piece_from_parent,
                     ts, parent.ip, parent.download_port, number, piece_meta["offset"],
+                    self._attested_digests.get(number, ""),
                 )
             except dferrors.DFError as e:
                 self._inflight.discard(number)
                 self._failed_parents.add(parent_id)
                 self.metrics.piece_task_failed.labels().inc()
-                logger.info("piece %d from %s failed: %s", number, parent_id, e)
+                # Attribution matters: a corrupt piece (bytes failed their
+                # scheduler-attested digest) quarantines the parent HOST
+                # cluster-wide, a plain transport failure only blocklists
+                # it for this child.
+                corrupt = isinstance(e, dferrors.PieceCorrupted)
+                logger.info("piece %d from %s failed%s: %s", number, parent_id,
+                            " (corrupt)" if corrupt else "", e)
+                if corrupt:
+                    # one corruption attribution per parent: concurrent
+                    # in-flight fetches all fail their digest check at
+                    # once, and reporting each would multiply the
+                    # scheduler's (already immediate) quarantine penalty
+                    if parent_id in self._reported_corrupt:
+                        continue
+                    self._reported_corrupt.add(parent_id)
                 await self.conn.send(
                     msg.DownloadPieceFailedRequest(
-                        peer_id=self.peer_id, parent_peer_id=parent_id
+                        peer_id=self.peer_id, parent_peer_id=parent_id,
+                        reason="corruption" if corrupt else "",
                     )
                 )
                 continue
@@ -401,13 +499,17 @@ class PeerTaskConductor:
         )
         loop = asyncio.get_running_loop()
 
-        def on_piece(number: int, length: int, cost_ns: int) -> None:
+        def on_piece(number: int, length: int, cost_ns: int, digest: str = "") -> None:
             self.metrics.piece_task.labels().inc()
             asyncio.run_coroutine_threadsafe(
                 self.conn.send(
                     msg.DownloadPieceFinishedRequest(
                         peer_id=self.peer_id, piece_number=number,
                         length=length, cost_ns=cost_ns,
+                        # origin-computed md5: the trust anchor of the
+                        # task's digest chain (the scheduler only adopts
+                        # digests from back-to-source reports)
+                        digest=digest,
                     )
                 ),
                 loop,
@@ -428,7 +530,10 @@ class PeerTaskConductor:
             return
         await self.conn.send(
             msg.DownloadPeerBackToSourceFinishedRequest(
-                peer_id=self.peer_id, content_length=content_length, piece_count=pieces
+                peer_id=self.peer_id, content_length=content_length,
+                piece_count=pieces,
+                # whole-task sha256 from mark_done: the chain's root
+                task_digest=ts.meta.digest,
             )
         )
         self._done.set()

@@ -72,7 +72,8 @@ class Daemon:
         self.storage = StorageManager(data_dir)
         # scenario-lab flaky-parent injection (scenarios/engine.py): this
         # daemon's piece serving errors/stalls per the injected schedule
-        self.upload = UploadServer(self.storage, host=ip, fault_injector=fault_injector)
+        self.upload = UploadServer(self.storage, host=ip, fault_injector=fault_injector,
+                                   on_piece_rot=self._report_piece_rot)
         self.pool = SchedulerClientPool(scheduler_addresses, ssl_context=ssl_context)
         self.shaper = TrafficShaper(total_rate_bps, mode="sampling" if total_rate_bps else "plain")
         self.gc = GC()
@@ -128,6 +129,9 @@ class Daemon:
         self.failover_recorder = PhaseRecorder(maxlen=256, name="dfdaemon.failover")
         self._dynconfig_task: asyncio.Task | None = None
         self._probe_task: asyncio.Task | None = None
+        # event loop captured at start(): verify-on-serve rot reports fire
+        # on upload-server handler threads and must hop onto it
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._seed_tasks: list[asyncio.Task] = []
         self._seed_downloads: set[asyncio.Task] = set()
         self._running: dict[str, asyncio.Task] = {}  # task dedup
@@ -165,6 +169,7 @@ class Daemon:
         # pay the one-time native build here, never on a request path
         from dragonfly2_tpu import native
 
+        self._loop = asyncio.get_running_loop()
         await asyncio.to_thread(native.ensure_built)
         self.upload.start()
         self.gc.start()
@@ -373,6 +378,34 @@ class Daemon:
             assert last_err is not None
             raise last_err
 
+    def _report_piece_rot(self, task_id: str, number: int) -> None:
+        """Verify-on-serve found local disk rot (upload.py; the piece is
+        already evicted from the finished set): SELF-report a
+        reason="corruption" piece failure — peer_id == parent_peer_id is
+        the self-report shape the scheduler maps straight to quarantine,
+        so this HOST stops being advertised cluster-wide (quarantine is
+        host-scoped, not per-task) instead of letting every child burn a
+        transfer discovering the rot. Fire-and-forget off the upload
+        handler thread; a dead control plane only costs the report."""
+        loop = self._loop
+        ts = self.storage.get(task_id)
+        if loop is None or loop.is_closed() or ts is None or not ts.meta.peer_id:
+            return
+
+        async def report() -> None:
+            try:
+                conn = await self.pool.for_task(task_id)
+                await self._ensure_announced(conn)
+                await conn.send(msg.DownloadPieceFailedRequest(
+                    peer_id=ts.meta.peer_id, parent_peer_id=ts.meta.peer_id,
+                    reason="corruption",
+                ))
+            except Exception:  # noqa: BLE001 - reporting is best-effort
+                logger.warning("piece-rot self-report failed for %s#%d",
+                               task_id, number, exc_info=True)
+
+        asyncio.run_coroutine_threadsafe(report(), loop)
+
     async def export_file(self, ts: TaskStorage, output: str | pathlib.Path) -> None:
         """Copy a completed task's bytes to a user path (dfget output)."""
         await asyncio.to_thread(shutil.copyfile, ts.data_path, output)
@@ -431,8 +464,12 @@ class Daemon:
         finished piece, so the scheduler adopts the seed as a Succeeded
         parent without a byte moving — the cluster regains a parent at
         announce cost instead of a second origin fetch."""
+        # persist the fresh id: rot self-reports use ts.meta.peer_id, and
+        # the scheduler only knows THIS registration after a failover
+        peer_id = idgen.peer_id_v2()
+        ts.set_peer_id(peer_id)
         await conn.send(msg.RegisterPeerRequest(
-            peer_id=idgen.peer_id_v2(),
+            peer_id=peer_id,
             task_id=ts.meta.task_id,
             host=self.host_info(),
             url=trigger.url,

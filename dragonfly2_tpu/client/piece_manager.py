@@ -42,20 +42,38 @@ class PieceManager:
     # ------------------------------------------------------------- parents
 
     def download_piece_from_parent(
-        self, ts: TaskStorage, parent_ip: str, parent_port: int, number: int, offset: int
+        self, ts: TaskStorage, parent_ip: str, parent_port: int, number: int, offset: int,
+        expected_digest: str = "",
     ) -> int:
         """Fetch one piece over the parent's upload server; returns bytes
-        written. Digest travels in a header and is checked before commit."""
+        written. `expected_digest` is the scheduler-ATTESTED md5 for this
+        piece (origin-reported, distributed in schedule responses); when
+        present it is authoritative and the parent's header is advisory
+        only — a parent serving corrupt bytes under a self-consistent
+        header still fails here. Verification happens BEFORE commit, so
+        corrupt bytes never reach disk; a mismatch raises the typed
+        PieceCorrupted the conductor reports as reason="corruption"."""
         url = f"http://{parent_ip}:{parent_port}/download/{ts.meta.task_id}?piece={number}"
         t0 = time.perf_counter_ns()
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as resp:
                 data = resp.read()
-                digest = resp.headers.get("X-Dragonfly-Piece-Digest", "")
+                header_digest = resp.headers.get("X-Dragonfly-Piece-Digest", "")
         except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
             raise dferrors.Unavailable(f"parent piece fetch {url}: {e}") from e
         cost = time.perf_counter_ns() - t0
-        ts.write_piece(number, offset, data, digest=digest, cost_ns=cost)
+        digest = expected_digest or header_digest
+        if digest:
+            actual = md5_from_bytes(data)
+            if actual != digest:
+                raise dferrors.PieceCorrupted(
+                    f"piece {number} from {parent_ip}:{parent_port}: digest "
+                    f"{actual} != {'attested' if expected_digest else 'header'} "
+                    f"{digest}"
+                )
+        # verified=True: the check above already hashed this exact buffer
+        ts.write_piece(number, offset, data, digest=digest, cost_ns=cost,
+                       verified=bool(digest))
         return len(data)
 
     # -------------------------------------------------------------- source
@@ -66,7 +84,11 @@ class PieceManager:
     ) -> tuple[int, int]:
         """Back-to-source download of the whole task; returns
         (content_length, piece_count). Known-length sources fan out ranged
-        piece-group fetches; unknown-length streams sequentially."""
+        piece-group fetches; unknown-length streams sequentially.
+        `on_piece(number, length, cost_ns, digest)` fires per committed
+        piece with the md5 this fetcher computed — the origin fetch is the
+        digest chain's trust anchor, so the conductor reports these to the
+        scheduler with each piece-finished message."""
         content_length = source_pkg.content_length(url, headers)
         piece_length = ts.meta.piece_length
         use_ranges = content_length >= 0
@@ -90,9 +112,11 @@ class PieceManager:
                         raise dferrors.Unavailable(
                             f"source range {off}+{length} returned {len(data)} bytes"
                         )
-                    ts.write_piece(n, off, data, digest=md5_from_bytes(data), cost_ns=cost)
+                    digest = md5_from_bytes(data)
+                    ts.write_piece(n, off, data, digest=digest, cost_ns=cost,
+                                   verified=True)
                     if on_piece is not None:
-                        on_piece(n, length, cost)
+                        on_piece(n, length, cost, digest)
             ts.mark_done(content_length, len(layout))
             return content_length, len(layout)
         # unknown length: sequential stream, cut into pieces as it arrives
@@ -103,17 +127,21 @@ class PieceManager:
             while len(buf) >= piece_length:
                 piece, buf = buf[:piece_length], buf[piece_length:]
                 cost = time.perf_counter_ns() - t0
-                ts.write_piece(number, offset, piece, digest=md5_from_bytes(piece), cost_ns=cost)
+                digest = md5_from_bytes(piece)
+                ts.write_piece(number, offset, piece, digest=digest, cost_ns=cost,
+                               verified=True)
                 if on_piece is not None:
-                    on_piece(number, len(piece), cost)
+                    on_piece(number, len(piece), cost, digest)
                 number += 1
                 offset += len(piece)
                 t0 = time.perf_counter_ns()
         if buf:
             cost = time.perf_counter_ns() - t0
-            ts.write_piece(number, offset, buf, digest=md5_from_bytes(buf), cost_ns=cost)
+            digest = md5_from_bytes(buf)
+            ts.write_piece(number, offset, buf, digest=digest, cost_ns=cost,
+                           verified=True)
             if on_piece is not None:
-                on_piece(number, len(buf), cost)
+                on_piece(number, len(buf), cost, digest)
             number += 1
             offset += len(buf)
         ts.mark_done(offset, number)

@@ -19,7 +19,24 @@ import time
 
 from dragonfly2_tpu.utils import dferrors
 from dragonfly2_tpu.utils.container import Bitset
-from dragonfly2_tpu.utils.digest import md5_from_bytes
+from dragonfly2_tpu.utils.digest import md5_from_bytes, sha256_from_reader
+
+
+class _BoundedReader:
+    """Read-at-most-N wrapper so the whole-task digest covers exactly
+    content_length bytes even if the data file grew past it."""
+
+    def __init__(self, f, limit: int):
+        self._f = f
+        self._left = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self._left <= 0:
+            return b""
+        n = self._left if n < 0 else min(n, self._left)
+        data = self._f.read(n)
+        self._left -= len(data)
+        return data
 
 
 @dataclasses.dataclass
@@ -39,6 +56,9 @@ class TaskMetadata:
     content_length: int = -1
     piece_length: int = 4 << 20
     total_pieces: int = -1
+    # whole-task sha256, computed at mark_done (the root of the digest
+    # chain the scheduler distributes; "" until the task completes)
+    digest: str = ""
     done: bool = False
     created_at: float = 0.0
     accessed_at: float = 0.0
@@ -76,11 +96,15 @@ class TaskStorage:
     # -------------------------------------------------------------- pieces
 
     def write_piece(
-        self, number: int, offset: int, data: bytes, digest: str = "", cost_ns: int = 0
+        self, number: int, offset: int, data: bytes, digest: str = "", cost_ns: int = 0,
+        verified: bool = False,
     ) -> PieceMetadata:
         """Write piece bytes at their offset; validates the digest first
-        (pieceManager digest check before commit)."""
-        if digest:
+        (pieceManager digest check before commit). `verified=True` means
+        the caller computed `digest` from THIS buffer moments ago
+        (piece_manager's fetch paths) — skip re-hashing the same up-to-
+        4 MiB buffer on the download hot path."""
+        if digest and not verified:
             actual = md5_from_bytes(data)
             if actual != digest:
                 raise dferrors.InvalidArgument(
@@ -128,13 +152,115 @@ class TaskStorage:
         with self._lock:
             return sorted(self.meta.pieces)
 
-    def mark_done(self, content_length: int | None = None, total_pieces: int | None = None) -> None:
+    def set_peer_id(self, peer_id: str) -> None:
+        """The daemon re-registers a held task under a FRESH peer id on
+        failover/restart re-announce; record it so later self-reports
+        (verify-on-serve rot) name a peer the scheduler actually knows —
+        a stale id would make quarantine silently no-op."""
         with self._lock:
-            self.meta.done = True
+            self.meta.peer_id = peer_id
+            self._flush_meta()
+
+    def evict_piece(self, number: int) -> bool:
+        """Un-commit one piece (its bytes failed a LATER integrity check:
+        verify-on-serve rot, or a whole-task digest mismatch attributed at
+        mark_done). The piece leaves the finished set and the task drops
+        out of `done`, so the conductor's resume/download path re-fetches
+        it instead of serving or re-serving bad bytes forever. The bytes
+        stay in the data file (harmless — unfinished ranges are never
+        served) and the piece journal is rewritten without the entry.
+        True iff THIS call removed the piece — concurrent detectors of the
+        same rot use it to collapse to one self-report."""
+        return bool(self.evict_pieces((number,)))
+
+    def evict_pieces(self, numbers) -> list[int]:
+        """Batch evict_piece: one journal rewrite + one metadata flush no
+        matter how many pieces fall (mark_done recovery can evict
+        thousands on a big task — per-piece rewrites would be O(n^2)
+        journal bytes). Returns the numbers actually removed."""
+        with self._lock:
+            evicted = [n for n in numbers if self.meta.pieces.pop(n, None) is not None]
+            if not evicted:
+                return evicted
+            for n in evicted:
+                self._bitset.clear(n)
+            self.meta.done = False
+            self.meta.digest = ""
+            with open(self.pieces_path, "w") as f:
+                for piece in self.meta.pieces.values():
+                    f.write(json.dumps(dataclasses.asdict(piece)) + "\n")
+            self._flush_meta()
+            return evicted
+
+    def verify_piece(self, number: int) -> bool:
+        """Re-hash a stored piece's bytes against its recorded digest
+        (verify-on-serve / fsck). False = local disk rot or a torn write;
+        the caller decides whether to 503, self-report, or just flag."""
+        with self._lock:
+            piece = self.meta.pieces.get(number)
+            if piece is None:
+                return False
+            with open(self.data_path, "rb") as f:
+                f.seek(piece.offset)
+                data = f.read(piece.length)
+        if len(data) != piece.length:
+            return False
+        return not piece.digest or md5_from_bytes(data) == piece.digest
+
+    def compute_digest(self) -> str:
+        """Whole-task sha256 over the first content_length bytes of the
+        data file ("" when the length is unknown)."""
+        with self._lock:
+            length = self.meta.content_length
+            if length < 0:
+                return ""
+            with open(self.data_path, "rb") as f:
+                return sha256_from_reader(_BoundedReader(f, length))
+
+    def mark_done(
+        self,
+        content_length: int | None = None,
+        total_pieces: int | None = None,
+        expected_digest: str | None = None,
+    ) -> None:
+        """Completion commit with integrity cross-checks. The caller's
+        (content_length, total_pieces) claim is verified against the
+        actual FinishedPieces state — a missed piece used to yield a
+        silently short file — and the whole-task sha256 is computed and
+        (when the scheduler attested one) verified before `done` flips.
+        Raises TaskIntegrityError / PieceCorrupted; the task then stays
+        resumable instead of serving a hole or corrupt bytes."""
+        with self._lock:
+            length = self.meta.content_length if content_length is None else content_length
+            total = self.meta.total_pieces if total_pieces is None else total_pieces
+            if total is not None and total > 0:
+                missing = [n for n in range(total) if n not in self.meta.pieces]
+                if missing:
+                    raise dferrors.TaskIntegrityError(
+                        f"task {self.meta.task_id}: {len(missing)} of {total} "
+                        f"pieces missing at mark_done (first hole: piece "
+                        f"{missing[0]})"
+                    )
+                if length is not None and length >= 0:
+                    stored = sum(p.length for p in self.meta.pieces.values()
+                                 if p.number < total)
+                    if stored != length:
+                        raise dferrors.TaskIntegrityError(
+                            f"task {self.meta.task_id}: stored piece bytes "
+                            f"{stored} != content_length {length}"
+                        )
             if content_length is not None:
                 self.meta.content_length = content_length
             if total_pieces is not None:
                 self.meta.total_pieces = total_pieces
+            digest = self.compute_digest()
+            if expected_digest and digest and digest != expected_digest:
+                raise dferrors.PieceCorrupted(
+                    f"task {self.meta.task_id}: whole-task sha256 {digest} "
+                    f"!= attested {expected_digest}"
+                )
+            self.meta.digest = digest
+            self.meta.done = True
             self._flush_meta()
             self.piece_cond.notify_all()
 

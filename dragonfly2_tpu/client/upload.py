@@ -35,12 +35,21 @@ import time
 import urllib.parse
 
 from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.utils import dferrors
+from dragonfly2_tpu.utils.digest import md5_from_bytes
 
 
 class UploadServer:
     def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0,
-                 fault_injector=None):
+                 fault_injector=None, on_piece_rot=None):
         self.storage = storage
+        # Verify-on-serve hook: called as on_piece_rot(task_id, number)
+        # when a stored piece's bytes no longer match their recorded
+        # digest (local disk rot / torn write). The daemon wires this to a
+        # self-reported reason="corruption" piece failure so the scheduler
+        # stops advertising this peer for the task instead of letting
+        # children discover the rot one wasted transfer at a time.
+        self.on_piece_rot = on_piece_rot
         # Scenario-lab hook (scenarios/engine.FaultInjector): when set,
         # piece serving consults it per (task, piece, attempt) and may
         # answer 503 or stall before serving — faults injected at the
@@ -130,6 +139,7 @@ class UploadServer:
                     self._reply(404, b"piece not stored")
                     return
                 injector = manager.fault_injector
+                verdict = None
                 if injector is not None:
                     verdict = injector.piece_fault(ts.meta.task_id, number)
                     if verdict == "error":
@@ -137,11 +147,43 @@ class UploadServer:
                         return
                     if verdict == "stall":
                         time.sleep(injector.stall_seconds)
-                piece = ts.meta.pieces[number]
-                data = ts.read_piece(number)
+                try:
+                    piece = ts.meta.pieces[number]
+                    data = ts.read_piece(number)
+                except (KeyError, dferrors.NotFound):
+                    # raced a concurrent eviction (another serve of this
+                    # rotted piece, or the conductor's mark_done recovery)
+                    # between has_piece and the read
+                    self._reply(404, b"piece not stored")
+                    return
+                digest = piece.digest
+                if verdict == "corrupt":
+                    # Scenario-lab adversary: serve deterministically
+                    # corrupted bytes under a SELF-CONSISTENT advisory
+                    # header (a lying parent, not a clumsy one) — only
+                    # verification against the scheduler-attested chain
+                    # catches this.
+                    data = injector.corrupt_bytes(ts.meta.task_id, number, data)
+                    digest = md5_from_bytes(data)
+                elif digest and md5_from_bytes(data) != digest:
+                    # Verify-on-serve: the stored bytes no longer hash to
+                    # the digest recorded at commit — local disk rot. Never
+                    # serve them; EVICT the piece (it leaves the finished
+                    # set so the daemon re-fetches instead of answering 503
+                    # for this piece forever) and self-report so the
+                    # scheduler quarantines this host rather than keeping
+                    # it advertised. Only the thread whose evict actually
+                    # removed the piece reports — N concurrent serves of
+                    # one rot event must not multiply the quarantine
+                    # penalty (the conductor dedups its reports the same
+                    # way via _reported_corrupt).
+                    if ts.evict_piece(number) and manager.on_piece_rot is not None:
+                        manager.on_piece_rot(ts.meta.task_id, number)
+                    self._reply(503, b"piece failed integrity check")
+                    return
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(data)))
-                self.send_header("X-Dragonfly-Piece-Digest", piece.digest)
+                self.send_header("X-Dragonfly-Piece-Digest", digest)
                 self.send_header("X-Dragonfly-Piece-Offset", str(piece.offset))
                 self.end_headers()
                 self.wfile.write(data)

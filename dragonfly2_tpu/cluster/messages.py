@@ -90,6 +90,11 @@ class DownloadPieceFinishedRequest:
     length: int
     cost_ns: int
     parent_peer_id: str = ""
+    # Per-piece md5 (pkg/digest dialect). The scheduler TRUSTS this only
+    # on back-to-source reports (parent_peer_id == ""): the origin/seed
+    # fetch is the trust anchor of the task's digest chain — a
+    # parent-relayed digest is what the chain exists to check.
+    digest: str = ""
 
 
 @dataclasses.dataclass
@@ -97,6 +102,10 @@ class DownloadPieceFailedRequest:
     peer_id: str
     parent_peer_id: str
     temporary: bool = True
+    # failure attribution: "" = transport/serve error (blocklist only),
+    # "corruption" = the piece's bytes failed digest verification against
+    # the scheduler-attested chain — the scheduler quarantines the parent
+    reason: str = ""
 
 
 @dataclasses.dataclass
@@ -123,6 +132,9 @@ class DownloadPeerBackToSourceFinishedRequest:
     peer_id: str
     content_length: int = 0
     piece_count: int = 0
+    # whole-task sha256 computed by the origin fetcher at mark_done — the
+    # root of the task's digest chain (children verify it at completion)
+    task_digest: str = ""
 
 
 @dataclasses.dataclass
@@ -155,6 +167,16 @@ class CandidateParent:
 class NormalTaskResponse:
     peer_id: str
     candidate_parents: list[CandidateParent]
+    # Scheduler-ATTESTED digest chain for the task (origin-reported piece
+    # md5s keyed by STRINGIFIED piece number — the wire codec's hardened
+    # msgpack unpack refuses int map keys — plus the whole-task sha256).
+    # The child verifies every parent-fetched piece against these: the
+    # parent's X-Dragonfly-Piece-Digest header is advisory once an
+    # attested digest exists, so a parent that lies consistently (header
+    # matching its corrupted bytes) is still caught. Empty until the
+    # origin fetch reports the chain.
+    piece_digests: dict = dataclasses.field(default_factory=dict)
+    task_digest: str = ""
 
 
 @dataclasses.dataclass

@@ -28,6 +28,7 @@ import numpy as np
 
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.cluster.probes import ProbeStore
+from dragonfly2_tpu.cluster.quarantine import QuarantineBoard
 from dragonfly2_tpu.config.config import Config
 from dragonfly2_tpu.graph.dag import TaskDAG
 from dragonfly2_tpu.ops import evaluator as ev
@@ -121,8 +122,9 @@ class SchedulerService:
         # process default registry at module import (ops/evaluator.py,
         # registry/serving.py) — read per-fn jit stats from flight_dump().
         reg = metrics_registry if metrics_registry is not None else default_registry()
+        series = scheduler_series(reg)
         self.recorder = PhaseRecorder(
-            histogram=scheduler_series(reg).schedule_phase,
+            histogram=series.schedule_phase,
             maxlen=4096,
             name="scheduler.tick",
         )
@@ -190,6 +192,27 @@ class SchedulerService:
         # in any dirty set.
         self._dirty_host_slots: set[int] = set()
         self._serving_full_sync = True
+        # Trust-boundary integrity (the digest chain the scheduler ATTESTS
+        # to children): per-task piece md5s and whole-task sha256, written
+        # ONLY from back-to-source reports — the origin fetch is the trust
+        # anchor; parent-relayed digests are exactly what the chain
+        # verifies. First writer wins: a later (possibly corrupt-parent)
+        # report can never rewrite an attested digest. Distributed in
+        # every NormalTaskResponse; dropped with the task's other maps.
+        self._task_piece_digests: dict[str, dict[int, str]] = {}
+        self._task_sha256: dict[str, str] = {}
+        # chain length already sent per (task -> peer): a 10 GiB task has
+        # thousands of piece md5s, and re-serializing the full map into
+        # EVERY schedule/reschedule response is O(pieces x responses) on
+        # the event loop — the child merges first-writer-wins, so it only
+        # needs the chain again when it has GROWN since its last response
+        self._chain_sent: dict[str, dict[str, int]] = {}
+        self._series = series
+        # Corrupt-parent quarantine: corruption-attributed piece failures
+        # score against the parent HOST with time-decay; quarantined
+        # hosts are skipped by the tick's candidate fill until the score
+        # cools (cluster/quarantine.py).
+        self.quarantine = QuarantineBoard(metrics=series)
 
     # ============================================================ messages
 
@@ -265,6 +288,7 @@ class SchedulerService:
                 self._leave_peer(peer_id)
         self.state.remove_host(host_id)
         self._host_info.pop(host_id, None)
+        self.quarantine.drop(host_id)
         if host_id in self._seed_hosts:
             self._seed_hosts.remove(host_id)
         # its serving edges die with it; neighbors' aggregates change
@@ -423,6 +447,19 @@ class SchedulerService:
         if idx is None:
             return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
         self.state.record_piece(idx, req.piece_number, float(req.cost_ns))
+        if (not req.parent_peer_id and req.digest
+                and self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)):
+            # origin-fetched piece: its md5 joins the task's attested
+            # digest chain (first writer wins — re-fetches and racing
+            # seeds cannot rewrite an attested entry). Gated on the
+            # scheduler's OWN record that this peer is mid-back-to-source
+            # (it sent BackToSourceStarted): a peer merely omitting
+            # parent_peer_id cannot forge "origin" digests and poison the
+            # chain against honest parents.
+            meta = self._peer_meta.get(req.peer_id)
+            if meta is not None:
+                chain = self._task_piece_digests.setdefault(meta.task_id, {})
+                chain.setdefault(int(req.piece_number), req.digest)
         if req.parent_peer_id:
             meta = self._peer_meta.get(req.peer_id)
             pidx = self.state.peer_index(req.parent_peer_id)
@@ -455,11 +492,31 @@ class SchedulerService:
 
     def piece_failed(self, req: msg.DownloadPieceFailedRequest):
         """DownloadPieceFailed: parent host failure accounting + reschedule
-        away from it."""
+        away from it. reason="corruption" means the child verified the
+        piece's bytes against the scheduler-attested digest and they did
+        NOT match — beyond the per-child blocklist, the parent HOST is
+        quarantined cluster-wide (with time-decayed release) and takes a
+        scoring penalty through the upload-failure feature every
+        evaluator algorithm already consumes."""
+        corrupt = req.reason == "corruption"
         pidx = self.state.peer_index(req.parent_peer_id)
         if pidx is not None:
             host_idx = self.state.peer_host[pidx]
-            self.state.host_upload_failed[host_idx] += 1
+            # corruption wastes a full transfer AND forces a re-fetch:
+            # weight it like several plain serve failures in the scoring
+            # features so a released host re-earns trust slowly
+            self.state.host_upload_failed[host_idx] += 5 if corrupt else 1
+            if corrupt:
+                host_id = self.state.host_id_at(int(host_idx))
+                if host_id is not None:
+                    self.quarantine.report(host_id, reason="corruption")
+        if corrupt:
+            self._series.piece_corruption.labels().inc()
+            if req.peer_id == req.parent_peer_id:
+                # SELF-report (upload verify-on-serve found local rot):
+                # the host stops being advertised via quarantine; there is
+                # no downloading child to reschedule.
+                return None
         return self.reschedule(
             msg.RescheduleRequest(
                 peer_id=req.peer_id, candidate_parent_ids=[req.parent_peer_id]
@@ -502,10 +559,23 @@ class SchedulerService:
         idx = self.state.peer_index(req.peer_id)
         if idx is None:
             return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+        # capture BEFORE the FSM flips to Succeeded: digest-root adoption
+        # is gated on the scheduler having seen this peer go back-to-source
+        # (DOWNLOAD_SUCCEEDED is also legal from RUNNING, so a P2P peer
+        # could send this message without ever fetching the origin)
+        was_back_to_source = (
+            self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)
+        )
         self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
         task_idx = self.state.peer_task[idx]
         if req.piece_count:
             self.state.task_total_pieces[task_idx] = req.piece_count
+        if req.task_digest and was_back_to_source:
+            # whole-task sha256 from the origin fetcher: the root of the
+            # attested chain (first writer wins, like the piece digests)
+            meta = self._peer_meta.get(req.peer_id)
+            if meta is not None:
+                self._task_sha256.setdefault(meta.task_id, req.task_digest)
         # The origin download proves the task's content exists: the task
         # FSM goes Succeeded (service_v2 handleDownloadPeerBackToSource-
         # FinishedRequest) — preheat job state polls exactly this. FAILED
@@ -658,6 +728,11 @@ class SchedulerService:
         # call's ~100 us marshalling was the biggest host-side tick cost
         # after the transport fix.
         task_pairs: dict[str, list[tuple[int, int, int, int]]] = {}
+        # Quarantine snapshot for this tick: hosts currently excluded for
+        # integrity failures. The common case (nothing quarantined) costs
+        # one lock-free-ish length check; members are re-checked through
+        # is_quarantined so decay-released hosts rejoin mid-snapshot.
+        q_active = self.quarantine.active() if self.quarantine.active_count() else ()
         for i, pending in enumerate(work):
             meta = self._peer_meta[pending.peer_id]
             child_peer_idx[i] = self.state.peer_index(pending.peer_id)
@@ -675,6 +750,11 @@ class SchedulerService:
                 pidx = self.state.peer_index(pid)
                 if pidx is None:
                     continue
+                if q_active:
+                    phost = self.state.host_id_at(int(self.state.peer_host[pidx]))
+                    if phost in q_active and self.quarantine.is_quarantined(phost):
+                        self._series.quarantine_skipped.labels().inc()
+                        continue
                 cand_peer_idx[i, j] = pidx
                 cand_valid[i, j] = True
                 blocklist[i, j] = pid in pending.blocklist
@@ -898,7 +978,28 @@ class SchedulerService:
             pending.retries += 1
             self._pending[pending.peer_id] = pending
             return None  # caller keeps the peer pending for the next tick
-        return msg.NormalTaskResponse(peer_id=pending.peer_id, candidate_parents=kept)
+        # Attach the attested digest chain (copied under service.mu: the
+        # response is serialized on the event loop after the tick returns,
+        # while origin reports may still be appending to the live dict) —
+        # but only when it grew since this peer's last response; the
+        # conductor merges entries first-writer-wins, so resending an
+        # unchanged chain is pure wire/CPU waste.
+        chain = self._task_piece_digests.get(meta.task_id)
+        digests = {}
+        if chain:
+            sent = self._chain_sent.setdefault(meta.task_id, {})
+            if sent.get(pending.peer_id, 0) < len(chain):
+                # string keys: the wire codec's hardened unpack
+                # (strict_map_key) refuses int map keys, and the
+                # conductor re-ints them on receipt
+                digests = {str(n): d for n, d in chain.items()}
+                sent[pending.peer_id] = len(digests)
+        return msg.NormalTaskResponse(
+            peer_id=pending.peer_id,
+            candidate_parents=kept,
+            piece_digests=digests,
+            task_digest=self._task_sha256.get(meta.task_id, ""),
+        )
 
     def _release_parent_slots(self, peer_id: str) -> None:
         """Free the upload slots this child holds on its parents' hosts.
@@ -1032,6 +1133,9 @@ class SchedulerService:
                         0, int(self.state.host_upload_used[host_idx]) - 1
                     )
         self._peer_meta.pop(peer_id, None)
+        sent = self._chain_sent.get(meta.task_id)
+        if sent is not None:
+            sent.pop(peer_id, None)
         idx = self.state.peer_index(peer_id)
         if idx is not None and self.state.peer_state[idx] != int(PeerState.LEAVE):
             self.state.peer_event(idx, PeerEvent.LEAVE)
@@ -1167,6 +1271,9 @@ class SchedulerService:
         self._dags.pop(task_id, None)
         self._dag_slot_peer.pop(task_id, None)
         self._task_peers.pop(task_id, None)
+        self._task_piece_digests.pop(task_id, None)
+        self._task_sha256.pop(task_id, None)
+        self._chain_sent.pop(task_id, None)
 
     def _gc_hosts(self) -> int:
         """host_manager.go:146-163 RunGC: a normal host with no peers and
@@ -1219,6 +1326,8 @@ class SchedulerService:
         c = self.state.counts()
         c["pending"] = len(self._pending)
         c["tasks_with_dag"] = len(self._dags)
+        c["quarantined_hosts"] = self.quarantine.active_count()
+        c["tasks_with_digest_chain"] = len(self._task_piece_digests)
         return c
 
     def flight_dump(self, last_n: int = 64) -> dict:

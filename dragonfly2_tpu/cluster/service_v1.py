@@ -42,6 +42,11 @@ CODE_SUCCESS = 200
 CODE_SCHED_ERROR = 5000
 CODE_SCHED_NEED_BACK_SOURCE = 5001
 CODE_SCHED_PEER_GONE = 5002
+# client-side piece verification failure (the common.proto client-error
+# band): a v1 peer reporting this code means the piece's bytes failed its
+# digest check — translated onto the v2 reason="corruption" quarantine
+# path, mirroring the reference's md5-mismatch piece-result handling.
+CODE_CLIENT_PIECE_MD5_NOT_MATCHED = 4004
 
 
 @dataclasses.dataclass
@@ -263,10 +268,16 @@ class SchedulerServiceV1:
                 cost_ns=int(res.piece_info.download_cost) * 1_000_000,
             ))
         # handlePieceFailure (:1210): blocklist the failed parent and
-        # reschedule — the v2 piece-failed handler does exactly that.
+        # reschedule — the v2 piece-failed handler does exactly that. An
+        # md5-mismatch code rides through as reason="corruption" so v1
+        # peers feed the same quarantine path as v2 ones.
         return self.svc.handle(msg.DownloadPieceFailedRequest(
             peer_id=res.src_pid,
             parent_peer_id=res.dst_pid,
+            reason=(
+                "corruption"
+                if res.code == CODE_CLIENT_PIECE_MD5_NOT_MATCHED else ""
+            ),
         ))
 
     # ------------------------------------------------------- final result

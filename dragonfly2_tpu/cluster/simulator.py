@@ -37,6 +37,10 @@ class SimStats:
     # announce plane, and waves beyond a peer's first (its retries)
     injected_piece_failures: int = 0
     injected_stalls: int = 0
+    # corruption verdicts from the scenario engine: the child's digest
+    # verification caught the piece, reported reason="corruption", and
+    # the scheduler quarantined the parent host (trust-boundary PR)
+    injected_corruptions: int = 0
     injected_crashes: int = 0
     injected_host_leaves: int = 0
     # control-plane chaos (scenarios/spec ControlPlaneSpec): scheduler
@@ -400,6 +404,18 @@ class ClusterSimulator:
                 self.scheduler.piece_failed(
                     msg.DownloadPieceFailedRequest(
                         peer_id=peer_id, parent_peer_id=parent.peer_id
+                    )
+                )
+                return
+            if fault == "corrupt":
+                # the modeled child verified the piece against the
+                # attested digest, refused the bytes, and attributed the
+                # failure — the scheduler quarantines the parent host
+                self.stats.injected_corruptions += 1
+                self.scheduler.piece_failed(
+                    msg.DownloadPieceFailedRequest(
+                        peer_id=peer_id, parent_peer_id=parent.peer_id,
+                        reason="corruption",
                     )
                 )
                 return

@@ -20,6 +20,12 @@ Key layout under an optional prefix:
     <model_id>/model.json             active-version pointer (+ name/type)
     <model_id>/<version>/version.json   metadata + evaluation
     <model_id>/<version>/params.msgpack trained params
+    <model_id>/<version>/params.sha256  integrity manifest: sha256 + size
+                                        of params.msgpack, written by the
+                                        publisher and verified by every
+                                        load_params — a torn or bit-rotted
+                                        blob raises DataLoss instead of
+                                        activating into serving
 
 `open_registry` dispatches a plain path to the orbax/fs ModelRegistry and
 a ``<vendor>://bucket/prefix?endpoint=...`` URL here, so every
@@ -42,12 +48,15 @@ from dragonfly2_tpu.registry.registry import (
     MODEL_TYPE_GNN,
     MODEL_TYPE_MLP,
     STATE_ACTIVE,
+    STATE_BAD,
     STATE_INACTIVE,
     ModelEvaluation,
     ModelRegistry,
     ModelVersion,
     _version_from_json,
 )
+from dragonfly2_tpu.utils import dferrors
+from dragonfly2_tpu.utils.digest import sha256_from_bytes
 from dragonfly2_tpu.utils.idgen import model_id as make_model_id
 
 
@@ -131,6 +140,14 @@ class BucketModelRegistry:
         self.backend.put_object(
             self.bucket, self._key(mid, next_version, "params.msgpack"), blob
         )
+        # Integrity manifest BESIDE the params (pkg/digest discipline on
+        # the model plane): load_params re-hashes the blob against this,
+        # so a torn PUT, truncated GET, or bit-rotted object raises
+        # DataLoss instead of deserializing garbage into serving.
+        self._put_json(
+            {"sha256": sha256_from_bytes(blob), "size": len(blob)},
+            mid, next_version, "params.sha256",
+        )
         self.backend.put_object_if_absent(
             self.bucket,
             self._key(mid, "model.json"),
@@ -153,8 +170,14 @@ class BucketModelRegistry:
         scheduler_host_id, so each model has exactly one natural activator
         (its owning scheduler's trainer) and the reference's DB
         transaction is not re-created here."""
-        if self._get_json(model_id, version, "version.json") is None:
+        vdata = self._get_json(model_id, version, "version.json")
+        if vdata is None:
             raise FileNotFoundError(f"{model_id} v{version} not found")
+        if vdata.get("state") == STATE_BAD:
+            raise ValueError(
+                f"{model_id} v{version} is marked bad (failed an integrity "
+                "or activation gate); publish a new version instead"
+            )
         # A publisher that died between reserving version.json and
         # uploading params leaves a permanently-visible params-less
         # version; activating it would make load_params fail at SERVING
@@ -170,11 +193,44 @@ class BucketModelRegistry:
         manifest["active_version"] = version
         self._put_json(manifest, model_id, "model.json")
         for v in self.list_versions(model_id):
+            if v.state == STATE_BAD:
+                continue  # bad stays bad; never resurrected to inactive
             state = STATE_ACTIVE if v.version == version else STATE_INACTIVE
             if v.state != state:
                 data = self._get_json(model_id, v.version, "version.json")
                 data["state"] = state
                 self._put_json(data, model_id, v.version, "version.json")
+
+    def mark_version_bad(self, model_id: str, version: int, reason: str = "") -> None:
+        """ModelRegistry.mark_version_bad over the bucket layout: flag the
+        version, and if it was active fall the pointer back to the newest
+        remaining good version (serving recovers to last-good)."""
+        data = self._get_json(model_id, version, "version.json")
+        if data is None:
+            return
+        data["state"] = STATE_BAD
+        data.setdefault("metadata", {})["bad_reason"] = reason
+        self._put_json(data, model_id, version, "version.json")
+        manifest = self._get_json(model_id, "model.json")
+        if not manifest or manifest.get("active_version") != version:
+            return
+        # fallback must be LOADABLE, not merely not-bad: a publisher that
+        # died before uploading params leaves a params-less version that
+        # activate() refuses — falling back onto it would wedge every
+        # subsequent refresh on a not-found instead of recovering
+        good = [
+            v for v in self.list_versions(model_id)
+            if v.state != STATE_BAD and self.backend.is_object_exist(
+                self.bucket, self._key(model_id, v.version, "params.msgpack")
+            )
+        ]
+        fallback = good[-1].version if good else None
+        if fallback is not None:
+            vdata = self._get_json(model_id, fallback, "version.json")
+            vdata["state"] = STATE_ACTIVE
+            self._put_json(vdata, model_id, fallback, "version.json")
+        manifest["active_version"] = fallback
+        self._put_json(manifest, model_id, "model.json")
 
     def delete_version(self, model_id: str, version: int) -> None:
         if self._get_json(model_id, version, "version.json") is None:
@@ -222,6 +278,22 @@ class BucketModelRegistry:
         blob = self.backend.get_object(
             self.bucket, self._key(model_id, version, "params.msgpack")
         )
+        manifest = self._get_json(model_id, version, "params.sha256")
+        if manifest:
+            # Verify BEFORE deserializing: flax/msgpack would happily
+            # restore a truncated blob into a params tree missing leaves,
+            # and nothing downstream re-checks byte integrity.
+            if len(blob) != manifest.get("size", len(blob)):
+                raise dferrors.DataLoss(
+                    f"{model_id} v{version}: params.msgpack is {len(blob)} "
+                    f"bytes, manifest says {manifest['size']} (torn write?)"
+                )
+            actual = sha256_from_bytes(blob)
+            if actual != manifest.get("sha256"):
+                raise dferrors.DataLoss(
+                    f"{model_id} v{version}: params sha256 {actual} != "
+                    f"manifest {manifest['sha256']} (bit rot or tamper)"
+                )
         if template is not None:
             return serialization.from_bytes(template, blob)
         return serialization.msgpack_restore(blob)

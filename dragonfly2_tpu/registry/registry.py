@@ -40,6 +40,12 @@ MODEL_TYPE_ATTENTION = "attention"
 
 STATE_INACTIVE = "inactive"
 STATE_ACTIVE = "active"
+# Guarded activation (trust-boundary PR): a version that failed an
+# integrity or canary check — a corrupt params blob, non-finite leaves, or
+# an insane canary scoring pass. Bad versions can never be (re)activated;
+# marking the ACTIVE version bad falls the pointer back to the newest
+# good version, so serving recovers to last-good without an operator.
+STATE_BAD = "bad"
 
 
 @dataclasses.dataclass
@@ -120,13 +126,54 @@ class ModelRegistry:
     def activate(self, model_id: str, version: int) -> None:
         """Flip the active version pointer; exactly one version active —
         manager/service/model.go:109-151's transactional state flip."""
-        if not (self.base / model_id / str(version) / "version.json").exists():
+        vpath = self.base / model_id / str(version) / "version.json"
+        if not vpath.exists():
             raise FileNotFoundError(f"{model_id} v{version} not found")
+        if json.loads(vpath.read_text()).get("state") == STATE_BAD:
+            raise ValueError(
+                f"{model_id} v{version} is marked bad (failed an integrity "
+                "or activation gate); publish a new version instead"
+            )
         manifest_path = self.base / model_id / "model.json"
         manifest = json.loads(manifest_path.read_text())
         for v in self.list_versions(model_id):
+            if v.state == STATE_BAD:
+                continue  # bad stays bad; never resurrected to inactive
             self._set_state(model_id, v.version, STATE_ACTIVE if v.version == version else STATE_INACTIVE)
         manifest["active_version"] = version
+        _atomic_write_json(manifest_path, manifest)
+
+    def mark_version_bad(self, model_id: str, version: int, reason: str = "") -> None:
+        """Record that a version failed an integrity/activation check. If
+        it was the active version, the pointer falls back to the NEWEST
+        remaining good version (or None) — the model-plane twin of PR 3's
+        fallback-past-torn-checkpoints: serving recovers to last-good and
+        the bad version can never be activated again."""
+        path = self.base / model_id / str(version) / "version.json"
+        if not path.exists():
+            return
+        data = json.loads(path.read_text())
+        data["state"] = STATE_BAD
+        data.setdefault("metadata", {})["bad_reason"] = reason
+        _atomic_write_json(path, data)
+        manifest_path = self.base / model_id / "model.json"
+        if not manifest_path.exists():
+            return
+        manifest = json.loads(manifest_path.read_text())
+        if manifest.get("active_version") != version:
+            return
+        # fallback must be LOADABLE, not merely not-bad: skip versions
+        # whose params never landed (publisher died mid-publish), or the
+        # recovered pointer would fail every load_params with not-found
+        good = [
+            v for v in self.list_versions(model_id)
+            if v.state != STATE_BAD
+            and (self.base / model_id / str(v.version) / "params").exists()
+        ]
+        fallback = good[-1].version if good else None
+        if fallback is not None:
+            self._set_state(model_id, fallback, STATE_ACTIVE)
+        manifest["active_version"] = fallback
         _atomic_write_json(manifest_path, manifest)
 
     def delete_version(self, model_id: str, version: int) -> None:

@@ -40,6 +40,7 @@ from dragonfly2_tpu.registry.registry import (
     MODEL_TYPE_MLP,
     ModelRegistry,
 )
+from dragonfly2_tpu.utils import dferrors
 
 logger = logging.getLogger(__name__)
 
@@ -125,9 +126,23 @@ class ModelServer:
         # the served (model, params, version) triple untouched — swapping
         # the module first and then raising would leave a mismatched pair
         # behind for callers that catch the error and keep serving.
-        new_params = self.registry.load_params(
-            self.model_id, active.version, template=self._template
-        )
+        try:
+            new_params = self.registry.load_params(
+                self.model_id, active.version, template=self._template
+            )
+        except dferrors.DataLoss as e:
+            # The version's bytes failed their integrity manifest: mark it
+            # bad so the active pointer falls back to the newest GOOD
+            # version (registry.mark_version_bad) — the next refresh then
+            # serves last-good instead of retrying the corrupt blob
+            # forever. The model-plane twin of the data plane's
+            # fallback-past-torn-checkpoints.
+            logger.error("refusing corrupt %s v%d: %s",
+                         self.model_id, active.version, e)
+            mark_bad = getattr(self.registry, "mark_version_bad", None)
+            if mark_bad is not None:
+                mark_bad(self.model_id, active.version, reason=str(e))
+            return False
         # Commit to device ONCE here: load_params returns numpy leaves
         # (topology portability), and numpy params passed to every jitted
         # infer/schedule call would re-pay one host->device transfer PER
@@ -263,8 +278,14 @@ class MLEvaluator:
     # keep at most this share of the graph on the incremental path; a
     # larger frontier recomputes everything (the gather wouldn't pay)
     INCREMENTAL_MAX_FRAC = 0.25
+    # canary tolerance: the residual ensemble bounds per-row deviation at
+    # ML_RESIDUAL_ALPHA * |z| * row_scale with |z| <= sqrt(K-1), so any
+    # healthy version lands well inside this multiple of the rule
+    # baseline's spread — exceeding it means numeric blowup, not opinion
+    CANARY_SPREAD_MULT = 8.0
 
-    def __init__(self, server: ModelServer, fallback_algorithm: str = "default"):
+    def __init__(self, server: ModelServer, fallback_algorithm: str = "default",
+                 metrics_registry=None):
         self.server = server
         self.fallback = fallback_algorithm
         # the ensemble's residual base: the same rule blend the fallback
@@ -289,6 +310,21 @@ class MLEvaluator:
         self.refresh_compute_s = 0.0
         self.refresh_count = 0
         self.incremental_refresh_count = 0
+        # Guarded activation: every params version is gated (finite
+        # leaves + canary scoring on a fixed probe batch) ON THE REFRESH
+        # WORKER before it can become the committed snapshot — a rejected
+        # version leaves serving on last-good and is marked bad in the
+        # registry. gate_runs counts gate executions so tests can pin
+        # that scheduling never pays for it.
+        self._rejected_versions: set = set()
+        self.gate_runs = 0
+        self.rejection_count = 0
+        from dragonfly2_tpu.telemetry import default_registry
+        from dragonfly2_tpu.telemetry.series import serving_series
+
+        self._metrics = serving_series(
+            metrics_registry if metrics_registry is not None else default_registry()
+        )
         # consistency audit trail for the refresh/serve race test: every
         # committed (params_version, emb_version) pair, and the pair the
         # last schedule call actually served from
@@ -431,6 +467,15 @@ class MLEvaluator:
         dirty = graph.pop("dirty_slots", None)
         full_sync = bool(graph.pop("full_sync", True))
         committed = self._committed
+        if version in self._rejected_versions:
+            # previously rejected activation still on the server: keep
+            # the embedding table tracking topology with LAST-GOOD params
+            # (or stay on the rule fallback if nothing good ever landed)
+            if committed is None:
+                return
+            model, params, version = (
+                committed.model, committed.params, committed.params_version
+            )
         n = graph["node_feats"].shape[0]
         emb = None
         incremental_ok = (
@@ -468,6 +513,25 @@ class MLEvaluator:
         # in-flight array would make the next tick's device call inherit
         # the embed compute wait — the stall this refactor removes
         jax.block_until_ready(emb)
+        if committed is None or committed.params_version != version:
+            # GUARDED ACTIVATION (on this worker, never the tick path): a
+            # new params version must pass finite-leaves + a canary
+            # scoring pass before it can serve. A rejected version leaves
+            # serving on the last-good snapshot, is marked bad in the
+            # registry (so the active pointer falls back and the trainer's
+            # next publish supersedes it), and never re-runs the gate.
+            reason = self._activation_gate(model, params, emb)
+            if reason is not None:
+                self._reject_version(version, reason)
+                if committed is None:
+                    return  # no last-good: serving stays on the rule blend
+                model, params, version = (
+                    committed.model, committed.params, committed.params_version
+                )
+                emb = _gnn_embed(model, params, _graph_only(graph))
+                jax.block_until_ready(emb)
+            else:
+                self._metrics.activation_accepted.labels().inc()
         snapshot = _EmbSnapshot(
             model=model,
             params=params,
@@ -481,6 +545,76 @@ class MLEvaluator:
         )
         self._need_full = False
         self.refresh_count += 1
+
+    # ----------------------------------------------------- activation gate
+
+    def _activation_gate(self, model, params, host_emb) -> str | None:
+        """Decide whether a params version may serve; returns a rejection
+        reason or None. Runs on the refresh worker (never a tick): checks
+        every leaf and the computed embedding table for non-finite values,
+        then scores a fixed deterministic probe batch and requires the ml
+        ensemble's deviation from the rule baseline to stay within a sane
+        multiple of the baseline's own spread — a NaN-poisoned, bit-
+        rotted, or numerically exploding checkpoint fails here instead of
+        activating into the serving snapshot."""
+        self.gate_runs += 1
+        for leaf in jax.tree_util.tree_leaves(params):
+            if not bool(np.all(np.isfinite(np.asarray(leaf)))):
+                return "nonfinite_params"
+        emb = np.asarray(host_emb)
+        if not bool(np.all(np.isfinite(emb))):
+            return "nonfinite_embeddings"
+        feats = _canary_probe()
+        b, k = feats["valid"].shape
+        n = emb.shape[0]
+        child_host = np.arange(b, dtype=np.int32) % n
+        cand_host = (np.arange(b * k, dtype=np.int32) % n).reshape(b, k)
+        child_idc = feats["child_idc"][:, None]
+        pair_feats = np.stack(
+            [
+                ((feats["parent_idc"] == child_idc) & (child_idc != 0)).astype(np.float32),
+                np.asarray(_loc_match_fraction(
+                    feats["parent_location"], feats["child_location"]
+                )),
+            ],
+            axis=-1,
+        )
+        scores = np.asarray(_ensemble_scores(
+            feats,
+            gnn_score(model, params, host_emb, child_host, cand_host, pair_feats),
+            self._base_alg,
+        ))
+        blend = np.asarray(ev.evaluate(feats, self._base_alg))
+        valid = feats["valid"].astype(bool)
+        if not bool(np.all(np.isfinite(scores[valid]))):
+            return "nonfinite_scores"
+        cnt = np.maximum(valid.sum(-1, keepdims=True), 1)
+        mean = (blend * valid).sum(-1, keepdims=True) / cnt
+        row_std = np.sqrt((((blend - mean) ** 2) * valid).sum(-1, keepdims=True) / cnt)
+        scale = float(np.max(np.maximum(row_std, ML_RESIDUAL_STD_FLOOR)))
+        deviation = float(np.max(np.abs(scores - blend) * valid))
+        if deviation > self.CANARY_SPREAD_MULT * scale:
+            return "score_spread"
+        return None
+
+    def _reject_version(self, version, reason: str) -> None:
+        self.rejection_count += 1
+        self._rejected_versions.add(version)
+        self._metrics.activation_rejected.labels(reason).inc()
+        logger.error(
+            "activation gate rejected %s v%s (%s): serving stays on "
+            "last-good", self.server.model_id, version, reason,
+        )
+        mark_bad = getattr(self.server.registry, "mark_version_bad", None)
+        if mark_bad is not None and version is not None:
+            try:
+                # flags the version AND falls the registry's active
+                # pointer back, so the server's next refresh() reloads
+                # the last good version instead of the rejected one
+                mark_bad(self.server.model_id, version, reason=reason)
+            except Exception:  # noqa: BLE001 - gate must not kill refresh
+                logger.exception("mark_version_bad failed for %s v%s",
+                                 self.server.model_id, version)
 
     def schedule(
         self,
@@ -574,6 +708,24 @@ class MLEvaluator:
         return ev.schedule_from_packed(
             buf, b, k, c, l, n, algorithm=self.fallback, limit=limit
         )
+
+
+@functools.lru_cache(maxsize=1)
+def _canary_probe() -> dict:
+    """Fixed probe batch for the activation gate: one small deterministic
+    synthetic cluster's download records replayed as scoring requests
+    (the same records/synth + features pipeline the trainer and the
+    evaluator differential tests use). Cached — the gate scores the SAME
+    batch for every version, so rejections are reproducible and the
+    per-gate cost is one tiny device call, not a data pipeline."""
+    from dragonfly2_tpu.records import synth
+    from dragonfly2_tpu.records.features import downloads_to_eval_batch
+
+    cluster = synth.make_cluster(16, seed=0)
+    records = synth.gen_download_records(cluster, 8)
+    return downloads_to_eval_batch(
+        records, batch_tasks=8, batch_candidates=8
+    ).as_dict()
 
 
 @jax.jit

@@ -133,8 +133,11 @@ class ScenarioEngine:
         piece: int, attempt: int,
     ) -> tuple[int, str | None]:
         """(cost_ns, fault) for one piece transfer. fault ∈ {None,
-        "error", "stall"}: an error aborts the transfer through the retry
-        path; a stall completes but carries the stall in its cost."""
+        "error", "stall", "corrupt"}: an error aborts the transfer through
+        the retry path; a stall completes but carries the stall in its
+        cost; a corrupt transfer completes with WRONG bytes — the child's
+        digest verification refuses them and reports reason="corruption"
+        (the quarantine path)."""
         key = (task_idx, piece, attempt)
         rtt = self.rtt_ns(child, parent, key=key)
         bw = self.pair_bandwidth(child, parent)
@@ -153,6 +156,9 @@ class ScenarioEngine:
             elif roll < flaky.piece_error_rate + flaky.piece_stall_rate:
                 fault = "stall"
                 cost += int(flaky.stall_seconds * 1e9)
+            elif roll < (flaky.piece_error_rate + flaky.piece_stall_rate
+                         + flaky.piece_corrupt_rate):
+                fault = "corrupt"
             if fault is not None:
                 self._record(fault, parent.id, *key)
         return cost, fault
@@ -280,7 +286,7 @@ class FaultInjector:
         self.stall_seconds = spec.flaky.stall_seconds
         self._mu = threading.Lock()
         self._attempts: dict[tuple[str, int], int] = {}
-        self.injected: dict[str, int] = {"error": 0, "stall": 0}
+        self.injected: dict[str, int] = {"error": 0, "stall": 0, "corrupt": 0}
 
     def piece_fault(self, task_id: str, piece: int) -> str | None:
         with self._mu:
@@ -292,8 +298,33 @@ class FaultInjector:
             verdict = "error"
         elif roll < flaky.piece_error_rate + flaky.piece_stall_rate:
             verdict = "stall"
+        elif roll < (flaky.piece_error_rate + flaky.piece_stall_rate
+                     + flaky.piece_corrupt_rate):
+            verdict = "corrupt"
         else:
             return None
         with self._mu:
             self.injected[verdict] += 1
         return verdict
+
+    def corrupt_bytes(self, task_id: str, piece: int, data: bytes) -> bytes:
+        """Deterministically corrupt one piece's bytes (the trust-boundary
+        adversary): the SAME (task, piece) always corrupts the same way,
+        so replays and the chaos e2e's byte-level assertions are stable.
+        "bitflip" flips one deterministic bit; "truncate" drops a
+        deterministic 1..64-byte tail. The serving side rewrites its
+        advisory digest header to match (a consistent liar) — only the
+        scheduler-attested chain catches the result."""
+        if not data:
+            return data
+        mode = self.spec.flaky.corrupt_mode
+        u = _u(self.seed, "corrupt_at", task_id, piece)
+        if mode == "truncate":
+            drop = 1 + int(u * min(len(data) - 1, 63)) if len(data) > 1 else 0
+            return data[: len(data) - drop] if drop else b""
+        # bitflip (default)
+        bit = int(u * len(data) * 8)
+        byte_i, bit_i = divmod(bit, 8)
+        out = bytearray(data)
+        out[byte_i] ^= 1 << bit_i
+        return bytes(out)

@@ -71,6 +71,14 @@ class FlakySpec:
     piece_error_rate: float = 0.0  # P(piece from a flaky parent errors)
     piece_stall_rate: float = 0.0  # P(piece from a flaky parent stalls)
     stall_seconds: float = 1.0     # injected stall duration
+    # Deterministic CONTENT corruption (the trust-boundary adversary): a
+    # corrupting parent serves bytes that differ from the origin's, with
+    # its advisory digest header rewritten to match — only verification
+    # against the scheduler-attested chain catches it. Modes: "bitflip"
+    # (one deterministic bit flipped) or "truncate" (deterministic tail
+    # dropped).
+    piece_corrupt_rate: float = 0.0  # P(piece from a flaky parent corrupts)
+    corrupt_mode: str = "bitflip"    # bitflip | truncate
 
 
 @dataclasses.dataclass
@@ -225,6 +233,23 @@ def builtin_scenarios() -> dict[str, ScenarioSpec]:
                 piece_error_rate=0.25,
                 piece_stall_rate=0.10,
                 stall_seconds=0.5,
+            ),
+        ),
+        "corruption": ScenarioSpec(
+            name="corruption",
+            description=(
+                "20% of hosts serve CORRUPT bytes on 30% of pieces "
+                "(deterministic bit flips under a self-consistent digest "
+                "header) plus a little plain flakiness — children verify "
+                "against scheduler-attested digests, report "
+                "reason=corruption, and the scheduler quarantines the "
+                "corrupting parents (time-decayed release)"
+            ),
+            flaky=FlakySpec(
+                parent_fraction=0.20,
+                piece_error_rate=0.05,
+                piece_corrupt_rate=0.30,
+                corrupt_mode="bitflip",
             ),
         ),
         "hotspot": ScenarioSpec(

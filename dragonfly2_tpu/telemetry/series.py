@@ -113,6 +113,46 @@ def scheduler_series(reg) -> _Namespace:
             "per-phase tick wall time", ("phase",),
             buckets=(.0005, .002, .01, .05, .2, 1, 5),
         ),
+        # trust-boundary integrity: corrupt-parent quarantine
+        # (cluster/quarantine.py QuarantineBoard)
+        quarantine_total=c(
+            "dragonfly_scheduler_quarantine_total",
+            "hosts quarantined after integrity failures", ("reason",),
+        ),
+        quarantine_released=c(
+            "dragonfly_scheduler_quarantine_released_total",
+            "quarantined hosts released after their penalty decayed",
+        ),
+        quarantine_active=reg.gauge(
+            "dragonfly_scheduler_quarantine_active",
+            "hosts currently excluded from candidate scheduling",
+        ),
+        quarantine_skipped=c(
+            "dragonfly_scheduler_quarantine_skipped_candidates_total",
+            "candidate slots skipped because their host is quarantined",
+        ),
+        piece_corruption=c(
+            "dragonfly_scheduler_piece_corruption_total",
+            "piece failures attributed to digest-verified corruption",
+        ),
+    )
+
+
+def serving_series(reg) -> _Namespace:
+    """Guarded model activation (registry/serving.py): every new params
+    version is gated — sha256 manifest at load, finite-leaves check, and
+    a canary scoring pass — before it can become the serving snapshot."""
+    c = reg.counter
+    return _Namespace(
+        activation_rejected=c(
+            "dragonfly_serving_activation_rejected_total",
+            "params versions rejected by the activation gate (serving "
+            "stays on the last-good snapshot)", ("reason",),
+        ),
+        activation_accepted=c(
+            "dragonfly_serving_activation_accepted_total",
+            "params versions that passed the activation gate",
+        ),
     )
 
 

@@ -25,6 +25,7 @@ class Code(enum.Enum):
     UNAUTHENTICATED = "Unauthenticated"
     INTERNAL = "Internal"
     DEADLINE_EXCEEDED = "DeadlineExceeded"
+    DATA_LOSS = "DataLoss"
 
 
 class DFError(Exception):
@@ -83,6 +84,25 @@ class Unauthenticated(DFError):
 
 class DeadlineExceeded(DFError):
     code = Code.DEADLINE_EXCEEDED
+
+
+class DataLoss(DFError):
+    """Bytes crossing a trust boundary failed an integrity check."""
+
+    code = Code.DATA_LOSS
+
+
+class PieceCorrupted(DataLoss):
+    """A fetched piece's digest does not match its attested digest — the
+    parent served corrupt bytes (or they were corrupted in flight). The
+    bytes are never committed; the failure report carries
+    reason="corruption" so the scheduler can quarantine the parent."""
+
+
+class TaskIntegrityError(DataLoss):
+    """A task's stored state is internally inconsistent at completion
+    time: piece holes in the finished bitset, summed piece lengths that
+    disagree with the content length, or a whole-task digest mismatch."""
 
 
 _BY_CODE = {cls.code: cls for cls in DFError.__subclasses__()}

@@ -47,6 +47,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection resilience tests (tier-1)"
     )
+    # like chaos: trust-boundary integrity tests (corrupt parents, digest
+    # chains, guarded activation) stay tier-1, never opt-in
+    config.addinivalue_line(
+        "markers", "corruption: trust-boundary integrity tests (tier-1)"
+    )
 
 
 @pytest.fixture

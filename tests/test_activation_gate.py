@@ -1,0 +1,360 @@
+"""Trust-boundary integrity, model plane (ISSUE 5): the params.sha256
+manifest, STATE_BAD + last-good fallback in both registries, and the
+MLEvaluator activation gate (finite-leaves + canary scoring on the
+refresh worker) — a NaN-poisoned or manifest-mismatched published
+version must NEVER become the serving snapshot."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models.graphsage import GraphSAGERanker
+from dragonfly2_tpu.objectstorage.backends import FilesystemBackend
+from dragonfly2_tpu.registry import (
+    BucketModelRegistry,
+    MLEvaluator,
+    ModelEvaluation,
+    ModelRegistry,
+    ModelServer,
+)
+from dragonfly2_tpu.registry.registry import (
+    MODEL_TYPE_GNN,
+    STATE_ACTIVE,
+    STATE_BAD,
+    STATE_INACTIVE,
+)
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.utils import dferrors
+
+pytestmark = pytest.mark.corruption
+
+
+def _graph(n_nodes=64, n_feats=12, edges=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "node_feats": rng.normal(size=(n_nodes, n_feats)).astype(np.float32),
+        "edge_src": rng.integers(0, n_nodes - 1, edges).astype(np.int32),
+        "edge_dst": rng.integers(0, n_nodes - 1, edges).astype(np.int32),
+        "edge_feats": rng.normal(size=(edges, 2)).astype(np.float32),
+    }
+
+
+def _gnn_params(model, graph, n_nodes=64):
+    child = np.zeros(4, np.int32)
+    cands = np.arange(16, dtype=np.int32).reshape(4, 4) % n_nodes
+    pair = np.zeros((4, 4, 2), np.float32)
+    return model.init(jax.random.key(0), graph, child, cands, pair)
+
+
+def _served(registry, graph, hidden=16):
+    model = GraphSAGERanker(hidden_dim=hidden)
+    params = _gnn_params(model, graph, graph["node_feats"].shape[0])
+    server = ModelServer(registry, "ranker", "h", MODEL_TYPE_GNN,
+                         template_params=params)
+    mv = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+        metadata={"hidden_dim": hidden},
+    )
+    registry.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    reg_metrics = m.Registry()
+    return server, MLEvaluator(server, metrics_registry=reg_metrics), params, mv
+
+
+def _poison(params):
+    bad = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(), params)
+    jax.tree_util.tree_leaves(bad)[0].ravel()[0] = np.nan
+    return bad
+
+
+def _packed_buf(b=64, k=8, n_hosts=64, seed=0):
+    from dragonfly2_tpu.ops import evaluator as ev
+    from dragonfly2_tpu.records.features import CandidateFeatures
+    from dragonfly2_tpu.state.fsm import PeerState
+
+    rng = np.random.default_rng(seed)
+    feats = CandidateFeatures.zeros(b, k)
+    feats.valid[:] = True
+    feats.peer_state[:] = int(PeerState.SUCCEEDED)
+    feats.upload_limit[:] = 10
+    feats.parent_host_id[:] = np.arange(1, b * k + 1).reshape(b, k)
+    feats.child_host_id[:] = 0
+    fd = feats.as_dict()
+    child = rng.integers(0, n_hosts, b).astype(np.int32)
+    cands = rng.integers(0, n_hosts, (b, k)).astype(np.int32)
+    buf = ev.pack_eval_batch(fd, child_host_slot=child, cand_host_slot=cands)
+    c = fd["piece_costs"].shape[-1]
+    l = fd["parent_location"].shape[-1]  # noqa: E741
+    n = fd["numeric"].shape[-1]
+    return buf, (b, k, c, l, n)
+
+
+# --------------------------------------------------------- activation gate
+
+
+def test_nan_poisoned_version_never_becomes_serving_snapshot(tmp_path):
+    """Acceptance: a NaN-poisoned published version is rejected BY THE
+    REFRESH WORKER — serving stays on the last-good (params_version,
+    emb_version) pair, the rejection metric increments, the version is
+    marked bad (active pointer falls back), and the gate never runs on
+    the schedule path."""
+    graph = _graph()
+    registry = ModelRegistry(tmp_path)
+    server, evaluator, params, mv = _served(registry, graph)
+    try:
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        good = evaluator.committed_versions[-1]
+        assert good == (server.version, 1)
+        good_params_version = server.version
+
+        mv2 = registry.create_model_version(
+            "ranker", MODEL_TYPE_GNN, "h", _poison(params), ModelEvaluation(),
+            metadata={"hidden_dim": 16},
+        )
+        registry.activate(mv2.model_id, mv2.version)
+        assert server.refresh()
+        assert server.version == mv2.version  # the poison IS on the server
+
+        # async: the gate must run on the worker, not in this caller.
+        # Poll for the post-rejection COMMIT (refresh_count advances
+        # strictly after _reject_version finished marking the registry).
+        evaluator.refresh_embeddings(dict(graph))
+        deadline = time.monotonic() + 60
+        while evaluator.refresh_count < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert evaluator.refresh_count == 2
+        assert evaluator.rejection_count == 1
+        assert evaluator._metrics.activation_rejected.value("nonfinite_params") == 1
+
+        # serving NEVER saw the poisoned version: the refresh that carried
+        # it committed with LAST-GOOD params (emb_version advances, the
+        # params_version does not)
+        snap = evaluator.serving_snapshot()
+        assert snap.params_version == good_params_version
+        assert all(p == good_params_version
+                   for p, _ in evaluator.committed_versions)
+
+        # the registry recovered to last-good without an operator
+        states = {v.version: v.state for v in registry.list_versions(mv.model_id)}
+        assert states == {1: STATE_ACTIVE, 2: STATE_BAD}
+        assert registry.active_version(mv.model_id).version == 1
+        # a bad version can never be (re)activated
+        with pytest.raises(ValueError):
+            registry.activate(mv.model_id, 2)
+        # ...but the trainer's NEXT publish supersedes it normally
+        mv3 = registry.create_model_version(
+            "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+            metadata={"hidden_dim": 16},
+        )
+        assert mv3.version == 3
+        registry.activate(mv3.model_id, 3)
+        assert server.refresh()
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        assert evaluator.serving_snapshot().params_version == 3
+        assert evaluator.rejection_count == 1  # healthy v3 passed the gate
+
+        # gate runs ONLY on refresh: a burst of schedule calls adds none
+        # (the tick-path-latency-unchanged pin, minus wall-clock noise)
+        buf, dims = _packed_buf()
+        gate_runs = evaluator.gate_runs
+        for _ in range(5):
+            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            assert out.shape[-1] == 2
+            assert np.all(np.isfinite(out))
+        assert evaluator.gate_runs == gate_runs
+        assert evaluator.last_used_versions[0] == 3
+    finally:
+        evaluator.close()
+
+
+def test_rejected_version_stays_rejected_across_refreshes(tmp_path):
+    """While the server still holds a rejected version (e.g. its refresh
+    loop has not yet picked up the fallback), topology refreshes keep the
+    table tracking with last-good params and the gate does NOT re-run."""
+    graph = _graph()
+    registry = ModelRegistry(tmp_path)
+    server, evaluator, params, mv = _served(registry, graph)
+    try:
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        mv2 = registry.create_model_version(
+            "ranker", MODEL_TYPE_GNN, "h", _poison(params), ModelEvaluation(),
+            metadata={"hidden_dim": 16},
+        )
+        registry.activate(mv2.model_id, mv2.version)
+        assert server.refresh()
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        assert evaluator.rejection_count == 1
+        runs = evaluator.gate_runs
+        # server NOT refreshed: it still serves the rejected version
+        for _ in range(3):
+            evaluator.refresh_embeddings(dict(graph), wait=True)
+        assert evaluator.gate_runs == runs  # never re-gated
+        assert evaluator.rejection_count == 1
+        assert evaluator.serving_snapshot().params_version == 1
+        assert evaluator.serving_snapshot().emb_version >= 2
+    finally:
+        evaluator.close()
+
+
+def test_gate_with_no_last_good_stays_on_rule_fallback(tmp_path):
+    """First-ever published version is poisoned: nothing commits, and
+    scheduling falls back to the rule blend (no snapshot to serve)."""
+    graph = _graph()
+    registry = ModelRegistry(tmp_path)
+    model = GraphSAGERanker(hidden_dim=16)
+    params = _gnn_params(model, graph)
+    server = ModelServer(registry, "ranker", "h", MODEL_TYPE_GNN,
+                         template_params=params)
+    mv = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", _poison(params), ModelEvaluation(),
+        metadata={"hidden_dim": 16},
+    )
+    registry.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    evaluator = MLEvaluator(server, metrics_registry=m.Registry())
+    try:
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        assert evaluator.rejection_count == 1
+        assert evaluator.serving_snapshot() is None
+        buf, dims = _packed_buf()
+        out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+        assert out.shape[-1] == 2 and np.all(np.isfinite(out))
+        assert evaluator.last_used_versions is None  # rule blend served
+    finally:
+        evaluator.close()
+
+
+# ------------------------------------------------- params.sha256 manifest
+
+
+def test_manifest_mismatch_never_activates_bucket(tmp_path):
+    """Acceptance (bucket registry): a params blob corrupted after
+    publish fails its params.sha256 manifest at load — ModelServer.refresh
+    refuses it, marks the version bad, and serving stays on last-good."""
+    graph = _graph()
+    backend = FilesystemBackend(tmp_path / "store")
+    registry = BucketModelRegistry(backend, "models")
+    server, evaluator, params, mv = _served(registry, graph)
+    try:
+        v1_params_version = server.version
+        mv2 = registry.create_model_version(
+            "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+            metadata={"hidden_dim": 16},
+        )
+        # bit-rot the published blob IN THE BUCKET (after the manifest
+        # was written): sha256 now disagrees
+        key = registry._key(mv2.model_id, mv2.version, "params.msgpack")
+        blob = bytearray(backend.get_object(registry.bucket, key))
+        blob[len(blob) // 2] ^= 0x40
+        backend.put_object(registry.bucket, key, bytes(blob))
+        with pytest.raises(dferrors.DataLoss, match="sha256"):
+            registry.load_params(mv2.model_id, mv2.version)
+
+        registry.activate(mv2.model_id, mv2.version)
+        assert not server.refresh()  # refused, not activated
+        assert server.version == v1_params_version
+        states = {v.version: v.state
+                  for v in registry.list_versions(mv.model_id)}
+        assert states == {1: STATE_ACTIVE, 2: STATE_BAD}
+        assert registry.active_version(mv.model_id).version == 1
+        assert server.refresh() is False  # already on the fallback v1
+        # a torn write (size mismatch) is caught before hashing
+        key3 = registry._key(mv2.model_id, mv2.version, "params.msgpack")
+        backend.put_object(registry.bucket, key3, bytes(blob[:100]))
+        with pytest.raises(dferrors.DataLoss, match="bytes"):
+            registry.load_params(mv2.model_id, mv2.version)
+    finally:
+        evaluator.close()
+
+
+def test_bucket_bad_version_stays_bad_on_activate_cycle(tmp_path):
+    """activate() must refuse a bad version and never resurrect one to
+    inactive while flipping states for a new activation."""
+    backend = FilesystemBackend(tmp_path / "store")
+    registry = BucketModelRegistry(backend, "models")
+    graph = _graph()
+    model = GraphSAGERanker(hidden_dim=16)
+    params = _gnn_params(model, graph)
+    v1 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    v2 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    registry.activate(v1.model_id, 2)
+    registry.mark_version_bad(v1.model_id, 2, reason="canary")
+    # the active pointer fell back to the newest good version
+    assert registry.active_version(v1.model_id).version == 1
+    with pytest.raises(ValueError, match="bad"):
+        registry.activate(v1.model_id, 2)
+    v3 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    registry.activate(v3.model_id, 3)
+    states = {v.version: v.state for v in registry.list_versions(v1.model_id)}
+    assert states == {1: STATE_INACTIVE, 2: STATE_BAD, 3: STATE_ACTIVE}
+    # marking the last good version bad leaves no active version
+    registry.mark_version_bad(v3.model_id, 3)
+    registry.mark_version_bad(v3.model_id, 1)
+    assert registry.active_version(v1.model_id) is None
+
+
+def test_mark_bad_fallback_skips_params_less_versions(tmp_path):
+    """The recover-to-last-good pointer must land on a LOADABLE version:
+    a publisher that died before uploading params leaves a not-bad but
+    params-less version that activate() refuses — the bad-version
+    fallback must skip it too (both registries)."""
+    graph = _graph()
+    model = GraphSAGERanker(hidden_dim=16)
+    params = _gnn_params(model, graph)
+    # bucket registry
+    backend = FilesystemBackend(tmp_path / "store")
+    bucket = BucketModelRegistry(backend, "models")
+    b1 = bucket.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                     ModelEvaluation())
+    b2 = bucket.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                     ModelEvaluation())
+    backend.delete_object(bucket.bucket,
+                          bucket._key(b2.model_id, 2, "params.msgpack"))
+    b3 = bucket.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                     ModelEvaluation())
+    bucket.activate(b3.model_id, 3)
+    bucket.mark_version_bad(b3.model_id, 3, reason="canary")
+    assert bucket.active_version(b1.model_id).version == 1  # skipped v2
+    # fs registry
+    import shutil
+
+    fs = ModelRegistry(tmp_path / "fs")
+    f1 = fs.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                 ModelEvaluation())
+    f2 = fs.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                 ModelEvaluation())
+    shutil.rmtree(fs.base / f2.model_id / "2" / "params")
+    f3 = fs.create_model_version("r", MODEL_TYPE_GNN, "h", params,
+                                 ModelEvaluation())
+    fs.activate(f3.model_id, 3)
+    fs.mark_version_bad(f3.model_id, 3, reason="canary")
+    assert fs.active_version(f1.model_id).version == 1  # skipped v2
+
+
+def test_fs_mark_version_bad_fallback(tmp_path):
+    """fs ModelRegistry: same bad/fallback semantics as the bucket."""
+    registry = ModelRegistry(tmp_path)
+    graph = _graph()
+    model = GraphSAGERanker(hidden_dim=16)
+    params = _gnn_params(model, graph)
+    v1 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    v2 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    registry.activate(v1.model_id, 2)
+    registry.mark_version_bad(v1.model_id, 2, reason="nonfinite_params")
+    assert registry.active_version(v1.model_id).version == 1
+    states = {v.version: v.state for v in registry.list_versions(v1.model_id)}
+    assert states == {1: STATE_ACTIVE, 2: STATE_BAD}
+    with pytest.raises(ValueError, match="bad"):
+        registry.activate(v1.model_id, 2)
+    # marking a non-active version bad does not move the pointer
+    v3 = registry.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation())
+    registry.mark_version_bad(v3.model_id, 3)
+    assert registry.active_version(v1.model_id).version == 1

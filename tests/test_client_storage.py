@@ -106,7 +106,9 @@ def test_download_source_known_length(tmp_path):
     )
     seen = []
     pm = PieceManager(concurrency=3)
-    total, pieces = pm.download_source(ts, f"file://{src}", on_piece=lambda n, l, c: seen.append(n))
+    total, pieces = pm.download_source(
+        ts, f"file://{src}", on_piece=lambda n, l, c, d: seen.append(n)
+    )
     assert (total, pieces) == (5000, 10)
     assert sorted(seen) == list(range(10))
     assert ts.read_range(0, 5000) == payload

@@ -377,7 +377,12 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
         t0 = time.perf_counter()
         evaluator.refresh_embeddings(dict(graph, full_sync=True), wait=True)
         t_full.append(time.perf_counter() - t0)
-    refresh_bound = max(min(t_full), 0.1)
+    # noise floor 0.15: the hammer's mid-run params flip now also runs the
+    # activation gate on the worker, whose first canary scoring pass pays
+    # a one-time jit compile that (on CPU) shares the XLA intra-op pool
+    # with serving — a fast machine's min(t_full) can undercut the real
+    # contention a tick may briefly see
+    refresh_bound = max(min(t_full), 0.15)
 
     buf, dims = _packed_buf(n_hosts=n_nodes)
     np.asarray(evaluator.schedule_from_packed(buf, *dims))  # warm the ml jit

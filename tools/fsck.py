@@ -1,0 +1,119 @@
+"""Offline integrity scan of a daemon's task storage (dfstore fsck).
+
+Walks every task directory under a daemon data dir (the layout
+`client/storage.py` writes: `<dir>/<task_id>/{data, metadata.json,
+pieces.jsonl}`), re-hashes each recorded piece's bytes against its
+committed md5, and — for completed tasks — the whole file against the
+recorded task sha256. Exit status is the contract: 0 = every digest
+matched, 1 = at least one mismatch/hole, 2 = nothing scannable.
+
+This is the OFFLINE leg of the trust-boundary integrity chain: the
+scheduler attests digests in-band (children verify before commit) and the
+upload server verifies on serve; fsck catches rot that happened while a
+daemon was down, before the task is ever advertised again.
+
+Usage:
+    python -m tools.fsck <data_dir> [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import sys
+
+from dragonfly2_tpu.client.storage import TaskStorage
+from dragonfly2_tpu.utils.digest import md5_from_bytes
+
+
+@dataclasses.dataclass
+class Finding:
+    task_id: str
+    kind: str       # piece_digest | task_digest | short_data | unreadable
+    detail: str
+    piece: int = -1
+
+
+def _scan_task(ts: TaskStorage) -> list[Finding]:
+    findings: list[Finding] = []
+    task_id = ts.meta.task_id
+    try:
+        # seek+read one piece at a time: a store can hold multi-GiB tasks
+        # and fsck must not allocate a whole data file per task
+        with open(ts.data_path, "rb") as f:
+            for number in sorted(ts.meta.pieces):
+                piece = ts.meta.pieces[number]
+                f.seek(piece.offset)
+                chunk = f.read(piece.length)
+                if len(chunk) != piece.length:
+                    findings.append(Finding(
+                        task_id, "short_data",
+                        f"piece {number}: data file holds {len(chunk)} of "
+                        f"{piece.length} bytes", number,
+                    ))
+                    continue
+                if piece.digest and md5_from_bytes(chunk) != piece.digest:
+                    findings.append(Finding(
+                        task_id, "piece_digest",
+                        f"piece {number}: md5 mismatch vs recorded digest",
+                        number,
+                    ))
+    except OSError as e:
+        return [Finding(task_id, "unreadable", f"data file: {e}")]
+    if ts.meta.done and ts.meta.digest and ts.meta.content_length >= 0:
+        actual = ts.compute_digest()
+        if actual != ts.meta.digest:
+            findings.append(Finding(
+                task_id, "task_digest",
+                f"whole-task sha256 {actual} != recorded {ts.meta.digest}",
+            ))
+    return findings
+
+
+def scan(data_dir: str | pathlib.Path) -> tuple[int, list[Finding]]:
+    """(tasks_scanned, findings) over every task directory in `data_dir`."""
+    base = pathlib.Path(data_dir)
+    scanned = 0
+    findings: list[Finding] = []
+    for task_dir in sorted(p for p in base.iterdir() if p.is_dir()):
+        if not (task_dir / "metadata.json").exists():
+            continue
+        ts = TaskStorage.load(base, task_dir)
+        if ts is None:
+            findings.append(Finding(task_dir.name, "unreadable",
+                                    "metadata failed to load"))
+            continue
+        scanned += 1
+        findings.extend(_scan_task(ts))
+    return scanned, findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("data_dir", help="daemon storage directory")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings on stdout")
+    args = parser.parse_args(argv)
+    if not pathlib.Path(args.data_dir).is_dir():
+        print(f"fsck: {args.data_dir}: not a directory", file=sys.stderr)
+        return 2
+    scanned, findings = scan(args.data_dir)
+    if args.as_json:
+        print(json.dumps({
+            "tasks_scanned": scanned,
+            "findings": [dataclasses.asdict(f) for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"BAD  {f.task_id} [{f.kind}] {f.detail}")
+        print(f"fsck: {scanned} task(s) scanned, {len(findings)} finding(s)")
+    if scanned == 0:
+        print(f"fsck: no tasks under {args.data_dir}", file=sys.stderr)
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
