@@ -623,11 +623,18 @@ def main() -> int:
             summary["loop_pieces_per_sec"] = leg.get("value")
         elif m == "full_loop_tick_p50_ms":
             summary["loop_tick_p50_ms"] = leg.get("value")
+            phases = leg.get("phases_p50_ms") or {}
             # pipelined-tick acceptance: host work overlapped with
             # in-flight device calls, as a share of in-flight wall
-            overlap = (leg.get("phases_p50_ms") or {}).get("overlap_pct")
+            overlap = phases.get("overlap_pct")
             if overlap is not None:
                 summary["loop_overlap_pct"] = overlap
+            # columnar control plane acceptance (PR 8): the host-side
+            # control phases' per-tick sum vs the device conversation —
+            # both REAL recorder phases now, not derived approximations
+            for key in ("control_dispatch", "device_call"):
+                if key in phases:
+                    summary[f"loop_{key}_p50_ms"] = phases[key]
         elif m == "full_loop_ml_tick_p50_ms":
             # off-critical-path refresh acceptance: time refresh stalled
             # the ml arm's serving (r05: 4.98 s) + ml/default throughput

@@ -60,6 +60,11 @@ from dragonfly2_tpu.utils.digest import stable_hash64
 
 logger = logging.getLogger(__name__)
 
+# FSM display strings by raw state value: the batched apply builds one
+# CandidateParent per kept parent, and constructing the PeerState enum per
+# parent is measurable at B~1k rows per tick.
+_STATE_DISPLAY = {int(s): s.display for s in PeerState}
+
 
 @dataclasses.dataclass
 class _Pending:
@@ -147,6 +152,34 @@ class SchedulerService:
         self._peer_meta: dict[str, _PeerMeta] = {}
         self._task_peers: dict[str, list[str]] = {}
         self._dag_slot_peer: dict[str, dict[int, str]] = {}
+        # Columnar control plane (ROADMAP item 1): per-task int32 column
+        # mapping DAG slot -> SoA peer row, maintained at register/leave,
+        # so candidate fill resolves a sampled slot matrix to peer rows
+        # with one fancy-index gather instead of two dict hops per
+        # candidate. False = the per-peer loop path, kept as the
+        # decision-equivalence oracle.
+        self.vectorized_control = bool(getattr(sched, "vectorized_control", True))
+        self._slot_pidx: dict[str, np.ndarray] = {}
+        # Reverse of _PeerMeta.held_parents: parent peer_id -> children
+        # holding one of its host's upload slots. _leave_peer used to scan
+        # EVERY peer's held_parents to find them (~200 us per leave at 10k
+        # hosts, the dominant GC cost); the reverse index makes it O(holders).
+        self._children_of_parent: dict[str, set[str]] = {}
+        # Buffered piece-report ingestion: piece_finished validates and
+        # enqueues (peer_row, piece, length, cost_ns, parent_row) tuples;
+        # stat mutation into the SoA columns happens as ONE vectorised
+        # apply per tick (report_ingest phase) or at an explicit flush
+        # valve (peer finish/fail, leave, GC, serving-graph reads) so no
+        # reader ever observes stale columns. Single list of tuples: an
+        # append is one atomic op under the GIL, so RPC threads can
+        # enqueue while the tick thread swaps the buffer out. The RPC
+        # server runs handlers AND tick under service.mu, but in-proc
+        # drivers (simulator, bench_loop, tests) call tick() bare — the
+        # small dedicated lock below covers the swap itself so a report
+        # can never be lost or double-absorbed between an append and a
+        # concurrent flush regardless of the driver.
+        self._piece_buf: list[tuple] = []
+        self._piece_buf_mu = threading.Lock()
         self._pending: dict[str, _Pending] = {}
         self._host_info: dict[str, msg.HostInfo] = {}
         # Seed-peer trigger path (resource/seed_peer.go TriggerTask): seed
@@ -384,6 +417,7 @@ class SchedulerService:
             dag_slot=slot,
             created_at_ns=time.time_ns(),
         )
+        self._slot_pidx[req.task_id][slot] = peer_idx
         self._task_peers.setdefault(req.task_id, []).append(req.peer_id)
 
         scope = (
@@ -441,12 +475,19 @@ class SchedulerService:
         return None
 
     def piece_finished(self, req: msg.DownloadPieceFinishedRequest):
-        """DownloadPieceFinished (:1102): bitset + cost ring on the child,
-        upload accounting on the parent host."""
+        """DownloadPieceFinished (:1102): validate + enqueue. The stat
+        mutation (child bitset + cost ring, parent host upload counters,
+        serving-edge accumulation) is BUFFERED and absorbed into the SoA
+        columns as one vectorised batch per tick (`report_ingest` phase)
+        — the reference mutates per report under a mutex
+        (service_v2.go:1102); at replay rates the per-report Python/numpy
+        scalar ops were the largest host-side cost between device calls.
+        Only the digest-chain adoption stays inline: it needs the peer's
+        FSM state AT REPORT TIME (back-to-source gate, trust-boundary
+        PR), and origin reports are rare."""
         idx = self.state.peer_index(req.peer_id)
         if idx is None:
             return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self.state.record_piece(idx, req.piece_number, float(req.cost_ns))
         if (not req.parent_peer_id and req.digest
                 and self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)):
             # origin-fetched piece: its md5 joins the task's attested
@@ -460,35 +501,158 @@ class SchedulerService:
             if meta is not None:
                 chain = self._task_piece_digests.setdefault(meta.task_id, {})
                 chain.setdefault(int(req.piece_number), req.digest)
-        if req.parent_peer_id:
-            meta = self._peer_meta.get(req.peer_id)
-            pidx = self.state.peer_index(req.parent_peer_id)
-            if meta is not None and pidx is not None:
-                stats = meta.parents.setdefault(
-                    req.parent_peer_id, {"pieces": [], "bytes": 0}
-                )
-                if len(stats["pieces"]) < 10:
-                    stats["pieces"].append(
-                        PieceRecord(length=req.length, cost=req.cost_ns, created_at=time.time_ns())
-                    )
-                stats["bytes"] += req.length
-                host_idx = self.state.peer_host[pidx]
-                self.state.host_upload_count[host_idx] += 1
-                if req.cost_ns > 0:
-                    c_slot, p_slot = int(self.state.peer_host[idx]), int(host_idx)
-                    key = (c_slot, self._slot_gen.get(c_slot, 0),
-                           p_slot, self._slot_gen.get(p_slot, 0))
-                    acc = self._serving_edges.get(key)
-                    if acc is None and len(self._serving_edges) < self._serving_edge_cap:
-                        acc = self._serving_edges[key] = [0.0, 0]
-                    if acc is not None:
-                        acc[0] += req.length / (req.cost_ns / 1e9)
-                        acc[1] += 1
-                        # the edge update changes BOTH endpoints' embedding
-                        # inputs — mark them for the incremental refresh
-                        self._dirty_host_slots.add(c_slot)
-                        self._dirty_host_slots.add(p_slot)
+        pidx = -1
+        if req.parent_peer_id and req.peer_id in self._peer_meta:
+            p = self.state.peer_index(req.parent_peer_id)
+            if p is not None:
+                pidx = int(p)
+        with self._piece_buf_mu:
+            self._piece_buf.append(
+                (int(idx), int(req.piece_number), int(req.length),
+                 float(req.cost_ns), pidx)
+            )
         return None
+
+    def pieces_finished_batch(
+        self,
+        peer_id: str,
+        piece_numbers,
+        lengths,
+        costs_ns,
+        parent_ids: list[str] = (),
+        parent_sel=None,
+    ):
+        """Bulk DownloadPieceFinished ingestion: one call enqueues a whole
+        wave of piece reports for `peer_id`. `parent_sel[i]` indexes
+        `parent_ids` (or -1 for origin/no parent) so the per-parent id
+        resolution happens once per distinct parent, not once per piece.
+        The simulator's event loop reports through here; the columns
+        absorb everything at the next flush exactly like per-report
+        `piece_finished` calls would have. Origin digest-chain adoption is
+        NOT supported on this path — callers carrying digests use
+        `piece_finished`."""
+        idx = self.state.peer_index(peer_id)
+        if idx is None:
+            return msg.ScheduleFailure(peer_id, "NotFound", "unknown peer")
+        idx = int(idx)
+        has_meta = peer_id in self._peer_meta
+        pmap = []
+        for pid in parent_ids:
+            p = self.state.peer_index(pid) if has_meta else None
+            pmap.append(-1 if p is None else int(p))
+        if parent_sel is None:
+            parent_sel = (-1,) * len(piece_numbers)
+        rows = [
+            (idx, int(piece), int(length), float(cost),
+             pmap[sel] if 0 <= sel < len(pmap) else -1)
+            for piece, length, cost, sel in zip(
+                piece_numbers, lengths, costs_ns, parent_sel
+            )
+        ]
+        with self._piece_buf_mu:
+            self._piece_buf.extend(rows)
+        return None
+
+    def flush_piece_reports(self) -> int:
+        """Absorb every buffered piece report into the SoA columns now.
+        Called automatically at the tick's report_ingest phase and at
+        every flush valve (peer finish/fail, leave, GC sweeps,
+        serving-graph reads); public so tests and out-of-band readers can
+        force column visibility."""
+        return self._absorb_piece_reports()
+
+    def _absorb_piece_reports(self) -> int:
+        """One vectorised apply of the buffered reports: bitset + cost
+        ring + liveness via state.record_pieces_batch, parent-host upload
+        counters via one scatter-add, serving-edge/dirty-frontier
+        accumulation grouped per (child_host, parent_host), and the
+        capped per-(child, parent) DownloadRecord stats. Equivalent to
+        the old per-report mutation applied in buffer order."""
+        if not self._piece_buf:
+            return 0
+        with self._piece_buf_mu:
+            buf = self._piece_buf
+            if not buf:
+                return 0
+            self._piece_buf = []
+        n = len(buf)
+        cols = np.asarray(buf, np.float64)
+        peer = cols[:, 0].astype(np.int64)
+        piece = cols[:, 1].astype(np.int64)
+        length = cols[:, 2].astype(np.int64)
+        cost = cols[:, 3]
+        parent = cols[:, 4].astype(np.int64)
+        st = self.state
+        st.record_pieces_batch(peer, piece, cost)
+        hasp = parent >= 0
+        if not hasp.any():
+            return n
+        p = parent[hasp]
+        c = peer[hasp]
+        plen = length[hasp]
+        pcost = cost[hasp]
+        phost = st.peer_host[p].astype(np.int64)
+        np.add.at(st.host_upload_count, phost, 1)
+        # serving-edge accumulation, grouped by (child_host, parent_host)
+        chost = st.peer_host[c].astype(np.int64)
+        pos = pcost > 0
+        if pos.any():
+            key = chost[pos] * st.max_hosts + phost[pos]
+            uniq, first, inv = np.unique(
+                key, return_index=True, return_inverse=True
+            )
+            tput_sum = np.zeros(uniq.size)
+            np.add.at(tput_sum, inv, plen[pos] / (pcost[pos] / 1e9))
+            cnt = np.bincount(inv, minlength=uniq.size)
+            # first-occurrence order, not numeric key order: under cap
+            # pressure the per-report path admitted whichever NEW pair was
+            # reported first — replay that admission order exactly
+            for i in np.argsort(first):
+                c_slot = int(uniq[i] // st.max_hosts)
+                p_slot = int(uniq[i] % st.max_hosts)
+                k4 = (c_slot, self._slot_gen.get(c_slot, 0),
+                      p_slot, self._slot_gen.get(p_slot, 0))
+                acc = self._serving_edges.get(k4)
+                if acc is None and len(self._serving_edges) < self._serving_edge_cap:
+                    acc = self._serving_edges[k4] = [0.0, 0]
+                if acc is not None:
+                    acc[0] += float(tput_sum[i])
+                    acc[1] += int(cnt[i])
+                    # the edge update changes BOTH endpoints' embedding
+                    # inputs — mark them for the incremental refresh
+                    self._dirty_host_slots.add(c_slot)
+                    self._dirty_host_slots.add(p_slot)
+        # per-(child, parent) DownloadRecord stats: bytes sum vectorised,
+        # PieceRecords capped at 10 per pair like the per-report path
+        pair_key = c * st.max_peers + p
+        order = np.argsort(pair_key, kind="stable")
+        sk = pair_key[order]
+        changed = np.empty(sk.size, bool)
+        changed[0] = True
+        np.not_equal(sk[1:], sk[:-1], out=changed[1:])
+        starts = np.flatnonzero(changed)
+        ends = np.empty(starts.size, np.int64)
+        ends[:-1] = starts[1:]
+        ends[-1] = sk.size
+        now_ns = time.time_ns()
+        for s, e in zip(starts, ends):
+            rows = order[s:e]
+            child_pid = st._peer_id[int(c[rows[0]])]
+            parent_pid = st._peer_id[int(p[rows[0]])]
+            if child_pid is None or parent_pid is None:
+                continue
+            meta = self._peer_meta.get(child_pid)
+            if meta is None:
+                continue
+            stats = meta.parents.setdefault(parent_pid, {"pieces": [], "bytes": 0})
+            stats["bytes"] += int(plen[rows].sum())
+            room = 10 - len(stats["pieces"])
+            for r in rows[:room] if room > 0 else ():
+                stats["pieces"].append(
+                    PieceRecord(length=int(plen[r]), cost=int(pcost[r]),
+                                created_at=now_ns)
+                )
+        return n
 
     def piece_failed(self, req: msg.DownloadPieceFailedRequest):
         """DownloadPieceFailed: parent host failure accounting + reschedule
@@ -685,10 +849,21 @@ class SchedulerService:
 
         scheduling.go:85-213's per-peer retry loop, inverted: back-to-source
         and retry-exhaustion decided host-side, everything else in a single
-        (B, K) device call.
+        (B, K) device call. The three control phases feeding it —
+        report_ingest (buffered piece-report absorption), candidate_fill
+        and apply_selection — run as columnar batch ops over the SoA
+        state; the per-tick sum of EVERY host-side phase (those three
+        plus pre_schedule, feature_gather and pack) is recorded as the
+        `control_dispatch` phase, next to `device_call` (= dispatch +
+        d2h_wait), so the control-plane-vs-device balance reads straight
+        off the flight recorder with nothing left out of either side.
         """
         recorder = self.recorder
         recorder.begin()
+        # Absorb every piece report buffered since the last flush valve:
+        # candidate scoring below reads the finished/cost/upload columns.
+        self._absorb_piece_reports()
+        recorder.mark("report_ingest")
         responses: list = []
         work: list[_Pending] = []
         for pending in list(self._pending.values()):
@@ -712,66 +887,20 @@ class SchedulerService:
 
         k = self.config.scheduler.filter_parent_limit
         b = len(work)
-        cand_peer_idx = np.zeros((b, k), np.int32)
-        cand_valid = np.zeros((b, k), bool)
-        child_peer_idx = np.zeros(b, np.int32)
-        blocklist = np.zeros((b, k), bool)
-        in_degree = np.zeros((b, k), np.int32)
-        can_add_edge = np.zeros((b, k), bool)
-        cand_ids: list[list[str]] = []
-        child_host_slots = np.zeros(b, np.int32)
-        cand_host_slots = np.zeros((b, k), np.int32)
-
-        # Cycle checks batch PER TASK, not per peer: all pending peers of
-        # one task share a DAG, and the (parent_slot, child_slot) pairs
-        # API pays one ctypes round-trip per task per tick — the per-peer
-        # call's ~100 us marshalling was the biggest host-side tick cost
-        # after the transport fix.
-        task_pairs: dict[str, list[tuple[int, int, int, int]]] = {}
-        # Quarantine snapshot for this tick: hosts currently excluded for
-        # integrity failures. The common case (nothing quarantined) costs
-        # one lock-free-ish length check; members are re-checked through
-        # is_quarantined so decay-released hosts rejoin mid-snapshot.
-        q_active = self.quarantine.active() if self.quarantine.active_count() else ()
-        for i, pending in enumerate(work):
-            meta = self._peer_meta[pending.peer_id]
-            child_peer_idx[i] = self.state.peer_index(pending.peer_id)
-            child_host_slots[i] = self.state.peer_host[child_peer_idx[i]]
-            dag = self._task_dag(meta.task_id)
-            sampled = dag.random_vertices(k, self.rng)
-            slot_to_peer = self._dag_slot_peer.get(meta.task_id, {})
-            ids = []
-            pairs = task_pairs.setdefault(meta.task_id, [])
-            j = 0
-            for slot in sampled:
-                pid = slot_to_peer.get(int(slot))
-                if pid is None or pid == pending.peer_id:
-                    continue
-                pidx = self.state.peer_index(pid)
-                if pidx is None:
-                    continue
-                if q_active:
-                    phost = self.state.host_id_at(int(self.state.peer_host[pidx]))
-                    if phost in q_active and self.quarantine.is_quarantined(phost):
-                        self._series.quarantine_skipped.labels().inc()
-                        continue
-                cand_peer_idx[i, j] = pidx
-                cand_valid[i, j] = True
-                blocklist[i, j] = pid in pending.blocklist
-                in_degree[i, j] = dag.in_degree[slot]
-                cand_host_slots[i, j] = self.state.peer_host[pidx]
-                pairs.append((int(slot), meta.dag_slot, i, j))
-                ids.append(pid)
-                j += 1
-                if j >= k:
-                    break
-            cand_ids.append(ids)
-        for task_id, pairs in task_pairs.items():
-            if not pairs:
-                continue
-            arr = np.asarray(pairs, np.int64)
-            ok = self._task_dag(task_id).can_add_edges_pairs(arr[:, 0], arr[:, 1])
-            can_add_edge[arr[:, 2], arr[:, 3]] = ok
+        # Candidate sampling is the same vectorised per-task draw on both
+        # fill paths (shared _sample_rows helper, identical rng call
+        # sequence), so the vectorised and per-peer loop fills are
+        # decision-comparable given the same seed.
+        if self.vectorized_control:
+            fill = self._fill_candidates_vec(work, k)
+        else:
+            fill = self._fill_candidates_loop(
+                work, self._sample_candidates(work, k)[0], k
+            )
+        (cand_peer_idx, cand_valid, child_peer_idx, blocklist, in_degree,
+         can_add_edge, child_host_slots, cand_host_slots, cand_slots,
+         cand_ids) = fill
+        cand_count = cand_valid.sum(axis=1).astype(np.int64)
         recorder.mark("candidate_fill")
 
         avg_rtt = has_rtt = None
@@ -852,41 +981,57 @@ class SchedulerService:
             return packed
 
         def _drain_chunk(s: int, e: int, packed, overlapped: bool) -> None:
-            """Block on chunk [s:e)'s D2H, then apply its selections. The
+            """Block on chunk [s:e)'s D2H, then apply its selections.
+            Phase attribution is explicit (recorder.add with measured
+            walls, not cursor marks): on pipelined multi-chunk ticks the
+            drain runs interleaved with the NEXT chunk's pack/dispatch
+            marks, and a cursor mark here would lump the apply
+            bookkeeping into whichever device phase marked last. The
             packed (B, limit, 2) selection is the jit's ONLY output, so a
             chunk pays exactly one D2H transfer; with `overlapped` the
-            host-side unpack+apply wall time is also credited to the
-            `overlap` phase — it ran while the NEXT chunk's device call
-            was in flight, which is the latency the pipeline hides."""
+            host-side unpack+apply wall is also credited to the `overlap`
+            phase — it ran while the NEXT chunk's device call was in
+            flight, which is the latency the pipeline hides."""
+            t_wait = time.perf_counter()
             arr = np.asarray(packed)[: e - s]
-            recorder.mark("d2h_wait")
             t0 = time.perf_counter()
+            recorder.add("d2h_wait", (t0 - t_wait) * 1e3)
             selected, selected_valid, selected_scores = ev.unpack_selection(arr)
-            for row, i in enumerate(range(s, e)):
-                pending = work[i]
-                meta = self._peer_meta[pending.peer_id]
-                parents = []
-                for j in range(limit):
-                    if not selected_valid[row, j]:
-                        break
-                    pid = (
-                        cand_ids[i][selected[row, j]]
-                        if selected[row, j] < len(cand_ids[i]) else None
-                    )
-                    if pid is None:
-                        continue
-                    parents.append((pid, float(selected_scores[row, j])))
-                if not parents:
-                    pending.retries += 1
-                    continue  # stays pending for the next tick (retry loop)
-                response = self._apply_selection(pending, meta, parents)
-                if response is None:
-                    continue  # all selections DAG-rejected; stays pending
-                responses.append(response)
-                self._pending.pop(pending.peer_id, None)
-            recorder.mark("apply_selection")
+            if self.vectorized_control:
+                self._apply_chunk_batch(
+                    work, s, e, selected, selected_valid, selected_scores,
+                    cand_peer_idx, cand_slots, cand_count, responses,
+                )
+            else:
+                for row, i in enumerate(range(s, e)):
+                    pending = work[i]
+                    meta = self._peer_meta[pending.peer_id]
+                    parents = []
+                    for j in range(limit):
+                        if not selected_valid[row, j]:
+                            break
+                        pid = (
+                            cand_ids[i][selected[row, j]]
+                            if selected[row, j] < len(cand_ids[i]) else None
+                        )
+                        if pid is None:
+                            continue
+                        parents.append((pid, float(selected_scores[row, j])))
+                    if not parents:
+                        pending.retries += 1
+                        continue  # stays pending for the next tick (retry loop)
+                    response = self._apply_selection(pending, meta, parents)
+                    if response is None:
+                        continue  # all selections DAG-rejected; stays pending
+                    responses.append(response)
+                    self._pending.pop(pending.peer_id, None)
+            dt = (time.perf_counter() - t0) * 1e3
+            recorder.add("apply_selection", dt)
             if overlapped:
-                recorder.add("overlap", (time.perf_counter() - t0) * 1e3)
+                recorder.add("overlap", dt)
+            # the drain timed itself via add(); move the mark cursor so
+            # the next chunk's "pack" mark doesn't inherit this wall
+            recorder.sync()
 
         # Double-buffered dispatch: chunk i+1's pack + device call are
         # issued BEFORE blocking on chunk i's D2H, and chunk i's host-side
@@ -909,8 +1054,325 @@ class SchedulerService:
                 _drain_chunk(*in_flight, overlapped=True)
             in_flight = (s, e, packed)
         _drain_chunk(*in_flight, overlapped=False)
+        # Aggregate phases for the operator-facing comparison (satellite:
+        # control_dispatch is a REAL recorded phase now, not bench_loop's
+        # trivial-dispatch link-RTT probe): control_dispatch sums the
+        # host-side control plane, device_call the device conversation.
+        recorder.add("control_dispatch", (
+            recorder.value("report_ingest") + recorder.value("pre_schedule")
+            + recorder.value("candidate_fill") + recorder.value("feature_gather")
+            + recorder.value("pack") + recorder.value("apply_selection")
+        ))
+        recorder.add("device_call", (
+            recorder.value("dispatch") + recorder.value("d2h_wait")
+        ))
         recorder.commit()
         return responses
+
+    # ------------------------------------------------- columnar tick ops
+
+    def _sample_candidates(self, work: list, k: int):
+        """Uniform up-to-k present-DAG-slot samples for every pending
+        peer, drawn per TASK group through the shared _sample_rows helper
+        (the vectorised fill draws identically inside its fused per-task
+        pass, so both paths see the same candidates for the same seed).
+        Returns ((b, k) int32 slot matrix padded -1, {task_id: rows})."""
+        b = len(work)
+        out = np.full((b, k), -1, np.int32)
+        groups = self._group_rows_by_task(work)
+        for task_id, rows in groups.items():
+            dag = self._task_dag(task_id)
+            live = np.flatnonzero(dag.present)
+            if live.size == 0:
+                continue
+            s = _sample_rows(self.rng, live, len(rows), k)
+            out[np.asarray(rows)[:, None], np.arange(s.shape[1])] = s
+        return out, groups
+
+    def _group_rows_by_task(self, work: list) -> dict[str, list[int]]:
+        groups: dict[str, list[int]] = {}
+        for i, pending in enumerate(work):
+            groups.setdefault(
+                self._peer_meta[pending.peer_id].task_id, []
+            ).append(i)
+        return groups
+
+    def _quarantined_slot_mask(self) -> np.ndarray:
+        """Bool mask over HOST slots for this tick's candidate fill: one
+        decay-aware is_quarantined check per active host (same release
+        side effects as the per-candidate checks it replaces), gathered
+        by the vectorised fill in one fancy index."""
+        mask = np.zeros(self.state.max_hosts, bool)
+        for host_id in self.quarantine.active():
+            if not self.quarantine.is_quarantined(host_id):
+                continue
+            slot = self.state.host_index(host_id)
+            if slot is not None:
+                mask[slot] = True
+        return mask
+
+    def _fill_candidates_vec(self, work: list, k: int):
+        """Columnar candidate fill: ONE fused pass per task group samples
+        live DAG slots (dag.go GetRandomVertices semantics) and gathers
+        slot->peer-row / in-degree columns; validity masking, self/
+        quarantine exclusion and the stable left-compaction (matching the
+        per-peer loop's skip-and-append candidate order) run as flat
+        (B, K) ops; DAG legality batches once per task. Python work is
+        O(tasks + blocklisted rows), not O(B x K)."""
+        st = self.state
+        b = len(work)
+        child_peer_idx = np.fromiter(
+            (st.peer_index(p.peer_id) for p in work), np.int64, b
+        ).astype(np.int32)
+        child_host_slots = st.peer_host[child_peer_idx].astype(np.int32)
+        child_dag_slot = np.fromiter(
+            (self._peer_meta[p.peer_id].dag_slot for p in work), np.int64, b
+        )
+        groups = self._group_rows_by_task(work)
+        samples = np.full((b, k), -1, np.int64)
+        pidx = np.full((b, k), -1, np.int64)
+        ind = np.zeros((b, k), np.int32)
+        task_rows: list[tuple] = []  # (task_id, dag, row_array) for legality
+        for task_id, rows in groups.items():
+            dag = self._task_dag(task_id)
+            spx = self._slot_pidx.get(task_id)
+            live = np.flatnonzero(dag.present)
+            r = np.asarray(rows, np.int64)
+            task_rows.append((task_id, dag, r))
+            if live.size == 0 or spx is None:
+                continue
+            s = _sample_rows(self.rng, live, r.size, k)
+            cols = np.arange(s.shape[1])
+            rr = r[:, None]
+            samples[rr, cols] = s
+            pidx[rr, cols] = spx[s]
+            ind[rr, cols] = dag.in_degree[s]
+        valid = pidx >= 0
+        safe = np.where(valid, pidx, 0)
+        valid &= st.peer_alive[safe]
+        valid &= pidx != child_peer_idx[:, None]
+        host = st.peer_host[safe].astype(np.int64)
+        if self.quarantine.active_count():
+            qmask = self._quarantined_slot_mask()
+            would = valid & qmask[np.clip(host, 0, st.max_hosts - 1)]
+            skipped = int(would.sum())
+            if skipped:
+                valid &= ~would
+                self._series.quarantine_skipped.labels().inc(skipped)
+        # left-compact valid candidates, preserving sample order (the
+        # per-peer loop appends survivors in sample order)
+        order = np.argsort(~valid, axis=1, kind="stable")
+        take = lambda a: np.take_along_axis(a, order, axis=1)  # noqa: E731
+        cand_valid = take(valid)
+        cand_peer_idx = np.where(cand_valid, take(safe), 0).astype(np.int32)
+        cand_slots = np.where(cand_valid, take(np.where(valid, samples, 0)), -1)
+        cand_host_slots = np.where(cand_valid, take(host), 0).astype(np.int32)
+        in_degree = np.where(cand_valid, take(ind), 0).astype(np.int32)
+        blocklist = np.zeros((b, k), bool)
+        for i, pending in enumerate(work):
+            if not pending.blocklist:
+                continue
+            bidx = {st.peer_index(x) for x in pending.blocklist}
+            bidx.discard(None)
+            if bidx:
+                blocklist[i] = cand_valid[i] & np.isin(
+                    cand_peer_idx[i], np.fromiter(bidx, np.int64, len(bidx))
+                )
+        can_add_edge = np.zeros((b, k), bool)
+        for task_id, dag, r in task_rows:
+            v = cand_valid[r]
+            if not v.any():
+                continue
+            rr, cc = np.nonzero(v)
+            ok = dag.can_add_edges_pairs(
+                cand_slots[r][rr, cc],
+                child_dag_slot[r][rr],
+            )
+            can_add_edge[r[rr], cc] = ok
+        return (cand_peer_idx, cand_valid, child_peer_idx, blocklist,
+                in_degree, can_add_edge, child_host_slots, cand_host_slots,
+                cand_slots, None)
+
+    def _fill_candidates_loop(self, work: list, samples: np.ndarray, k: int):
+        """Per-peer loop fill (the pre-columnar path, kept verbatim as the
+        decision-equivalence oracle): consumes the same shared candidate
+        samples, then filters/marks one candidate at a time."""
+        st = self.state
+        b = len(work)
+        cand_peer_idx = np.zeros((b, k), np.int32)
+        cand_valid = np.zeros((b, k), bool)
+        child_peer_idx = np.zeros(b, np.int32)
+        blocklist = np.zeros((b, k), bool)
+        in_degree = np.zeros((b, k), np.int32)
+        can_add_edge = np.zeros((b, k), bool)
+        cand_ids: list[list[str]] = []
+        child_host_slots = np.zeros(b, np.int32)
+        cand_host_slots = np.zeros((b, k), np.int32)
+        cand_slots = np.full((b, k), -1, np.int64)
+        # Cycle checks batch PER TASK, not per peer: all pending peers of
+        # one task share a DAG, and the (parent_slot, child_slot) pairs
+        # API pays one ctypes round-trip per task per tick — the per-peer
+        # call's ~100 us marshalling was the biggest host-side tick cost
+        # after the transport fix.
+        task_pairs: dict[str, list[tuple[int, int, int, int]]] = {}
+        # Quarantine snapshot for this tick: hosts currently excluded for
+        # integrity failures. The common case (nothing quarantined) costs
+        # one lock-free-ish length check; members are re-checked through
+        # is_quarantined so decay-released hosts rejoin mid-snapshot.
+        q_active = self.quarantine.active() if self.quarantine.active_count() else ()
+        for i, pending in enumerate(work):
+            meta = self._peer_meta[pending.peer_id]
+            child_peer_idx[i] = st.peer_index(pending.peer_id)
+            child_host_slots[i] = st.peer_host[child_peer_idx[i]]
+            dag = self._task_dag(meta.task_id)
+            sampled = samples[i][samples[i] >= 0]
+            slot_to_peer = self._dag_slot_peer.get(meta.task_id, {})
+            ids = []
+            pairs = task_pairs.setdefault(meta.task_id, [])
+            j = 0
+            for slot in sampled:
+                pid = slot_to_peer.get(int(slot))
+                if pid is None or pid == pending.peer_id:
+                    continue
+                pidx = st.peer_index(pid)
+                if pidx is None:
+                    continue
+                if q_active:
+                    phost = st.host_id_at(int(st.peer_host[pidx]))
+                    if phost in q_active and self.quarantine.is_quarantined(phost):
+                        self._series.quarantine_skipped.labels().inc()
+                        continue
+                cand_peer_idx[i, j] = pidx
+                cand_valid[i, j] = True
+                blocklist[i, j] = pid in pending.blocklist
+                in_degree[i, j] = dag.in_degree[slot]
+                cand_host_slots[i, j] = st.peer_host[pidx]
+                cand_slots[i, j] = int(slot)
+                pairs.append((int(slot), meta.dag_slot, i, j))
+                ids.append(pid)
+                j += 1
+                if j >= k:
+                    break
+            cand_ids.append(ids)
+        for task_id, pairs in task_pairs.items():
+            if not pairs:
+                continue
+            arr = np.asarray(pairs, np.int64)
+            ok = self._task_dag(task_id).can_add_edges_pairs(arr[:, 0], arr[:, 1])
+            can_add_edge[arr[:, 2], arr[:, 3]] = ok
+        return (cand_peer_idx, cand_valid, child_peer_idx, blocklist,
+                in_degree, can_add_edge, child_host_slots, cand_host_slots,
+                cand_slots, cand_ids)
+
+    def _apply_chunk_batch(self, work: list, s: int, e: int, selected,
+                           selected_valid, selected_scores, cand_peer_idx,
+                           cand_slots, cand_count, responses: list) -> None:
+        """Batched selection apply for rows [s:e): DAG edges land through
+        one grouped legality batch per task (graph/dag.add_edges_grouped,
+        sequential-equivalent), upload-slot accounting through one
+        scatter-add, and responses are emitted in row order (the same
+        order the per-peer path produces, so downstream consumers see an
+        identical stream)."""
+        st = self.state
+        limit = self.config.scheduler.candidate_parent_limit
+        # pass 1: decode selections per row, group DAG edge adds per task
+        rows_sel: list = [None] * (e - s)
+        by_task: dict[str, list[int]] = {}
+        for row, i in enumerate(range(s, e)):
+            pending = work[i]
+            meta = self._peer_meta[pending.peer_id]
+            count = int(cand_count[i])
+            pslots, ppidx, pscores = [], [], []
+            for j in range(limit):
+                if not selected_valid[row, j]:
+                    break
+                pos = int(selected[row, j])
+                if pos >= count:
+                    continue
+                pslots.append(int(cand_slots[i, pos]))
+                ppidx.append(int(cand_peer_idx[i, pos]))
+                pscores.append(float(selected_scores[row, j]))
+            if not pslots:
+                pending.retries += 1
+                continue  # stays pending for the next tick (retry loop)
+            rows_sel[row] = (pending, meta, pslots, ppidx, pscores)
+            by_task.setdefault(meta.task_id, []).append(row)
+        # pass 2: one grouped edge-add batch per task (row order within a
+        # task preserved; tasks have disjoint DAGs so cross-task order is
+        # immaterial)
+        accepted: dict[int, np.ndarray] = {}
+        for task_id, task_rows in by_task.items():
+            dag = self._task_dag(task_id)
+            acc = dag.add_edges_grouped(
+                [np.asarray(rows_sel[r][2], np.int64) for r in task_rows],
+                np.asarray([rows_sel[r][1].dag_slot for r in task_rows], np.int64),
+            )
+            for r, a in zip(task_rows, acc):
+                accepted[r] = a
+        # pass 3: responses + upload accounting, in row order
+        upload_hosts: list[int] = []
+        for row in range(e - s):
+            entry = rows_sel[row]
+            if entry is None:
+                continue
+            pending, meta, pslots, ppidx, pscores = entry
+            acc = accepted.get(row)
+            kept = []
+            for pid_idx, score, ok in zip(ppidx, pscores, acc):
+                if not ok:
+                    continue
+                pid = st._peer_id[pid_idx]
+                pmeta = self._peer_meta.get(pid) if pid is not None else None
+                if pmeta is None:
+                    continue
+                upload_hosts.append(int(st.peer_host[pid_idx]))
+                meta.held_parents.add(pid)
+                self._children_of_parent.setdefault(pid, set()).add(
+                    pending.peer_id
+                )
+                host = self._host_info.get(pmeta.host_id)
+                kept.append(
+                    msg.CandidateParent(
+                        peer_id=pid,
+                        host_id=pmeta.host_id,
+                        ip=host.ip if host else "",
+                        port=host.port if host else 0,
+                        download_port=host.download_port if host else 0,
+                        state=_STATE_DISPLAY[int(st.peer_state[pid_idx])],
+                        score=score,
+                    )
+                )
+            if not kept:
+                pending.retries += 1
+                continue  # stays pending (all selections DAG-rejected)
+            responses.append(self._finish_normal_response(pending, meta, kept))
+            self._pending.pop(pending.peer_id, None)
+        if upload_hosts:
+            np.add.at(
+                st.host_upload_used, np.asarray(upload_hosts, np.int64), 1
+            )
+
+    def _finish_normal_response(self, pending: _Pending, meta: _PeerMeta,
+                                kept: list) -> msg.NormalTaskResponse:
+        """Attach the attested digest chain (when it grew since this
+        peer's last response) and build the NormalTaskResponse — shared
+        tail of the per-peer and batched apply paths."""
+        chain = self._task_piece_digests.get(meta.task_id)
+        digests = {}
+        if chain:
+            sent = self._chain_sent.setdefault(meta.task_id, {})
+            if sent.get(pending.peer_id, 0) < len(chain):
+                # string keys: the wire codec's hardened unpack
+                # (strict_map_key) refuses int map keys, and the
+                # conductor re-ints them on receipt
+                digests = {str(n): d for n, d in chain.items()}
+                sent[pending.peer_id] = len(digests)
+        return msg.NormalTaskResponse(
+            peer_id=pending.peer_id,
+            candidate_parents=kept,
+            piece_digests=digests,
+            task_digest=self._task_sha256.get(meta.task_id, ""),
+        )
 
     # ============================================================ helpers
 
@@ -962,6 +1424,7 @@ class SchedulerService:
             pidx = self.state.peer_index(pid)
             self.state.host_upload_used[self.state.peer_host[pidx]] += 1
             meta.held_parents.add(pid)
+            self._children_of_parent.setdefault(pid, set()).add(pending.peer_id)
             host = self._host_info.get(pmeta.host_id)
             kept.append(
                 msg.CandidateParent(
@@ -978,28 +1441,7 @@ class SchedulerService:
             pending.retries += 1
             self._pending[pending.peer_id] = pending
             return None  # caller keeps the peer pending for the next tick
-        # Attach the attested digest chain (copied under service.mu: the
-        # response is serialized on the event loop after the tick returns,
-        # while origin reports may still be appending to the live dict) —
-        # but only when it grew since this peer's last response; the
-        # conductor merges entries first-writer-wins, so resending an
-        # unchanged chain is pure wire/CPU waste.
-        chain = self._task_piece_digests.get(meta.task_id)
-        digests = {}
-        if chain:
-            sent = self._chain_sent.setdefault(meta.task_id, {})
-            if sent.get(pending.peer_id, 0) < len(chain):
-                # string keys: the wire codec's hardened unpack
-                # (strict_map_key) refuses int map keys, and the
-                # conductor re-ints them on receipt
-                digests = {str(n): d for n, d in chain.items()}
-                sent[pending.peer_id] = len(digests)
-        return msg.NormalTaskResponse(
-            peer_id=pending.peer_id,
-            candidate_parents=kept,
-            piece_digests=digests,
-            task_digest=self._task_sha256.get(meta.task_id, ""),
-        )
+        return self._finish_normal_response(pending, meta, kept)
 
     def _release_parent_slots(self, peer_id: str) -> None:
         """Free the upload slots this child holds on its parents' hosts.
@@ -1017,11 +1459,21 @@ class SchedulerService:
                 self.state.host_upload_used[host_idx] = max(
                     0, int(self.state.host_upload_used[host_idx]) - 1
                 )
+            holders = self._children_of_parent.get(pid)
+            if holders is not None:
+                holders.discard(peer_id)
+                if not holders:
+                    del self._children_of_parent[pid]
         meta.held_parents.clear()
 
     def _write_download_record(self, peer_id: str, state: str) -> None:
         if self.storage is None:
             return
+        # flush valve: the record reads the piece columns and the
+        # per-parent stats buffered reports feed. Record-less services
+        # (the bench A/B arms, most tests) skip this entirely and absorb
+        # once per tick instead of once per completion.
+        self._absorb_piece_reports()
         meta = self._peer_meta.get(peer_id)
         idx = self.state.peer_index(peer_id)
         if meta is None or idx is None:
@@ -1103,35 +1555,47 @@ class SchedulerService:
         if dag is None:
             dag = TaskDAG(self._dag_capacity)
             self._dags[task_id] = dag
+            # columnar twin of _dag_slot_peer: DAG slot -> SoA peer row
+            self._slot_pidx[task_id] = np.full(self._dag_capacity, -1, np.int32)
         return dag
 
     def _alloc_dag_slot(self, task_id: str, peer_id: str, dag: TaskDAG) -> int:
         """Next free vertex slot, or -1 when every slot is held by a live
         peer (register_peer refuses the peer; the daemon back-sources)."""
         slots = self._dag_slot_peer.setdefault(task_id, {})
-        for slot in range(dag.capacity):
-            if not dag.present[slot]:
-                dag.ensure_vertex(slot)
-                slots[slot] = peer_id
-                return slot
-        return -1
+        free = np.flatnonzero(~dag.present)
+        if free.size == 0:
+            return -1
+        slot = int(free[0])  # lowest free slot, like the old linear scan
+        dag.ensure_vertex(slot)
+        slots[slot] = peer_id
+        return slot
 
     def _leave_peer(self, peer_id: str) -> None:
+        # flush FIRST: buffered piece reports reference SoA rows by index,
+        # and this is the only path that frees rows for reuse — absorbing
+        # after the free could credit a recycled row
+        self._absorb_piece_reports()
         meta = self._peer_meta.get(peer_id)
         if meta is None:
             return
         # Free slots this child holds, and slots children hold on THIS peer's
-        # host (its out-edges die with the vertex).
+        # host (its out-edges die with the vertex). The reverse index
+        # (_children_of_parent) names the holders directly — the previous
+        # every-peer scan was ~200 us per leave at 10k hosts, the
+        # dominant GC-sweep cost in the loop bench.
         self._release_parent_slots(peer_id)
-        for child_meta in self._peer_meta.values():
-            if peer_id in child_meta.held_parents:
-                child_meta.held_parents.discard(peer_id)
-                idx_self = self.state.peer_index(peer_id)
-                if idx_self is not None:
-                    host_idx = self.state.peer_host[idx_self]
-                    self.state.host_upload_used[host_idx] = max(
-                        0, int(self.state.host_upload_used[host_idx]) - 1
-                    )
+        for child_pid in self._children_of_parent.pop(peer_id, ()):
+            child_meta = self._peer_meta.get(child_pid)
+            if child_meta is None or peer_id not in child_meta.held_parents:
+                continue
+            child_meta.held_parents.discard(peer_id)
+            idx_self = self.state.peer_index(peer_id)
+            if idx_self is not None:
+                host_idx = self.state.peer_host[idx_self]
+                self.state.host_upload_used[host_idx] = max(
+                    0, int(self.state.host_upload_used[host_idx]) - 1
+                )
         self._peer_meta.pop(peer_id, None)
         sent = self._chain_sent.get(meta.task_id)
         if sent is not None:
@@ -1142,6 +1606,9 @@ class SchedulerService:
         dag = self._task_dag(meta.task_id)
         dag.delete_vertex(meta.dag_slot)
         self._dag_slot_peer.get(meta.task_id, {}).pop(meta.dag_slot, None)
+        spx = self._slot_pidx.get(meta.task_id)
+        if spx is not None and 0 <= meta.dag_slot < spx.shape[0]:
+            spx[meta.dag_slot] = -1
         peers = self._task_peers.get(meta.task_id)
         if peers and peer_id in peers:
             peers.remove(peer_id)
@@ -1210,6 +1677,9 @@ class SchedulerService:
         sched = self.config.scheduler
         swept: dict[str, int] = {}
         with self.mu:
+            # TTL sweeps read peer/host updated_at — absorb buffered
+            # reports so recent activity counts as liveness
+            self._absorb_piece_reports()
             if force or now - self._last_peer_gc >= sched.peer_gc_interval_seconds:
                 self._last_peer_gc = now
                 swept["peers"] = self._gc_peers(now)
@@ -1270,6 +1740,7 @@ class SchedulerService:
     def _drop_task_maps(self, task_id: str) -> None:
         self._dags.pop(task_id, None)
         self._dag_slot_peer.pop(task_id, None)
+        self._slot_pidx.pop(task_id, None)
         self._task_peers.pop(task_id, None)
         self._task_piece_digests.pop(task_id, None)
         self._task_sha256.pop(task_id, None)
@@ -1361,6 +1832,7 @@ class SchedulerService:
         from dragonfly2_tpu.records.features import EDGE_FEATURE_SCALE
 
         with self.mu:
+            self._absorb_piece_reports()  # edges/dirty-frontier visibility
             alive_mask = np.asarray(self.state.host_alive, bool)
             alive = np.nonzero(alive_mask)[0]
             used = int(alive.max()) + 1 if alive.size else 1
@@ -1479,6 +1951,20 @@ class SchedulerService:
 
 def _round_up_64(n: int) -> int:
     return ((n + 63) // 64) * 64
+
+
+def _sample_rows(rng: np.random.Generator, live: np.ndarray, m: int, k: int
+                 ) -> np.ndarray:
+    """(m, min(k, len(live))) independent uniform k-subsets of `live`,
+    one rng draw for the whole group: random keys per row + argpartition
+    (argsort when everything fits) pick k distinct slots uniformly.
+    Shared by both candidate-fill paths so their rng streams match."""
+    keys = rng.random((m, live.size))
+    if live.size <= k:
+        idx = np.argsort(keys, axis=1, kind="stable")
+    else:
+        idx = np.argpartition(keys, k - 1, axis=1)[:, :k]
+    return live[idx].astype(np.int32)
 
 
 # Fixed (B, K) batch buckets for the jitted scheduling kernels; the largest

@@ -29,6 +29,8 @@ from __future__ import annotations
 import dataclasses
 import typing
 
+import numpy as np
+
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.state.fsm import PeerState, TaskState
 from dragonfly2_tpu.utils import idgen
@@ -251,7 +253,10 @@ class SchedulerServiceV1:
     def report_piece_result(self, res: V1PieceResult):
         """service_v1.go:187 — one piece frame. Returns a v2-shaped
         response (or None); the caller converts tick/stream responses for
-        v1 connections with `to_peer_packet`."""
+        v1 connections with `to_peer_packet`. Success frames land in the
+        scheduler's buffered piece-report ingestion (absorbed into the
+        SoA columns once per tick, report_ingest phase) — the v1 stream
+        shares the columnar control plane with v2."""
         num = res.piece_info.piece_num
         if num == BEGIN_OF_PIECE:
             # handleBeginOfPiece (:1122): Received -> Running happens on
@@ -325,8 +330,12 @@ class SchedulerServiceV1:
         ))
         idx = self.svc.state.peer_index(req.peer_id)
         if idx is not None:
-            for piece in range(max(req.total_piece_count, 1)):
-                self.svc.state.record_piece(idx, piece, 0.0)
+            # one columnar batch instead of a per-piece record_piece loop
+            # (an announced replica can carry thousands of pieces)
+            n = max(req.total_piece_count, 1)
+            self.svc.state.record_pieces_batch(
+                np.full(n, int(idx), np.int64), np.arange(n), np.zeros(n)
+            )
         self.svc.handle(msg.DownloadPeerFinishedRequest(peer_id=req.peer_id))
 
     def stat_task(self, req: msg.StatTaskRequest) -> V1Task:

@@ -78,10 +78,21 @@ class ClusterSimulator:
         seed: int = 0,
         piece_length: int = 4 << 20,
         scenario=None,
+        deterministic_peer_ids: bool = False,
     ):
         self.scheduler = scheduler
         self.cluster = synth.make_cluster(num_hosts, seed=seed)
         self.rng = self.cluster.rng
+        # Vectorised draws for the legacy (scenario-less) piece-cost
+        # model: same distributions as the old per-piece
+        # rtt_ns/lognormvariate calls, one numpy draw per wave. Seeded
+        # from the sim seed so paired A/B arms stay paired.
+        self._nprng = np.random.default_rng(seed + 0x5EED)
+        # deterministic peer ids ("peer-<reg index>") let two sims with
+        # the same seed be compared response-for-response (the
+        # vectorized-vs-loop control-plane equivalence test); default
+        # keeps uuid4 so concurrent sims can share a scheduler.
+        self._det_ids = deterministic_peer_ids
         self.piece_length = piece_length
         self.stats = SimStats()
         # Scenario lab (scenarios/): a ScenarioSpec turns on the
@@ -155,7 +166,9 @@ class ClusterSimulator:
                 task = self.rng.choices(self._tasks, weights=self._task_weights)[0]
             else:
                 task = self.rng.choice(self._tasks)
-        peer_id = str(uuid.uuid4())
+        peer_id = (
+            f"peer-{self._reg_index}" if self._det_ids else str(uuid.uuid4())
+        )
         self._peer_reg[peer_id] = self._reg_index
         self._reg_index += 1
         self._peer_host[peer_id] = host.id
@@ -291,7 +304,11 @@ class ClusterSimulator:
             info = self._host_info.get(trig.host_id)
             if task is None or info is None:
                 continue
-            peer_id = f"seed-{uuid.uuid4()}"
+            if self._det_ids:
+                peer_id = f"seed-{self._reg_index}"
+                self._reg_index += 1
+            else:
+                peer_id = f"seed-{uuid.uuid4()}"
             self._peer_host[peer_id] = trig.host_id
             self._task_of[peer_id] = task
             svc.register_peer(msg.RegisterPeerRequest(
@@ -354,24 +371,43 @@ class ClusterSimulator:
         n_pieces = task["pieces"]
         parents = resp.candidate_parents
         if self.engine is None:
-            # legacy homogeneous replay: latent host quality + IDC RTT
-            for piece in range(n_pieces):
-                parent = parents[piece % len(parents)]
-                parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
-                rtt = self.cluster.rtt_ns(child_host, parent_host)
-                service_ms = self.piece_length / (max(parent_host.quality, 0.05) * 100e6) * 1e3
-                cost = int(rtt + service_ms * self.rng.lognormvariate(0.0, 0.25) * 1e6)
-                self.scheduler.piece_finished(
-                    msg.DownloadPieceFinishedRequest(
-                        peer_id=peer_id,
-                        piece_number=piece,
-                        length=self.piece_length,
-                        cost_ns=cost,
-                        parent_peer_id=parent.peer_id,
-                    )
+            # legacy homogeneous replay: latent host quality + IDC RTT,
+            # vectorised per wave (same distributions as the per-piece
+            # rtt_ns + lognormvariate calls — base RTT by IDC/region tier
+            # with lognorm(0, 0.3) jitter, service time from the parent's
+            # latent quality with lognorm(0, 0.25) jitter) and reported
+            # as ONE pieces_finished_batch call into the scheduler's
+            # columnar report buffer instead of n_pieces message objects.
+            base_ms = np.empty(len(parents))
+            svc_ms = np.empty(len(parents))
+            for pi, parent in enumerate(parents):
+                ph = self._hosts_by_id[
+                    self._peer_host.get(parent.peer_id, parent.host_id)
+                ]
+                base_ms[pi] = self.cluster.base_rtt_ms(child_host, ph)
+                svc_ms[pi] = (
+                    self.piece_length / (max(ph.quality, 0.05) * 100e6) * 1e3
                 )
-                self.stats.pieces += 1
-                self.stats.piece_cost_ns_total += cost
+            psel = np.arange(n_pieces) % len(parents)
+            rtt = np.maximum(
+                1,
+                (base_ms[psel]
+                 * self._nprng.lognormal(0.0, synth.RTT_JITTER_SIGMA, n_pieces)
+                 * 1e6).astype(np.int64),
+            )
+            cost = rtt + (
+                svc_ms[psel] * self._nprng.lognormal(0.0, 0.25, n_pieces) * 1e6
+            ).astype(np.int64)
+            self.scheduler.pieces_finished_batch(
+                peer_id,
+                range(n_pieces),
+                np.full(n_pieces, self.piece_length, np.int64),
+                cost,
+                parent_ids=[p.peer_id for p in parents],
+                parent_sel=psel,
+            )
+            self.stats.pieces += n_pieces
+            self.stats.piece_cost_ns_total += int(cost.sum())
             self.scheduler.peer_finished(
                 msg.DownloadPeerFinishedRequest(
                     peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces
@@ -390,10 +426,32 @@ class ClusterSimulator:
         if wave > 1:
             self.stats.retry_waves += 1
         crash_after = self.engine.crash_point(self._peer_reg.get(peer_id, 0), n_pieces)
+        # Per-piece costs/faults stay on the engine's counter-hashed
+        # deterministic draws, but the finished reports accumulate and
+        # land in ONE pieces_finished_batch call (flushed before any
+        # fault/crash report so the scheduler observes the same
+        # report-then-fail order the per-piece path produced).
+        parent_ids = [p.peer_id for p in parents]
+        batch_nums: list[int] = []
+        batch_costs: list[int] = []
+        batch_sel: list[int] = []
+
+        def flush_batch():
+            if batch_nums:
+                self.scheduler.pieces_finished_batch(
+                    peer_id, batch_nums,
+                    [self.piece_length] * len(batch_nums),
+                    batch_costs, parent_ids=parent_ids, parent_sel=batch_sel,
+                )
+                batch_nums.clear()
+                batch_costs.clear()
+                batch_sel.clear()
+
         for piece in range(n_pieces):
             if piece in have:
                 continue
-            parent = parents[piece % len(parents)]
+            sel = piece % len(parents)
+            parent = parents[sel]
             parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
             cost, fault = self.engine.piece_cost_ns(
                 child_host, parent_host, self.piece_length,
@@ -401,6 +459,7 @@ class ClusterSimulator:
             )
             if fault == "error":
                 self.stats.injected_piece_failures += 1
+                flush_batch()
                 self.scheduler.piece_failed(
                     msg.DownloadPieceFailedRequest(
                         peer_id=peer_id, parent_peer_id=parent.peer_id
@@ -412,6 +471,7 @@ class ClusterSimulator:
                 # attested digest, refused the bytes, and attributed the
                 # failure — the scheduler quarantines the parent host
                 self.stats.injected_corruptions += 1
+                flush_batch()
                 self.scheduler.piece_failed(
                     msg.DownloadPieceFailedRequest(
                         peer_id=peer_id, parent_peer_id=parent.peer_id,
@@ -421,26 +481,22 @@ class ClusterSimulator:
                 return
             if fault == "stall":
                 self.stats.injected_stalls += 1
-            self.scheduler.piece_finished(
-                msg.DownloadPieceFinishedRequest(
-                    peer_id=peer_id,
-                    piece_number=piece,
-                    length=self.piece_length,
-                    cost_ns=cost,
-                    parent_peer_id=parent.peer_id,
-                )
-            )
+            batch_nums.append(piece)
+            batch_costs.append(cost)
+            batch_sel.append(sel)
             have.add(piece)
             self.stats.pieces += 1
             self.stats.piece_cost_ns_total += cost
             if crash_after is not None and len(have) >= crash_after:
                 self.stats.injected_crashes += 1
+                flush_batch()
                 self.scheduler.peer_failed(
                     msg.DownloadPeerFailedRequest(
                         peer_id=peer_id, description="scenario churn: crashed"
                     )
                 )
                 return
+        flush_batch()
         self.scheduler.peer_finished(
             msg.DownloadPeerFinishedRequest(
                 peer_id=peer_id, content_length=task["content_length"], piece_count=n_pieces
@@ -490,6 +546,12 @@ class ClusterSimulator:
             return 0
         n = 0
         alive = np.asarray(self.scheduler.state.host_alive[: self.scheduler.state.max_hosts])
+        # slot -> host resolved once per round (a 10k-entry dict per
+        # SOURCE dominated the probe round's wall at scale)
+        slot_to_host = {
+            self.scheduler.state.host_index(h.id): h for h in self.cluster.hosts
+            if self.scheduler.state.host_index(h.id) is not None
+        }
         for _ in range(sources):
             src = self.rng.choice(self.cluster.hosts)
             src_slot = self.scheduler.state.host_index(src.id)
@@ -498,10 +560,6 @@ class ClusterSimulator:
             targets = probes.find_probed_hosts(
                 alive, jax.random.key(self.rng.randint(0, 1 << 30)), k=5
             )
-            slot_to_host = {
-                self.scheduler.state.host_index(h.id): h for h in self.cluster.hosts
-                if self.scheduler.state.host_index(h.id) is not None
-            }
             srcs, dsts, rtts = [], [], []
             for t in targets:
                 dst = slot_to_host.get(int(t))

@@ -59,6 +59,12 @@ class SchedulerConfig:
     max_hosts: int = 16384
     max_peers_per_task: int = 256
     max_tasks: int = 4096
+    # Columnar control plane (PR 8): candidate fill, selection apply and
+    # piece-report absorption run as vectorised batch ops over the SoA
+    # columns. False falls back to the per-peer loop path — kept as the
+    # decision-equivalence oracle (tests/test_control_equivalence.py),
+    # not as a production mode.
+    vectorized_control: bool = True
     # resource GC (scheduler/config/config.go GCConfig; pkg/gc/gc.go
     # interval runner semantics — swept from the live tick loop)
     peer_gc_interval_seconds: float = CONSTANTS.PEER_GC_INTERVAL_SECONDS

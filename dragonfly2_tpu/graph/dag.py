@@ -167,11 +167,17 @@ class TaskDAG:
         )
         word, bit = np.divmod(safe_c, 64)
         ok &= (self.adj[safe_p, word] & (np.uint64(1) << bit.astype(np.uint64))) == 0
-        if not ok.any():
+        # Cycle check only where the child can reach ANYTHING: a child with
+        # no out-edges (the common case — a fresh downloader serves nobody
+        # yet) cannot reach the parent, so the edge is legal without a
+        # reachability query. This drops the native round-trip from "every
+        # scheduled peer" to "peers that already serve others".
+        need = ok & (self.out_degree[safe_c] > 0)
+        if not need.any():
             return ok
         from dragonfly2_tpu import native
 
-        idx = np.nonzero(ok)[0]
+        idx = np.nonzero(need)[0]
         batch = native.dag_reachable_batch(self.adj, children[idx], parents[idx])
         if batch is not None:
             ok[idx] &= ~batch
@@ -213,6 +219,97 @@ class TaskDAG:
                 seen.add(p)
                 self._add_edge_unchecked(p, child)
         return ok
+
+    def add_edges_grouped(
+        self, parents_list: list[np.ndarray], children: np.ndarray
+    ) -> list[np.ndarray]:
+        """Batched `add_edges_from` over MANY children in ONE legality
+        round-trip, with sequential-equivalent semantics.
+
+        Children must be distinct (one scheduling decision per peer per
+        tick). The legality of every (parent, child) pair is checked in a
+        single `can_add_edges_pairs` batch against the pre-batch graph;
+        groups are then applied in list order. A pre-batch answer can only
+        go stale for a pair whose parent became reachable from a child
+        that gained in-edges EARLIER in this batch (every new path
+        traverses some new edge, and all new edges end at batch
+        children), so the apply loop tracks `affected` — the union of
+        {child} ∪ descendants(child) bitsets of already-edged children,
+        computed against the then-current graph — and re-checks exactly
+        the pairs whose parent bit is set. In the common case (children
+        with no out-edges) `affected` stays one bit per child and no pair
+        ever re-checks, so the whole batch costs one native call where
+        the per-peer path paid one per child.
+
+        Returns the per-group accepted masks, identical to what
+        sequential `add_edges_from` calls would have returned."""
+        children = np.asarray(children, np.int64)
+        lens = [len(p) for p in parents_list]
+        if not lens or sum(lens) == 0:
+            return [np.zeros(n, bool) for n in lens]
+        flat_p = np.concatenate(
+            [np.asarray(p, np.int64) for p in parents_list if len(p)]
+        )
+        flat_c = np.repeat(children, lens)
+        ok0 = self.can_add_edges_pairs(flat_p, flat_c)
+        results: list[np.ndarray] = []
+        affected = np.zeros(self.words, np.uint64)
+        any_touched = False
+        off = 0
+        for parents, child in zip(parents_list, children):
+            n = len(parents)
+            ok = ok0[off : off + n].copy()
+            off += n
+            child = int(child)
+            seen: set[int] = set()
+            touched = False
+            for i in range(n):
+                if not ok[i]:
+                    continue
+                p = int(parents[i])
+                if p in seen:
+                    ok[i] = False
+                    continue
+                if any_touched:
+                    w, b = divmod(p, 64)
+                    if affected[w] & (np.uint64(1) << np.uint64(b)):
+                        # p is (possibly) reachable from an earlier-edged
+                        # child — the pre-batch legality answer may be
+                        # stale; re-check against the CURRENT graph
+                        if self.reachable(child, p):
+                            ok[i] = False
+                            continue
+                seen.add(p)
+                self._add_edge_unchecked(p, child)
+                touched = True
+            if touched:
+                any_touched = True
+                if self.out_degree[child] == 0:
+                    # no descendants: affected gains exactly the child bit
+                    w, b = divmod(child, 64)
+                    affected[w] |= np.uint64(1) << np.uint64(b)
+                else:
+                    affected |= self._reach_bitset(child)
+            results.append(ok)
+        return results
+
+    def _reach_bitset(self, src: int) -> np.ndarray:
+        """{src} ∪ descendants(src) as a word-bitset (numpy BFS over
+        adjacency rows; exits immediately for a vertex with no
+        out-edges)."""
+        out = np.zeros(self.words, np.uint64)
+        w, b = divmod(src, 64)
+        out[w] = np.uint64(1) << np.uint64(b)
+        frontier = [src]
+        while frontier:
+            nxt = np.bitwise_or.reduce(self.adj[frontier], axis=0) & ~out
+            if not nxt.any():
+                break
+            out |= nxt
+            frontier = np.flatnonzero(
+                np.unpackbits(nxt.view(np.uint8), bitorder="little")
+            ).tolist()
+        return out
 
     def delete_edge(self, u: int, v: int) -> None:
         if not self.has_edge(u, v):

@@ -34,6 +34,13 @@ REGIONS = ["as", "eu", "na"]
 
 NS_PER_MS = 1_000_000
 
+# latent RTT tier model (shared by rtt_ns and the simulator's vectorised
+# legacy piece-cost replay — keep in one place so they cannot drift)
+RTT_SAME_IDC_MS = 0.5
+RTT_SAME_REGION_MS = 5.0
+RTT_CROSS_REGION_MS = 60.0
+RTT_JITTER_SIGMA = 0.3
+
 
 @dataclasses.dataclass
 class SynthHost:
@@ -80,17 +87,20 @@ class SynthCluster:
             updated_at=now_ns,
         )
 
+    def base_rtt_ms(self, src: SynthHost, dst: SynthHost) -> float:
+        """Jitter-free latent RTT tier — the ONE source of truth for the
+        IDC-structured model (the simulator's vectorised legacy replay
+        draws its own jitter batch over these same tiers)."""
+        if src.idc == dst.idc:
+            return RTT_SAME_IDC_MS
+        if src.location.split("|")[0] == dst.location.split("|")[0]:
+            return RTT_SAME_REGION_MS
+        return RTT_CROSS_REGION_MS
+
     def rtt_ns(self, src: SynthHost, dst: SynthHost) -> int:
         """IDC-structured latent RTT: ~0.5ms same IDC, ~5ms same region, ~60ms cross."""
-        src_region, dst_region = src.location.split("|")[0], dst.location.split("|")[0]
-        if src.idc == dst.idc:
-            base = 0.5
-        elif src_region == dst_region:
-            base = 5.0
-        else:
-            base = 60.0
-        jitter = self.rng.lognormvariate(0.0, 0.3)
-        return max(1, int(base * jitter * NS_PER_MS))
+        jitter = self.rng.lognormvariate(0.0, RTT_JITTER_SIGMA)
+        return max(1, int(self.base_rtt_ms(src, dst) * jitter * NS_PER_MS))
 
 
 def make_cluster(num_hosts: int, seed: int = 0, seed_peer_fraction: float = 0.05) -> SynthCluster:

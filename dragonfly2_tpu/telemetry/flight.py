@@ -89,6 +89,21 @@ class PhaseRecorder:
         phases = self._phases
         phases[name] = phases.get(name, 0.0) + ms
 
+    def sync(self) -> None:
+        """Move the phase cursor to now WITHOUT recording anything —
+        callers that time a section explicitly (via add) use this so the
+        NEXT mark() does not inherit that section's wall time."""
+        if self._open:
+            self._t0 = _perf()
+
+    def value(self, name: str) -> float:
+        """Accumulated ms of `name` in the currently-OPEN tick (0.0 when
+        unmarked or no tick is open) — lets the tick compute aggregate
+        phases (control_dispatch = sum of the control-plane phases,
+        device_call = dispatch + d2h_wait) from its own marks before
+        commit."""
+        return self._phases.get(name, 0.0) if self._open else 0.0
+
     def commit(self) -> None:
         if not self._open:
             return

@@ -154,6 +154,9 @@ def test_piece_and_peer_finished_bookkeeping(tmp_path):
                 parent_peer_id="seed-peer",
             )
         )
+    # piece reports buffer until the next tick/flush valve (columnar
+    # report_ingest); force column visibility before asserting
+    svc.flush_piece_reports()
     child_idx = svc.state.peer_index("child-1")
     assert svc.state.peer_finished_count[child_idx] == 4
     seed_host_idx = svc.state.host_index("host-0")

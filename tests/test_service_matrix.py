@@ -213,6 +213,7 @@ def test_piece_accounting_matrix():
                 peer_id="child-1", piece_number=piece, length=1 << 20,
                 cost_ns=5_000_000, parent_peer_id="parent-1",
             ))
+    svc.flush_piece_reports()  # buffered ingestion: make columns visible
     assert svc.state.peer_finished_count[cidx] == 2  # deduped bitset
     assert int(svc.state.host_upload_count[phost]) == upload_before + 4
 

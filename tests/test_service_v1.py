@@ -77,6 +77,7 @@ def test_piece_stream_drives_state_and_failure_reschedules():
         piece_info=sv1.V1PieceInfo(piece_num=0, range_size=1 << 20, download_cost=12),
     ))
     idx = svc.state.peer_index("child-1")
+    svc.flush_piece_reports()  # buffered columnar ingestion
     assert svc.state.peer_finished_count[idx] == 1
     # failed piece blocklists the parent and re-queues the child
     v1.report_piece_result(sv1.V1PieceResult(
@@ -196,6 +197,7 @@ def test_v1_piece_stream_sentinels_and_backsource_pieces():
         task_id="t-1", src_pid="p-1", success=True,
         piece_info=sv1.V1PieceInfo(piece_num=0, range_size=1 << 20),
     ))
+    svc.flush_piece_reports()  # buffered columnar ingestion
     assert svc.state.peer_finished_count[idx] == 1
 
 
