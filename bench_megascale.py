@@ -1,0 +1,126 @@
+"""Megascale scenario-lab bench: event-batch engine runs at 10^5–10^6
+hosts → BENCH_mega.json.
+
+Drives `dragonfly2_tpu.megascale.run_megascale` for one or more
+(scenario, hosts) cells and writes the BENCH_rXX-format artifact with
+per-run reports plus a summary: pieces/s, per-region time-to-complete
+percentiles, origin-traffic fraction, quarantine/failover event counts,
+engine step-phase p50s, and peak RSS.
+
+    python bench_megascale.py --quick                 # 10k-host smoke
+    python bench_megascale.py --full --artifact BENCH_mega.json
+        # the acceptance pair: 100k-host planet (regions + diurnal Zipf
+        # + flash crowds) and 100k-host soak (every fault family at once)
+    python bench_megascale.py --scenario soak --hosts 1000000 \
+        --rounds 30 --artifact BENCH_mega_1m.json     # slow-tier scale
+
+Everything outside each run's `timing` block is deterministic in
+(scenario, hosts, seed) — same contract as BENCH_scenarios.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def summarize(runs: list[dict]) -> dict:
+    out = {}
+    for r in runs:
+        key = f"{r['scenario']}_{r['hosts']}"
+        total = (r.get("origin_bytes") or 0) + (r.get("p2p_bytes") or 0)
+        out[key] = {
+            "pieces_per_sec": r["timing"]["pieces_per_sec"],
+            "wall_s": r["timing"]["wall_s"],
+            "setup_s": r["timing"]["setup_s"],
+            "peak_rss_mb": r["timing"]["peak_rss_mb"],
+            "completed": r["stats"]["completed"],
+            "pieces": r["stats"]["pieces"],
+            "origin_traffic_fraction": r.get("origin_traffic_fraction"),
+            "origin_gib": round(total and (r["origin_bytes"] / (1 << 30)), 2),
+            "ttc_ms_p50_by_region": {
+                name: v["ttc_ms_p50"] for name, v in r["regions"].items()
+            },
+            "fault_families": r["fault_families"],
+            "quarantine": r["quarantine"],
+            "failover": r["failover"],
+        }
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", default="planet",
+                    help="megascale builtin (planet|soak) or any scenario builtin")
+    ap.add_argument("--hosts", type=int, default=100_000)
+    ap.add_argument("--tasks", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="engine rounds (default: one compressed day + drain)")
+    ap.add_argument("--arrivals", type=int, default=None,
+                    help="arrival wave size per round (default ~1.5x hosts/day)")
+    ap.add_argument("--algorithm", default="default")
+    ap.add_argument("--retire", type=int, default=24,
+                    help="retire completed downloads after this many rounds")
+    ap.add_argument("--quick", action="store_true",
+                    help="10k-host smoke configuration")
+    ap.add_argument("--full", action="store_true",
+                    help="the acceptance pair: 100k planet + 100k soak")
+    ap.add_argument("--artifact", default=None,
+                    help="write BENCH_mega.json-format artifact here")
+    args = ap.parse_args()
+
+    from dragonfly2_tpu.megascale.soak import run_megascale
+
+    cells: list[tuple[str, int]] = []
+    if args.full:
+        cells = [("planet", args.hosts), ("soak", args.hosts)]
+    else:
+        if args.quick:
+            args.hosts = 10_000
+        cells = [(args.scenario, args.hosts)]
+
+    runs = []
+    for scenario, hosts in cells:
+        report = run_megascale(
+            scenario=scenario, num_hosts=hosts, num_tasks=args.tasks,
+            seed=args.seed, rounds=args.rounds,
+            arrivals_per_round=args.arrivals, algorithm=args.algorithm,
+            retire_after_rounds=args.retire,
+        )
+        runs.append(report)
+        print(json.dumps({
+            "scenario": scenario, "hosts": hosts,
+            "pieces_per_sec": report["timing"]["pieces_per_sec"],
+            "wall_s": report["timing"]["wall_s"],
+            "origin_traffic_fraction": report["origin_traffic_fraction"],
+        }))
+
+    summary = summarize(runs)
+    print("bench_megascale_summary " + json.dumps(summary))
+    if args.artifact:
+        import platform
+
+        import jax
+
+        with open(args.artifact, "w") as f:
+            json.dump({
+                "cmd": " ".join(["python", "bench_megascale.py"] + sys.argv[1:]),
+                "platform": {
+                    "jax": jax.__version__,
+                    "devices": [str(d) for d in jax.devices()],
+                    "machine": platform.machine(),
+                    "python": platform.python_version(),
+                },
+                "summary": summary,
+                "runs": runs,
+            }, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,7 +111,8 @@ class SchedulerService:
         self.state = ClusterState(
             max_hosts=sched.max_hosts,
             max_tasks=sched.max_tasks,
-            max_peers=sched.max_hosts * 4,
+            max_peers=getattr(sched, "max_peers", 0) or sched.max_hosts * 4,
+            piece_bitset_words=getattr(sched, "piece_bitset_words", 64),
         )
         self.storage = storage
         self.probes = probes
@@ -319,6 +320,34 @@ class SchedulerService:
         for peer_id, meta in list(self._peer_meta.items()):
             if meta.host_id == host_id:
                 self._leave_peer(peer_id)
+        self._drop_host(host_id)
+
+    def leave_hosts_batch(self, host_ids) -> int:
+        """Bulk LeaveHost (megascale bulk API, the leave twin of
+        `register_peers_batch`): one pass over the peer table groups
+        departing peers by host, then each host leaves exactly as
+        sequential `leave_host` calls would — same per-host peer order
+        (peer-table insertion order), same side effects. The per-call
+        `leave_host` scans EVERY peer per host; a rolling-upgrade churn
+        wave at 10^5 hosts retires thousands of hosts per round, and the
+        O(hosts x peers) rescan was the wall. Returns hosts dropped."""
+        targets = [h for h in host_ids if h in self._host_info]
+        if not targets:
+            return 0
+        target_set = set(targets)
+        by_host: dict[str, list[str]] = {}
+        for peer_id, meta in self._peer_meta.items():
+            if meta.host_id in target_set:
+                by_host.setdefault(meta.host_id, []).append(peer_id)
+        for host_id in targets:
+            for peer_id in by_host.get(host_id, ()):
+                self._leave_peer(peer_id)
+            self._drop_host(host_id)
+        return len(targets)
+
+    def _drop_host(self, host_id: str) -> None:
+        """Host-table teardown shared by the single and batch leave paths
+        (the peers must already be gone)."""
         self.state.remove_host(host_id)
         self._host_info.pop(host_id, None)
         self.quarantine.drop(host_id)
@@ -326,6 +355,28 @@ class SchedulerService:
             self._seed_hosts.remove(host_id)
         # its serving edges die with it; neighbors' aggregates change
         self._serving_full_sync = True
+
+    def _pick_seed_host(self, requester: msg.HostInfo) -> str:
+        """Seed host for a cold task's trigger: plain round-robin by
+        default (seed_peer.go TriggerTask); with
+        `scheduler.region_aware_seeds` the round-robin is scoped to seed
+        peers in the requester's region (first location element) when any
+        exist, so a megascale WAN topology's origin fetches land on the
+        in-region seeds instead of paying a WAN hop (ISSUE: seed peers
+        per region)."""
+        pool = self._seed_hosts
+        if getattr(self.config.scheduler, "region_aware_seeds", False):
+            region = requester.location.split("|", 1)[0]
+            local = [
+                h for h in self._seed_hosts
+                if self._host_info.get(h) is not None
+                and self._host_info[h].location.split("|", 1)[0] == region
+            ]
+            if local:
+                pool = local
+        seed_host = pool[self._seed_rr % len(pool)]
+        self._seed_rr += 1
+        return seed_host
 
     def register_peer(self, req: msg.RegisterPeerRequest):
         """handleRegisterPeerRequest (+ handleResource): upsert host/task/
@@ -358,8 +409,7 @@ class SchedulerService:
             and not self._task_peers.get(req.task_id)
             and req.host.host_id not in self._seed_hosts
         ):
-            seed_host = self._seed_hosts[self._seed_rr % len(self._seed_hosts)]
-            self._seed_rr += 1
+            seed_host = self._pick_seed_host(req.host)
             self.seed_triggers.append(
                 msg.TriggerSeedRequest(
                     host_id=seed_host,
@@ -459,6 +509,17 @@ class SchedulerService:
                 return None  # nothing to schedule; it serves, not fetches
         self._pending[req.peer_id] = _Pending(peer_id=req.peer_id, blocklist=set())
         return None  # response arrives from tick()
+
+    def register_peers_batch(self, reqs) -> list:
+        """Bulk RegisterPeer (megascale bulk API): one lock acquisition
+        and one call boundary for a whole arrival batch instead of one
+        per peer — the event-batch simulation engine registers a round's
+        diurnal-arrival wave through here. Semantically identical to
+        sequential `register_peer` calls in list order (same slot
+        allocation, same seed-trigger round-robin); returns the
+        per-request responses (None = queued for the tick)."""
+        with self.mu:
+            return [self.register_peer(req) for req in reqs]
 
     def reschedule(self, req: msg.RescheduleRequest):
         """RescheduleRequest (:972): drop given parents, re-queue."""

@@ -79,9 +79,17 @@ class ClusterSimulator:
         piece_length: int = 4 << 20,
         scenario=None,
         deterministic_peer_ids: bool = False,
+        cluster=None,
     ):
         self.scheduler = scheduler
-        self.cluster = synth.make_cluster(num_hosts, seed=seed)
+        # `cluster` lets a subclass (megascale EventBatchEngine) supply a
+        # pre-built host population (region/WAN topology) while keeping
+        # every protocol interaction here; default stays the latent
+        # synth model, bit-for-bit.
+        self.cluster = (
+            cluster if cluster is not None
+            else synth.make_cluster(num_hosts, seed=seed)
+        )
         self.rng = self.cluster.rng
         # Vectorised draws for the legacy (scenario-less) piece-cost
         # model: same distributions as the old per-piece
@@ -111,6 +119,14 @@ class ClusterSimulator:
         self._reg_index = 0
         self._offline: set[str] = set()
         self._partitioned: set[str] = set()
+        # Arrival host pool with some hosts unavailable, cached between
+        # membership changes: offline/partitioned sets only change at
+        # round boundaries (churn/partition epoch application), while a
+        # round draws many arrivals — rebuilding the O(hosts) online
+        # list per ARRIVAL was the dominant soak cost at megascale
+        # (100k hosts x 1.5k arrivals/round). Content and order are
+        # identical to an inline rebuild, so rng draws are unchanged.
+        self._online_cache: list | None = None
         # peers whose scheduling response was lost to a partition: they
         # re-announce (register is load-not-create) once their host heals
         self._partition_stalled: set[str] = set()
@@ -151,11 +167,20 @@ class ClusterSimulator:
 
     # ------------------------------------------------------------- driving
 
-    def start_download(self, host=None, task=None) -> str:
+    def _new_download_request(self, host=None, task=None) -> msg.RegisterPeerRequest:
+        """Draw (host, task), allocate the peer identity and sim-side
+        bookkeeping, and build the register request WITHOUT sending it —
+        split from `start_download` so the event-batch engine can build a
+        whole arrival wave and register it through the scheduler's
+        `register_peers_batch` bulk API with identical draws."""
         if host is None:
-            unavailable = self._offline | self._partitioned
-            if unavailable:
-                online = [h for h in self.cluster.hosts if h.id not in unavailable]
+            if self._offline or self._partitioned:
+                online = self._online_cache
+                if online is None:
+                    unavailable = self._offline | self._partitioned
+                    online = self._online_cache = [
+                        h for h in self.cluster.hosts if h.id not in unavailable
+                    ]
                 host = self.rng.choice(online or self.cluster.hosts)
             else:
                 host = self.rng.choice(self.cluster.hosts)
@@ -172,22 +197,24 @@ class ClusterSimulator:
         self._peer_reg[peer_id] = self._reg_index
         self._reg_index += 1
         self._peer_host[peer_id] = host.id
-        self.scheduler.register_peer(
-            msg.RegisterPeerRequest(
-                peer_id=peer_id,
-                task_id=task["task_id"],
-                host=self._host_info[host.id],
-                url=task["url"],
-                content_length=task["content_length"],
-                piece_length=self.piece_length,
-                total_piece_count=task["pieces"],
-                tag="sim",
-                application="simulator",
-            )
-        )
         self.stats.registered += 1
         self._task_of[peer_id] = task
-        return peer_id
+        return msg.RegisterPeerRequest(
+            peer_id=peer_id,
+            task_id=task["task_id"],
+            host=self._host_info[host.id],
+            url=task["url"],
+            content_length=task["content_length"],
+            piece_length=self.piece_length,
+            total_piece_count=task["pieces"],
+            tag="sim",
+            application="simulator",
+        )
+
+    def start_download(self, host=None, task=None) -> str:
+        req = self._new_download_request(host, task)
+        self.scheduler.register_peer(req)
+        return req.peer_id
 
     def run_round(self, new_downloads: int = 8) -> list:
         """One simulation round: start downloads, tick the scheduler, act on
@@ -251,15 +278,24 @@ class ClusterSimulator:
                 total_piece_count=task["pieces"],
                 tag="sim",
                 application="simulator",
-                finished_pieces=sorted(self._peer_have.get(pid, ())) or None,
+                finished_pieces=self._finished_pieces(pid) or None,
             ))
             self.stats.crash_reannounced_peers += 1
+
+    def _finished_pieces(self, peer_id: str) -> list[int]:
+        """Pieces this peer holds, ascending — what a daemon re-announces
+        after a scheduler crash or healed partition. Overridable: the
+        event-batch engine decodes its columnar have-bitsets here instead
+        of keeping per-peer sets."""
+        return sorted(self._peer_have.get(peer_id, ()))
 
     def _apply_partitions(self) -> None:
         """Epoch re-roll of silently partitioned hosts; healed peers whose
         scheduling response was lost re-announce and re-enter the queue."""
         partitioned_now = self.engine.partitioned_hosts(self._round)
         healed = self._partitioned - partitioned_now
+        if partitioned_now != self._partitioned:
+            self._online_cache = None
         self._partitioned = partitioned_now
         if not healed:
             return
@@ -282,7 +318,7 @@ class ClusterSimulator:
                 total_piece_count=task["pieces"],
                 tag="sim",
                 application="simulator",
-                finished_pieces=sorted(self._peer_have.get(pid, ())) or None,
+                finished_pieces=self._finished_pieces(pid) or None,
             ))
 
     def consume_seed_triggers(self) -> int:
@@ -336,21 +372,34 @@ class ClusterSimulator:
             self.stats.seed_downloads += 1
         return len(triggers)
 
+    def _extra_offline(self, round_idx: int) -> set[str]:
+        """Additional hosts off the announce plane this round beyond the
+        engine's churn epochs — the megascale engine contributes its
+        rolling-upgrade cohort here. Base: none."""
+        return set()
+
     def _apply_host_churn(self) -> None:
         """Scenario churn: flap hosts off/onto the announce plane. A host
         going offline LEAVES (LeaveHost drops its peers mid-download —
         the reference's host-GC/leave path); a returning host re-announces
-        and rejoins scheduling with fresh per-connection state."""
-        offline_now = self.engine.offline_hosts(self._round)
-        for host_id in offline_now - self._offline:
-            if host_id in self._host_info:
-                self.scheduler.leave_host(host_id)
-                self.stats.injected_host_leaves += 1
-        for host_id in self._offline - offline_now:
+        and rejoins scheduling with fresh per-connection state. Leaves go
+        through the scheduler's batched `leave_hosts_batch` (one peer-
+        table pass for the whole cohort instead of one per host) in
+        sorted host-id order, which also makes the leave order — and
+        therefore slot-free-list order — identical across runs."""
+        offline_now = self.engine.offline_hosts(self._round) | self._extra_offline(self._round)
+        leaving = sorted(
+            h for h in offline_now - self._offline if h in self._host_info
+        )
+        if leaving:
+            self.scheduler.leave_hosts_batch(leaving)
+            self.stats.injected_host_leaves += len(leaving)
+        for host_id in sorted(self._offline - offline_now):
             info = self._host_info.get(host_id)
             if info is not None:
                 self.scheduler.announce_host(info)
         self._offline = offline_now
+        self._online_cache = None
 
     def _act(self, resp) -> None:
         if isinstance(resp, msg.NormalTaskResponse):
@@ -447,18 +496,36 @@ class ClusterSimulator:
                 batch_costs.clear()
                 batch_sel.clear()
 
-        for piece in range(n_pieces):
-            if piece in have:
-                continue
-            sel = piece % len(parents)
+        # Wave-invariant work hoisted out of the piece loop (this loop is
+        # the oracle's hot path — it runs per PIECE at equivalence-test
+        # scale): the parent-slot resolution (two dict hops per parent),
+        # the missing-piece enumeration (the `have` membership test per
+        # piece), and the bound methods/attrs the loop re-read per
+        # iteration. Resolving parents once per wave is exact: a wave's
+        # parent set is fixed by the response.
+        parent_hosts = [
+            self._hosts_by_id[self._peer_host.get(p.peer_id, p.host_id)]
+            for p in parents
+        ]
+        n_parents = len(parents)
+        task_index = task["index"]
+        piece_cost_ns = self.engine.piece_cost_ns
+        piece_length = self.piece_length
+        stats = self.stats
+        missing = (
+            [p for p in range(n_pieces) if p not in have]
+            if have else range(n_pieces)
+        )
+        for piece in missing:
+            sel = piece % n_parents
             parent = parents[sel]
-            parent_host = self._hosts_by_id[self._peer_host.get(parent.peer_id, parent.host_id)]
-            cost, fault = self.engine.piece_cost_ns(
-                child_host, parent_host, self.piece_length,
-                task["index"], piece, wave,
+            parent_host = parent_hosts[sel]
+            cost, fault = piece_cost_ns(
+                child_host, parent_host, piece_length,
+                task_index, piece, wave,
             )
             if fault == "error":
-                self.stats.injected_piece_failures += 1
+                stats.injected_piece_failures += 1
                 flush_batch()
                 self.scheduler.piece_failed(
                     msg.DownloadPieceFailedRequest(
@@ -470,7 +537,7 @@ class ClusterSimulator:
                 # the modeled child verified the piece against the
                 # attested digest, refused the bytes, and attributed the
                 # failure — the scheduler quarantines the parent host
-                self.stats.injected_corruptions += 1
+                stats.injected_corruptions += 1
                 flush_batch()
                 self.scheduler.piece_failed(
                     msg.DownloadPieceFailedRequest(
@@ -480,15 +547,15 @@ class ClusterSimulator:
                 )
                 return
             if fault == "stall":
-                self.stats.injected_stalls += 1
+                stats.injected_stalls += 1
             batch_nums.append(piece)
             batch_costs.append(cost)
             batch_sel.append(sel)
             have.add(piece)
-            self.stats.pieces += 1
-            self.stats.piece_cost_ns_total += cost
+            stats.pieces += 1
+            stats.piece_cost_ns_total += cost
             if crash_after is not None and len(have) >= crash_after:
-                self.stats.injected_crashes += 1
+                stats.injected_crashes += 1
                 flush_batch()
                 self.scheduler.peer_failed(
                     msg.DownloadPeerFailedRequest(

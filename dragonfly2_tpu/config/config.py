@@ -59,6 +59,21 @@ class SchedulerConfig:
     max_hosts: int = 16384
     max_peers_per_task: int = 256
     max_tasks: int = 4096
+    # Absolute peer-table capacity; 0 keeps the historical max_hosts * 4.
+    # The megascale scenario lab sizes this to its planned download count
+    # so a 10^6-host state does not allocate 4M rows it will never use.
+    max_peers: int = 0
+    # uint64 words per peer finished-piece bitset (64 pieces per word).
+    # The default supports 4096-piece tasks; megascale runs cap tasks at
+    # 64 pieces and shrink this to 1 word — at 10^6 hosts the bitset
+    # column is the difference between 16 MB and 2 GB.
+    piece_bitset_words: int = 64
+    # Route a cold task's seed trigger to a seed peer in the SAME region
+    # (first location element) as the requesting host when one exists.
+    # Off by default: single-region deployments keep the plain
+    # round-robin the reference uses (seed_peer.go TriggerTask); the
+    # megascale WAN topology turns it on so origin fetches land in-region.
+    region_aware_seeds: bool = False
     # Columnar control plane (PR 8): candidate fill, selection apply and
     # piece-report absorption run as vectorised batch ops over the SoA
     # columns. False falls back to the per-peer loop path — kept as the

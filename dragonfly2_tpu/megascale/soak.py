@@ -1,0 +1,150 @@
+"""Megascale run harness: one entry point the soak tests and
+``bench_megascale.py`` share, so the artifact and the test suite measure
+the same replay.
+
+``run_megascale`` builds a scale-sized scheduler + event-batch engine
+for a named megascale scenario ("planet" | "soak" | any builtin), drives
+it for a number of rounds (default: one full compressed day plus a drain
+tail), and returns the report dict — SimStats + MegaStats counters,
+per-region completion percentiles, origin-traffic fraction,
+quarantine/failover event counts, engine step-phase p50s, and peak RSS.
+Everything except the ``timing`` sub-object is deterministic in
+(scenario, hosts, seed); the determinism test pins that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dragonfly2_tpu.megascale.engine import EventBatchEngine, megascale_service
+from dragonfly2_tpu.scenarios.spec import builtin_scenarios, megascale_scenarios
+
+
+def peak_rss_mb() -> float | None:
+    """VmHWM from /proc (peak resident set of this process), in MiB."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    return None
+
+
+def resolve_scenario(name: str):
+    mega = megascale_scenarios()
+    if name in mega:
+        return mega[name]
+    return builtin_scenarios()[name]
+
+
+def run_megascale(
+    scenario: str = "soak",
+    num_hosts: int = 50_000,
+    num_tasks: int = 96,
+    seed: int = 7,
+    rounds: int | None = None,
+    arrivals_per_round: int | None = None,
+    algorithm: str = "default",
+    retire_after_rounds: int | None = 24,
+    probe_every: int = 0,
+    drain_rounds: int = 12,
+    max_peers_per_task: int | None = None,
+) -> dict:
+    """One megascale replay. `arrivals_per_round` defaults to ~1.5 total
+    downloads per host spread over the day; `rounds` defaults to one
+    compressed day plus `drain_rounds` of trailing arrivals-light rounds
+    so in-flight downloads finish. Returns the report dict."""
+    spec = resolve_scenario(scenario)
+    day = spec.traffic.day_rounds or 96
+    if rounds is None:
+        rounds = day + drain_rounds
+    # a short run must still mostly be a LOADED run: clamp the drain
+    # tail so an explicit --rounds below the default drain length does
+    # not silently degrade into an all-idle replay
+    drain_rounds = min(drain_rounds, max(rounds // 4, 1))
+    if arrivals_per_round is None:
+        arrivals_per_round = max(1, int(num_hosts * 1.5) // max(day, 1))
+    # live-peer bound: arrivals x (retirement window + in-flight slack),
+    # plus flash-crowd bursts and seed registrations
+    window = (retire_after_rounds or rounds) + 16
+    peak = arrivals_per_round * max(
+        spec.traffic.peak_multiplier, 1.0
+    ) + arrivals_per_round * spec.flash.arrival_multiplier * (
+        1 if spec.flash.events_per_day else 0
+    )
+    max_live = int(peak * window) + 8192
+    if max_peers_per_task is None:
+        # hottest-swarm bound: top Zipf task share x arrivals x live
+        # window, next power of two, clamped — a hot task past this cap
+        # spills its overflow to origin (the refused-registration path),
+        # exactly the tradeoff a production per-task peer limit makes
+        hottest = int(arrivals_per_round * 0.15 * window * 2)
+        max_peers_per_task = min(8192, max(2048, 1 << hottest.bit_length()))
+    svc = megascale_service(
+        num_hosts, num_tasks=num_tasks, max_live_peers=max_live,
+        algorithm=algorithm, seed=seed, max_peers_per_task=max_peers_per_task,
+    )
+    t0 = time.perf_counter()
+    sim = EventBatchEngine(
+        svc, num_hosts=num_hosts, num_tasks=num_tasks, seed=seed,
+        scenario=spec, retire_after_rounds=retire_after_rounds,
+    )
+    setup_s = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    for r in range(rounds):
+        sim.run_round(arrivals_per_round if r < rounds - drain_rounds else 1)
+        if probe_every and (r + 1) % probe_every == 0:
+            sim.run_probe_round(sources=8)
+    wall = time.perf_counter() - t1
+
+    st = sim.stats
+    report = {
+        "scenario": scenario,
+        "hosts": num_hosts,
+        "tasks": num_tasks,
+        "seed": seed,
+        "rounds": rounds,
+        "arrivals_per_round": arrivals_per_round,
+        "algorithm": algorithm,
+        "stats": dataclasses.asdict(st),
+        "mega": dataclasses.asdict(sim.mega),
+        **sim.region_report(),
+        "fault_schedule_digest": sim.fault_schedule_digest(),
+        "fault_families": {
+            # the soak acceptance gate: every family nonzero in one run
+            "chaos": st.injected_scheduler_crashes + st.injected_partition_drops,
+            "corruption": st.injected_corruptions,
+            "churn": st.injected_crashes + st.injected_host_leaves,
+            "flash_crowds": sim.mega.flash_arrivals,
+        },
+        "quarantine": {
+            "corruption_reports": st.injected_corruptions,
+            "quarantined_hosts_final": svc.quarantine.active_count(),
+        },
+        "failover": {
+            "scheduler_crashes": st.injected_scheduler_crashes,
+            "crash_reannounced_peers": st.crash_reannounced_peers,
+            "partition_drops": st.injected_partition_drops,
+        },
+        "scheduler_counts": svc.counts(),
+        "timing": {
+            "setup_s": round(setup_s, 2),
+            "wall_s": round(wall, 2),
+            "pieces_per_sec": round(st.pieces / max(wall, 1e-9), 1),
+            "events_per_sec": round(sim.mega.piece_events / max(wall, 1e-9), 1),
+            "step_phases_p50_ms": sim.recorder.phase_p50s(),
+            "tick_phases_p50_ms": svc.recorder.phase_p50s(),
+            "peak_rss_mb": peak_rss_mb(),
+        },
+    }
+    return report
+
+
+def deterministic_view(report: dict) -> dict:
+    """The report minus wall-clock-dependent fields (same contract as
+    scenarios/ab.deterministic_view)."""
+    return {k: v for k, v in report.items() if k != "timing"}

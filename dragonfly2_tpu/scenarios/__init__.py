@@ -24,11 +24,17 @@ premise needs, deterministically from a (spec, seed) pair:
 
 from dragonfly2_tpu.scenarios.spec import (  # noqa: F401
     ChurnSpec,
+    ControlPlaneSpec,
     FlakySpec,
+    FlashCrowdSpec,
     LinkSpec,
     ScenarioSpec,
     SkewSpec,
+    TrafficSpec,
+    UpgradeSpec,
+    WanSpec,
     builtin_scenarios,
     load_scenario,
+    megascale_scenarios,
 )
 from dragonfly2_tpu.scenarios.engine import FaultInjector, ScenarioEngine  # noqa: F401

@@ -94,6 +94,11 @@ class ScenarioEngine:
                 self.bandwidth[h.id] = (
                     link.base_bandwidth_bps * link.slow_nic_multiplier
                 )
+        # per-epoch membership caches (offline/partitioned are pure
+        # functions of (spec, seed, epoch) — recomputing them every round
+        # is O(hosts) of blake2b at megascale)
+        self._offline_cache: tuple[int, set[str]] | None = None
+        self._partition_cache: tuple[int, set[str]] | None = None
 
     # -------------------------------------------------------- link model
 
@@ -179,16 +184,23 @@ class ScenarioEngine:
 
     def offline_hosts(self, round_idx: int) -> set[str]:
         """Host ids off the announce plane during this round's epoch.
-        Membership re-rolls per epoch so hosts flap rather than die."""
+        Membership re-rolls per epoch so hosts flap rather than die.
+        Cached per epoch — the membership is a pure function of (spec,
+        seed, epoch), and re-hashing every host every round was O(hosts)
+        per round at megascale (0.5 s/round at 10^5 hosts). Callers must
+        not mutate the returned set."""
         churn = self.spec.churn
         if churn.host_leave_rate <= 0:
             return set()
         epoch = round_idx // max(churn.leave_epoch_rounds, 1)
+        if self._offline_cache is not None and self._offline_cache[0] == epoch:
+            return self._offline_cache[1]
         out = {
             h.id
             for h in self.hosts
             if _u(self.seed, "leave", epoch, h.id) < churn.host_leave_rate
         }
+        self._offline_cache = (epoch, out)
         return out
 
     # ----------------------------------------------------- control plane
@@ -232,11 +244,91 @@ class ScenarioEngine:
         if control.partition_rate <= 0:
             return set()
         epoch = round_idx // max(control.partition_epoch_rounds, 1)
-        return {
+        if self._partition_cache is not None and self._partition_cache[0] == epoch:
+            return self._partition_cache[1]
+        out = {
             h.id
             for h in self.hosts
             if _u(self.seed, "partition", epoch, h.id) < control.partition_rate
         }
+        self._partition_cache = (epoch, out)
+        return out
+
+    # ----------------------------------------------- megascale traffic
+
+    def diurnal_multiplier(self, round_idx: int) -> float:
+        """Arrival-rate multiplier for this round of the compressed day:
+        a raised cosine between trough and peak (trough at round 0, peak
+        mid-day). Pure function of (spec, round) — no sampling."""
+        traffic = self.spec.traffic
+        if traffic.day_rounds <= 0:
+            return 1.0
+        phase = (round_idx % traffic.day_rounds) / traffic.day_rounds
+        lo, hi = traffic.trough_multiplier, traffic.peak_multiplier
+        return lo + (hi - lo) * 0.5 * (1.0 - math.cos(2.0 * math.pi * phase))
+
+    def flash_crowds(self, round_idx: int, n_tasks: int) -> list[int]:
+        """Hot task ranks under an active flash-crowd storm this round
+        (empty = no storm). Each of the day's `events_per_day` storms
+        starts at a deterministic (seed, day, event) round and pins
+        `hot_tasks` deterministic task ranks for `duration_rounds`."""
+        flash = self.spec.flash
+        day = self.spec.traffic.day_rounds or max(flash.duration_rounds * 8, 1)
+        if flash.events_per_day <= 0 or n_tasks <= 0:
+            return []
+        d, r = divmod(round_idx, day)
+        hot: list[int] = []
+        span = max(day - flash.duration_rounds, 1)
+        for e in range(flash.events_per_day):
+            start = int(_u(self.seed, "flash_start", d, e) * span)
+            if start <= r < start + flash.duration_rounds:
+                if r == start:
+                    self._record("flash", d, e)
+                for t in range(flash.hot_tasks):
+                    hot.append(int(_u(self.seed, "flash_task", d, e, t) * n_tasks))
+        return hot
+
+    def upgrade_window(self, round_idx: int) -> tuple[float, float] | None:
+        """Host-order fraction window [lo, hi) currently restarting under
+        a rolling-upgrade wave, or None. The window (width =
+        `cohort_fraction`) sweeps 0 → 1 across the host order over
+        `wave_rounds`; with hosts laid out in contiguous region blocks
+        (megascale topology) that is a region-by-region rollout. Wave
+        start rounds are deterministic in (seed, day, wave)."""
+        upgrade = self.spec.upgrade
+        day = self.spec.traffic.day_rounds or max(upgrade.wave_rounds * 2, 1)
+        if upgrade.waves_per_day <= 0:
+            return None
+        d, r = divmod(round_idx, day)
+        span = max(day - upgrade.wave_rounds, 1)
+        for w in range(upgrade.waves_per_day):
+            start = int(_u(self.seed, "upgrade_start", d, w) * span)
+            if start <= r < start + upgrade.wave_rounds:
+                progress = (r - start) / max(upgrade.wave_rounds, 1)
+                lo = progress * (1.0 - upgrade.cohort_fraction)
+                return (lo, lo + upgrade.cohort_fraction)
+        return None
+
+    def rotated_task_weights(self, n_tasks: int, round_idx: int) -> list[float] | None:
+        """Time-varying Zipf popularity for the diurnal traffic model:
+        the rank → task assignment rotates `rotate_hot_tasks` times per
+        day by a deterministic (seed, rotation-epoch) offset, so WHICH
+        content is hot changes through the day while the popularity
+        SHAPE stays Zipf(traffic.zipf_alpha). Falls back to the static
+        skew weights when the traffic model is off."""
+        traffic = self.spec.traffic
+        if traffic.day_rounds <= 0 or traffic.zipf_alpha <= 0:
+            return self.task_weights(n_tasks)
+        base = [
+            1.0 / (rank + 1) ** traffic.zipf_alpha for rank in range(n_tasks)
+        ]
+        if traffic.rotate_hot_tasks > 0:
+            phase_len = max(traffic.day_rounds // traffic.rotate_hot_tasks, 1)
+            epoch = round_idx // phase_len
+            offset = int(_u(self.seed, "task_rotation", epoch) * n_tasks)
+            base = [base[(rank + offset) % n_tasks] for rank in range(n_tasks)]
+        total = sum(base)
+        return [x / total for x in base]
 
     # ------------------------------------------------------------- skew
 

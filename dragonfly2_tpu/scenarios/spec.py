@@ -103,6 +103,75 @@ class ControlPlaneSpec:
 
 
 @dataclasses.dataclass
+class WanSpec:
+    """Multi-region WAN hierarchy (megascale scenario lab). `regions=0`
+    disables the hierarchy — the base single-region link model applies.
+    With regions, hosts partition into contiguous region blocks, each
+    region gets its own seed peers, intra-region paths keep the
+    ``LinkSpec`` RTT tiers, and CROSS-region paths pay the WAN tier:
+    `wan_rtt_ms` latency and a bandwidth cap of `wan_bandwidth_bps`
+    (modeling the analytic link-tier characterization of arXiv
+    2103.10515 — parameterized tiers, not packet simulation). A
+    back-to-source escalation outside `origin_region` pays
+    `back_to_source_penalty_ms` on top of the origin transfer."""
+
+    regions: int = 0
+    seeds_per_region: int = 2
+    zones_per_region: int = 4
+    racks_per_zone: int = 16
+    wan_rtt_ms: float = 80.0
+    wan_jitter_sigma: float = 0.3
+    wan_bandwidth_bps: float = 25e6
+    origin_region: int = 0
+    back_to_source_penalty_ms: float = 250.0
+
+
+@dataclasses.dataclass
+class TrafficSpec:
+    """Diurnal Zipf traffic arrival (time-varying task popularity).
+    `day_rounds=0` disables — arrivals stay flat. Otherwise the per-round
+    arrival count scales sinusoidally between `trough_multiplier` and
+    `peak_multiplier` over a `day_rounds`-round compressed day, task
+    popularity is Zipf(`zipf_alpha`) over rotated ranks, and the hot
+    ranks rotate `rotate_hot_tasks` times per day (the "what is popular
+    changes through the day" regime a static Zipf cannot express)."""
+
+    day_rounds: int = 0
+    peak_multiplier: float = 3.0
+    trough_multiplier: float = 0.3
+    zipf_alpha: float = 1.1
+    rotate_hot_tasks: int = 0
+
+
+@dataclasses.dataclass
+class FlashCrowdSpec:
+    """Flash-crowd preheat storms: `events_per_day` bursts at
+    deterministic (seed, day, event) start rounds; during a burst,
+    `arrival_multiplier` x the base arrival rate slams onto `hot_tasks`
+    deterministically chosen task ranks for `duration_rounds` rounds —
+    the release-day preheat stampede."""
+
+    events_per_day: int = 0
+    arrival_multiplier: float = 8.0
+    duration_rounds: int = 6
+    hot_tasks: int = 1
+
+
+@dataclasses.dataclass
+class UpgradeSpec:
+    """Rolling-upgrade churn waves: `waves_per_day` sweeps per compressed
+    day; each sweep moves a restart window of `cohort_fraction` of the
+    fleet across the host order (region blocks first — the region-by-
+    region rollout shape) over `wave_rounds` rounds. Hosts in the window
+    are off the announce plane (LeaveHost) and re-announce when the
+    window passes them."""
+
+    waves_per_day: int = 0
+    wave_rounds: int = 30
+    cohort_fraction: float = 0.05
+
+
+@dataclasses.dataclass
 class ScenarioSpec:
     name: str = "homogeneous"
     description: str = ""
@@ -111,6 +180,13 @@ class ScenarioSpec:
     flaky: FlakySpec = dataclasses.field(default_factory=FlakySpec)
     skew: SkewSpec = dataclasses.field(default_factory=SkewSpec)
     control: ControlPlaneSpec = dataclasses.field(default_factory=ControlPlaneSpec)
+    # megascale scenario lab (dragonfly2_tpu/megascale): multi-region WAN
+    # topology, diurnal arrival, flash crowds, rolling upgrades — all
+    # default-disabled so every pre-existing builtin is bit-unchanged
+    wan: WanSpec = dataclasses.field(default_factory=WanSpec)
+    traffic: TrafficSpec = dataclasses.field(default_factory=TrafficSpec)
+    flash: FlashCrowdSpec = dataclasses.field(default_factory=FlashCrowdSpec)
+    upgrade: UpgradeSpec = dataclasses.field(default_factory=UpgradeSpec)
 
     # ------------------------------------------------------------- codecs
 
@@ -136,11 +212,45 @@ class ScenarioSpec:
     def dumps(self) -> str:
         return json.dumps(self.to_dict(), indent=2, sort_keys=True)
 
+    def to_toml(self) -> str:
+        """Serialize to the flat ``[section] key = value`` TOML subset
+        both parsers (stdlib ``tomllib`` and the <3.11 fallback) accept —
+        the round-trip the parser-agreement test pins."""
+        # top-level scalars first (TOML: root keys precede any [section]),
+        # then one flat section per nested spec dataclass
+        scalars: list[str] = []
+        sections: list[str] = []
+        for field in dataclasses.fields(self):
+            value = getattr(self, field.name)
+            if dataclasses.is_dataclass(value):
+                sections.append(f"[{field.name}]")
+                for sub in dataclasses.fields(value):
+                    sections.append(
+                        f"{sub.name} = {_toml_value(getattr(value, sub.name))}"
+                    )
+                sections.append("")
+            else:
+                scalars.append(f"{field.name} = {_toml_value(value)}")
+        return "\n".join(scalars + [""] + sections)
+
+
+def _toml_value(value: Any) -> str:
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, str):
+        return json.dumps(value)  # TOML basic strings == JSON strings here
+    if isinstance(value, float) and value == int(value) and abs(value) < 1e15:
+        # tomllib keeps 1.0 a float; emit a form both parsers read as float
+        return f"{value:.1f}"
+    return repr(value)
+
 
 def load_scenario(path: str | pathlib.Path) -> ScenarioSpec:
-    """Load a spec from a ``.toml`` or ``.json`` file. TOML uses stdlib
-    ``tomllib`` where available (3.11+); on older interpreters a minimal
-    flat ``[section] key = value`` parser covers the spec grammar."""
+    """Load a spec from a ``.toml`` or ``.json`` file. TOML parsing uses
+    stdlib ``tomllib`` (py3.11+) directly; on older interpreters the
+    hand-rolled flat-section fallback below covers the spec grammar (the
+    parser-agreement test pins that both read every builtin scenario
+    identically)."""
     path = pathlib.Path(path)
     text = path.read_text()
     if path.suffix == ".toml":
@@ -150,11 +260,15 @@ def load_scenario(path: str | pathlib.Path) -> ScenarioSpec:
 
 def _parse_toml(text: str) -> dict:
     try:
-        import tomllib  # py311+
-
-        return tomllib.loads(text)
+        import tomllib  # py311+: the real parser
     except ImportError:
-        pass
+        return _parse_toml_fallback(text)
+    return tomllib.loads(text)
+
+
+def _parse_toml_fallback(text: str) -> dict:
+    """Minimal ``[section] key = value`` parser for interpreters without
+    ``tomllib`` (<3.11) — only the flat spec grammar, not general TOML."""
     root: dict[str, Any] = {}
     section = root
     for raw in text.splitlines():
@@ -170,8 +284,16 @@ def _parse_toml(text: str) -> dict:
 
 
 def _coerce(value: str) -> Any:
-    if value.startswith(("'", '"')) and value.endswith(("'", '"')):
-        return value[1:-1]
+    if value.startswith('"') and value.endswith('"'):
+        try:
+            # TOML basic strings share JSON's escape grammar — decoding
+            # through json keeps the fallback byte-identical to tomllib
+            # on escaped/non-ASCII content
+            return json.loads(value)
+        except json.JSONDecodeError:
+            return value[1:-1]
+    if value.startswith("'") and value.endswith("'"):
+        return value[1:-1]  # TOML literal string: no escapes
     if value.lower() in ("true", "false"):
         return value.lower() == "true"
     for cast in (int, float):
@@ -280,6 +402,82 @@ def builtin_scenarios() -> dict[str, ScenarioSpec]:
                 crash_epoch_rounds=20,
                 partition_rate=0.10,
                 partition_epoch_rounds=15,
+            ),
+        ),
+    }
+
+
+def megascale_scenarios() -> dict[str, ScenarioSpec]:
+    """Megascale scenario-lab builtins (dragonfly2_tpu/megascale): specs
+    whose WAN/traffic extensions only the event-batch engine can drive at
+    fidelity. Kept out of ``builtin_scenarios`` so the BENCH_scenarios
+    A/B grid (which replays every builtin through the per-peer oracle)
+    is unchanged.
+
+    - ``planet``: the scale proof — multi-region WAN, diurnal Zipf
+      arrivals, flash-crowd preheat storms; NO per-piece fault families,
+      so a 10^5-host run measures the engine and scheduler, not blake2b;
+    - ``soak``: the compressed "24 h in production" trace — every fault
+      family at once (control-plane chaos + partitions, corruption,
+      churn + rolling upgrades, flash crowds) on the WAN topology.
+    """
+    day = 96  # compressed day: 96 rounds = one "15-minute" tick per round
+    wan = WanSpec(
+        regions=4, seeds_per_region=3, wan_rtt_ms=85.0,
+        wan_bandwidth_bps=20e6, back_to_source_penalty_ms=250.0,
+    )
+    traffic = TrafficSpec(
+        day_rounds=day, peak_multiplier=3.0, trough_multiplier=0.25,
+        # moderate skew: the top task draws ~10% of arrivals — deep
+        # swarms without every hot task slamming its peer-DAG cap (the
+        # capacity-bounded swarm spill to origin is exercised by the
+        # flash crowds, not the steady state)
+        zipf_alpha=0.9, rotate_hot_tasks=4,
+    )
+    flash = FlashCrowdSpec(
+        events_per_day=3, arrival_multiplier=5.0, duration_rounds=4,
+        hot_tasks=4,
+    )
+    return {
+        "planet": ScenarioSpec(
+            name="planet",
+            description=(
+                "planet-scale day: 4 WAN regions with in-region seeds, "
+                "diurnal Zipf arrivals rotating hot content, flash-crowd "
+                "preheat storms — no injected faults, pure scale"
+            ),
+            link=LinkSpec(slow_fraction=0.3, slow_multiplier=0.25),
+            wan=wan, traffic=traffic, flash=flash,
+        ),
+        "soak": ScenarioSpec(
+            name="soak",
+            description=(
+                "24h-in-production soak: every fault family at once — "
+                "scheduler crashes + silent partitions (chaos), corrupt "
+                "parents (integrity), peer churn + rolling-upgrade waves, "
+                "flash crowds — over the 4-region WAN topology"
+            ),
+            link=LinkSpec(
+                slow_fraction=0.3, slow_multiplier=0.25,
+                spine_oversubscription=2.0,
+            ),
+            churn=ChurnSpec(
+                peer_crash_rate=0.06, crash_progress=0.5,
+                host_leave_rate=0.04, leave_epoch_rounds=16,
+            ),
+            flaky=FlakySpec(
+                parent_fraction=0.18, piece_error_rate=0.10,
+                piece_stall_rate=0.05, stall_seconds=0.2,
+                piece_corrupt_rate=0.10, corrupt_mode="bitflip",
+            ),
+            skew=SkewSpec(zipf_alpha=1.1),
+            control=ControlPlaneSpec(
+                scheduler_crash_rate=0.7, crash_epoch_rounds=16,
+                partition_rate=0.08, partition_epoch_rounds=12,
+            ),
+            wan=wan, traffic=traffic, flash=flash,
+            upgrade=UpgradeSpec(
+                waves_per_day=1, wave_rounds=24, cohort_fraction=0.04
             ),
         ),
     }

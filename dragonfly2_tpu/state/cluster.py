@@ -413,6 +413,21 @@ class ClusterState:
             self.touch_peer_host(peer_idx)
         return adopted
 
+    def peer_finished_pieces(self, peer_idx: int) -> np.ndarray:
+        """Piece numbers set in the peer's finished bitset, ascending —
+        the decode twin of `record_pieces_batch`/`adopt_pieces`, for
+        inspection surfaces (tests, debug dumps) that need piece NUMBERS
+        rather than the raw bitset words. The failover re-announce path
+        does not read scheduler state (a crash wipes it first); the
+        megascale engine decodes its own have-bitset columns instead
+        (megascale/engine.EventBatchEngine._finished_pieces)."""
+        words = self.peer_finished_bitset[peer_idx]
+        bits = (
+            words[:, None] >> np.arange(64, dtype=np.uint64)[None, :]
+        ) & np.uint64(1)
+        word_i, bit_i = np.nonzero(bits)
+        return (word_i * 64 + bit_i).astype(np.int64)
+
     def peer_piece_costs_ordered(self, peer_idx: int) -> np.ndarray:
         """Costs oldest->newest (ring unrolled) for the 3-sigma rule."""
         count = int(self.peer_piece_cost_count[peer_idx])

@@ -156,6 +156,26 @@ def serving_series(reg) -> _Namespace:
     )
 
 
+def megascale_series(reg) -> _Namespace:
+    """Megascale scenario lab (dragonfly2_tpu/megascale): the event-batch
+    engine's per-step phase breakdown (fault application, arrivals, the
+    scheduler tick, the vectorised event batch, retirement) plus event
+    throughput — the lab's analogue of the scheduler tick phases, read by
+    bench_megascale.py through the same PhaseRecorder ring operators
+    scrape."""
+    return _Namespace(
+        step_phase=reg.histogram(
+            "dragonfly_megascale_step_phase_seconds",
+            "per-phase engine step wall time", ("phase",),
+            buckets=(.001, .005, .02, .1, .5, 2, 10, 60),
+        ),
+        piece_events=reg.counter(
+            "dragonfly_megascale_piece_events_total",
+            "piece-transfer events simulated by the event-batch engine",
+        ),
+    )
+
+
 def daemon_series(reg) -> _Namespace:
     c = reg.counter
     return _Namespace(

@@ -52,6 +52,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "corruption: trust-boundary integrity tests (tier-1)"
     )
+    # megascale scenario lab: the tier-1 soak smoke (>=50k hosts, a few
+    # engine steps, time-budgeted well under the tier-1 wall); the full
+    # 24h-trace soak and the >=100k-host runs live behind `slow` and
+    # bench_megascale.py --artifact
+    config.addinivalue_line(
+        "markers", "soak: megascale soak smoke (tier-1, time-budgeted)"
+    )
 
 
 @pytest.fixture

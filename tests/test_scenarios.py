@@ -66,6 +66,58 @@ def test_spec_loads_toml_and_json(tmp_path):
     assert load_scenario(js) == spec
 
 
+def _all_builtin_specs():
+    from dragonfly2_tpu.scenarios import megascale_scenarios
+
+    return {**builtin_scenarios(), **megascale_scenarios()}
+
+
+def test_toml_roundtrip_every_builtin():
+    """to_toml → the hand-rolled fallback parser → from_dict reproduces
+    every builtin (incl. megascale) exactly — the fallback grammar covers
+    the whole spec surface, WAN/traffic sections included."""
+    from dragonfly2_tpu.scenarios.spec import _parse_toml_fallback
+
+    for name, spec in _all_builtin_specs().items():
+        parsed = ScenarioSpec.from_dict(_parse_toml_fallback(spec.to_toml()))
+        assert parsed == spec, f"fallback TOML round-trip broke {name!r}"
+
+
+def test_tomllib_and_fallback_agree_on_every_builtin():
+    """Satellite contract: stdlib tomllib (py3.11+, the primary parser)
+    and the <3.11 fallback read every builtin scenario identically —
+    values AND types. Skips where tomllib does not exist (the fallback
+    is then the only parser, covered by the round-trip test above)."""
+    tomllib = pytest.importorskip("tomllib")
+    from dragonfly2_tpu.scenarios.spec import _parse_toml_fallback
+
+    def typed(d):
+        return {
+            k: typed(v) if isinstance(v, dict) else (type(v).__name__, v)
+            for k, v in d.items()
+        }
+
+    for name, spec in _all_builtin_specs().items():
+        text = spec.to_toml()
+        assert typed(tomllib.loads(text)) == typed(_parse_toml_fallback(text)), (
+            f"parser disagreement on builtin {name!r}"
+        )
+
+
+def test_megascale_builtins_default_disabled_elsewhere():
+    """Pre-existing builtins carry the megascale extensions DISABLED —
+    the oracle's replays are bit-unchanged by the new spec fields."""
+    for name, spec in builtin_scenarios().items():
+        assert spec.wan.regions == 0, name
+        assert spec.traffic.day_rounds == 0, name
+        assert spec.flash.events_per_day == 0, name
+        assert spec.upgrade.waves_per_day == 0, name
+    from dragonfly2_tpu.scenarios import megascale_scenarios
+
+    soak = megascale_scenarios()["soak"]
+    assert soak.wan.regions > 0 and soak.traffic.day_rounds > 0
+
+
 def test_builtin_scenarios_cover_required_grid():
     names = set(builtin_scenarios())
     assert {"homogeneous", "bandwidth_skew", "churn", "flaky_parent"} <= names
