@@ -386,15 +386,23 @@ class StorageManager:
             return list(self._tasks.values())
 
     def reload(self) -> int:
-        """Reload persisted tasks after restart (ReloadPersistentTask)."""
+        """Reload persisted tasks after restart (ReloadPersistentTask).
+
+        The disk scan runs unlocked (it is slow and touches no shared
+        state); each check-then-insert takes the task-table lock so a
+        reload racing live registrations cannot clobber a TaskStorage a
+        download is already writing through (dflint LOCK001)."""
         loaded = 0
         for task_dir in self.base.iterdir() if self.base.exists() else []:
             if not task_dir.is_dir():
                 continue
             ts = TaskStorage.load(self.base, task_dir)
-            if ts is not None and ts.meta.task_id not in self._tasks:
-                self._tasks[ts.meta.task_id] = ts
-                loaded += 1
+            if ts is None:
+                continue
+            with self._lock:
+                if ts.meta.task_id not in self._tasks:
+                    self._tasks[ts.meta.task_id] = ts
+                    loaded += 1
         return loaded
 
     # ------------------------------------------------------------------ gc

@@ -278,7 +278,17 @@ class SchedulerService:
             return msg.ScheduleFailure(peer_id, "InvalidTransition", str(e))
 
     def announce_host(self, host: msg.HostInfo) -> int:
-        """AnnounceHost: upsert SoA host row (service_v2 AnnounceHost)."""
+        """AnnounceHost: upsert SoA host row (service_v2 AnnounceHost).
+
+        Takes service.mu itself (reentrant under the RPC edge's dispatch
+        lock): the LOCK001 sweep showed the announce path mutating
+        mu-guarded state (_host_info, _serving_full_sync, the dirty
+        frontier) bare when driven in-proc, racing the refresh worker's
+        serving_graph_arrays read."""
+        with self.mu:
+            return self._announce_host_locked(host)
+
+    def _announce_host_locked(self, host: msg.HostInfo) -> int:
         self._host_info[host.host_id] = host
         if host.host_type != "normal" and host.host_id not in self._seed_hosts:
             self._seed_hosts.append(host.host_id)
@@ -317,10 +327,11 @@ class SchedulerService:
 
     def leave_host(self, host_id: str) -> None:
         """LeaveHost: drop the host and every peer on it (service_v2)."""
-        for peer_id, meta in list(self._peer_meta.items()):
-            if meta.host_id == host_id:
-                self._leave_peer(peer_id)
-        self._drop_host(host_id)
+        with self.mu:
+            for peer_id, meta in list(self._peer_meta.items()):
+                if meta.host_id == host_id:
+                    self._leave_peer(peer_id)
+            self._drop_host(host_id)
 
     def leave_hosts_batch(self, host_ids) -> int:
         """Bulk LeaveHost (megascale bulk API, the leave twin of
@@ -331,19 +342,20 @@ class SchedulerService:
         `leave_host` scans EVERY peer per host; a rolling-upgrade churn
         wave at 10^5 hosts retires thousands of hosts per round, and the
         O(hosts x peers) rescan was the wall. Returns hosts dropped."""
-        targets = [h for h in host_ids if h in self._host_info]
-        if not targets:
-            return 0
-        target_set = set(targets)
-        by_host: dict[str, list[str]] = {}
-        for peer_id, meta in self._peer_meta.items():
-            if meta.host_id in target_set:
-                by_host.setdefault(meta.host_id, []).append(peer_id)
-        for host_id in targets:
-            for peer_id in by_host.get(host_id, ()):
-                self._leave_peer(peer_id)
-            self._drop_host(host_id)
-        return len(targets)
+        with self.mu:
+            targets = [h for h in host_ids if h in self._host_info]
+            if not targets:
+                return 0
+            target_set = set(targets)
+            by_host: dict[str, list[str]] = {}
+            for peer_id, meta in self._peer_meta.items():
+                if meta.host_id in target_set:
+                    by_host.setdefault(meta.host_id, []).append(peer_id)
+            for host_id in targets:
+                for peer_id in by_host.get(host_id, ()):
+                    self._leave_peer(peer_id)
+                self._drop_host(host_id)
+            return len(targets)
 
     def _drop_host(self, host_id: str) -> None:
         """Host-table teardown shared by the single and batch leave paths
@@ -380,7 +392,16 @@ class SchedulerService:
 
     def register_peer(self, req: msg.RegisterPeerRequest):
         """handleRegisterPeerRequest (+ handleResource): upsert host/task/
-        peer, size-scope dispatch, queue normal peers for scheduling."""
+        peer, size-scope dispatch, queue normal peers for scheduling.
+
+        Takes service.mu itself (reentrant under the RPC edge and
+        register_peers_batch): the register path mutates the seed-trigger
+        queue, task maps and the pending queue — all mu-guarded on every
+        other path."""
+        with self.mu:
+            return self._register_peer_locked(req)
+
+    def _register_peer_locked(self, req: msg.RegisterPeerRequest):
         if req.host.host_id not in self._host_info:
             self.announce_host(req.host)
         host_idx = self.state.host_index(req.host.host_id)
@@ -503,6 +524,11 @@ class SchedulerService:
             if (
                 req.priority == 1
                 and total > 0
+                # peer_idx is a FRESH SoA row allocated in this very call:
+                # buffered reports cannot name it (_leave_peer flushes
+                # before any row free, so the buffer never aliases a
+                # recycled index) — the count below cannot be stale
+                # dflint: waive[FLUSH001] -- fresh row from this call; buffer cannot alias it (leave flushes before row free)
                 and self.state.peer_finished_count[peer_idx] >= total
             ):
                 self.state.peer_event(peer_idx, PeerEvent.DOWNLOAD_SUCCEEDED)
@@ -523,17 +549,18 @@ class SchedulerService:
 
     def reschedule(self, req: msg.RescheduleRequest):
         """RescheduleRequest (:972): drop given parents, re-queue."""
-        meta = self._peer_meta.get(req.peer_id)
-        if meta is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self._release_parent_slots(req.peer_id)
-        dag = self._task_dag(meta.task_id)
-        dag.delete_in_edges(meta.dag_slot)
-        pending = self._pending.get(req.peer_id) or _Pending(peer_id=req.peer_id, blocklist=set())
-        pending.blocklist |= set(req.candidate_parent_ids)
-        pending.retries += 1
-        self._pending[req.peer_id] = pending
-        return None
+        with self.mu:
+            meta = self._peer_meta.get(req.peer_id)
+            if meta is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            self._release_parent_slots(req.peer_id)
+            dag = self._task_dag(meta.task_id)
+            dag.delete_in_edges(meta.dag_slot)
+            pending = self._pending.get(req.peer_id) or _Pending(peer_id=req.peer_id, blocklist=set())
+            pending.blocklist |= set(req.candidate_parent_ids)
+            pending.retries += 1
+            self._pending[req.peer_id] = pending
+            return None
 
     def piece_finished(self, req: msg.DownloadPieceFinishedRequest):
         """DownloadPieceFinished (:1102): validate + enqueue. The stat
@@ -545,34 +572,38 @@ class SchedulerService:
         scalar ops were the largest host-side cost between device calls.
         Only the digest-chain adoption stays inline: it needs the peer's
         FSM state AT REPORT TIME (back-to-source gate, trust-boundary
-        PR), and origin reports are rare."""
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        if (not req.parent_peer_id and req.digest
-                and self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)):
-            # origin-fetched piece: its md5 joins the task's attested
-            # digest chain (first writer wins — re-fetches and racing
-            # seeds cannot rewrite an attested entry). Gated on the
-            # scheduler's OWN record that this peer is mid-back-to-source
-            # (it sent BackToSourceStarted): a peer merely omitting
-            # parent_peer_id cannot forge "origin" digests and poison the
-            # chain against honest parents.
-            meta = self._peer_meta.get(req.peer_id)
-            if meta is not None:
-                chain = self._task_piece_digests.setdefault(meta.task_id, {})
-                chain.setdefault(int(req.piece_number), req.digest)
-        pidx = -1
-        if req.parent_peer_id and req.peer_id in self._peer_meta:
-            p = self.state.peer_index(req.parent_peer_id)
-            if p is not None:
-                pidx = int(p)
-        with self._piece_buf_mu:
-            self._piece_buf.append(
-                (int(idx), int(req.piece_number), int(req.length),
-                 float(req.cost_ns), pidx)
-            )
-        return None
+        PR), and origin reports are rare. Runs under service.mu (the
+        digest chain and peer meta are mu-guarded state); the buffer
+        append additionally takes _piece_buf_mu so a bare-driven tick's
+        concurrent swap stays safe either way."""
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            if (not req.parent_peer_id and req.digest
+                    and self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)):
+                # origin-fetched piece: its md5 joins the task's attested
+                # digest chain (first writer wins — re-fetches and racing
+                # seeds cannot rewrite an attested entry). Gated on the
+                # scheduler's OWN record that this peer is mid-back-to-source
+                # (it sent BackToSourceStarted): a peer merely omitting
+                # parent_peer_id cannot forge "origin" digests and poison the
+                # chain against honest parents.
+                meta = self._peer_meta.get(req.peer_id)
+                if meta is not None:
+                    chain = self._task_piece_digests.setdefault(meta.task_id, {})
+                    chain.setdefault(int(req.piece_number), req.digest)
+            pidx = -1
+            if req.parent_peer_id and req.peer_id in self._peer_meta:
+                p = self.state.peer_index(req.parent_peer_id)
+                if p is not None:
+                    pidx = int(p)
+            with self._piece_buf_mu:
+                self._piece_buf.append(
+                    (int(idx), int(req.piece_number), int(req.length),
+                     float(req.cost_ns), pidx)
+                )
+            return None
 
     def pieces_finished_batch(
         self,
@@ -592,27 +623,28 @@ class SchedulerService:
         `piece_finished` calls would have. Origin digest-chain adoption is
         NOT supported on this path — callers carrying digests use
         `piece_finished`."""
-        idx = self.state.peer_index(peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(peer_id, "NotFound", "unknown peer")
-        idx = int(idx)
-        has_meta = peer_id in self._peer_meta
-        pmap = []
-        for pid in parent_ids:
-            p = self.state.peer_index(pid) if has_meta else None
-            pmap.append(-1 if p is None else int(p))
-        if parent_sel is None:
-            parent_sel = (-1,) * len(piece_numbers)
-        rows = [
-            (idx, int(piece), int(length), float(cost),
-             pmap[sel] if 0 <= sel < len(pmap) else -1)
-            for piece, length, cost, sel in zip(
-                piece_numbers, lengths, costs_ns, parent_sel
-            )
-        ]
-        with self._piece_buf_mu:
-            self._piece_buf.extend(rows)
-        return None
+        with self.mu:
+            idx = self.state.peer_index(peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(peer_id, "NotFound", "unknown peer")
+            idx = int(idx)
+            has_meta = peer_id in self._peer_meta
+            pmap = []
+            for pid in parent_ids:
+                p = self.state.peer_index(pid) if has_meta else None
+                pmap.append(-1 if p is None else int(p))
+            if parent_sel is None:
+                parent_sel = (-1,) * len(piece_numbers)
+            rows = [
+                (idx, int(piece), int(length), float(cost),
+                 pmap[sel] if 0 <= sel < len(pmap) else -1)
+                for piece, length, cost, sel in zip(
+                    piece_numbers, lengths, costs_ns, parent_sel
+                )
+            ]
+            with self._piece_buf_mu:
+                self._piece_buf.extend(rows)
+            return None
 
     def flush_piece_reports(self) -> int:
         """Absorb every buffered piece report into the SoA columns now.
@@ -620,7 +652,8 @@ class SchedulerService:
         every flush valve (peer finish/fail, leave, GC sweeps,
         serving-graph reads); public so tests and out-of-band readers can
         force column visibility."""
-        return self._absorb_piece_reports()
+        with self.mu:
+            return self._absorb_piece_reports()
 
     def _absorb_piece_reports(self) -> int:
         """One vectorised apply of the buffered reports: bitset + cost
@@ -723,109 +756,116 @@ class SchedulerService:
         quarantined cluster-wide (with time-decayed release) and takes a
         scoring penalty through the upload-failure feature every
         evaluator algorithm already consumes."""
-        corrupt = req.reason == "corruption"
-        pidx = self.state.peer_index(req.parent_peer_id)
-        if pidx is not None:
-            host_idx = self.state.peer_host[pidx]
-            # corruption wastes a full transfer AND forces a re-fetch:
-            # weight it like several plain serve failures in the scoring
-            # features so a released host re-earns trust slowly
-            self.state.host_upload_failed[host_idx] += 5 if corrupt else 1
+        with self.mu:
+            corrupt = req.reason == "corruption"
+            pidx = self.state.peer_index(req.parent_peer_id)
+            if pidx is not None:
+                host_idx = self.state.peer_host[pidx]
+                # corruption wastes a full transfer AND forces a re-fetch:
+                # weight it like several plain serve failures in the scoring
+                # features so a released host re-earns trust slowly
+                self.state.host_upload_failed[host_idx] += 5 if corrupt else 1
+                if corrupt:
+                    host_id = self.state.host_id_at(int(host_idx))
+                    if host_id is not None:
+                        self.quarantine.report(host_id, reason="corruption")
             if corrupt:
-                host_id = self.state.host_id_at(int(host_idx))
-                if host_id is not None:
-                    self.quarantine.report(host_id, reason="corruption")
-        if corrupt:
-            self._series.piece_corruption.labels().inc()
-            if req.peer_id == req.parent_peer_id:
-                # SELF-report (upload verify-on-serve found local rot):
-                # the host stops being advertised via quarantine; there is
-                # no downloading child to reschedule.
-                return None
-        return self.reschedule(
-            msg.RescheduleRequest(
-                peer_id=req.peer_id, candidate_parent_ids=[req.parent_peer_id]
+                self._series.piece_corruption.labels().inc()
+                if req.peer_id == req.parent_peer_id:
+                    # SELF-report (upload verify-on-serve found local rot):
+                    # the host stops being advertised via quarantine; there
+                    # is no downloading child to reschedule.
+                    return None
+            return self.reschedule(
+                msg.RescheduleRequest(
+                    peer_id=req.peer_id, candidate_parent_ids=[req.parent_peer_id]
+                )
             )
-        )
 
     def peer_finished(self, req: msg.DownloadPeerFinishedRequest):
         """DownloadPeerFinished (:991): FSM -> Succeeded, free parent upload
         slots, emit the Download trace record."""
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
-        self._release_parent_slots(req.peer_id)
-        self._pending.pop(req.peer_id, None)
-        self._write_download_record(req.peer_id, "Succeeded")
-        return None
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+            self._release_parent_slots(req.peer_id)
+            self._pending.pop(req.peer_id, None)
+            self._write_download_record(req.peer_id, "Succeeded")
+            return None
 
     def peer_failed(self, req: msg.DownloadPeerFailedRequest):
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
-        self._release_parent_slots(req.peer_id)
-        self._pending.pop(req.peer_id, None)
-        self._write_download_record(req.peer_id, "Failed")
-        return None
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
+            self._release_parent_slots(req.peer_id)
+            self._pending.pop(req.peer_id, None)
+            self._write_download_record(req.peer_id, "Failed")
+            return None
 
     def back_to_source_started(self, req: msg.DownloadPeerBackToSourceStartedRequest):
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self.state.peer_event(idx, PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
-        task_idx = self.state.peer_task[idx]
-        self.state.task_back_to_source_count[task_idx] += 1
-        self._pending.pop(req.peer_id, None)
-        return None
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            self.state.peer_event(idx, PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+            task_idx = self.state.peer_task[idx]
+            self.state.task_back_to_source_count[task_idx] += 1
+            self._pending.pop(req.peer_id, None)
+            return None
 
     def back_to_source_finished(self, req: msg.DownloadPeerBackToSourceFinishedRequest):
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        # capture BEFORE the FSM flips to Succeeded: digest-root adoption
-        # is gated on the scheduler having seen this peer go back-to-source
-        # (DOWNLOAD_SUCCEEDED is also legal from RUNNING, so a P2P peer
-        # could send this message without ever fetching the origin)
-        was_back_to_source = (
-            self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)
-        )
-        self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
-        task_idx = self.state.peer_task[idx]
-        if req.piece_count:
-            self.state.task_total_pieces[task_idx] = req.piece_count
-        if req.task_digest and was_back_to_source:
-            # whole-task sha256 from the origin fetcher: the root of the
-            # attested chain (first writer wins, like the piece digests)
-            meta = self._peer_meta.get(req.peer_id)
-            if meta is not None:
-                self._task_sha256.setdefault(meta.task_id, req.task_digest)
-        # The origin download proves the task's content exists: the task
-        # FSM goes Succeeded (service_v2 handleDownloadPeerBackToSource-
-        # FinishedRequest) — preheat job state polls exactly this. FAILED
-        # is a legal source too (fsm.py DOWNLOAD_SUCCEEDED transitions): a
-        # retry that lands must recover a task an earlier attempt failed.
-        if self.state.task_state[task_idx] in (
-            int(TaskState.RUNNING), int(TaskState.FAILED)
-        ):
-            self.state.task_event(task_idx, TaskEvent.DOWNLOAD_SUCCEEDED)
-        self._write_download_record(req.peer_id, "Succeeded")
-        return None
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            # capture BEFORE the FSM flips to Succeeded: digest-root adoption
+            # is gated on the scheduler having seen this peer go back-to-source
+            # (DOWNLOAD_SUCCEEDED is also legal from RUNNING, so a P2P peer
+            # could send this message without ever fetching the origin)
+            was_back_to_source = (
+                self.state.peer_state[idx] == int(PeerState.BACK_TO_SOURCE)
+            )
+            self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
+            task_idx = self.state.peer_task[idx]
+            if req.piece_count:
+                self.state.task_total_pieces[task_idx] = req.piece_count
+            if req.task_digest and was_back_to_source:
+                # whole-task sha256 from the origin fetcher: the root of the
+                # attested chain (first writer wins, like the piece digests)
+                meta = self._peer_meta.get(req.peer_id)
+                if meta is not None:
+                    self._task_sha256.setdefault(meta.task_id, req.task_digest)
+            # The origin download proves the task's content exists: the task
+            # FSM goes Succeeded (service_v2 handleDownloadPeerBackToSource-
+            # FinishedRequest) — preheat job state polls exactly this. FAILED
+            # is a legal source too (fsm.py DOWNLOAD_SUCCEEDED transitions): a
+            # retry that lands must recover a task an earlier attempt failed.
+            if self.state.task_state[task_idx] in (
+                int(TaskState.RUNNING), int(TaskState.FAILED)
+            ):
+                self.state.task_event(task_idx, TaskEvent.DOWNLOAD_SUCCEEDED)
+            self._write_download_record(req.peer_id, "Succeeded")
+            return None
 
     def back_to_source_failed(self, req: msg.DownloadPeerBackToSourceFailedRequest):
-        idx = self.state.peer_index(req.peer_id)
-        if idx is None:
-            return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
-        self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
-        task_idx = self.state.peer_task[idx]
-        if self.state.task_state[task_idx] == int(TaskState.RUNNING):
-            self.state.task_event(task_idx, TaskEvent.DOWNLOAD_FAILED)
-        self._write_download_record(req.peer_id, "Failed")
-        return None
+        with self.mu:
+            idx = self.state.peer_index(req.peer_id)
+            if idx is None:
+                return msg.ScheduleFailure(req.peer_id, "NotFound", "unknown peer")
+            self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
+            task_idx = self.state.peer_task[idx]
+            if self.state.task_state[task_idx] == int(TaskState.RUNNING):
+                self.state.task_event(task_idx, TaskEvent.DOWNLOAD_FAILED)
+            self._write_download_record(req.peer_id, "Failed")
+            return None
 
     def leave_peer(self, peer_id: str) -> None:
-        self._leave_peer(peer_id)
+        with self.mu:
+            self._leave_peer(peer_id)
 
     # ============================================================== tick
 
@@ -918,7 +958,17 @@ class SchedulerService:
         `control_dispatch` phase, next to `device_call` (= dispatch +
         d2h_wait), so the control-plane-vs-device balance reads straight
         off the flight recorder with nothing left out of either side.
+
+        Holds service.mu for the whole round — identical to how the RPC
+        edge has always driven it (rpc/server.py _tick_once); taking it
+        here too makes bare in-proc drivers (simulator, bench_loop,
+        tests) safe against concurrent handlers, which the LOCK001 sweep
+        showed they were not.
         """
+        with self.mu:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> list:
         recorder = self.recorder
         recorder.begin()
         # Absorb every piece report buffered since the last flush valve:

@@ -308,5 +308,8 @@ class DynConfig:
                     pass
 
     def refresh_now(self) -> None:
-        self._last_refresh = 0.0
+        # the reset rides the same lock as _maybe_refresh's bookkeeping
+        # (dflint LOCK001); the refresh itself re-takes the lock inside
+        with self._lock:
+            self._last_refresh = 0.0
         self._maybe_refresh()

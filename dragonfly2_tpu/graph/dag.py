@@ -354,7 +354,8 @@ class TaskDAG:
 # ----------------------------------------------------------------- device
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
-def batch_reachable(adj: jax.Array, src: jax.Array, dst: jax.Array, max_depth: int = 0):
+def batch_reachable(adj: jax.Array, src: jax.Array, dst: jax.Array,
+                    max_depth: int = 0) -> jax.Array:
     """Batched reachability on stacked bool adjacency.
 
     adj:  (B, P, P) bool — adj[b, u, v] means edge u->v in graph b
@@ -391,7 +392,7 @@ def batch_can_add_edge(
     parent: jax.Array,     # (B, K) int32 proposed parent vertex
     child: jax.Array,      # (B,) int32 child vertex
     max_depth: int = 0,
-):
+) -> jax.Array:
     """(B, K) bool: adding parent->child keeps the graph acyclic and simple.
 
     Mirrors TaskDAG.can_add_edge for a whole evaluator batch in one call:

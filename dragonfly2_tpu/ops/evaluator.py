@@ -67,7 +67,8 @@ MIN_SCORE = jnp.float32(CONSTANTS.MIN_SCORE)
 _BAD_STATES = tuple(sorted(int(s) for s in BAD_NODE_STATES))
 
 
-def piece_score(finished, child_finished, total):
+def piece_score(finished: jax.Array, child_finished: jax.Array,
+                total: jax.Array) -> jax.Array:
     """finished/total when total is known, else raw finished-count delta
     (evaluator_base.go:86-99). Unbounded by design."""
     total_f = total.astype(jnp.float32)[..., None]
@@ -77,7 +78,8 @@ def piece_score(finished, child_finished, total):
     return jnp.where(known, normalized, delta)
 
 
-def upload_success_score(upload_count, upload_failed):
+def upload_success_score(upload_count: jax.Array,
+                         upload_failed: jax.Array) -> jax.Array:
     """(uc-ufc)/uc; never-scheduled hosts get max (evaluator_base.go:102-115)."""
     uc = upload_count.astype(jnp.float32)
     ufc = upload_failed.astype(jnp.float32)
@@ -86,14 +88,15 @@ def upload_success_score(upload_count, upload_failed):
     return jnp.where((upload_count == 0) & (upload_failed == 0), MAX_SCORE, score)
 
 
-def free_upload_score(upload_limit, upload_used):
+def free_upload_score(upload_limit: jax.Array,
+                      upload_used: jax.Array) -> jax.Array:
     free = (upload_limit - upload_used).astype(jnp.float32)
     limit = upload_limit.astype(jnp.float32)
     ok = (limit > 0) & (free > 0)
     return jnp.where(ok, free / jnp.maximum(limit, 1.0), MIN_SCORE)
 
 
-def host_type_score(host_type, peer_state):
+def host_type_score(host_type: jax.Array, peer_state: jax.Array) -> jax.Array:
     """Seed peers max out while Received/Running, else 0; normal hosts 0.5
     (evaluator_base.go:129-143)."""
     is_normal = host_type == 0
@@ -104,13 +107,14 @@ def host_type_score(host_type, peer_state):
     return jnp.where(is_normal, MAX_SCORE * 0.5, seed_score)
 
 
-def idc_affinity_score(parent_idc, child_idc):
+def idc_affinity_score(parent_idc: jax.Array, child_idc: jax.Array) -> jax.Array:
     child = child_idc[..., None]
     both = (parent_idc != 0) & (child != 0)
     return jnp.where(both & (parent_idc == child), MAX_SCORE, MIN_SCORE).astype(jnp.float32)
 
 
-def location_affinity_score(parent_loc, child_loc):
+def location_affinity_score(parent_loc: jax.Array,
+                            child_loc: jax.Array) -> jax.Array:
     """Leading-element match depth / 5, exact match = 1.0, either side
     empty = 0 (evaluator_base.go:159-188). Operates on per-element hash
     codes; code 0 = absent element."""
@@ -125,7 +129,7 @@ def location_affinity_score(parent_loc, child_loc):
     return jnp.where(both_present, score, MIN_SCORE)
 
 
-def probe_score(avg_rtt_ns, has_rtt):
+def probe_score(avg_rtt_ns: jax.Array, has_rtt: jax.Array) -> jax.Array:
     """(pingTimeout - avgRTT) / pingTimeout, 0 when unprobed
     (evaluator_network_topology.go:217-224)."""
     timeout = jnp.float32(CONSTANTS.PING_TIMEOUT_NS)
@@ -157,7 +161,8 @@ def evaluate(feats: dict, algorithm: str = "default") -> jax.Array:
     return _blend(feats, weights)
 
 
-def is_bad_node(piece_costs, piece_cost_count, peer_state):
+def is_bad_node(piece_costs: jax.Array, piece_cost_count: jax.Array,
+                peer_state: jax.Array) -> jax.Array:
     """(B, K) bool — replicate IsBadNode's sampled-outlier rule on padded
     cost rings ordered oldest->newest (evaluator.go:93-129).
 
@@ -243,7 +248,8 @@ def filter_candidates(
     return mask
 
 
-def _filter_and_select(feats, scores, blocklist, in_degree, can_add_edge, limit):
+def _filter_and_select(feats: dict, scores: jax.Array, blocklist, in_degree,
+                       can_add_edge, limit: int) -> dict:
     """Shared contract of every scheduling path: eligibility mask + masked
     top-k over the provided scores."""
     mask = filter_candidates(feats, blocklist, in_degree, can_add_edge)
@@ -257,7 +263,8 @@ def _filter_and_select(feats, scores, blocklist, in_degree, can_add_edge, limit)
     }
 
 
-def _pack_selection(values, indices, valid):
+def _pack_selection(values: jax.Array, indices: jax.Array,
+                    valid: jax.Array) -> jax.Array:
     """Pack (indices, valid, scores) into ONE (B, limit, 2) float32 array:
     channel 0 = candidate index, or -1 for empty slots; channel 1 = score.
     Candidate indices are < 128 so float32 carries them exactly. One output
@@ -268,8 +275,10 @@ def _pack_selection(values, indices, valid):
     return jnp.stack([idx, values], axis=-1)
 
 
-def unpack_selection(packed):
-    """Host-side decode of `_pack_selection` output (accepts np arrays)."""
+def unpack_selection(packed) -> tuple:
+    """Host-side decode of `_pack_selection` output: (indices int32,
+    valid bool, scores). Accepts np arrays (the tick's D2H read) or jax
+    arrays (tests)."""
     idx = packed[..., 0]
     return idx.astype("int32"), idx >= 0, packed[..., 1]
 
@@ -373,7 +382,9 @@ _PACK_ONE_BYTE = (
 )
 
 
-def _packed_field_specs(b: int, k: int, c: int, l: int, n: int):
+def _packed_field_specs(
+    b: int, k: int, c: int, l: int, n: int
+) -> list[tuple[str, str, tuple[int, ...]]]:
     """Ordered (name, dtype_str, shape) for the packed transport."""
     shapes = {
         "valid": (b, k), "has_rtt": (b, k), "blocklist": (b, k),
@@ -403,7 +414,7 @@ def _packed_field_specs(b: int, k: int, c: int, l: int, n: int):
     return specs
 
 
-def _packed_layout(b: int, k: int, c: int, l: int, n: int):
+def _packed_layout(b: int, k: int, c: int, l: int, n: int) -> tuple[list, int]:
     """[(name, dtype_str, shape, offset, nbytes)], total buffer size."""
     import numpy as np
 
@@ -425,7 +436,7 @@ def pack_eval_batch(
     can_add_edge=None,
     child_host_slot=None,
     cand_host_slot=None,
-):
+):  # -> np.uint8 buffer (numpy imported lazily to keep module load lean)
     """Host side: CandidateFeatures dict (+ filter aux + optional ml host
     slots) -> one contiguous np.uint8 buffer for `schedule_from_packed`."""
     import numpy as np

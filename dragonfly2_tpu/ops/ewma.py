@@ -50,7 +50,7 @@ def enqueue(
     count: jax.Array,      # (N,) int32 valid entries
     pair_idx: jax.Array,   # (M,) int32 pairs receiving a new probe
     rtt_ns: jax.Array,     # (M,) float32
-):
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Scatter M new probes into their pair rings, drop the oldest where
     full, and return recomputed averages for ALL pairs.
 
@@ -74,7 +74,10 @@ def probed_count_increment(probed_count: jax.Array, host_idx: jax.Array) -> jax.
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def least_probed_hosts(probed_count: jax.Array, alive: jax.Array, noise_key: jax.Array, k: int = CONSTANTS.FIND_PROBED_HOSTS_LIMIT):
+def least_probed_hosts(
+    probed_count: jax.Array, alive: jax.Array, noise_key: jax.Array,
+    k: int = CONSTANTS.FIND_PROBED_HOSTS_LIMIT,
+) -> tuple[jax.Array, jax.Array]:
     """Pick up to k alive hosts, least-probed first with random tie-break —
     FindProbedHosts semantics (networktopology/network_topology.go:190-257)."""
     n = probed_count.shape[0]

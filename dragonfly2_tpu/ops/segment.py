@@ -65,9 +65,9 @@ def pad_pow2(n: int, min_pad: int = 64) -> int:
 
 
 def gather_coo_subgraph(
-    edge_src,
-    edge_dst,
-    dirty,
+    edge_src,  # (E,) int array-like
+    edge_dst,  # (E,) int array-like
+    dirty,     # (D,) int array-like — frontier node ids
     num_nodes: int,
     hops: int = 2,
     max_frac: float = 0.25,

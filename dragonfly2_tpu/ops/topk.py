@@ -41,7 +41,9 @@ _SCORE_FLOOR = jnp.float32(-1e37)
 _RANK_SELECT_MAX_WIDTH = 128
 
 
-def _masked_top_k_rank(scores: jax.Array, mask: jax.Array, k: int):
+def _masked_top_k_rank(
+    scores: jax.Array, mask: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Exact top-k via pairwise ranking (no sort). Matches lax.top_k's
     value order and lowest-index tie-break for non-NaN input."""
     n = scores.shape[-1]
@@ -63,7 +65,9 @@ def _masked_top_k_rank(scores: jax.Array, mask: jax.Array, k: int):
     return jnp.where(valid, values, NEG_INF), indices, valid
 
 
-def masked_top_k(scores: jax.Array, mask: jax.Array, k: int):
+def masked_top_k(
+    scores: jax.Array, mask: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k along the last axis honoring a validity mask.
 
     Returns (values, indices, valid): `valid[i, j]` is False for slots that

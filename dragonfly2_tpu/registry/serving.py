@@ -391,10 +391,17 @@ class MLEvaluator:
         if drain:
             self._drain_requests()
         _signal_worker_stop(self._stop, self._wake)
-        worker = self._worker
+        # swap the worker OUT under _req_mu (dflint LOCK001), THEN join:
+        # clearing after an unlocked read could null a newer worker a
+        # racing _ensure_worker spawned between our read and the clear —
+        # close() would return with that worker alive and unjoined. The
+        # swap is atomic with the spawn check (_ensure_worker holds
+        # _req_mu and sees _stop set), so whatever we swap out is the
+        # only worker there will ever be.
+        with self._req_mu:
+            worker, self._worker = self._worker, None
         if worker is not None and worker is not threading.current_thread():
             worker.join(timeout)
-        self._worker = None
 
     def _ensure_worker(self) -> None:
         # under _req_mu: an unsynchronized check-then-start would let two

@@ -273,6 +273,7 @@ class SchedulerClientPool:
 
         for key in list(self._conns):
             if key not in addr:
+                # dflint: waive[LOCK001] -- _lock is an asyncio.Lock owned by the event loop; this worker thread cannot await it. The pop is GIL-atomic; a conn _get resurrects concurrently is parked+closed by the next update sweep (docstring above)
                 conn = self._conns.pop(key, None)
                 if conn is not None:
                     with self._stale_mu:
@@ -676,6 +677,7 @@ class SyncSchedulerClient:
         # snapshot-swap: two racing closers (a failing call()'s error path
         # and update_schedulers dropping the scheduler) must not leave one
         # of them calling close() on None
+        # dflint: waive[LOCK001] -- deliberate lock-free snapshot-swap (GIL-atomic tuple assign); taking _mu here would deadlock a closer invoked from inside call()'s locked error path
         sock, self._sock = self._sock, None
         if sock is not None:
             try:

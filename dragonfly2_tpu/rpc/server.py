@@ -590,6 +590,11 @@ class SchedulerRPCServer:
     def _stat_peer(self, peer_id: str) -> msg.StatResponse:
         from dragonfly2_tpu.state.fsm import PeerState
 
+        # flush valve (dflint FLUSH001): finished_pieces reads the
+        # buffered piece-report columns — without this, a StatPeer racing
+        # the tick reported a count missing reports already acknowledged
+        # to the reporting peer
+        self.service.flush_piece_reports()
         idx = self.service.state.peer_index(peer_id)
         if idx is None:
             return msg.StatResponse(found=False)

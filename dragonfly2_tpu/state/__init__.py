@@ -3,7 +3,7 @@ from dragonfly2_tpu.state.fsm import PeerState, TaskState, HostType, PeerEvent, 
 __all__ = ["PeerState", "TaskState", "HostType", "PeerEvent", "TaskEvent", "ClusterState"]
 
 
-def __getattr__(name):
+def __getattr__(name: str) -> type:
     # Lazy: cluster depends on records.features, which imports state.fsm —
     # eager import here would make that a cycle.
     if name == "ClusterState":

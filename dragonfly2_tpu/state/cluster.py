@@ -392,7 +392,7 @@ class ClusterState:
         self.host_updated_at[hosts] = now
         return newly
 
-    def adopt_pieces(self, peer_idx: int, piece_numbers) -> int:
+    def adopt_pieces(self, peer_idx: int, piece_numbers: "np.ndarray | list[int] | tuple[int, ...]") -> int:
         """Mark pieces a re-announcing peer ALREADY holds (the failover
         resume path, cluster/scheduler.py register_peer): bitset +
         finished count only — no cost samples, because no transfer was

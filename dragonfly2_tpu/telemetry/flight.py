@@ -210,7 +210,7 @@ _WRAPPERS: dict[str, "weakref.ref[JitWrapper]"] = {}
 _wrappers_mu = threading.Lock()
 
 
-def _sig_of(v):
+def _sig_of(v) -> object:
     """Hashable call-signature component: arrays collapse to (shape,
     dtype) — the thing jit specializes on — containers recurse, hashable
     statics ride as themselves, everything else degrades to its type."""
@@ -281,7 +281,7 @@ class JitWrapper:
             self._cache.set(self.cache_entries())
         return out
 
-    def __getattr__(self, item):
+    def __getattr__(self, item: str):
         return getattr(self.__wrapped__, item)
 
     def cache_entries(self) -> int:
@@ -324,7 +324,7 @@ def jit_wrappers() -> dict[str, JitWrapper]:
 # ------------------------------------------------------------------- dump
 
 
-def _plain(value):
+def _plain(value) -> "bool | int | float | str | None":
     """msgpack/json-safe scalar: pass primitives, stringify the rest."""
     if value is None or isinstance(value, (bool, int, float, str)):
         return value

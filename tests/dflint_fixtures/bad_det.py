@@ -1,0 +1,28 @@
+"""dflint red fixture: DET001 x2 (global rng + unseeded default_rng),
+DET002 (wall clock), DET003 (set iteration) — in a file the test
+configures as a decision module."""
+
+import random
+import time
+
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self.offline = set()
+
+    def draw(self):
+        return np.random.rand()  # <- DET001 (legacy global rng)
+
+    def make_rng(self):
+        return np.random.default_rng()  # <- DET001 (unseeded)
+
+    def stamp(self):
+        return time.time()  # <- DET002 (wall clock in decision path)
+
+    def sweep(self):
+        out = []
+        for host in self.offline:  # <- DET003 (set iteration order)
+            out.append(host)
+        return out

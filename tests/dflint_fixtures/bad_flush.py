@@ -1,0 +1,22 @@
+"""dflint red fixture: FLUSH001 (buffered column read without a valve,
+in a public method and in a helper reachable dirty) and FLUSH002
+(direct buffer inspection outside the valves)."""
+
+
+class SchedulerService:  # the flush pass keys on the owner class name
+    def __init__(self, state):
+        self.state = state
+        self._piece_buf: list = []
+
+    def flush_piece_reports(self):
+        self._piece_buf = []
+
+    def stale_read(self):
+        return self.state.peer_finished_count[0]  # <- FLUSH001
+
+    def peek_buffer(self):
+        return len(self._piece_buf)  # <- FLUSH002
+
+    def covered_read(self):
+        self.flush_piece_reports()
+        return self.state.peer_finished_count[0]  # covered: no finding

@@ -1,0 +1,29 @@
+"""dflint red fixture: one finding per jit-hygiene rule.
+
+JIT001 x2 (``.item()`` + ``float(tracer)``), JIT002 (``if`` on a
+tracer), JIT003 (un-allowlisted host sync in a hot function — the test
+configures ``hot_tick`` as hot), JIT004 (dynamic slice into a jit call).
+"""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def score(batch, limit):
+    peak = batch.max().item()  # <- JIT001 (.item() host sync)
+    scale = float(batch[0, 0])  # <- JIT001 (cast concretizes tracer)
+    if batch.sum() > 0:  # <- JIT002 (python branch on tracer)
+        peak = peak + scale
+    return batch * peak
+
+
+def hot_tick(packed):
+    out = np.asarray(packed)  # <- JIT003 (not on the d2h allowlist)
+    return out
+
+
+def caller(rows, n):
+    return score(rows[:n], 4)  # <- JIT004 (runtime-length slice into jit)

@@ -1,0 +1,22 @@
+"""dflint red fixture: LOCK001 must trip exactly once — `count` is
+mutated under `_mu` in `locked_bump` and bare in `racy_bump`. `unshared`
+is never guarded anywhere, so it must NOT trip (single-threaded idiom)."""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.count = 0
+        self.unshared = 0
+
+    def locked_bump(self):
+        with self._mu:
+            self.count += 1
+
+    def racy_bump(self):
+        self.count += 1  # <- the one expected LOCK001
+
+    def single_threaded(self):
+        self.unshared += 1

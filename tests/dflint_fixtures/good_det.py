@@ -1,0 +1,29 @@
+"""dflint green fixture: determinism idioms the pass must accept —
+seeded generators, perf_counter measurement, sorted set iteration, and
+order-insensitive set consumption."""
+
+import time
+
+import numpy as np
+
+
+class Engine:
+    def __init__(self, seed: int):
+        self.rng = np.random.default_rng(seed)
+        self.offline = set()
+
+    def draw(self):
+        return self.rng.random()
+
+    def measure(self):
+        return time.perf_counter()  # measuring, not deciding
+
+    def sweep(self):
+        out = []
+        for host in sorted(self.offline):  # deterministic order
+            out.append(host)
+        return out
+
+    def census(self):
+        # comprehension feeding an order-insensitive consumer
+        return sorted(h for h in self.offline if h), len(self.offline)

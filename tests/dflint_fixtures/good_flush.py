@@ -1,0 +1,33 @@
+"""dflint green fixture: flush-valve idioms the pass must accept —
+flush-before-read, producer appends, a private helper whose only caller
+flushes first, and unrelated attributes that merely share a column
+name (no `.state.` hop)."""
+
+
+class SchedulerService:
+    def __init__(self, state):
+        self.state = state
+        self._piece_buf: list = []
+        self.peer_finished_count = {}  # NOT a column: no .state. hop
+
+    def flush_piece_reports(self):
+        buf, self._piece_buf = self._piece_buf, []
+        return len(buf)
+
+    def enqueue(self, row):
+        self._piece_buf.append(row)  # producer side: allowed
+
+    def fresh_read(self):
+        self.flush_piece_reports()
+        return self.state.peer_finished_count[0]
+
+    def entry(self):
+        self.flush_piece_reports()
+        return self._covered_helper()
+
+    def _covered_helper(self):
+        # only caller is `entry`, which flushes before the call
+        return self.state.peer_finished_count[1]
+
+    def unrelated(self):
+        return self.peer_finished_count.get("x")

@@ -1,0 +1,35 @@
+"""dflint green fixture: jit idioms the pass must accept — branching on
+static args and shape metadata, None-structure gates, host math on
+non-traced locals, and bucket-padded call sites."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm", "k"))
+def select(batch, mask, algorithm, k):
+    if algorithm == "nt":  # static arg: legal python branch
+        batch = batch * 2.0
+    if batch.ndim > 1:  # shape metadata is static under trace
+        batch = batch.reshape(batch.shape[0], -1)
+    if mask is None:  # pytree-structure gate: static, legal
+        mask = jnp.ones_like(batch)
+    return jnp.where(mask > 0, batch, -jnp.inf)
+
+
+def pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def host_caller(rows):
+    # host-side padding BEFORE the jit call: the blessed idiom
+    padded = np.zeros((pad_pow2(rows.shape[0]), rows.shape[1]), rows.dtype)
+    padded[: rows.shape[0]] = rows
+    n = int(rows.shape[0])  # host value, not a tracer
+    return select(padded, None, "default", 4), float(n)

@@ -1,0 +1,34 @@
+"""dflint green fixture: every LOCK001-adjacent idiom the pass must
+accept — under[...] markers, call-graph propagation through private
+helpers, reentrant public entry points, and lock-free READS."""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.count = 0
+        self.items = []
+
+    def bump(self):
+        with self._mu:
+            self.count += 1
+            self._bump_locked()
+
+    def _bump_locked(self):
+        # no marker needed: every in-class call site holds _mu, the
+        # pass's propagation proves it
+        self.count += 1
+        self.items.append(self.count)
+
+    def helper_with_marker(self):  # dflint: under[_mu]
+        self.count -= 1
+
+    def read_without_lock(self) -> int:
+        # reads are never flagged: atomic-swap readers are an idiom
+        return self.count
+
+    def swap(self):
+        with self._mu:
+            self.items = []

@@ -380,6 +380,22 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
     reg, server, evaluator, graph, params = _served_evaluator(
         tmp_path, n_nodes=n_nodes, hidden=128, edges=edges
     )
+    # runtime lock-order harness (tools/dflint/lockorder): the hammer /
+    # worker / serving triangle is exactly where a req_mu<->compute_mu
+    # inversion or an unlocked mailbox/snapshot write would hide
+    from tools.dflint.lockorder import (
+        assert_clean, guard_attributes, instrument_locks,
+    )
+
+    lock_graph = instrument_locks(evaluator, {
+        "_req_mu": "serving.req_mu",
+        "_compute_mu": "serving.compute_mu",
+    })
+    guard_attributes(evaluator, {
+        "_request": "_req_mu",     # mailbox writes: merge/take under req_mu
+        "_committed": "_compute_mu",  # snapshot commit: only on the drain
+        "_worker": "_req_mu",      # spawn/clear under req_mu (LOCK001 fix)
+    }, lock_graph)
     rng = np.random.default_rng(7)
     evaluator.refresh_embeddings(dict(graph), wait=True)  # commit + warm jit
     # serial full-refresh cost = the stall each tick USED to pay
@@ -461,6 +477,10 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
         "a tick served from a (params_version, emb_version) pair that was "
         "never committed together"
     )
+    # lock-order verdict over the whole hammer run: no acquisition-order
+    # cycles between the mailbox and compute locks, and every _request/
+    # _committed/_worker write held its owning lock
+    assert_clean(lock_graph)
     # Ticks never inherited a refresh (4.98 s of r05's 7.01 s ml wall was
     # exactly that inheritance). On CPU the background refresh shares the
     # XLA intra-op pool with serving, so a tick CAN wait out the tail of

@@ -1,0 +1,36 @@
+"""dflint — repo-invariant static analysis for the dragonfly2_tpu tree.
+
+The Go reference gets an entire correctness-tooling layer for free
+(`go vet`, golangci-lint, `go test -race`); this rebuild's hard-won
+invariants — lock discipline across the threaded service objects, the
+PR-8 "flush valves at every columnar reader" rule, jit tracer hygiene
+and the compile-shape-stability contract, and the seed-determinism the
+paired-seed oracles depend on — lived only in comments and
+after-the-fact tests. dflint turns each of them into an AST pass that
+must run clean over the package (tests/test_static_analysis.py, tier-1):
+
+- ``LOCK001``  lock-discipline: mixed guarded/unguarded mutation of the
+  same ``self.*`` attribute within a class.
+- ``FLUSH001/FLUSH002`` flush-valve: readers of buffered columnar state
+  must flush the piece-report buffer first.
+- ``JIT001..JIT004`` jit-hygiene: host syncs / Python branching on
+  tracers inside jitted bodies, un-allowlisted host syncs in the
+  serving hot path, dynamic shapes entering a jit call.
+- ``DET001..DET003`` determinism: unseeded rng, wall-clock reads, and
+  set-iteration order dependence in simulator/scenario decision paths.
+
+Findings are suppressible ONLY via inline justified waivers::
+
+    something_flagged()  # dflint: waive[LOCK001] -- why this is safe
+
+and methods whose contract is "caller holds lock L" declare it::
+
+    def _helper(self):  # dflint: under[mu]
+
+which the lock pass honors statically and the runtime lock-order
+harness (tools/dflint/lockorder.py) can verify dynamically.
+"""
+
+from tools.dflint.core import Finding, LintReport, run_dflint
+
+__all__ = ["Finding", "LintReport", "run_dflint"]

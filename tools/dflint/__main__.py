@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.dflint [package-or-paths...]``.
+
+Exit codes: 0 clean (waived findings allowed, but every waiver must
+carry a reason), 1 unwaived findings or reason-less waivers, 2 usage.
+
+``--list-waived`` prints the waived findings too — the audit view the
+review wants when judging whether a waiver's argument still holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.dflint.core import run_dflint
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="dflint")
+    parser.add_argument(
+        "paths", nargs="*", default=["dragonfly2_tpu"],
+        help="package dir (default: dragonfly2_tpu) or explicit .py files",
+    )
+    parser.add_argument("--root", default=".", help="repo root")
+    parser.add_argument("--list-waived", action="store_true",
+                        help="also print waived findings with their reasons")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    files: list[Path] | None = None
+    package = "dragonfly2_tpu"
+    if args.paths != ["dragonfly2_tpu"]:
+        explicit: list[Path] = []
+        for p in args.paths:
+            path = (root / p).resolve() if not Path(p).is_absolute() else Path(p)
+            if path.is_dir():
+                explicit.extend(sorted(path.rglob("*.py")))
+            elif path.suffix == ".py":
+                explicit.append(path)
+            else:
+                print(f"dflint: not a python file or dir: {p}", file=sys.stderr)
+                return 2
+        files = explicit
+    report, contexts = run_dflint(root, package=package, files=files)
+    print(report.render(include_waived=args.list_waived))
+    reasonless = report.reasonless_waivers(contexts)
+    for row in reasonless:
+        print(f"REASONLESS WAIVER: {row}")
+    return 1 if (report.unwaived() or reasonless) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

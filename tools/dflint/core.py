@@ -1,0 +1,261 @@
+"""dflint core: file contexts, waiver/marker parsing, pass runner.
+
+Waivers are inline and must carry a reason::
+
+    self._seed_rr += 1  # dflint: waive[LOCK001] -- single-writer by design
+
+A waiver with an empty reason does NOT suppress the finding (the tier-1
+gate additionally fails on reason-less waivers so they cannot silently
+accumulate). A waiver comment may sit on the flagged line, on the line
+directly above it, or on the enclosing ``def`` line (function-scoped).
+
+``# dflint: under[<lock>]`` on a ``def`` line is not a waiver but a
+contract marker: "every caller holds ``self.<lock>``". The
+lock-discipline pass treats the whole body as guarded by that lock; the
+runtime harness (lockorder.py) is the dynamic check that the contract
+actually holds in the concurrency tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import time
+from pathlib import Path
+
+WAIVE_RE = re.compile(
+    r"#\s*dflint:\s*waive\[([A-Z]+\d{3})\]\s*(?:--\s*(\S.*?))?\s*$"
+)
+UNDER_RE = re.compile(r"#\s*dflint:\s*under\[([A-Za-z_][A-Za-z0-9_]*)\]")
+
+DEFAULT_PACKAGE = "dragonfly2_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``finding_id`` is stable across line churn
+    (rule + file + symbol), which is what the fixture golden tests pin;
+    ``location`` is the clickable exact site."""
+
+    rule: str
+    path: str  # repo-relative
+    line: int
+    symbol: str  # Class.method / function qualname ("" at module scope)
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    @property
+    def finding_id(self) -> str:
+        return f"{self.rule}@{self.path}:{self.symbol or 'module'}"
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        tag = f" [waived: {self.waive_reason}]" if self.waived else ""
+        sym = f" ({self.symbol})" if self.symbol else ""
+        return f"{self.location}: {self.rule}{sym}: {self.message}{tag}"
+
+
+class FileContext:
+    """Parsed source + waiver/marker tables for one file."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        # line -> [(rule, reason)]
+        self.waivers: dict[int, list[tuple[str, str]]] = {}
+        # line -> lock name (under[...] markers, keyed by the def line)
+        self.under: dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = WAIVE_RE.search(text)
+            if m:
+                self.waivers.setdefault(i, []).append(
+                    (m.group(1), (m.group(2) or "").strip())
+                )
+            m = UNDER_RE.search(text)
+            if m:
+                self.under[i] = m.group(1)
+
+    def waiver_at(self, rule: str, *lines: int) -> tuple[str, str] | None:
+        """(reason, 'line') for the first waiver of `rule` at any of the
+        candidate lines (the flagged line, the line above, the def line)."""
+        for line in lines:
+            for wrule, reason in self.waivers.get(line, ()):
+                if wrule == rule:
+                    return reason, f"line {line}"
+        return None
+
+    def under_lock(self, func: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+        """Lock named by an under[...] marker on (or just above) the def."""
+        for line in (func.lineno, func.lineno - 1):
+            if line in self.under:
+                return self.under[line]
+        return None
+
+    def make_finding(
+        self,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        symbol: str = "",
+        def_line: int | None = None,
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        candidates = [line, line - 1]
+        if def_line is not None:
+            candidates.append(def_line)
+        waiver = self.waiver_at(rule, *candidates)
+        if waiver is not None:
+            reason, _ = waiver
+            return Finding(rule, self.rel, line, symbol, message,
+                           waived=bool(reason), waive_reason=reason)
+        return Finding(rule, self.rel, line, symbol, message)
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]
+    files_scanned: int
+    duration_s: float
+
+    def unwaived(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    def by_rule(self) -> dict[str, list[Finding]]:
+        out: dict[str, list[Finding]] = {}
+        for finding in self.findings:
+            out.setdefault(finding.rule, []).append(finding)
+        return out
+
+    def reasonless_waivers(self, contexts: list[FileContext]) -> list[str]:
+        """Waiver comments whose reason is empty — the gate fails on
+        these: a waiver without an argument is just a muzzle."""
+        bad = []
+        for ctx in contexts:
+            for line, entries in sorted(ctx.waivers.items()):
+                for rule, reason in entries:
+                    if not reason:
+                        bad.append(f"{ctx.rel}:{line}: waive[{rule}] has no reason")
+        return bad
+
+    def render(self, include_waived: bool = False) -> str:
+        rows = [
+            f.render() for f in self.findings if include_waived or not f.waived
+        ]
+        summary = (
+            f"dflint: {len(self.unwaived())} finding(s), "
+            f"{len(self.waived())} waived, {self.files_scanned} file(s), "
+            f"{self.duration_s:.2f}s"
+        )
+        return "\n".join(rows + [summary])
+
+
+# --------------------------------------------------------- AST utilities
+
+
+def attr_chain(node: ast.AST) -> str | None:
+    """Dotted name for Name/Attribute chains: ``self.state.peer_host`` ->
+    "self.state.peer_host"; None when the chain roots in a call/subscript
+    (e.g. ``foo().bar`` — not a stable name)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """"x" for ``self.x`` (exactly one level), else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def iter_class_functions(cls: ast.ClassDef):
+    """(funcdef) for every method directly on the class (nested defs are
+    walked by the passes themselves so with-scope context is preserved)."""
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield stmt
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted callee name, or None for computed callees."""
+    return attr_chain(node.func)
+
+
+# --------------------------------------------------------------- runner
+
+
+def collect_files(root: Path, package: str = DEFAULT_PACKAGE) -> list[Path]:
+    base = root / package
+    return sorted(p for p in base.rglob("*.py") if p.is_file())
+
+
+def parse_contexts(root: Path, files: list[Path]) -> list[FileContext]:
+    contexts = []
+    for path in files:
+        try:
+            rel = str(path.relative_to(root))
+        except ValueError:  # outside the repo root (fixture tmp dirs)
+            rel = str(path)
+        contexts.append(FileContext(path, rel))
+    return contexts
+
+
+def default_passes():
+    from tools.dflint.passes.determinism import DeterminismPass
+    from tools.dflint.passes.flush_valve import FlushValvePass
+    from tools.dflint.passes.jit_hygiene import JitHygienePass
+    from tools.dflint.passes.lock_discipline import LockDisciplinePass
+
+    return [
+        LockDisciplinePass(),
+        FlushValvePass(),
+        JitHygienePass(),
+        DeterminismPass(),
+    ]
+
+
+def run_dflint(
+    root: str | Path,
+    package: str = DEFAULT_PACKAGE,
+    passes=None,
+    files: list[Path] | None = None,
+) -> tuple[LintReport, list[FileContext]]:
+    """Run all (or the given) passes over `root/package` (or explicit
+    `files`). Returns the report plus the parsed contexts so callers
+    (the tier-1 gate) can audit waiver reasons."""
+    root = Path(root)
+    t0 = time.perf_counter()
+    if files is None:
+        files = collect_files(root, package)
+    contexts = parse_contexts(root, files)
+    if passes is None:
+        passes = default_passes()
+    findings: list[Finding] = []
+    for ctx in contexts:
+        for lint_pass in passes:
+            findings.extend(lint_pass.run(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return (
+        LintReport(findings, len(contexts), time.perf_counter() - t0),
+        contexts,
+    )

@@ -1,0 +1,225 @@
+"""Runtime lock-order harness — the `go test -race` analog for this
+tree's lock discipline.
+
+Static analysis (tools/dflint LOCK001 + ``under[...]`` markers) proves
+the SHAPE of the discipline; this module checks it live. The
+concurrency tests wrap the interesting locks in :class:`TrackedLock`
+instances that report every acquisition to a :class:`LockOrderGraph`:
+
+- **ordering**: acquiring B while holding A records the edge A→B. After
+  the test, :meth:`LockOrderGraph.cycles` must be empty — a cycle in the
+  cross-thread acquisition graph is deadlock potential, even if this
+  particular run happened not to interleave fatally (that is exactly
+  why a runtime-order check beats waiting for the hang).
+- **guarded attributes**: :func:`guard_attributes` swaps the object onto
+  a dynamic subclass whose ``__setattr__`` records a violation whenever
+  a guarded attribute is WRITTEN by a thread not holding the owning
+  tracked lock — the dynamic twin of the static ``under[...]`` contract.
+  (Reads are deliberately unchecked: lock-free reads of atomically
+  swapped references are an idiom here, and guarding ``__getattribute__``
+  would also distort the timings the concurrency tests exist to stress.)
+
+Instrumentation is cooperative and per-object: production code never
+imports this module; tests call :func:`instrument_locks` /
+:func:`guard_attributes` on the instances they drive and assert
+:func:`assert_clean` at the end.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LockOrderGraph:
+    """Cross-thread lock-acquisition graph + guarded-attr violations."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (held_name, acquired_name) -> set of "thread | held-stack" descs
+        self.edges: dict[tuple[str, str], set[str]] = {}
+        self.violations: list[str] = []
+        self._local = threading.local()
+
+    # ------------------------------------------------------- per-thread
+
+    def _state(self) -> tuple[list[str], dict[str, int]]:
+        local = self._local
+        if not hasattr(local, "held"):
+            local.held = []  # first-acquisition order
+            local.counts = {}
+        return local.held, local.counts
+
+    def note_acquire(self, name: str) -> None:
+        held, counts = self._state()
+        if counts.get(name, 0) == 0:
+            if held:
+                thread = threading.current_thread().name
+                with self._mu:
+                    for h in held:
+                        self.edges.setdefault((h, name), set()).add(
+                            f"{thread} holding [{', '.join(held)}]"
+                        )
+            held.append(name)
+        counts[name] = counts.get(name, 0) + 1
+
+    def note_release(self, name: str) -> None:
+        held, counts = self._state()
+        n = counts.get(name, 0)
+        if n <= 0:
+            with self._mu:
+                self.violations.append(
+                    f"release of '{name}' on {threading.current_thread().name} "
+                    f"which does not hold it"
+                )
+            return
+        counts[name] = n - 1
+        if counts[name] == 0 and name in held:
+            held.remove(name)
+
+    def holds(self, name: str) -> bool:
+        _, counts = self._state()
+        return counts.get(name, 0) > 0
+
+    def record_violation(self, message: str) -> None:
+        with self._mu:
+            self.violations.append(message)
+
+    # --------------------------------------------------------- analysis
+
+    def cycles(self) -> list[list[str]]:
+        """Simple cycles in the acquisition graph (each reported once,
+        rotated to start at its lexicographically smallest node)."""
+        with self._mu:
+            adjacency: dict[str, list[str]] = {}
+            for a, b in self.edges:
+                adjacency.setdefault(a, []).append(b)
+                adjacency.setdefault(b, [])
+        seen_cycles: set[tuple[str, ...]] = set()
+        out: list[list[str]] = []
+
+        def dfs(node: str, path: list[str], on_path: set[str]) -> None:
+            for nxt in adjacency.get(node, ()):
+                if nxt in on_path:
+                    cycle = path[path.index(nxt):]
+                    start = min(range(len(cycle)), key=lambda i: cycle[i])
+                    key = tuple(cycle[start:] + cycle[:start])
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(list(key))
+                else:
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    dfs(nxt, path, on_path)
+                    on_path.discard(nxt)
+                    path.pop()
+
+        for node in sorted(adjacency):
+            dfs(node, [node], {node})
+        return out
+
+    def describe_edges(self) -> list[str]:
+        with self._mu:
+            return [
+                f"{a} -> {b}  ({'; '.join(sorted(who))})"
+                for (a, b), who in sorted(self.edges.items())
+            ]
+
+
+class TrackedLock:
+    """Wraps a Lock/RLock, reporting acquisitions to a LockOrderGraph.
+    Reentrant acquisition (RLock) does not re-edge; the graph tracks
+    per-thread hold counts."""
+
+    def __init__(self, inner, name: str, graph: LockOrderGraph):
+        self._inner = inner
+        self.name = name
+        self.graph = graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self.graph.note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self.graph.note_release(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def held_by_current_thread(self) -> bool:
+        return self.graph.holds(self.name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+
+def instrument_locks(
+    obj, attrs: dict[str, str], graph: LockOrderGraph | None = None
+) -> LockOrderGraph:
+    """Replace ``obj.<attr>`` locks with TrackedLocks labelled per
+    `attrs` ({attr_name: label}); returns the (possibly shared) graph.
+    Idempotent: an already-tracked lock is relabelled into the SAME
+    graph only if it was created by this call chain."""
+    if graph is None:
+        graph = LockOrderGraph()
+    for attr, label in attrs.items():
+        inner = getattr(obj, attr)
+        if isinstance(inner, TrackedLock):
+            continue
+        setattr(obj, attr, TrackedLock(inner, label, graph))
+    return graph
+
+
+def guard_attributes(
+    obj, guards: dict[str, str], graph: LockOrderGraph
+) -> None:
+    """Enforce "attribute X is only written under lock attr L" on ONE
+    instance: swaps the instance onto a dynamic subclass whose
+    ``__setattr__`` records a violation when a guarded attribute is
+    written without the owning TrackedLock held by the current thread.
+    `guards` maps attribute name -> lock ATTRIBUTE name (which must
+    already be a TrackedLock via instrument_locks)."""
+    cls = type(obj)
+    guard_map = dict(guards)
+
+    def checked_setattr(self, name, value):
+        lock_attr = guard_map.get(name)
+        if lock_attr is not None:
+            lock = object.__getattribute__(self, lock_attr)
+            if isinstance(lock, TrackedLock) and not lock.held_by_current_thread():
+                graph.record_violation(
+                    f"write of guarded attribute '{name}' on "
+                    f"{threading.current_thread().name} without holding "
+                    f"'{lock_attr}'"
+                )
+        super(sub, self).__setattr__(name, value)
+
+    sub = type(
+        cls.__name__ + "·LockGuarded", (cls,), {"__setattr__": checked_setattr}
+    )
+    obj.__class__ = sub
+
+
+def assert_clean(graph: LockOrderGraph) -> None:
+    """Raise AssertionError on acquisition-order cycles or guarded-attr
+    violations, with the full edge list for diagnosis."""
+    cycles = graph.cycles()
+    problems = []
+    if cycles:
+        rendered = "; ".join(" -> ".join(c + [c[0]]) for c in cycles)
+        problems.append(
+            f"lock-order cycles (deadlock potential): {rendered}\n"
+            f"edges:\n  " + "\n  ".join(graph.describe_edges())
+        )
+    if graph.violations:
+        problems.append(
+            "guarded-attribute violations:\n  "
+            + "\n  ".join(graph.violations[:20])
+        )
+    assert not problems, "\n".join(problems)

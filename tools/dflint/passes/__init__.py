@@ -1,0 +1,4 @@
+"""dflint passes. Each pass is a class with ``name``, ``rules`` and
+``run(ctx: FileContext) -> list[Finding]``; configuration lives in the
+constructor so the fixture tests can retarget a pass at synthetic files
+while the tier-1 gate runs the defaults over the real package."""

@@ -1,0 +1,267 @@
+"""DET001..DET003 — seed-determinism of the simulator/scenario decision
+paths.
+
+The paired-seed equivalence oracles (PR-8's vectorized-vs-loop control
+plane, PR-9's megascale-vs-per-peer engine) and the scenario A/B matrix
+all rest on one property: the same seed + spec produces the same event
+stream, bit for bit, run after run. Three things silently break it:
+
+- ``DET001`` unseeded randomness: module-level ``random.*`` /
+  ``np.random.*`` calls draw from process-global state any import or
+  test can perturb; ``default_rng()`` / ``Random()`` with no seed
+  differ per process. Decision paths must draw from an explicitly
+  seeded generator threaded through the object.
+- ``DET002`` wall-clock reads (``time.time``/``time_ns``/``monotonic``/
+  ``datetime.now``): a replay domain has MODEL time (rounds, event
+  clocks); wall time makes the fault schedule depend on machine load.
+  ``perf_counter`` is exempt — measuring how long a run took is not a
+  decision.
+- ``DET003`` iteration over a ``set``/``frozenset`` in a decision path:
+  Python string hashing is randomized per process (PYTHONHASHSEED), so
+  set order differs across runs even with identical seeds — a
+  cross-run artifact diff waiting to happen. Wrap in ``sorted(...)``,
+  or waive with the argument that the loop body is order-commutative.
+
+Scope is the configured decision modules (simulator, scenario engine and
+specs, megascale) — wall clocks are legitimate elsewhere (GC TTLs,
+metrics), so a tree-wide DET002 would be noise, not signal. DET003
+additionally covers the scheduler: its selection stream is what the
+equivalence oracles diff.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+
+DEFAULT_DECISION_SUFFIXES = (
+    "cluster/simulator.py",
+    "scenarios/engine.py",
+    "scenarios/spec.py",
+    "megascale/engine.py",
+    "megascale/topology.py",
+    "megascale/soak.py",
+)
+# DET003 also guards the scheduler: the selection/response stream it
+# produces is exactly what the paired-seed oracles compare
+DEFAULT_SET_ITER_SUFFIXES = DEFAULT_DECISION_SUFFIXES + (
+    "cluster/scheduler.py",
+)
+
+WALL_CLOCKS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+SEEDED_FACTORIES = {"default_rng", "Random", "SeedSequence", "Generator", "key", "PRNGKey"}
+
+
+class DeterminismPass:
+    name = "determinism"
+    rules = ("DET001", "DET002", "DET003")
+
+    def __init__(
+        self,
+        decision_suffixes: tuple[str, ...] = DEFAULT_DECISION_SUFFIXES,
+        set_iter_suffixes: tuple[str, ...] = DEFAULT_SET_ITER_SUFFIXES,
+    ):
+        self.decision_suffixes = decision_suffixes
+        self.set_iter_suffixes = set_iter_suffixes
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        in_decision = any(ctx.rel.endswith(s) for s in self.decision_suffixes)
+        in_set_scope = any(ctx.rel.endswith(s) for s in self.set_iter_suffixes)
+        if not (in_decision or in_set_scope):
+            return []
+        findings: list[Finding] = []
+        set_names = _collect_set_names(ctx.tree) if in_set_scope else set()
+        safe_comp_iters = _order_insensitive_comp_iters(ctx.tree)
+        for func, symbol in _functions_with_symbols(ctx.tree):
+            for node in ast.walk(func):
+                if in_decision and isinstance(node, ast.Call):
+                    findings.extend(
+                        self._check_call(ctx, node, symbol, func.lineno)
+                    )
+                if in_set_scope and isinstance(node, (ast.For, ast.AsyncFor)):
+                    findings.extend(self._check_iteration(
+                        ctx, node, node.iter, set_names, symbol, func.lineno
+                    ))
+                if in_set_scope and isinstance(node, ast.comprehension) \
+                        and id(node.iter) not in safe_comp_iters:
+                    findings.extend(self._check_iteration(
+                        ctx, node.iter, node.iter, set_names, symbol,
+                        func.lineno,
+                    ))
+        return findings
+
+    # ------------------------------------------------------------- calls
+
+    def _check_call(self, ctx, node: ast.Call, symbol, def_line) -> list[Finding]:
+        chain = attr_chain(node.func)
+        if chain is None:
+            return []
+        findings = []
+        parts = chain.split(".")
+        # module-global randomness: random.<fn>(...) / np.random.<fn>(...)
+        if parts[0] == "random" and len(parts) == 2 \
+                and parts[1] not in SEEDED_FACTORIES:
+            findings.append(ctx.make_finding(
+                "DET001", node,
+                f"'{chain}()' draws from the process-global random state — "
+                f"decision paths must use an explicitly seeded "
+                f"random.Random/np.random.Generator",
+                symbol=symbol, def_line=def_line,
+            ))
+        elif len(parts) >= 3 and parts[-2] == "random" \
+                and parts[0] in ("np", "numpy") \
+                and parts[-1] not in SEEDED_FACTORIES:
+            findings.append(ctx.make_finding(
+                "DET001", node,
+                f"'{chain}()' uses numpy's legacy global rng — seed a "
+                f"Generator (np.random.default_rng(seed)) instead",
+                symbol=symbol, def_line=def_line,
+            ))
+        elif parts[-1] in ("default_rng", "Random") and not node.args \
+                and not node.keywords:
+            findings.append(ctx.make_finding(
+                "DET001", node,
+                f"'{chain}()' without a seed differs per process — thread "
+                f"the scenario/sim seed through",
+                symbol=symbol, def_line=def_line,
+            ))
+        elif chain in WALL_CLOCKS:
+            findings.append(ctx.make_finding(
+                "DET002", node,
+                f"'{chain}()' reads the wall clock inside a deterministic "
+                f"replay domain — use the model clock (rounds/event time); "
+                f"perf_counter is fine for measuring, never for deciding",
+                symbol=symbol, def_line=def_line,
+            ))
+        return findings
+
+    # --------------------------------------------------------- iteration
+
+    def _check_iteration(
+        self, ctx, report_node, iter_expr, set_names, symbol, def_line
+    ) -> list[Finding]:
+        reason = _set_typed(iter_expr, set_names)
+        if reason is None:
+            return []
+        return [ctx.make_finding(
+            "DET003", report_node,
+            (
+                f"iteration over a set ({reason}) in a decision path — "
+                f"set order depends on PYTHONHASHSEED across processes; "
+                f"wrap in sorted(...) or waive with an order-commutativity "
+                f"argument"
+            ),
+            symbol=symbol, def_line=def_line,
+        )]
+
+
+# ------------------------------------------------------------- helpers
+
+# consumers whose result does not depend on iteration order: a set-fed
+# comprehension inside one of these is deterministic by construction
+ORDER_INSENSITIVE_CONSUMERS = {
+    "sorted", "set", "frozenset", "sum", "min", "max", "any", "all", "len",
+    "Counter", "collections.Counter",
+}
+
+
+def _order_insensitive_comp_iters(tree) -> set[int]:
+    """ids of comprehension iter-exprs whose comprehension is the direct
+    argument of an order-insensitive consumer (``sorted(x for x in s)``)."""
+    safe: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if chain not in ORDER_INSENSITIVE_CONSUMERS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+                for gen in arg.generators:
+                    safe.add(id(gen.iter))
+    return safe
+
+
+def _collect_set_names(tree) -> set[str]:
+    """Names (locals and ``self.x`` attrs, flattened to their last
+    component) assigned from set constructors anywhere in the module —
+    a deliberately name-based approximation."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value, names):
+            for target in node.targets:
+                chain = attr_chain(target)
+                if chain is not None:
+                    names.add(chain.rsplit(".", 1)[-1])
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            ann = getattr(node.annotation, "id", None) or attr_chain(node.annotation)
+            if _is_set_expr(node.value, names) or (
+                isinstance(ann, str) and ann.startswith(("set", "frozenset"))
+            ):
+                chain = attr_chain(node.target)
+                if chain is not None:
+                    names.add(chain.rsplit(".", 1)[-1])
+    return names
+
+
+def _is_set_expr(node: ast.AST, known: set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        return _is_set_expr(node.left, known) or _is_set_expr(node.right, known)
+    chain = attr_chain(node)
+    if chain is not None and chain.rsplit(".", 1)[-1] in known:
+        return True
+    return False
+
+
+def _set_typed(iter_expr: ast.AST, set_names: set[str]) -> str | None:
+    """Why the iterated expression is set-ordered, or None. sorted(...)
+    and list(...)/tuple(...) wrappers of sets still reach here only when
+    the ITERATED expr itself is the set — wrapping in sorted() changes
+    the iterated expr to the sorted() call, which is not set-typed."""
+    if isinstance(iter_expr, (ast.Set, ast.SetComp)):
+        return "set literal/comprehension"
+    if isinstance(iter_expr, ast.Call):
+        chain = attr_chain(iter_expr.func)
+        if chain in ("set", "frozenset"):
+            return f"{chain}(...) result"
+        # x.active() style known-set-returning calls are out of scope —
+        # name-based only, by design
+        return None
+    if isinstance(iter_expr, ast.BinOp) and isinstance(
+        iter_expr.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        left = _set_typed(iter_expr.left, set_names)
+        right = _set_typed(iter_expr.right, set_names)
+        if left or right:
+            return f"set algebra ({left or right})"
+        return None
+    chain = attr_chain(iter_expr)
+    if chain is not None and chain.rsplit(".", 1)[-1] in set_names:
+        return f"'{chain}' assigned from a set constructor"
+    return None
+
+
+def _functions_with_symbols(tree):
+    """(funcdef, qualified symbol) pairs, class-aware, one level deep
+    (nested defs inherit the enclosing symbol via the walk)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield item, f"{node.name}.{item.name}"

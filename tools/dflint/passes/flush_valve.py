@@ -1,0 +1,270 @@
+"""FLUSH001/FLUSH002 — buffered columnar state must be flushed before
+it is read.
+
+PR-8 buffered piece-report ingestion: ``piece_finished`` /
+``pieces_finished_batch`` enqueue into ``SchedulerService._piece_buf``
+and the SoA columns only absorb the buffer at the tick's
+``report_ingest`` phase or at an explicit flush valve
+(``flush_piece_reports`` / ``_absorb_piece_reports``). The invariant —
+"flush valves at every columnar reader" — means any code that READS one
+of the buffered columns without flushing first can observe stale state:
+a peer's finished count missing reports that already arrived, a GC
+sweep reaping a peer whose liveness touch is still sitting in the
+buffer.
+
+- ``FLUSH001``: a read of a buffered column (``*.state.<column>`` chain,
+  or a buffered read-method on the state object) with no flush earlier
+  in the function, in a context that can be entered with a dirty
+  buffer.
+- ``FLUSH002``: direct read of ``_piece_buf`` outside the valve methods
+  (producers may append; only the valves may consume or inspect).
+
+Within the owner class (``SchedulerService``) the pass propagates flush
+coverage through the in-class call graph: a private helper all of whose
+callers flush before the call is covered; a public method is assumed
+callable with a dirty buffer unless it flushes first itself. Outside
+the owner class (e.g. the RPC server reading ``service.state.*``) the
+check is per-function: flush before read, or carry a waiver.
+
+The column owner (``state/cluster.py``) is exempt — the columns are its
+storage; the valve contract binds consumers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+
+# columns mutated by the buffered absorb (state.record_pieces_batch and
+# the parent-side accounting in _absorb_piece_reports)
+DEFAULT_BUFFERED_COLUMNS = frozenset({
+    "peer_finished_bitset", "peer_finished_count", "peer_piece_costs",
+    "peer_piece_cost_count", "peer_cost_cursor", "peer_updated_at",
+    "host_updated_at", "host_upload_count",
+})
+# read-methods on the state object that internally read buffered columns
+DEFAULT_BUFFERED_READ_METHODS = frozenset({
+    "gather_candidates", "peer_piece_costs_ordered", "peer_finished_pieces",
+})
+DEFAULT_VALVES = frozenset({"flush_piece_reports", "_absorb_piece_reports"})
+DEFAULT_OWNER_CLASS = "SchedulerService"
+DEFAULT_BUFFER_ATTR = "_piece_buf"
+# the column owner: reading its own storage is what it is for
+DEFAULT_EXEMPT_SUFFIXES = ("state/cluster.py",)
+
+
+@dataclasses.dataclass
+class _Read:
+    node: ast.AST
+    what: str
+    order: int  # source position index within the function
+
+
+class FlushValvePass:
+    name = "flush-valve"
+    rules = ("FLUSH001", "FLUSH002")
+
+    def __init__(
+        self,
+        buffered_columns: frozenset[str] = DEFAULT_BUFFERED_COLUMNS,
+        buffered_read_methods: frozenset[str] = DEFAULT_BUFFERED_READ_METHODS,
+        valves: frozenset[str] = DEFAULT_VALVES,
+        owner_class: str = DEFAULT_OWNER_CLASS,
+        buffer_attr: str = DEFAULT_BUFFER_ATTR,
+        exempt_suffixes: tuple[str, ...] = DEFAULT_EXEMPT_SUFFIXES,
+    ):
+        self.buffered_columns = buffered_columns
+        self.buffered_read_methods = buffered_read_methods
+        self.valves = valves
+        self.owner_class = owner_class
+        self.buffer_attr = buffer_attr
+        self.exempt_suffixes = exempt_suffixes
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        if any(ctx.rel.endswith(suffix) for suffix in self.exempt_suffixes):
+            return []
+        findings: list[Finding] = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                if node.name == self.owner_class:
+                    findings.extend(self._check_owner_class(ctx, node))
+                else:
+                    findings.extend(self._check_plain_scope(ctx, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(ctx, node, symbol=node.name))
+        return findings
+
+    # ------------------------------------------------- per-function scan
+
+    def _scan(self, func) -> tuple[list[_Read], list[int], list[tuple[str, int]]]:
+        """(buffered reads, flush positions, self-call sites) in source
+        order. Source order is a deliberate approximation: a flush in a
+        conditional branch counts as covering later reads — this is a
+        lint for a discipline, not a proof system."""
+        reads: list[_Read] = []
+        flushes: list[int] = []
+        calls: list[tuple[str, int]] = []
+        order = 0
+        for node in ast.walk(func):
+            order = max(order, getattr(node, "lineno", order))
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is not None:
+                    leaf = chain.rsplit(".", 1)[-1]
+                    if leaf in self.valves:
+                        flushes.append(node.lineno)
+                    elif (
+                        leaf in self.buffered_read_methods
+                        and ".state." in f".{chain}."
+                    ):
+                        reads.append(_Read(node, f"{leaf}()", node.lineno))
+                    elif chain.startswith("self.") and chain.count(".") == 1:
+                        calls.append((chain.split(".", 1)[1], node.lineno))
+            elif isinstance(node, ast.Attribute):
+                if node.attr in self.buffered_columns:
+                    chain = attr_chain(node)
+                    # require the chain to pass through a `.state.` hop so
+                    # unrelated attributes sharing a column name elsewhere
+                    # in the tree do not alias into the invariant
+                    if chain is not None and (
+                        ".state." in chain or chain.startswith("state.")
+                    ):
+                        reads.append(_Read(node, node.attr, node.lineno))
+        return reads, sorted(flushes), calls
+
+    def _uncovered(self, func) -> tuple[list[_Read], list[tuple[str, int]], bool]:
+        """Reads not preceded (in source order) by a flush, the call
+        sites with a flag for whether a flush precedes them, and whether
+        the function flushes at all."""
+        reads, flushes, calls = self._scan(func)
+        first_flush = flushes[0] if flushes else None
+        uncovered = [
+            r for r in reads if first_flush is None or r.order < first_flush
+        ]
+        call_flags = [
+            (name, first_flush is not None and line >= first_flush)
+            for name, line in calls
+        ]
+        return uncovered, call_flags, bool(flushes)
+
+    # ------------------------------------------------------- owner class
+
+    def _check_owner_class(self, ctx: FileContext, cls: ast.ClassDef) -> list[Finding]:
+        methods = {
+            f.name: f for f in cls.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        info = {}
+        for name, func in methods.items():
+            if name in self.valves or name == "__init__":
+                continue
+            info[name] = self._uncovered(func)
+
+        # fixpoint: can a method be ENTERED with a dirty buffer?
+        # public -> yes (external callers make no promise); private ->
+        # only if some caller reaches its call site without flushing.
+        dirty_entry = {
+            name: not name.startswith("_") for name in info
+        }
+        for _ in range(len(info) + 1):
+            changed = False
+            for name in info:
+                if dirty_entry[name]:
+                    continue
+                entered_dirty = False
+                for caller, (_, call_flags, _) in info.items():
+                    for callee, flushed_before in call_flags:
+                        if callee == name and dirty_entry.get(caller, False) \
+                                and not flushed_before:
+                            entered_dirty = True
+                if entered_dirty:
+                    dirty_entry[name] = True
+                    changed = True
+            if not changed:
+                break
+
+        findings = []
+        for name, (uncovered, _, _) in sorted(info.items()):
+            if not dirty_entry.get(name, True):
+                continue
+            func = methods[name]
+            for read in uncovered:
+                findings.append(ctx.make_finding(
+                    "FLUSH001",
+                    read.node,
+                    (
+                        f"read of buffered column/state '{read.what}' with no "
+                        f"prior flush valve in a context reachable with a "
+                        f"dirty _piece_buf — call flush_piece_reports() (or "
+                        f"_absorb_piece_reports()) before reading"
+                    ),
+                    symbol=f"{cls.name}.{name}",
+                    def_line=func.lineno,
+                ))
+            findings.extend(self._check_buffer_reads(ctx, cls.name, name, func))
+        return findings
+
+    def _check_buffer_reads(self, ctx, cls_name, name, func) -> list[Finding]:
+        """FLUSH002: direct reads of the buffer outside the valves."""
+        if name in self.valves:
+            return []
+        # producer idiom is allowed: `self._piece_buf.append/extend(...)`
+        producer_nodes: set[int] = set()
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "extend")
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == self.buffer_attr
+            ):
+                producer_nodes.add(id(node.func.value))
+        out = []
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Attribute) and node.attr == self.buffer_attr):
+                continue
+            if id(node) in producer_nodes:
+                continue
+            out.append(ctx.make_finding(
+                "FLUSH002",
+                node,
+                (
+                    f"direct access to {self.buffer_attr} outside the flush "
+                    f"valves — only the valves may consume or inspect the "
+                    f"buffer (producers use the append/extend enqueue paths)"
+                ),
+                symbol=f"{cls_name}.{name}",
+                def_line=func.lineno,
+            ))
+        return out
+
+    # ------------------------------------------- non-owner scopes
+
+    def _check_plain_scope(self, ctx: FileContext, cls: ast.ClassDef) -> list[Finding]:
+        findings = []
+        for func in cls.body:
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(
+                    ctx, func, symbol=f"{cls.name}.{func.name}"
+                ))
+        return findings
+
+    def _check_function(self, ctx: FileContext, func, symbol: str) -> list[Finding]:
+        uncovered, _, _ = self._uncovered(func)
+        return [
+            ctx.make_finding(
+                "FLUSH001",
+                read.node,
+                (
+                    f"read of buffered column/state '{read.what}' without a "
+                    f"prior flush valve — buffered piece reports may not yet "
+                    f"be visible in the SoA columns; call "
+                    f"service.flush_piece_reports() first"
+                ),
+                symbol=f"{symbol}",
+                def_line=func.lineno,
+            )
+            for read in uncovered
+        ]

@@ -1,0 +1,412 @@
+"""JIT001..JIT004 — tracer hygiene inside jitted programs and in the
+serving hot path.
+
+The repo's perf story (BENCH_r01→r06: evaluator 1.11 ms → 0.09 ms, tick
+p50 97.5 → 7.1 ms) rests on two contracts:
+
+- zero new jit signatures after warmup (the compile-shape-stability
+  test): every jitted entry sees only the three fixed bucket shapes;
+- exactly one designed D2H sync per chunk (the ``d2h_wait`` phase) —
+  any other host sync re-serializes the pipelined tick.
+
+Rules:
+
+- ``JIT001`` host sync inside a jit-compiled body: ``.item()`` /
+  ``.tolist()`` / ``jax.device_get`` / ``block_until_ready`` /
+  ``np.asarray``/``np.array`` on a traced value, or ``float()``/
+  ``int()``/``bool()`` of a traced value. Under trace these either
+  fail or silently force a device round-trip per call.
+- ``JIT002`` Python control flow on a traced value (``if``/``while``/
+  ``assert`` conditions referencing a non-static parameter). Branching
+  on tracers raises ConcretizationError or, worse, bakes one branch
+  into the compiled program. ``is None`` / ``is not None`` tests are
+  exempt: pytree STRUCTURE is static, so None-gating is legal jit
+  style.
+- ``JIT003`` host sync in a serving hot-path function that is not on
+  the pass's explicit allowlist. The allowlist (``D2H_ALLOWLIST``)
+  *documents* the pipeline design: the tick's single drain point, the
+  warmup forcing, and the refresh worker's off-critical-path landing
+  are intentional; anything new must be argued onto the list (or
+  waived inline).
+- ``JIT004`` dynamic shape entering a jit call: an argument sliced to
+  a runtime-dependent length (``x[:n]``) at a direct call site of a
+  known-jitted callable — the shape becomes a fresh signature and a
+  recompile. Pad to a bucket (``pad_pow2`` / ``_pad_rows``) instead.
+
+Static parameters (``static_argnames``) are excluded from taint; taint
+propagates through simple assignments within the body (one forward
+pass — an intentionally shallow, low-false-positive approximation).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+
+SYNC_CALL_LEAVES = {"asarray", "array", "device_get", "block_until_ready"}
+SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
+CAST_FUNCS = {"float", "int", "bool"}
+NUMPY_ROOTS = {"np", "numpy", "onp"}
+
+# functions whose body is the serving hot path: host syncs here must be
+# explicitly allowlisted (file suffix, enclosing function name)
+DEFAULT_HOT_FUNCTIONS = {
+    ("cluster/scheduler.py", "tick"),
+    ("cluster/scheduler.py", "_dispatch_chunk"),
+    ("cluster/scheduler.py", "_drain_chunk"),
+    ("cluster/scheduler.py", "warmup"),
+    ("registry/serving.py", "_perform_refresh"),
+}
+
+# (file suffix, enclosing function, callee leaf) -> justification.
+# THIS LIST IS THE DESIGN DOCUMENT for every intentional host sync on
+# the serving path (ROADMAP item-1 residual: the d2h_wait points below
+# are what the tunneled-TPU re-run must re-measure).
+D2H_ALLOWLIST: dict[tuple[str, str, str], str] = {
+    ("cluster/scheduler.py", "_drain_chunk", "asarray"): (
+        "THE designed D2H point of the pipelined tick: chunk i's packed "
+        "selection is read back exactly once, timed as the d2h_wait "
+        "phase, while chunk i+1's device call is already in flight"
+    ),
+    ("cluster/scheduler.py", "_dispatch_chunk", "asarray"): (
+        "plugin scorers run HOST-side on the feature dict by contract "
+        "(plugin API stability over transfer count); the asarray "
+        "normalizes the plugin's host output, it does not sync a device "
+        "array — plugins are not the serving default"
+    ),
+    ("cluster/scheduler.py", "warmup", "asarray"): (
+        "warmup forces compile+execute for every bucket BEFORE serving "
+        "starts; blocking here is the point — it keeps the 35 s cold "
+        "compile off the first real tick"
+    ),
+    ("registry/serving.py", "_perform_refresh", "block_until_ready"): (
+        "the refresh worker lands the embed compute on ITS thread so the "
+        "committed snapshot is never an in-flight array a tick would "
+        "then block on — the stall PR-4 removed"
+    ),
+    ("registry/serving.py", "_perform_refresh", "asarray"): (
+        "host-side COO subgraph gather (numpy in, numpy out) feeding the "
+        "jitted embed program; no device array is synced here"
+    ),
+}
+
+
+class JitHygienePass:
+    name = "jit-hygiene"
+    rules = ("JIT001", "JIT002", "JIT003", "JIT004")
+
+    def __init__(
+        self,
+        hot_functions: set[tuple[str, str]] | None = None,
+        allowlist: dict[tuple[str, str, str], str] | None = None,
+    ):
+        self.hot_functions = (
+            DEFAULT_HOT_FUNCTIONS if hot_functions is None else hot_functions
+        )
+        self.allowlist = D2H_ALLOWLIST if allowlist is None else allowlist
+
+    # ------------------------------------------------------------- run
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        jit_funcs = _collect_jit_functions(ctx.tree)
+        jit_names = {f.name for f, _ in jit_funcs}
+        for func, static in jit_funcs:
+            findings.extend(self._check_jit_body(ctx, func, static))
+        findings.extend(self._check_hot_functions(ctx))
+        findings.extend(self._check_jit_call_sites(ctx, jit_names))
+        return findings
+
+    # ------------------------------------------------------- jit bodies
+
+    def _check_jit_body(self, ctx, func, static: set[str]) -> list[Finding]:
+        tainted = {
+            a.arg for a in (
+                func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+            )
+            if a.arg not in static and a.arg not in ("self", "model")
+        }
+        # one forward taint pass through simple assignments
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and _references(node.value, tainted):
+                for target in node.targets:
+                    for name in ast.walk(target):
+                        if isinstance(name, ast.Name):
+                            tainted.add(name.id)
+        findings = []
+        symbol = func.name
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                leaf, root = _callee_leaf_root(node)
+                if leaf in SYNC_ATTR_CALLS and isinstance(node.func, ast.Attribute):
+                    findings.append(ctx.make_finding(
+                        "JIT001", node,
+                        f".{leaf}() inside jit-compiled '{func.name}' forces "
+                        f"a host sync per call under trace",
+                        symbol=symbol, def_line=func.lineno,
+                    ))
+                elif (
+                    leaf in SYNC_CALL_LEAVES
+                    and root in NUMPY_ROOTS | {"jax"}
+                    and _references_call_args(node, tainted)
+                ):
+                    findings.append(ctx.make_finding(
+                        "JIT001", node,
+                        f"{root}.{leaf}() on a traced value inside "
+                        f"jit-compiled '{func.name}' — a host "
+                        f"materialization under trace",
+                        symbol=symbol, def_line=func.lineno,
+                    ))
+                elif (
+                    leaf in CAST_FUNCS and root is None
+                    and _references_call_args(node, tainted)
+                ):
+                    findings.append(ctx.make_finding(
+                        "JIT001", node,
+                        f"{leaf}() of a traced value inside jit-compiled "
+                        f"'{func.name}' concretizes the tracer",
+                        symbol=symbol, def_line=func.lineno,
+                    ))
+            elif isinstance(node, (ast.If, ast.While)):
+                if _branches_on_tracer(node.test, tainted):
+                    findings.append(ctx.make_finding(
+                        "JIT002", node,
+                        f"Python {'if' if isinstance(node, ast.If) else 'while'} "
+                        f"on a traced value inside jit-compiled '{func.name}' "
+                        f"— use lax.cond/jnp.where (None-structure gates are "
+                        f"exempt)",
+                        symbol=symbol, def_line=func.lineno,
+                    ))
+            elif isinstance(node, ast.Assert):
+                if _branches_on_tracer(node.test, tainted):
+                    findings.append(ctx.make_finding(
+                        "JIT002", node,
+                        f"assert on a traced value inside jit-compiled "
+                        f"'{func.name}' concretizes the tracer",
+                        symbol=symbol, def_line=func.lineno,
+                    ))
+        return findings
+
+    # ---------------------------------------------------- hot functions
+
+    def _check_hot_functions(self, ctx) -> list[Finding]:
+        hot_names = {
+            name for suffix, name in self.hot_functions
+            if ctx.rel.endswith(suffix)
+        }
+        if not hot_names:
+            return []
+        findings = []
+        for func in _walk_functions(ctx.tree):
+            if func.name not in hot_names:
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf, root = _callee_leaf_root(node)
+                is_sync = (
+                    (leaf in SYNC_CALL_LEAVES and root in NUMPY_ROOTS | {"jax"})
+                    or (leaf in SYNC_ATTR_CALLS
+                        and isinstance(node.func, ast.Attribute))
+                )
+                if not is_sync:
+                    continue
+                owner = _enclosing_function(func, node)
+                if owner != func.name and any(
+                    ctx.rel.endswith(suffix) and name == owner
+                    for suffix, name in self.hot_functions
+                ):
+                    continue  # a nested hot function reports on its own scan
+                key = None
+                for suffix, name in self.hot_functions:
+                    if ctx.rel.endswith(suffix) and name == owner:
+                        key = (suffix, name, leaf)
+                        break
+                if key is not None and key in self.allowlist:
+                    continue
+                findings.append(ctx.make_finding(
+                    "JIT003", node,
+                    (
+                        f"host sync '{leaf}' in serving hot path "
+                        f"'{owner}' is not on the d2h allowlist — a new "
+                        f"sync point re-serializes the pipelined tick; "
+                        f"argue it onto tools/dflint/passes/jit_hygiene."
+                        f"D2H_ALLOWLIST or waive inline"
+                    ),
+                    symbol=owner, def_line=func.lineno,
+                ))
+        return findings
+
+    # --------------------------------------------------- jit call sites
+
+    def _check_jit_call_sites(self, ctx, jit_names: set[str]) -> list[Finding]:
+        if not jit_names:
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None or chain.rsplit(".", 1)[-1] not in jit_names:
+                continue
+            for arg in node.args:
+                if _is_dynamic_slice(arg):
+                    findings.append(ctx.make_finding(
+                        "JIT004", arg,
+                        (
+                            f"runtime-length slice passed straight into "
+                            f"jitted '{chain}' — each distinct length is a "
+                            f"fresh compile signature; pad to a fixed "
+                            f"bucket (pad_pow2/_pad_rows) first"
+                        ),
+                        symbol=chain,
+                    ))
+        return findings
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _collect_jit_functions(tree) -> list[tuple[ast.FunctionDef, set[str]]]:
+    """(funcdef, static param names) for every jit-compiled function:
+    ``@jax.jit``, ``@jit``, ``@(functools.)partial(jax.jit, ...)``
+    decorators, and ``name = jax.jit(func)`` rebinds."""
+    by_name: dict[str, ast.FunctionDef] = {}
+    out: list[tuple[ast.FunctionDef, set[str]]] = []
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                static = _jit_decorator_statics(dec)
+                if static is not None and id(node) not in seen:
+                    seen.add(id(node))
+                    out.append((node, static))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            chain = attr_chain(node.value.func)
+            if chain in ("jax.jit", "jit") and node.value.args:
+                target = node.value.args[0]
+                if isinstance(target, ast.Name):
+                    func = by_name.get(target.id)
+                    if func is not None and id(func) not in seen:
+                        seen.add(id(func))
+                        out.append((func, _static_names(node.value)))
+    return out
+
+
+def _jit_decorator_statics(dec: ast.AST) -> set[str] | None:
+    """static_argnames for a jit decorator, or None if not a jit."""
+    chain = attr_chain(dec)
+    if chain in ("jax.jit", "jit"):
+        return set()
+    if isinstance(dec, ast.Call):
+        chain = attr_chain(dec.func)
+        if chain in ("jax.jit", "jit"):
+            return _static_names(dec)
+        if chain in ("functools.partial", "partial") and dec.args:
+            inner = attr_chain(dec.args[0])
+            if inner in ("jax.jit", "jit"):
+                return _static_names(dec)
+    return None
+
+
+def _static_names(call: ast.Call) -> set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            value = kw.value
+            names = set()
+            if isinstance(value, (ast.Tuple, ast.List)):
+                for elt in value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        names.add(elt.value)
+            elif isinstance(value, ast.Constant) and isinstance(value.value, str):
+                names.add(value.value)
+            return names
+    return set()
+
+
+# attribute reads that are STATIC under trace even on a tracer — shape
+# metadata, not values; `if data.ndim > 1:` is legal jit style
+STATIC_TRACER_ATTRS = {"ndim", "shape", "dtype", "size"}
+
+
+def _references(node: ast.AST, names: set[str]) -> bool:
+    static_value_ids = {
+        id(attr.value)
+        for attr in ast.walk(node)
+        if isinstance(attr, ast.Attribute) and attr.attr in STATIC_TRACER_ATTRS
+    }
+    return any(
+        isinstance(n, ast.Name) and n.id in names
+        and id(n) not in static_value_ids
+        for n in ast.walk(node)
+    )
+
+
+def _references_call_args(call: ast.Call, names: set[str]) -> bool:
+    return any(_references(arg, names) for arg in call.args)
+
+
+def _branches_on_tracer(test: ast.AST, tainted: set[str]) -> bool:
+    """Condition references a tainted name — excluding `is (not) None`
+    structure gates and `isinstance` checks (both static under jit)."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return False
+    if isinstance(test, ast.Call):
+        chain = attr_chain(test.func)
+        if chain in ("isinstance", "hasattr", "callable"):
+            return False
+    if isinstance(test, ast.BoolOp):
+        return any(_branches_on_tracer(v, tainted) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _branches_on_tracer(test.operand, tainted)
+    return _references(test, tainted)
+
+
+def _callee_leaf_root(node: ast.Call) -> tuple[str | None, str | None]:
+    chain = attr_chain(node.func)
+    if chain is None:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr, None  # computed root: x[...].item()
+        return None, None
+    parts = chain.split(".")
+    return parts[-1], parts[0] if len(parts) > 1 else None
+
+
+def _is_dynamic_slice(arg: ast.AST) -> bool:
+    if not isinstance(arg, ast.Subscript):
+        return False
+    sl = arg.slice
+    if not isinstance(sl, ast.Slice):
+        return False
+    for bound in (sl.lower, sl.upper):
+        if bound is None or isinstance(bound, ast.Constant):
+            continue
+        if isinstance(bound, ast.UnaryOp) and isinstance(
+            bound.operand, ast.Constant
+        ):
+            continue
+        return True
+    return False
+
+
+def _walk_functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_function(outer, target) -> str:
+    """Name of the innermost function within `outer` containing `target`
+    (by nested def walk); falls back to outer's name."""
+    best = outer.name
+    for node in ast.walk(outer):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not outer:
+            if any(n is target for n in ast.walk(node)):
+                best = node.name
+    return best

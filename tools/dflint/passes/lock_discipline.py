@@ -1,0 +1,319 @@
+"""LOCK001 — mixed guarded/unguarded mutation of a class attribute.
+
+For every class that owns at least one ``threading.Lock``/``RLock``/
+``Condition`` attribute, the pass infers which ``self.*`` attributes are
+mutated inside ``with self.<lock>:`` scopes. An attribute that is
+mutated under a lock in one place and bare in another is exactly the
+shape of bug Go's race detector catches at runtime: the class clearly
+TREATS the attribute as shared, but at least one writer skips the lock.
+Attributes that are never guarded anywhere are not flagged — plenty of
+classes are single-threaded by design, and the mixed pattern is the
+signal.
+
+Cross-method lock knowledge travels two ways:
+
+- ``# dflint: under[<lock>]`` on a ``def`` line asserts "every caller
+  holds ``self.<lock>``" — the body is analyzed with that lock held.
+  The runtime lock-order harness is the dynamic check of the marker.
+- Call-graph propagation: a private method whose every in-class call
+  site sits inside ``with self.<lock>:`` (or inside a method itself
+  entered with the lock) inherits the lock, so internal helpers do not
+  need markers when the code already proves the discipline.
+
+Mutations counted: assignment / augmented assignment / ``del`` whose
+target chain roots at ``self.<attr>``, and calls of known mutating
+methods (``append``, ``add``, ``pop``, ``update``, …) on such chains.
+Reads are deliberately NOT counted — lock-free reads of
+atomically-swapped references are an idiom this codebase uses on
+purpose (``_EmbSnapshot``, buffered-report truthiness probes).
+
+Known approximation: a nested function inherits the with-stack at its
+definition site. Closures defined inside a lock scope and *called* there
+(the tick's ``_dispatch_chunk``/``_drain_chunk``) analyze correctly; a
+closure that escapes the scope would be mis-credited — none do today.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "add", "discard", "update", "setdefault", "sort",
+    "reverse", "rotate", "__setitem__", "insort",
+}
+
+INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+@dataclasses.dataclass
+class _Site:
+    attr: str
+    node: ast.AST
+    method: str
+    def_line: int
+    held: frozenset[str]
+
+
+@dataclasses.dataclass
+class _CallSite:
+    callee: str
+    held: frozenset[str]
+    caller: str
+
+
+class LockDisciplinePass:
+    name = "lock-discipline"
+    rules = ("LOCK001",)
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    # ------------------------------------------------------------ class
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef) -> list[Finding]:
+        locks = _collect_lock_attrs(cls)
+        if not locks:
+            return []
+        methods = [
+            stmt for stmt in cls.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        sites: list[_Site] = []
+        calls: list[_CallSite] = []
+        markers: dict[str, frozenset[str]] = {}
+        for func in methods:
+            under = ctx.under_lock(func)
+            if under is not None:
+                markers[func.name] = frozenset({under})
+            if func.name in INIT_METHODS:
+                continue  # construction precedes sharing
+            collector = _MethodCollector(func.name, func.lineno, locks)
+            for stmt in func.body:
+                collector.visit(stmt)
+            sites.extend(collector.sites)
+            calls.extend(collector.calls)
+
+        entry = _propagate_entry_locks(
+            [f.name for f in methods], markers, calls, locks
+        )
+
+        guarded: dict[str, list[_Site]] = {}
+        bare: dict[str, list[_Site]] = {}
+        for site in sites:
+            effective = site.held | entry.get(site.method, frozenset())
+            bucket = guarded if effective & locks else bare
+            bucket.setdefault(site.attr, []).append(site)
+
+        findings = []
+        for attr, bare_sites in sorted(bare.items()):
+            guarded_sites = guarded.get(attr)
+            if not guarded_sites:
+                continue  # never guarded anywhere: single-threaded idiom
+            lock_names = sorted(
+                set().union(*[
+                    s.held | entry.get(s.method, frozenset())
+                    for s in guarded_sites
+                ]) & locks
+            )
+            example = guarded_sites[0]
+            for site in bare_sites:
+                findings.append(ctx.make_finding(
+                    "LOCK001",
+                    site.node,
+                    (
+                        f"self.{attr} is mutated under "
+                        f"{'/'.join('self.' + ln for ln in lock_names)} "
+                        f"elsewhere in {cls.name} "
+                        f"(e.g. {example.method}:{example.node.lineno}) but "
+                        f"bare here — either take the lock, mark the method "
+                        f"'# dflint: under[{lock_names[0]}]', or waive with "
+                        f"a justification"
+                    ),
+                    symbol=f"{cls.name}.{site.method}",
+                    def_line=site.def_line,
+                ))
+        return findings
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _collect_lock_attrs(cls: ast.ClassDef) -> frozenset[str]:
+    """Attributes assigned a threading.Lock/RLock/Condition anywhere in
+    the class body (typically ``__init__``)."""
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        callee = attr_chain(value.func)
+        if callee is None:
+            continue
+        leaf = callee.rsplit(".", 1)[-1]
+        if leaf not in LOCK_FACTORIES:
+            continue
+        for target in node.targets:
+            chain = attr_chain(target)
+            if chain is not None and chain.startswith("self.") and chain.count(".") == 1:
+                locks.add(chain.split(".", 1)[1])
+    return frozenset(locks)
+
+
+def _propagate_entry_locks(
+    method_names: list[str],
+    markers: dict[str, frozenset[str]],
+    calls: list[_CallSite],
+    locks: frozenset[str],
+) -> dict[str, frozenset[str]]:
+    """Fixpoint: which locks are guaranteed held at entry of each method.
+
+    Public methods (no leading underscore) are externally callable bare:
+    entry = their marker (or nothing). Private methods start optimistic
+    (all locks) and intersect over every in-class call site's
+    held-at-site ∪ caller-entry; a private method nobody in the class
+    calls gets the empty set (unknown callers — likely called via a
+    dispatch table or externally)."""
+    call_sites: dict[str, list[_CallSite]] = {}
+    for call in calls:
+        call_sites.setdefault(call.callee, []).append(call)
+
+    entry: dict[str, frozenset[str]] = {}
+    for name in method_names:
+        if name in markers:
+            entry[name] = markers[name]
+        elif name.startswith("_") and not name.startswith("__") and call_sites.get(name):
+            entry[name] = locks  # optimistic start; intersected below
+        else:
+            entry[name] = frozenset()
+
+    for _ in range(len(method_names) + 1):
+        changed = False
+        for name in method_names:
+            if name in markers or not (
+                name.startswith("_") and not name.startswith("__")
+            ):
+                continue
+            sites = call_sites.get(name)
+            if not sites:
+                continue
+            new = frozenset(locks)
+            for site in sites:
+                new &= site.held | entry.get(site.caller, frozenset())
+            if new != entry[name]:
+                entry[name] = new
+                changed = True
+        if not changed:
+            break
+    return entry
+
+
+class _MethodCollector(ast.NodeVisitor):
+    """Walk one method body tracking the ``with self.<lock>:`` stack;
+    record mutation sites and in-class call sites with the held set."""
+
+    def __init__(self, method: str, def_line: int, locks: frozenset[str]):
+        self.method = method
+        self.def_line = def_line
+        self.locks = locks
+        self.held: list[str] = []
+        self.sites: list[_Site] = []
+        self.calls: list[_CallSite] = []
+
+    # ------------------------------------------------------ with scopes
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            chain = attr_chain(item.context_expr)
+            if chain is not None and chain.startswith("self."):
+                name = chain.split(".", 1)[1]
+                if name in self.locks:
+                    acquired.append(name)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if acquired:
+            del self.held[len(self.held) - len(acquired):]
+
+    visit_AsyncWith = visit_With  # same scoping rules
+
+    # ------------------------------------------------------- mutations
+
+    def _record_target(self, target: ast.AST) -> None:
+        chain = attr_chain(target)
+        if chain is None:
+            # self.x[k] = v / self.x.y[k] = v — unwrap subscripts
+            while isinstance(target, ast.Subscript):
+                target = target.value
+            chain = attr_chain(target)
+        if chain is None or not chain.startswith("self."):
+            return
+        attr = chain.split(".")[1]
+        if attr in self.locks:
+            return  # re-binding the lock itself is its own (rare) sin
+        self.sites.append(_Site(
+            attr, target, self.method, self.def_line, frozenset(self.held)
+        ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    self._record_target(elt)
+            else:
+                self._record_target(target)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._record_target(target)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = attr_chain(node.func)
+        if chain is not None and chain.startswith("self."):
+            parts = chain.split(".")
+            if len(parts) >= 3 and parts[-1] in MUTATOR_METHODS:
+                # self.<attr>(...).append-style chains root at the attr
+                self.sites.append(_Site(
+                    parts[1], node, self.method, self.def_line,
+                    frozenset(self.held),
+                ))
+            elif len(parts) == 2:
+                self.calls.append(_CallSite(
+                    parts[1], frozenset(self.held), self.method
+                ))
+        self.generic_visit(node)
+
+    # nested defs inherit the with-stack at their definition site (see
+    # module docstring for the escape caveat)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Run the checked-in mypy config over the strict core subset.
+
+Usage: ``python tools/typecheck.py [--strict-subset] [extra mypy args]``
+
+The container this repo builds in does not ship mypy (and the build
+constraint forbids installing packages), so the runner GATES instead of
+failing: without mypy it prints the subset it would check and exits 0
+with a SKIPPED marker. On a rig with mypy (``pip install mypy`` on a dev
+box), it runs ``mypy --config-file mypy.ini`` and propagates the exit
+code — tests/test_static_analysis.py invokes it and skips on the
+SKIPPED marker, so a mypy-equipped CI automatically tightens the gate.
+"""
+
+from __future__ import annotations
+
+import configparser
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+CONFIG = ROOT / "mypy.ini"
+
+SKIP_MARKER = "TYPECHECK SKIPPED: mypy not installed in this rig"
+
+
+def subset() -> list[str]:
+    parser = configparser.ConfigParser()
+    parser.read(CONFIG)
+    files = parser.get("mypy", "files", fallback="")
+    return [part.strip() for part in files.split(",") if part.strip()]
+
+
+def main(argv: list[str]) -> int:
+    if "--strict-subset" in argv:
+        print("\n".join(subset()))
+        return 0
+    if importlib.util.find_spec("mypy") is None:
+        print(SKIP_MARKER)
+        print("would check: " + ", ".join(subset()))
+        return 0
+    cmd = [
+        sys.executable, "-m", "mypy",
+        "--config-file", str(CONFIG),
+        *[a for a in argv if a != "--strict-subset"],
+    ]
+    return subprocess.call(cmd, cwd=ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
