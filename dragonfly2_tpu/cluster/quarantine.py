@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any, Callable
 
 # One corruption report reaches the threshold by default: a host observed
 # serving corrupt bytes should stop being advertised IMMEDIATELY — the
@@ -46,9 +47,9 @@ class QuarantineBoard:
         threshold: float = DEFAULT_THRESHOLD,
         half_life_s: float = DEFAULT_HALF_LIFE_S,
         release_fraction: float = DEFAULT_RELEASE_FRACTION,
-        clock=time.monotonic,
-        metrics=None,
-    ):
+        clock: Callable[[], float] = time.monotonic,
+        metrics: Any | None = None,
+    ) -> None:
         self.threshold = threshold
         self.half_life_s = half_life_s
         self.release_fraction = release_fraction

@@ -190,11 +190,11 @@ class ScenarioSpec:
 
     # ------------------------------------------------------------- codecs
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
 
     @classmethod
-    def from_dict(cls, data: dict) -> "ScenarioSpec":
+    def from_dict(cls, data: dict[str, Any]) -> "ScenarioSpec":
         spec = cls()
         for key, value in (data or {}).items():
             if not hasattr(spec, key):
@@ -258,7 +258,7 @@ def load_scenario(path: str | pathlib.Path) -> ScenarioSpec:
     return ScenarioSpec.from_dict(json.loads(text))
 
 
-def _parse_toml(text: str) -> dict:
+def _parse_toml(text: str) -> dict[str, Any]:
     try:
         import tomllib  # py311+: the real parser
     except ImportError:
@@ -266,7 +266,7 @@ def _parse_toml(text: str) -> dict:
     return tomllib.loads(text)
 
 
-def _parse_toml_fallback(text: str) -> dict:
+def _parse_toml_fallback(text: str) -> dict[str, Any]:
     """Minimal ``[section] key = value`` parser for interpreters without
     ``tomllib`` (<3.11) — only the flat spec grammar, not general TOML."""
     root: dict[str, Any] = {}

@@ -151,6 +151,32 @@ def resource_leak_guard():
 
 
 @pytest.fixture(scope="session", autouse=True)
+def serving_retrace_tripwire():
+    """dfshape's runtime half (tools/dflint/retracer.py): every compile
+    signature the serving jits route during the whole session must land
+    inside the statically-proven ``_EVAL_BUCKETS`` set — a compile the
+    static shape pass did not predict fails the suite. The donation
+    guards ride along in mark mode: a donated staging buffer passed
+    twice raises UseAfterDonateError at the offending call, and donated
+    buffers are frozen so a later write crashes loudly."""
+    import pathlib
+
+    from tools.dflint import retracer
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    tripwire = retracer.RetraceTripwire(root=root)
+    guards = retracer.install_donation_guards()
+    yield
+    retracer.uninstall_donation_guards(guards)
+    violations = tripwire.violations()
+    if violations:
+        pytest.fail(
+            "retrace tripwire: serving jit compiled outside the "
+            "statically-proven signature set:\n" + "\n".join(violations)
+        )
+
+
+@pytest.fixture(scope="session", autouse=True)
 def ml_refresh_worker_guard():
     """The background embedding-refresh worker (registry/serving.py
     MLEvaluator) is a daemon thread, so the non-daemon sweep above cannot

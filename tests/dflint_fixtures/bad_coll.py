@@ -1,0 +1,31 @@
+"""dflint red fixture: collective-hygiene violations in a meshed body.
+
+CollectivePass: COLL001 x2 (axis not in MESH_AXES; axis inconsistent
+with the enclosing shard_map's partition specs), COLL002 x2 (host syncs
+in a shard_map body: .item() and np.asarray). JitHygienePass over the
+same file: JIT001 x2 + JIT002 — the satellite pin that the jit pass now
+sees inside shard_map-wrapped bodies.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.utils.jaxcompat import shard_map
+
+
+def rogue_axis(x):
+    return jax.lax.psum(x, "rows")  # <- COLL001 (axis not registered)
+
+
+def mesh_body(x):
+    y = jax.lax.ppermute(x, "tp", [(0, 1)])  # <- COLL001 (specs say dp)
+    peak = y.max().item()  # <- JIT001 (host sync in traced body)
+    if x.sum() > 0:  # <- JIT002 (python branch on a shard)
+        y = y + peak
+    return np.asarray(y)  # <- COLL002 (+ JIT001: host materialization)
+
+
+def wrapper(mesh, x):
+    fn = shard_map(mesh_body, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+    return fn(x)

@@ -1,0 +1,35 @@
+"""dflint red fixture: reads of donated staging buffers.
+
+DON001 x3: one read-after-donate in the donating function itself, one
+through the call-graph fixpoint (the helper forwards its parameter into
+the donated position, so the CALLER's later read is the bug), and one
+loop-carried reuse (buffer bound outside the loop, donated inside — the
+second iteration re-donates a dead buffer).
+"""
+
+from dragonfly2_tpu.ops import evaluator as ev
+
+
+def reuse_after_donate(fd, k, c, l, n):
+    buf = ev.pack_eval_batch(fd)
+    out = ev.schedule_from_packed(buf, 64, k, c, l, n)
+    checksum = buf.sum()  # <- DON001 (buf was donated above)
+    return out, checksum
+
+
+def helper_forwards(staging, b, k, c, l, n):
+    return ev.schedule_from_packed(staging, b, k, c, l, n)
+
+
+def caller_via_fixpoint(fd, k, c, l, n):
+    staging = ev.pack_eval_batch(fd)
+    out = helper_forwards(staging, 64, k, c, l, n)
+    return out, staging.mean()  # <- DON001 (helper donates its param)
+
+
+def loop_carried_reuse(fd, k, c, l, n):
+    buf = ev.pack_eval_batch(fd)  # bound outside the loop
+    outs = []
+    for _ in range(5):
+        outs.append(ev.schedule_from_packed(buf, 64, k, c, l, n))  # <- DON001
+    return outs

@@ -1,0 +1,29 @@
+"""dflint red fixture: unbucketed shapes into the serving jits.
+
+SHAPE001 x2 (runtime batch dim; runtime-length slice into a registered
+serving jit), SHAPE002 (runtime value into a static arg). The callee
+leaf ``schedule_from_packed`` matches the SERVING_JIT_REGISTRY entry,
+exactly like a call site in cluster/scheduler.py would.
+"""
+
+import numpy as np
+
+from dragonfly2_tpu.ops import evaluator as ev
+
+
+def unbucketed_batch(work, fd, k, c, l, n):
+    b = len(work)  # runtime-varying
+    buf_a = ev.pack_eval_batch(fd)
+    return ev.schedule_from_packed(buf_a, b, k, c, l, n)  # <- SHAPE001
+
+
+def runtime_slice(work, rows, k, c, l, n):
+    b = len(work)
+    return ev.schedule_from_packed(rows[:b], 64, k, c, l, n)  # <- SHAPE001
+
+
+def runtime_static_kwarg(parents, fd, k, c, l, n):
+    buf_b = ev.pack_eval_batch(fd)
+    return ev.schedule_from_packed(
+        buf_b, 64, k, c, l, n, limit=len(parents)  # <- SHAPE002
+    )

@@ -1,0 +1,32 @@
+"""dflint green fixture: disciplined meshed code. All silent.
+
+Registered axes bound via functools.partial / parameter default, a
+``psum(1, axis)`` axis-size idiom (static, branchable), and collectives
+consistent with the wrapper's partition specs — the parallel/ idioms.
+"""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from dragonfly2_tpu.utils.jaxcompat import shard_map
+
+
+def tp_body(x, w, axis_name: str = TP_AXIS):
+    n = jax.lax.psum(1, axis_name)  # axis size: static under trace
+    partial_out = x @ w
+    if n > 1:  # branching on the static axis size is legal
+        partial_out = partial_out / n
+    return jax.lax.psum(partial_out, axis_name)
+
+
+def wrapper(mesh, x, w):
+    fn = shard_map(
+        functools.partial(tp_body, axis_name=TP_AXIS),
+        mesh=mesh,
+        in_specs=(P(DP_AXIS), P(None, TP_AXIS)),
+        out_specs=P(DP_AXIS),
+    )
+    return fn(x, w)

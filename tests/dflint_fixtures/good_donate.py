@@ -1,0 +1,41 @@
+"""dflint green fixture: legal donation idioms. All silent.
+
+Fresh buffer per donating call, the trainer's rebind idiom (donated
+args immediately rebound from the return), and mutually-exclusive
+if/else branches each donating the same staging buffer once.
+"""
+
+import functools
+
+import jax
+
+from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS
+from dragonfly2_tpu.ops import evaluator as ev
+
+
+def fresh_buffer_per_call(fd, k, c, l, n):
+    outs = []
+    for bsz in _EVAL_BUCKETS:
+        buf = ev.pack_eval_batch(fd)  # fresh per donation
+        outs.append(ev.schedule_from_packed(buf, bsz, k, c, l, n))
+    return outs
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def run_epoch(params, opt_state, batches):
+    return params, opt_state, batches
+
+
+def rebind_epoch(params, opt_state, batches):
+    # donated args rebound by the same statement: donation is killed
+    params, opt_state, losses = run_epoch(params, opt_state, batches)
+    return params, opt_state, losses
+
+
+def branch_exclusive(fd, use_ml, mle, k, c, l, n):
+    buf = ev.pack_eval_batch(fd)
+    if use_ml:
+        out = mle.schedule_from_packed(buf, 64, k, c, l, n)
+    else:
+        out = ev.schedule_from_packed(buf, 64, k, c, l, n)
+    return out

@@ -1,0 +1,32 @@
+"""dflint green fixture: the bucketed idioms the shape pass must prove.
+
+Batch dims arrive through the bucket machinery (``_EVAL_BUCKETS``
+iteration, ``_bucket_rows``), arrays through the padding helpers
+(``_pad_rows``), statics from config attributes — the exact shapes of
+warmup() and _dispatch_chunk in cluster/scheduler.py. All silent.
+"""
+
+import numpy as np
+
+from dragonfly2_tpu.cluster.scheduler import (
+    _EVAL_BUCKETS,
+    _bucket_rows,
+    _pad_rows,
+)
+from dragonfly2_tpu.ops import evaluator as ev
+
+
+def warm_all_buckets(fd, k, c, l, n, config):
+    limit = config.scheduler.candidate_parent_limit  # config: fixed
+    for bsz in _EVAL_BUCKETS:  # bucket-set iteration
+        buf = ev.pack_eval_batch(fd)
+        out = ev.schedule_from_packed(buf, bsz, k, c, l, n, limit=limit)
+        np.asarray(out)
+
+
+def dispatch_chunk(fd, s, e, k, c, l, n):
+    bsz = _bucket_rows(e - s)  # bucket producer
+    buf = ev.pack_eval_batch(
+        {name: _pad_rows(v[s:e], bsz) for name, v in fd.items()}
+    )
+    return ev.schedule_from_packed(buf, bsz, k, c, l, n)

@@ -160,7 +160,7 @@ def test_nan_poisoned_version_never_becomes_serving_snapshot(tmp_path):
         buf, dims = _packed_buf()
         gate_runs = evaluator.gate_runs
         for _ in range(5):
-            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            out = np.asarray(evaluator.schedule_from_packed(buf.copy(), *dims))
             assert out.shape[-1] == 2
             assert np.all(np.isfinite(out))
         assert evaluator.gate_runs == gate_runs
@@ -219,7 +219,7 @@ def test_gate_with_no_last_good_stays_on_rule_fallback(tmp_path):
         assert evaluator.rejection_count == 1
         assert evaluator.serving_snapshot() is None
         buf, dims = _packed_buf()
-        out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+        out = np.asarray(evaluator.schedule_from_packed(buf.copy(), *dims))
         assert out.shape[-1] == 2 and np.all(np.isfinite(out))
         assert evaluator.last_used_versions is None  # rule blend served
     finally:

@@ -258,8 +258,22 @@ def test_schedule_from_packed_matches_dict_transport(batch):
     """The single-buffer transport selects the SAME parents as the dict
     transport (scores may differ by float-fusion ulps, never ordering):
     the serving tick's one-H2D contract cannot drift from the oracle-
-    tested dict path."""
+    tested dict path. The batch is padded to the smallest _EVAL_BUCKETS
+    shape (pad rows valid=False) because the instrumented packed jit is
+    under the session retrace tripwire: every signature it routes —
+    tests included — must come from the proven bucket set."""
+    from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS
+
     fd = batch.as_dict()
+    rows = fd["valid"].shape[0]
+    bucket = _EVAL_BUCKETS[0]
+    assert rows <= bucket
+    fd = {
+        name: np.concatenate(
+            [v, np.zeros((bucket - rows,) + v.shape[1:], v.dtype)]
+        )
+        for name, v in fd.items()
+    }
     b, k = fd["valid"].shape
     c, l, n = (
         fd["piece_costs"].shape[-1],

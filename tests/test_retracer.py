@@ -1,0 +1,153 @@
+"""Runtime half of dfshape (tools/dflint/retracer.py): the retrace
+tripwire that fails tier-1 on any serving-jit compile outside the
+statically-proven bucket set, and the donation guard that makes
+use-after-donate of host staging buffers crash loudly.
+
+The static/runtime agreement test is the acceptance pin: the SAME
+deliberate unbucketed call that the static shape pass flags in the
+fixture file trips the runtime tripwire when executed."""
+
+import functools
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tools.dflint import retracer
+from tools.dflint.core import run_dflint
+from tools.dflint.passes.shape import ShapeDonationPass
+
+ROOT = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "dflint_fixtures"
+
+
+def _toy_wrapper(name: str):
+    """A jitted toy with the serving calling convention (buf, b) wrapped
+    in the flight recorder, so the tripwire sees it like a serving jit
+    — the REAL serving wrappers stay clean for the session tripwire."""
+    from dragonfly2_tpu.telemetry.flight import instrument_jit
+
+    @functools.partial(jax.jit, static_argnames=("b",))
+    def toy(buf, b):
+        return jnp.reshape(buf.astype(jnp.float32), (b, -1)).sum(axis=1)
+
+    return instrument_jit(toy, name, service="scheduler")
+
+
+def test_derived_buckets_match_scheduler_constant():
+    """The AST-derived bucket set IS the scheduler's _EVAL_BUCKETS: one
+    source of truth for the static pass, the tripwire and the tests."""
+    from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS
+
+    assert retracer.load_eval_buckets(ROOT) == _EVAL_BUCKETS
+    derived = retracer.derive_static_signature_sets(ROOT)
+    assert set(derived) == set(retracer.SERVING_B_ARGS)
+    for allowed in derived.values():
+        assert allowed == frozenset(_EVAL_BUCKETS)
+
+
+def test_unbucketed_call_trips_static_pass_and_runtime_tripwire():
+    """Acceptance pin: a deliberate unbucketed call trips BOTH halves.
+    Statically, the bad_shape fixture's runtime-b call site is a
+    SHAPE001 finding; dynamically, executing the same mistake compiles a
+    signature the tripwire rejects against the same proven set."""
+    # static half: the fixture call site is flagged
+    report, _ = run_dflint(ROOT, files=[FIXTURES / "bad_shape.py"],
+                           passes=[ShapeDonationPass()])
+    assert any(f.rule == "SHAPE001" for f in report.findings)
+
+    # runtime half: same mistake, executed
+    name = "retracer.toy_unbucketed"
+    wrapper = _toy_wrapper(name)
+    buckets = frozenset(retracer.load_eval_buckets(ROOT))
+    tripwire = retracer.RetraceTripwire(
+        root=ROOT,
+        allowed={f"scheduler.{name}": buckets},
+        b_args={f"scheduler.{name}": 1},
+    )
+    tripwire.arm()
+    np.asarray(wrapper(np.zeros(64 * 4, np.uint8), 64))  # bucketed: fine
+    assert tripwire.violations() == []
+    b = 100  # the "len(work)" mistake: a runtime batch dim
+    np.asarray(wrapper(np.zeros(b * 4, np.uint8), b))
+    assert tripwire.new_signatures() == {f"scheduler.{name}": 2}
+    violations = tripwire.violations()
+    assert len(violations) == 1 and "100" in violations[0], violations
+
+
+def test_tripwire_reports_unreadable_call_convention():
+    name = "retracer.toy_convention"
+    wrapper = _toy_wrapper(name)
+    np.asarray(wrapper(np.zeros(64, np.uint8), 16))
+    tripwire = retracer.RetraceTripwire(
+        root=ROOT,
+        allowed={f"scheduler.{name}": frozenset({16})},
+        b_args={f"scheduler.{name}": 7},  # no arg 7: must fail LOUDLY
+    )
+    violations = tripwire.violations()
+    assert len(violations) == 1 and "no readable batch dim" in violations[0]
+
+
+def test_donation_guard_mark_mode_reuse_and_write_crash():
+    """mark mode (the tier-1 default): a donated buffer passed twice
+    raises at the second call; a write to a donated buffer raises; a
+    fresh buffer per call stays silent."""
+    calls = []
+
+    def fake_jit(buf, b):
+        calls.append(b)
+        return np.zeros(2, np.float32)
+
+    guard = retracer.DonationGuard(fake_jit, (0,), "test.guard")
+    buf = np.zeros(16, np.uint8)
+    guard(buf, 64)
+    with pytest.raises(ValueError):
+        buf[0] = 1  # frozen: a post-donation write crashes loudly
+    with pytest.raises(retracer.UseAfterDonateError):
+        guard(buf, 64)
+    guard(np.zeros(16, np.uint8), 64)  # fresh buffer: fine
+    assert calls == [64, 64]
+    assert guard.donations == 2 and guard.reuse_trips == 1
+
+
+def test_donation_guard_poison_mode_makes_stale_reads_loud():
+    """poison mode: after the (blocked) call, the donated host buffer is
+    filled with the canary byte — a use-after-donate read sees 0xDB
+    garbage instead of plausible stale data. The result itself is
+    computed BEFORE poisoning (block_until_ready gate), so the guard can
+    never corrupt the in-flight computation even under zero-copy H2D."""
+    @jax.jit
+    def summer(buf):
+        return buf.astype(jnp.int32).sum()
+
+    guard = retracer.DonationGuard(summer, (0,), "test.poison", poison=True)
+    buf = np.full(32, 7, np.uint8)
+    out = int(guard(buf))
+    assert out == 7 * 32  # computed from pre-poison bytes
+    assert np.all(buf == retracer.POISON_BYTE)
+
+
+def test_real_serving_jits_are_guarded_this_session():
+    """conftest installs the guards session-wide: the module attributes
+    the scheduler calls through ARE DonationGuard wrappers, and attribute
+    forwarding keeps the flight-recorder surface intact."""
+    from dragonfly2_tpu.ops import evaluator as ev
+    from dragonfly2_tpu.registry import serving
+
+    assert isinstance(ev.schedule_from_packed, retracer.DonationGuard)
+    assert isinstance(serving._ml_schedule_from_packed, retracer.DonationGuard)
+    assert ev.schedule_from_packed.donate_argnums == (0,)
+    # forwarded JitWrapper surface (stats used by the tripwire + tests)
+    assert "signatures" in ev.schedule_from_packed.stats()
+
+
+def test_guard_install_is_idempotent_and_reversible():
+    from dragonfly2_tpu.ops import evaluator as ev
+
+    before = ev.schedule_from_packed
+    again = retracer.install_donation_guards()
+    assert again == []  # already guarded: left alone
+    assert ev.schedule_from_packed is before

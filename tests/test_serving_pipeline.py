@@ -122,6 +122,53 @@ def test_tick_compile_shapes_stable_across_buckets():
         "tick chunking reached a (B, K) shape warmup never compiled"
     )
 
+    # dfshape acceptance: the STATICALLY-derived signature set (retracer
+    # parses _EVAL_BUCKETS out of scheduler.py by AST) exactly matches
+    # the runtime-observed compile set of the serving jit — warmup plus
+    # ticks across every bucket regime compiled all proven buckets and
+    # nothing else
+    from pathlib import Path
+
+    from tools.dflint import retracer
+
+    root = Path(__file__).resolve().parents[1]
+    name = "scheduler.evaluator.schedule_from_packed"
+    derived = retracer.derive_static_signature_sets(root)[name]
+    observed = retracer.observed_batch_buckets(
+        wrapper, retracer.SERVING_B_ARGS[name]
+    )
+    assert observed == set(derived), (observed, derived)
+
+
+def test_ml_serving_jit_signature_set_matches_static(tmp_path):
+    """The ml packed entry honors the same proven bucket set: warming
+    every bucket through MLEvaluator.schedule_from_packed lands exactly
+    _EVAL_BUCKETS as the wrapper's observed batch dims."""
+    from pathlib import Path
+
+    from tools.dflint import retracer
+
+    reg, server, evaluator, graph, params = _served_evaluator(tmp_path)
+    try:
+        evaluator.refresh_embeddings(dict(graph), wait=True)
+        assert evaluator.serving_snapshot() is not None
+        for bsz in _EVAL_BUCKETS:
+            buf, dims = _packed_buf(b=bsz)
+            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            assert out.shape == (bsz, out.shape[1], 2)
+    finally:
+        evaluator.close()
+    root = Path(__file__).resolve().parents[1]
+    name = "scheduler.ml.schedule_from_packed"
+    wrapper = jit_wrappers()[name]
+    derived = retracer.derive_static_signature_sets(root)[name]
+    observed = retracer.observed_batch_buckets(
+        wrapper, retracer.SERVING_B_ARGS[name]
+    )
+    # every proven bucket observed (this test warmed all three), and
+    # nothing outside the proven set (the session tripwire's invariant)
+    assert observed == set(derived), (observed, derived)
+
 
 def test_pipelined_tick_overlaps_dispatch_and_apply():
     """A multi-chunk tick records the split phases AND nonzero overlap:
@@ -412,7 +459,10 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
     refresh_bound = max(min(t_full), 0.15)
 
     buf, dims = _packed_buf(n_hosts=n_nodes)
-    np.asarray(evaluator.schedule_from_packed(buf, *dims))  # warm the ml jit
+    # .copy(): the donation guard (tools/dflint/retracer.py) enforces the
+    # one-shot contract on donated staging buffers session-wide — every
+    # call gets its own buffer, exactly like the tick packs fresh
+    np.asarray(evaluator.schedule_from_packed(buf.copy(), *dims))  # warm the ml jit
     # blocking accumulated so far is the DELIBERATE synchronous phase
     # (incl. the embed jit compile); the hammer below must add ~nothing
     blocking_before_hammer = evaluator.refresh_blocking_s
@@ -444,7 +494,7 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
                 reg.activate(mv.model_id, mv.version)
                 assert server.refresh()
             t0 = time.perf_counter()
-            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            out = np.asarray(evaluator.schedule_from_packed(buf.copy(), *dims))
             tick_s.append(time.perf_counter() - t0)
             assert out.shape[-1] == 2
             used_pairs.append(evaluator.last_used_versions)
@@ -464,7 +514,7 @@ def test_refresh_serve_race_consistent_versions_and_bounded_ticks(tmp_path):
             evaluator.refresh_embeddings(g)  # async nudge
             time.sleep(0.05)
             t0 = time.perf_counter()
-            out = np.asarray(evaluator.schedule_from_packed(buf, *dims))
+            out = np.asarray(evaluator.schedule_from_packed(buf.copy(), *dims))
             tick_s.append(time.perf_counter() - t0)
             used_pairs.append(evaluator.last_used_versions)
     finally:

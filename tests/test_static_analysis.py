@@ -16,10 +16,12 @@ from pathlib import Path
 import pytest
 
 from tools.dflint.core import run_dflint
+from tools.dflint.passes.collective import CollectivePass
 from tools.dflint.passes.determinism import DeterminismPass
 from tools.dflint.passes.flush_valve import FlushValvePass
 from tools.dflint.passes.jit_hygiene import JitHygienePass
 from tools.dflint.passes.lock_discipline import LockDisciplinePass
+from tools.dflint.passes.shape import ShapeDonationPass
 
 ROOT = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).parent / "dflint_fixtures"
@@ -50,6 +52,8 @@ def test_dflint_package_gate_zero_unwaived_findings():
     )
     # every waiver must argue its case: a reason-less waiver is a muzzle
     assert report.reasonless_waivers(contexts) == []
+    # and stay live: a waiver whose rule no longer fires must be deleted
+    assert report.stale_waivers(contexts) == []
     # waivers exist and carry substantive reasons (not one-word shrugs)
     for finding in report.waived():
         assert len(finding.waive_reason) >= 20, (
@@ -141,6 +145,113 @@ def test_determinism_fixtures():
     assert not any("good_det" in f.path for f in report.findings), [
         f.render() for f in report.findings if "good_det" in f.path
     ]
+
+
+def test_shape_donation_fixtures():
+    report, _ = _lint(
+        [ShapeDonationPass()],
+        "bad_shape.py", "good_shape.py", "bad_donate.py", "good_donate.py",
+    )
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"SHAPE001": 2, "SHAPE002": 1, "DON001": 3}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    assert not any("good_" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_" in f.path
+    ]
+    assert sorted(
+        f.finding_id for f in report.findings if f.rule == "DON001"
+    ) == [
+        "DON001@tests/dflint_fixtures/bad_donate.py:caller_via_fixpoint",
+        "DON001@tests/dflint_fixtures/bad_donate.py:loop_carried_reuse",
+        "DON001@tests/dflint_fixtures/bad_donate.py:reuse_after_donate",
+    ]
+
+
+def test_collective_fixtures():
+    report, _ = _lint([CollectivePass()], "bad_coll.py", "good_coll.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"COLL001": 2, "COLL002": 2}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    assert not any("good_coll" in f.path for f in report.findings)
+    # satellite pin: the jit-hygiene pass sees inside shard_map bodies
+    report2, _ = _lint([JitHygienePass()], "bad_coll.py", "good_coll.py")
+    by_rule2 = {rule: len(fs) for rule, fs in report2.by_rule().items()}
+    assert by_rule2 == {"JIT001": 2, "JIT002": 1}, (
+        by_rule2, [f.render() for f in report2.findings]
+    )
+    assert not any("good_coll" in f.path for f in report2.findings)
+
+
+def test_waiver_audit_flags_stale_waivers(tmp_path):
+    """A waiver whose rule still fires is live; one aimed at a silent
+    line is stale — the audit (and only the audit) fails on it."""
+    src = tmp_path / "mixed.py"
+    src.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self.x = 0\n"
+        "        self.y = 0\n"
+        "    def a(self):\n"
+        "        with self._mu:\n"
+        "            self.x += 1\n"
+        "            self.y += 1\n"
+        "    def live(self):\n"
+        "        self.x += 1  # dflint: waive[LOCK001] -- single writer thread by design\n"
+        "    def stale(self):\n"
+        "        with self._mu:\n"
+        "            self.y += 1  # dflint: waive[LOCK001] -- guarded; rule does not fire\n"
+    )
+    from tools.dflint.passes.lock_discipline import LockDisciplinePass
+
+    report, contexts = run_dflint(ROOT, files=[src],
+                                  passes=[LockDisciplinePass()])
+    assert report.unwaived() == []
+    stale = report.stale_waivers(contexts)
+    assert len(stale) == 1 and "waive[LOCK001] is stale" in stale[0], stale
+    assert str(src) in stale[0]
+
+
+def test_cli_json_output_and_audit_exit_codes(tmp_path, capsys):
+    """--json emits the machine-readable document with stable finding
+    ids; --audit-waivers turns stale waivers into exit 1."""
+    import json as jsonlib
+
+    from tools.dflint.__main__ import main
+
+    rc = main([
+        "--root", str(ROOT), "--json",
+        "tests/dflint_fixtures/bad_lock.py",
+    ])
+    doc = jsonlib.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["ok"] is False
+    assert doc["findings"][0]["id"] == (
+        "LOCK001@tests/dflint_fixtures/bad_lock.py:Board.racy_bump"
+    )
+    assert doc["stale_waivers"] == [] and doc["reasonless_waivers"] == []
+
+    stale_file = tmp_path / "stale.py"
+    stale_file.write_text(
+        "X = 1  # dflint: waive[LOCK001] -- nothing fires here anymore\n"
+    )
+    rc = main(["--root", str(ROOT), "--audit-waivers", str(stale_file)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "STALE WAIVER" in out
+    # without the audit flag the same tree is clean (stale != unwaived)
+    rc = main(["--root", str(ROOT), str(stale_file)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_lint_all_entry_point_is_green():
+    """Satellite: the single gate CI and tier-1 share — dflint with the
+    waiver audit plus the typecheck runner — passes on this tree."""
+    from tools.lint_all import main
+
+    assert main([]) == 0
 
 
 def test_fixture_findings_carry_stable_ids_and_locations():
@@ -342,6 +453,8 @@ def test_typecheck_runner_gates_or_passes():
     assert subset() == [
         "dragonfly2_tpu/state", "dragonfly2_tpu/graph", "dragonfly2_tpu/ops",
         "dragonfly2_tpu/telemetry/flight.py",
+        "dragonfly2_tpu/cluster/quarantine.py",
+        "dragonfly2_tpu/scenarios/spec.py",
     ]
     proc = subprocess.run(
         [sys.executable, "tools/typecheck.py"],

@@ -18,6 +18,21 @@ must run clean over the package (tests/test_static_analysis.py, tier-1):
   serving hot path, dynamic shapes entering a jit call.
 - ``DET001..DET003`` determinism: unseeded rng, wall-clock reads, and
   set-iteration order dependence in simulator/scenario decision paths.
+- ``SHAPE001/SHAPE002`` dfshape: the serving jits' compiled-signature
+  set is closed over the ``_EVAL_BUCKETS`` lattice — no runtime-
+  dependent batch dims, slices, or static-arg values at any call site.
+- ``DON001`` donation flow: ``donate_argnums`` staging buffers are
+  one-shot; no read after the donating call, fixpoint over forwarding
+  layers.
+- ``COLL001/COLL002`` collective hygiene: collective axis names must be
+  registered in ``MESH_AXES`` and consistent with the enclosing
+  ``shard_map`` specs; host syncs in meshed bodies ride the justified
+  ``D2H_ALLOWLIST``.
+
+The runtime backstops live next to the passes: ``lockorder.py`` (the
+``-race`` analog for the lock contracts) and ``retracer.py`` (the
+retrace tripwire + donation guard for the shape/donation contracts,
+installed session-wide by tests/conftest.py).
 
 Findings are suppressible ONLY via inline justified waivers::
 

@@ -1,15 +1,27 @@
 """CLI: ``python -m tools.dflint [package-or-paths...]``.
 
 Exit codes: 0 clean (waived findings allowed, but every waiver must
-carry a reason), 1 unwaived findings or reason-less waivers, 2 usage.
+carry a reason), 1 unwaived findings or reason-less waivers (or, with
+``--audit-waivers``, stale waivers), 2 usage.
 
 ``--list-waived`` prints the waived findings too — the audit view the
 review wants when judging whether a waiver's argument still holds.
+
+``--audit-waivers`` additionally fails on STALE waivers: a
+``waive[RULE]`` comment whose rule no longer fires at that site. The
+tier-1 static-analysis gate runs with this on, so an argued waiver is
+deleted the moment its argument stops being needed instead of rotting
+into a muzzle for the next unrelated finding.
+
+``--json`` emits one machine-readable document (findings with stable
+``rule@file:symbol`` ids, stale/reason-less waiver lists, scan stats)
+for CI annotators; the human rendering is suppressed.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -25,6 +37,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--root", default=".", help="repo root")
     parser.add_argument("--list-waived", action="store_true",
                         help="also print waived findings with their reasons")
+    parser.add_argument("--audit-waivers", action="store_true",
+                        help="fail on waivers whose rule no longer fires")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings on stdout")
     args = parser.parse_args(argv)
 
     root = Path(args.root).resolve()
@@ -43,11 +59,34 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
         files = explicit
     report, contexts = run_dflint(root, package=package, files=files)
-    print(report.render(include_waived=args.list_waived))
     reasonless = report.reasonless_waivers(contexts)
+    # the stale list is always computed (nearly free once contexts are
+    # parsed) so --json consumers can't mistake 'not audited' for
+    # 'audited and clean'; --audit-waivers gates only the VERDICT
+    stale = report.stale_waivers(contexts)
+    failed = bool(
+        report.unwaived() or reasonless or (stale and args.audit_waivers)
+    )
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in report.findings],
+            "reasonless_waivers": reasonless,
+            "stale_waivers": stale,
+            "waivers_audited": args.audit_waivers,
+            "files_scanned": report.files_scanned,
+            "duration_s": round(report.duration_s, 3),
+            "ok": not failed,
+        }, indent=2))
+        return 1 if failed else 0
+
+    print(report.render(include_waived=args.list_waived))
     for row in reasonless:
         print(f"REASONLESS WAIVER: {row}")
-    return 1 if (report.unwaived() or reasonless) else 0
+    if args.audit_waivers:
+        for row in stale:
+            print(f"STALE WAIVER: {row}")
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -45,6 +45,9 @@ class Finding:
     message: str
     waived: bool = False
     waive_reason: str = ""
+    # line of the waiver comment this finding matched (0 = none): the
+    # waiver audit uses it to tell live waivers from stale ones
+    waive_line: int = 0
 
     @property
     def finding_id(self) -> str:
@@ -53,6 +56,20 @@ class Finding:
     @property
     def location(self) -> str:
         return f"{self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        """Machine-readable row (--json): the stable id plus everything
+        a CI annotator needs to place and explain the finding."""
+        return {
+            "id": self.finding_id,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "waived": self.waived,
+            "waive_reason": self.waive_reason,
+        }
 
     def render(self) -> str:
         tag = f" [waived: {self.waive_reason}]" if self.waived else ""
@@ -83,13 +100,15 @@ class FileContext:
             if m:
                 self.under[i] = m.group(1)
 
-    def waiver_at(self, rule: str, *lines: int) -> tuple[str, str] | None:
-        """(reason, 'line') for the first waiver of `rule` at any of the
-        candidate lines (the flagged line, the line above, the def line)."""
+    def waiver_at(self, rule: str, *lines: int) -> tuple[str, int] | None:
+        """(reason, waiver line) for the first waiver of `rule` at any of
+        the candidate lines (the flagged line, the line above, the def
+        line). The line rides as an int — the stale-waiver audit keys on
+        it, so it must never round-trip through display text."""
         for line in lines:
             for wrule, reason in self.waivers.get(line, ()):
                 if wrule == rule:
-                    return reason, f"line {line}"
+                    return reason, line
         return None
 
     def under_lock(self, func: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
@@ -113,9 +132,10 @@ class FileContext:
             candidates.append(def_line)
         waiver = self.waiver_at(rule, *candidates)
         if waiver is not None:
-            reason, _ = waiver
+            reason, waive_line = waiver
             return Finding(rule, self.rel, line, symbol, message,
-                           waived=bool(reason), waive_reason=reason)
+                           waived=bool(reason), waive_reason=reason,
+                           waive_line=waive_line)
         return Finding(rule, self.rel, line, symbol, message)
 
 
@@ -147,6 +167,28 @@ class LintReport:
                     if not reason:
                         bad.append(f"{ctx.rel}:{line}: waive[{rule}] has no reason")
         return bad
+
+    def stale_waivers(self, contexts: list[FileContext]) -> list[str]:
+        """Waiver comments whose rule no longer fires at their site — a
+        stale waiver is a muzzle aimed at nothing, waiting to silently
+        swallow the NEXT finding that lands on its line. The audit mode
+        (`--audit-waivers`) and the tier-1 gate both fail on these, so
+        an argued waiver dies when its argument stops being needed."""
+        claimed = {
+            (f.path, f.waive_line, f.rule)
+            for f in self.findings if f.waive_line
+        }
+        stale = []
+        for ctx in contexts:
+            for line, entries in sorted(ctx.waivers.items()):
+                for rule, _reason in entries:
+                    if (ctx.rel, line, rule) not in claimed:
+                        stale.append(
+                            f"{ctx.rel}:{line}: waive[{rule}] is stale — "
+                            f"the rule no longer fires here; delete the "
+                            f"waiver"
+                        )
+        return stale
 
     def render(self, include_waived: bool = False) -> str:
         rows = [
@@ -221,16 +263,20 @@ def parse_contexts(root: Path, files: list[Path]) -> list[FileContext]:
 
 
 def default_passes():
+    from tools.dflint.passes.collective import CollectivePass
     from tools.dflint.passes.determinism import DeterminismPass
     from tools.dflint.passes.flush_valve import FlushValvePass
     from tools.dflint.passes.jit_hygiene import JitHygienePass
     from tools.dflint.passes.lock_discipline import LockDisciplinePass
+    from tools.dflint.passes.shape import ShapeDonationPass
 
     return [
         LockDisciplinePass(),
         FlushValvePass(),
         JitHygienePass(),
         DeterminismPass(),
+        ShapeDonationPass(),
+        CollectivePass(),
     ]
 
 

@@ -1,0 +1,427 @@
+"""COLL001/COLL002 — collective hygiene for meshed jits.
+
+ROADMAP items 1 and 2 (pod-scale sharded serving, fused on-device tick)
+will put ``psum``/``all_gather``/``ppermute`` collectives and
+``shard_map`` bodies on the serving path. Two disciplines must hold
+BEFORE that code lands, so it lands gated:
+
+- ``COLL001`` — axis-name hygiene. Every collective's axis name must be
+  declared in the ``MESH_AXES`` registry below (one source of truth,
+  mirroring ``parallel/mesh.py``'s axis constants), and inside a
+  ``shard_map`` body whose partition specs name a resolvable axis set,
+  every collective must use axes from that set — a collective over an
+  axis its own in/out specs never partition is either dead communication
+  or a partition bug (the 2103.10515 communication model only prices
+  declared axes).
+- ``COLL002`` — D2H discipline inside meshed bodies. A host sync
+  (``np.asarray`` / ``.item()`` / ``block_until_ready`` ...) inside a
+  ``shard_map`` body re-serializes EVERY device in the mesh, not just
+  one chip's dispatch queue; it rides the same justified
+  ``D2H_ALLOWLIST`` as the jit-hygiene pass (argue it on, or waive
+  inline with a reason).
+
+Axis names resolve statically from, in order: string literals, the
+known ``parallel/mesh.py`` axis constants (``DP_AXIS`` ...), same-file
+module/function-level constant assignments, ``functools.partial``
+bindings on the shard_map'd callable, and parameter defaults.
+Unresolvable axes (a bare forwarded parameter) stay silent — the wrapper
+that BINDS the axis is where the check lands, which every wrapper in
+``parallel/`` does via partial or default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+from tools.dflint.passes.jit_hygiene import (
+    D2H_ALLOWLIST,
+    NUMPY_ROOTS,
+    SYNC_ATTR_CALLS,
+    SYNC_CALL_LEAVES,
+)
+
+# THE mesh-axis registry: every collective axis in the tree must be one
+# of these (parallel/mesh.py axis constants; keep the two in sync — the
+# fixture tests pin that an unregistered axis trips COLL001).
+MESH_AXES: dict[str, str] = {
+    "dp": "data parallelism — batch sharded, grads all-reduced over ICI",
+    "graph": "graph parallelism — edge shards psum-combined (train.py)",
+    "sp": "sequence/context parallelism (ring/ulysses attention)",
+    "tp": "tensor parallelism — hidden dim sharded (parallel/tensor.py)",
+    "pp": "pipeline parallelism — stage hops over ppermute",
+    "ep": "expert parallelism — token/expert all_to_all (parallel/moe.py)",
+}
+
+# mirror of parallel/mesh.py's exported constants, so importing files
+# resolve Name references without a cross-file import graph
+KNOWN_AXIS_CONSTANTS: dict[str, str] = {
+    "DP_AXIS": "dp", "GRAPH_AXIS": "graph", "SP_AXIS": "sp",
+    "TP_AXIS": "tp", "PP_AXIS": "pp", "EP_AXIS": "ep",
+}
+
+# collective leaf -> positional index of the axis-name argument
+COLLECTIVE_AXIS_ARG: dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "ppermute": 1, "pshuffle": 1, "all_to_all": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+
+class CollectivePass:
+    name = "collective-hygiene"
+    rules = ("COLL001", "COLL002")
+
+    def __init__(
+        self,
+        mesh_axes: dict[str, str] | None = None,
+        allowlist: dict[tuple[str, str, str], str] | None = None,
+    ):
+        self.mesh_axes = MESH_AXES if mesh_axes is None else mesh_axes
+        self.allowlist = D2H_ALLOWLIST if allowlist is None else allowlist
+
+    # ------------------------------------------------------------- run
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        resolver = _AxisResolver(ctx.tree)
+        wrapped = collect_shard_map_bodies(ctx.tree)
+        spec_axes = {id(func): axes for func, _, axes in wrapped}
+        bindings = {id(func): b for func, b, _ in wrapped}
+        body_ids = set(spec_axes)
+        # 1) registry check on every collective in the file
+        for func, symbol, ancestors in _functions_with_symbols(ctx.tree):
+            # a nested closure resolves through its enclosing functions'
+            # params/partial-bindings too (ring/ulysses body closures)
+            scope_chain = [func, *ancestors]
+            for node in _walk_own(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf, axis_node = _collective_axis(node)
+                if leaf is None:
+                    continue
+                axes = None
+                for scope in scope_chain:
+                    axes = resolver.resolve(
+                        axis_node, scope, bindings.get(id(scope), {})
+                    )
+                    if axes is not None:
+                        break
+                if axes is None:
+                    continue  # forwarded param without a binding: silent
+                for axis in axes:
+                    if axis not in self.mesh_axes:
+                        findings.append(ctx.make_finding(
+                            "COLL001", node,
+                            (
+                                f"collective '{leaf}' over axis "
+                                f"'{axis}' not declared in MESH_AXES — "
+                                f"register the mesh axis (tools/dflint/"
+                                f"passes/collective.py) or fix the name"
+                            ),
+                            symbol=symbol, def_line=func.lineno,
+                        ))
+                    elif _spec_violation(
+                        axis, scope_chain, body_ids, spec_axes
+                    ):
+                        findings.append(ctx.make_finding(
+                            "COLL001", node,
+                            (
+                                f"collective '{leaf}' over axis "
+                                f"'{axis}' inconsistent with the "
+                                f"enclosing shard_map's partition specs "
+                                f"({sorted(_declared_axes(scope_chain, body_ids, spec_axes))}) "
+                                f"— the body communicates over an axis "
+                                f"its specs never partition"
+                            ),
+                            symbol=symbol, def_line=func.lineno,
+                        ))
+        # 2) D2H discipline inside shard_map bodies
+        for func, _bindings, _axes in wrapped:
+            findings.extend(self._check_body_syncs(ctx, func))
+        return findings
+
+    def _check_body_syncs(self, ctx, func) -> list[Finding]:
+        findings = []
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf, root = _leaf_root(node)
+            is_sync = (
+                (leaf in SYNC_CALL_LEAVES and root in NUMPY_ROOTS | {"jax"})
+                or (leaf in SYNC_ATTR_CALLS
+                    and isinstance(node.func, ast.Attribute))
+            )
+            if not is_sync:
+                continue
+            key = None
+            for (suffix, fname, sleaf), _reason in self.allowlist.items():
+                if ctx.rel.endswith(suffix) and fname == func.name \
+                        and sleaf == leaf:
+                    key = (suffix, fname, sleaf)
+                    break
+            if key is not None:
+                continue
+            findings.append(ctx.make_finding(
+                "COLL002", node,
+                (
+                    f"host sync '{leaf}' inside shard_map body "
+                    f"'{func.name}' stalls every device in the mesh — "
+                    f"argue it onto D2H_ALLOWLIST "
+                    f"(tools/dflint/passes/jit_hygiene.py) or waive "
+                    f"inline"
+                ),
+                symbol=func.name, def_line=func.lineno,
+            ))
+        return findings
+
+
+# -------------------------------------------------- shard_map detection
+
+
+def collect_shard_map_bodies(tree) -> list[tuple[ast.AST, dict, set[str]]]:
+    """(funcdef, partial kwarg bindings, axes named by in/out specs) for
+    every function the file wraps in ``shard_map``. Shared with the
+    jit-hygiene pass, which applies its tracer checks to these bodies."""
+    by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+    resolver = _AxisResolver(tree)
+    out = []
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if chain is None or chain.rsplit(".", 1)[-1] != "shard_map":
+            continue
+        if not node.args:
+            continue
+        target, bindings = _unwrap_partial(node.args[0])
+        if not isinstance(target, ast.Name):
+            continue
+        func = by_name.get(target.id)
+        if func is None or id(func) in seen:
+            continue
+        seen.add(id(func))
+        axes: set[str] = set()
+        for kw in node.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                axes |= _spec_axes(kw.value, resolver)
+        for pos_arg in node.args[2:4]:  # positional in_specs/out_specs
+            axes |= _spec_axes(pos_arg, resolver)
+        out.append((func, bindings, axes))
+    return out
+
+
+def _unwrap_partial(node: ast.AST) -> tuple[ast.AST, dict]:
+    """``partial(f, x=1)`` -> (Name f, {'x': <node 1>}); plain names pass
+    through with no bindings."""
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain in ("functools.partial", "partial") and node.args:
+            bindings = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            return node.args[0], bindings
+    return node, {}
+
+
+def _spec_axes(node: ast.AST, resolver: "_AxisResolver") -> set[str]:
+    """Axis names inside P(...) partition-spec expressions (literal or
+    resolvable through local/module constants)."""
+    axes: set[str] = set()
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        chain = attr_chain(call.func)
+        if chain is None or chain.rsplit(".", 1)[-1] not in ("P", "PartitionSpec"):
+            continue
+        for arg in call.args:
+            resolved = resolver.resolve(arg, None)
+            if resolved:
+                axes |= resolved
+    # a Name that is itself a spec variable (edge_spec = P(...)) resolves
+    # through the constant table when the resolver knows its P(...) value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Name):
+                axes |= resolver.spec_var_axes(elt.id)
+    elif isinstance(node, ast.Name):
+        axes |= resolver.spec_var_axes(node.id)
+    return axes
+
+
+# ------------------------------------------------------ axis resolution
+
+
+class _AxisResolver:
+    """Static axis-name resolution over one file: module + function-local
+    constant assignments, known mesh constants, parameter defaults."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self.assigns: dict[str, ast.AST] = {}
+        # recursion guard: mutually-referential assignments (A = (B,),
+        # B = (A,)) must degrade to unresolvable, not RecursionError
+        self._stack: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                # last assignment wins; good enough for constant tables
+                self.assigns[node.targets[0].id] = node.value
+
+    def resolve(
+        self, node: ast.AST | None, func, bindings: dict | None = None
+    ) -> set[str] | None:
+        """Set of axis names, or None when unresolvable. `func` supplies
+        parameter defaults (and may be None for spec contexts);
+        `bindings` are functools.partial keyword bindings on the wrapped
+        callable, which override defaults."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, str):
+                return {node.value}
+            return set() if node.value is None else None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: set[str] = set()
+            for elt in node.elts:
+                resolved = self.resolve(elt, func, bindings)
+                if resolved is None:
+                    return None
+                out |= resolved
+            return out
+        if isinstance(node, ast.Name):
+            if node.id in KNOWN_AXIS_CONSTANTS:
+                return {KNOWN_AXIS_CONSTANTS[node.id]}
+            if node.id in self._stack:
+                return None  # assignment cycle: unresolvable
+            if bindings and node.id in bindings:
+                return self.resolve(bindings[node.id], None)
+            default = _param_default(func, node.id) if func is not None else None
+            if default is not None:
+                return self.resolve(default, None)
+            value = self.assigns.get(node.id)
+            if value is not None and not isinstance(value, ast.Name):
+                self._stack.add(node.id)
+                try:
+                    return self.resolve(value, func, bindings)
+                finally:
+                    self._stack.discard(node.id)
+            return None
+        if isinstance(node, ast.Attribute):
+            leaf = node.attr
+            if leaf in KNOWN_AXIS_CONSTANTS:
+                return {KNOWN_AXIS_CONSTANTS[leaf]}
+            return None
+        return None
+
+    def spec_var_axes(self, name: str) -> set[str]:
+        """Axes of a variable assigned a P(...) spec expression."""
+        value = self.assigns.get(name)
+        if value is None:
+            return set()
+        return _spec_axes(value, self)
+
+
+def _param_default(func, name: str) -> ast.AST | None:
+    if func is None:
+        return None
+    args = func.args
+    positional = args.posonlyargs + args.args
+    defaults = args.defaults
+    offset = len(positional) - len(defaults)
+    for i, a in enumerate(positional):
+        if a.arg == name and i >= offset:
+            return defaults[i - offset]
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if a.arg == name and d is not None:
+            return d
+    return None
+
+
+# -------------------------------------------------------------- helpers
+
+
+def _collective_axis(node: ast.Call) -> tuple[str | None, ast.AST | None]:
+    chain = attr_chain(node.func)
+    if chain is None:
+        return None, None
+    leaf = chain.rsplit(".", 1)[-1]
+    if leaf not in COLLECTIVE_AXIS_ARG:
+        return None, None
+    # collectives must be QUALIFIED through jax.lax / lax — a bare name
+    # would alias user helpers called `psum`; the precision-over-recall
+    # tradeoff (a `from jax.lax import psum` import style goes unchecked)
+    # matches the rest of dflint, and the tree only uses jax.lax.*
+    parts = chain.split(".")
+    if len(parts) < 2 or parts[-2] not in ("lax", "jax"):
+        return None, None
+    pos = COLLECTIVE_AXIS_ARG[leaf]
+    axis_node = None
+    if pos < len(node.args):
+        axis_node = node.args[pos]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_node = kw.value
+    return leaf, axis_node
+
+
+def _leaf_root(node: ast.Call) -> tuple[str | None, str | None]:
+    chain = attr_chain(node.func)
+    if chain is None:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr, None
+        return None, None
+    parts = chain.split(".")
+    return parts[-1], parts[0] if len(parts) > 1 else None
+
+
+def _declared_axes(scope_chain, body_ids, spec_axes) -> set[str]:
+    """Partition-spec axes of the innermost shard_map body on the scope
+    chain (empty set when none resolves)."""
+    for scope in scope_chain:
+        if id(scope) in body_ids and spec_axes[id(scope)]:
+            return spec_axes[id(scope)]
+    return set()
+
+
+def _spec_violation(axis, scope_chain, body_ids, spec_axes) -> bool:
+    declared = _declared_axes(scope_chain, body_ids, spec_axes)
+    return bool(declared) and axis not in declared
+
+
+def _functions_with_symbols(tree):
+    """Every funcdef in the file (module, method, nested) with a dotted
+    symbol and its enclosing-function chain (innermost first); callers
+    pair this with `_walk_own` so each node is scanned under exactly one
+    function."""
+    def visit(node, prefix, ancestors):
+        for stmt in ast.iter_child_nodes(node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                symbol = f"{prefix}{stmt.name}"
+                yield stmt, symbol, ancestors
+                yield from visit(stmt, f"{symbol}.", [stmt, *ancestors])
+            elif isinstance(stmt, ast.ClassDef):
+                yield from visit(stmt, f"{prefix}{stmt.name}.", ancestors)
+            else:
+                yield from visit(stmt, prefix, ancestors)
+
+    yield from visit(tree, "", [])
+
+
+def _walk_own(func):
+    """Walk a function's subtree, pruning nested function bodies (they
+    are visited as their own functions)."""
+    stack = [iter(ast.iter_child_nodes(func))]
+    while stack:
+        try:
+            node = next(stack[-1])
+        except StopIteration:
+            stack.pop()
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.append(iter(ast.iter_child_nodes(node)))

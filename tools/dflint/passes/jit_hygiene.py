@@ -36,6 +36,19 @@ Rules:
 Static parameters (``static_argnames``) are excluded from taint; taint
 propagates through simple assignments within the body (one forward
 pass — an intentionally shallow, low-false-positive approximation).
+
+``shard_map``-wrapped bodies are traced programs too (ROADMAP item-1
+residual: the D2H/branching discipline must carry into meshed jits
+before any sharding code lands on the serving path), so JIT001/JIT002
+apply to them as well. Their static set is inferred rather than
+declared: ``functools.partial`` bindings on the wrapped callable,
+axis-like parameter names (``axis_name``/``axes``/``mesh``), and
+parameters with constant defaults (config flags like ``use_flash``) are
+static; everything else is a device shard and taints. Collective ops
+(``psum``/``all_gather``/``ppermute``/``all_to_all``...) are device
+ops, never host syncs — ``psum(1, axis)`` axis-size idioms stay
+untainted, while ``axis_index`` results are per-device values and taint
+their targets.
 """
 
 from __future__ import annotations
@@ -48,6 +61,12 @@ SYNC_CALL_LEAVES = {"asarray", "array", "device_get", "block_until_ready"}
 SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
 CAST_FUNCS = {"float", "int", "bool"}
 NUMPY_ROOTS = {"np", "numpy", "onp"}
+# parameter names that carry mesh topology, not array data — static in
+# any traced body (shard_map bodies have no static_argnames to declare)
+AXIS_PARAM_NAMES = {"axis_name", "axis", "axes", "mesh"}
+# collective whose result is a per-device value: taints its target even
+# though its operands are static
+TRACER_SOURCE_LEAVES = {"axis_index"}
 
 # functions whose body is the serving hot path: host syncs here must be
 # explicitly allowlisted (file suffix, enclosing function name)
@@ -109,8 +128,23 @@ class JitHygienePass:
     # ------------------------------------------------------------- run
 
     def run(self, ctx: FileContext) -> list[Finding]:
+        # function-level import: collective.py imports this module's
+        # sync sets/allowlist, so the top level must stay acyclic
+        from tools.dflint.passes.collective import collect_shard_map_bodies
+
         findings: list[Finding] = []
         jit_funcs = _collect_jit_functions(ctx.tree)
+        jit_ids = {id(f) for f, _ in jit_funcs}
+        for func, bindings, _axes in collect_shard_map_bodies(ctx.tree):
+            if id(func) in jit_ids:
+                continue
+            # axis-like param names are static ONLY for shard_map bodies
+            # (they carry mesh topology there); a plain jit param that
+            # happens to be named `axes` keeps its taint
+            jit_funcs.append((
+                func,
+                set(bindings) | _mesh_static_params(func) | AXIS_PARAM_NAMES,
+            ))
         jit_names = {f.name for f, _ in jit_funcs}
         for func, static in jit_funcs:
             findings.extend(self._check_jit_body(ctx, func, static))
@@ -127,9 +161,13 @@ class JitHygienePass:
             )
             if a.arg not in static and a.arg not in ("self", "model")
         }
-        # one forward taint pass through simple assignments
+        # one forward taint pass through simple assignments; axis_index
+        # results are per-device values and taint even from static args
         for node in ast.walk(func):
-            if isinstance(node, ast.Assign) and _references(node.value, tainted):
+            if isinstance(node, ast.Assign) and (
+                _references(node.value, tainted)
+                or _calls_tracer_source(node.value)
+            ):
                 for target in node.targets:
                     for name in ast.walk(target):
                         if isinstance(name, ast.Name):
@@ -266,6 +304,32 @@ class JitHygienePass:
 
 
 # ------------------------------------------------------------- helpers
+
+
+def _mesh_static_params(func) -> set[str]:
+    """Params of a shard_map body that are static at trace time: constant
+    defaults mark config flags (use_flash/causal/capacity), not shards."""
+    static: set[str] = set()
+    args = func.args
+    positional = args.posonlyargs + args.args
+    defaults = args.defaults
+    offset = len(positional) - len(defaults)
+    for i, a in enumerate(positional):
+        if i >= offset and isinstance(defaults[i - offset], ast.Constant):
+            static.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(d, ast.Constant):
+            static.add(a.arg)
+    return static
+
+
+def _calls_tracer_source(node: ast.AST) -> bool:
+    for inner in ast.walk(node):
+        if isinstance(inner, ast.Call):
+            chain = attr_chain(inner.func)
+            if chain and chain.rsplit(".", 1)[-1] in TRACER_SOURCE_LEAVES:
+                return True
+    return False
 
 
 def _collect_jit_functions(tree) -> list[tuple[ast.FunctionDef, set[str]]]:

@@ -1,0 +1,689 @@
+"""SHAPE001/SHAPE002/DON001 — dfshape: static shape/dtype/donation
+verification of the jit compile-signature set.
+
+The serving pipeline's perf contract is that the compiled-signature set
+of the device entry points is CLOSED: every batch that reaches a jitted
+scheduling kernel is padded to one of the three fixed ``_EVAL_BUCKETS``
+(cluster/scheduler.py), every serving-graph array is ``pad_pow2``-padded
+(ops/segment.py), and a chunk's batch dim comes out of ``_bucket_rows``/
+``_chunk_stride`` — so warmup() compiles everything the process will
+ever execute and a tick can never eat a 35 s XLA compile. The runtime
+compile-shape-stability test (tests/test_serving_pipeline.py) and the
+retrace tripwire (tools/dflint/retracer.py) check this dynamically; this
+pass proves it statically at every call site, so a NEW call site that
+can feed a runtime-dependent shape fails tier-1 before it ever runs.
+
+The pass runs a small abstract interpreter over each function body. Int
+expressions live in a four-point lattice:
+
+- ``CONST``   — literal ints, ``CONSTANTS.*`` / ``*.config.*`` reads,
+  module-level UPPERCASE constants: fixed per process.
+- ``BUCKET``  — provably a member of the closed bucket set: produced by
+  ``_bucket_rows``/``_chunk_stride``, iterated out of ``_EVAL_BUCKETS``,
+  or returned by ``pad_pow2``.
+- ``RUNTIME`` — provably runtime-varying: ``len(...)``, arithmetic on a
+  RUNTIME value, loop indices over runtime ranges. Feeding one of these
+  into a compile-signature position is a finding.
+- ``UNKNOWN`` — everything else (function parameters, attribute reads).
+  UNKNOWN stays silent: the proof is compositional — a forwarding layer
+  (e.g. MLEvaluator.schedule_from_packed passing ``b`` through) is
+  checked at the call sites where the value ORIGINATES, which is where
+  scheduler.py computes it from the bucket machinery.
+
+Rules:
+
+- ``SHAPE001`` — a runtime-dependent value (RUNTIME) or a runtime-length
+  slice reaches a shape-bearing argument of a registered serving jit
+  entry (``SERVING_JIT_REGISTRY``). Each distinct value is a fresh
+  compiled signature; the bucket set is no longer closed.
+- ``SHAPE002`` — a RUNTIME value flows into a ``static_argnames``
+  parameter of a jit call (same-file jit defs contribute their static
+  sets; registry entries their static keyword names). Static args are
+  part of the compile key, so a runtime-length ``limit=len(parents)``
+  recompiles per distinct length.
+- ``DON001`` — a read of a donated buffer after the donating call.
+  ``donate_argnums`` positions are collected from same-file jit
+  decorators and the cross-file ``DONATING_CALLABLES`` registry, then a
+  fixpoint over the in-module call graph marks functions that forward a
+  parameter into a donated position as donating that parameter — so the
+  PR-4 argument "verified no caller reuses buf" is machine-checked at
+  every layer, not just at the jit boundary. Donations created by a
+  ``return``-statement call don't leak into unreachable code; rebinding
+  (``params, opt = run_epoch(params, opt, ...)``) kills the donation.
+
+Like every dflint pass this is a lint for a discipline, not a proof
+system: coverage is source-order within a function, and UNKNOWN gives
+the benefit of the doubt. The retrace tripwire + donation guard
+(tools/dflint/retracer.py) are the runtime backstop for whatever this
+approximation lets through.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+from tools.dflint.passes.collective import _functions_with_symbols, _walk_own
+from tools.dflint.passes.jit_hygiene import _collect_jit_functions
+
+CONST = "const"
+BUCKET = "bucket"
+RUNTIME = "runtime"
+UNKNOWN = "unknown"
+
+# producers whose return value is provably inside the closed bucket set
+BUCKET_PRODUCERS = frozenset({"_bucket_rows", "_chunk_stride", "pad_pow2"})
+# the bucket-set constants themselves (iteration / subscript yields BUCKET)
+BUCKET_CONSTANTS = frozenset({"_EVAL_BUCKETS", "EVAL_BUCKETS"})
+# array producers whose output shape is fixed by their bucket argument
+PADDED_PRODUCERS = frozenset({"_pad_rows", "pack_eval_batch"})
+# callables whose int result is runtime-varying by construction
+RUNTIME_PRODUCERS = frozenset({"len", "sum", "count_nonzero"})
+
+# Registered serving jit entries, keyed by callee LEAF name (cross-file
+# call sites resolve by leaf, same as the rest of dflint). Specs:
+#   b_arg        positional index of the batch-bucket static dim
+#   static_args  positional indexes that are compile-key statics
+#   static_kw    keyword names that are compile-key statics
+#   donate       positional indexes donated to the device program
+# THIS REGISTRY IS THE DESIGN DOCUMENT for the serving signature set:
+# the retrace tripwire (retracer.py) derives its runtime-allowed set
+# from the same bucket constants these entries are proven against.
+SERVING_JIT_REGISTRY: dict[str, dict] = {
+    # ops/evaluator.schedule_from_packed(buf, b, k, c, l, n, ...)
+    # and registry/serving.MLEvaluator.schedule_from_packed(buf, b, ...)
+    "schedule_from_packed": {
+        "b_arg": 1,
+        "static_args": (1, 2, 3, 4, 5),
+        "static_kw": ("limit", "algorithm", "b", "k", "c", "l", "n"),
+        "donate": (0,),
+    },
+    # registry/serving._ml_schedule_from_packed(model, params, host_emb,
+    # buf, b, k, c, l, n, limit, ...)
+    "_ml_schedule_from_packed": {
+        "b_arg": 4,
+        "static_args": (4, 5, 6, 7, 8, 9),
+        "static_kw": ("limit", "algorithm"),
+        "donate": (3,),
+    },
+}
+
+# cross-file donating callables: leaf name -> donated positional indexes
+# (non-self). Same-file jit defs contribute their decorators' literal
+# donate_argnums on top of this seed set.
+DONATING_CALLABLES: dict[str, tuple[int, ...]] = {
+    leaf: spec["donate"] for leaf, spec in SERVING_JIT_REGISTRY.items()
+}
+
+
+@dataclasses.dataclass
+class _Donation:
+    name: str
+    after_line: int  # reads strictly after this line are suspect
+    callee: str
+    kills: list[int] = dataclasses.field(default_factory=list)
+    # line ranges of sibling if/else branches: a read there is on a
+    # mutually-exclusive path and never follows this donation
+    exclusions: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+
+
+class ShapeDonationPass:
+    name = "shape-donation"
+    rules = ("SHAPE001", "SHAPE002", "DON001")
+
+    def __init__(
+        self,
+        registry: dict[str, dict] | None = None,
+        donating: dict[str, tuple[int, ...]] | None = None,
+    ):
+        self.registry = SERVING_JIT_REGISTRY if registry is None else registry
+        self.donating_seed = (
+            DONATING_CALLABLES if donating is None else donating
+        )
+
+    # ------------------------------------------------------------- run
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        jit_funcs = _collect_jit_functions(ctx.tree)
+        jit_statics = {f.name: static for f, static in jit_funcs}
+        donating = dict(self.donating_seed)
+        donating.update(_collect_donating_defs(ctx.tree))
+        scopes = list(_functions_with_symbols(ctx.tree))
+        functions = {symbol: func for func, symbol, _anc in scopes}
+        donating.update(_donation_fixpoint(functions, donating))
+        module_consts = _module_constants(ctx.tree)
+        # one _Env per actual scope, chained to the enclosing function's
+        # env (closure reads fall back outward; a nested helper's locals
+        # can never pollute — or launder — the outer classification)
+        envs: dict[int, _Env] = {}
+        for func, symbol, ancestors in scopes:
+            parent = envs.get(id(ancestors[0])) if ancestors else None
+            env = _Env(func, module_consts, parent=parent)
+            envs[id(func)] = env
+            findings.extend(self._check_shapes(ctx, func, symbol, env, jit_statics))
+            findings.extend(self._check_donations(ctx, func, symbol, donating))
+        return findings
+
+    # ------------------------------------------------------- SHAPE001/2
+
+    def _check_shapes(self, ctx, func, symbol, env, jit_statics) -> list[Finding]:
+        findings = []
+        for node in _walk_own(func):  # nested defs scan as their own scope
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None:
+                continue
+            leaf = chain.rsplit(".", 1)[-1]
+            spec = self.registry.get(leaf)
+            if spec is not None:
+                findings.extend(
+                    self._check_registry_call(ctx, func, symbol, env, node, leaf, spec)
+                )
+            if leaf in jit_statics:
+                findings.extend(self._check_static_kwargs(
+                    ctx, func, symbol, env, node, leaf, jit_statics[leaf]
+                ))
+        return findings
+
+    def _check_registry_call(self, ctx, func, symbol, env, node, leaf, spec):
+        findings = []
+        b_arg = spec.get("b_arg")
+        for i, arg in enumerate(node.args):
+            if i == b_arg and env.classify(arg) == RUNTIME:
+                findings.append(ctx.make_finding(
+                    "SHAPE001", arg,
+                    (
+                        f"runtime-dependent batch dim feeds jitted "
+                        f"'{leaf}' — every distinct value is a fresh "
+                        f"compile signature; route it through "
+                        f"_bucket_rows/_chunk_stride so the compiled set "
+                        f"stays closed over _EVAL_BUCKETS"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+            elif i == b_arg:
+                continue
+            elif _is_runtime_slice(arg, env):
+                findings.append(ctx.make_finding(
+                    "SHAPE001", arg,
+                    (
+                        f"runtime-length slice passed into jitted "
+                        f"'{leaf}' — the sliced length becomes a fresh "
+                        f"compile signature; pad to a bucket "
+                        f"(_pad_rows/pad_pow2) first"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+            elif i in spec.get("static_args", ()) and env.classify(arg) == RUNTIME:
+                findings.append(ctx.make_finding(
+                    "SHAPE002", arg,
+                    (
+                        f"runtime-dependent value in static position "
+                        f"{i} of jitted '{leaf}' — static args are part "
+                        f"of the compile key; each distinct value "
+                        f"recompiles"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+        for kw in node.keywords:
+            if kw.arg in spec.get("static_kw", ()) and \
+                    env.classify(kw.value) == RUNTIME:
+                findings.append(ctx.make_finding(
+                    "SHAPE002", kw.value,
+                    (
+                        f"runtime-dependent value for static arg "
+                        f"'{kw.arg}' of jitted '{leaf}' — each distinct "
+                        f"value is a fresh compile"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+        return findings
+
+    def _check_static_kwargs(self, ctx, func, symbol, env, node, leaf, statics):
+        findings = []
+        for kw in node.keywords:
+            if kw.arg in statics and env.classify(kw.value) == RUNTIME:
+                findings.append(ctx.make_finding(
+                    "SHAPE002", kw.value,
+                    (
+                        f"runtime-dependent value for static_argnames "
+                        f"param '{kw.arg}' of jitted '{leaf}' — each "
+                        f"distinct value recompiles the program"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+        return findings
+
+    # ----------------------------------------------------------- DON001
+
+    def _check_donations(self, ctx, func, symbol, donating) -> list[Finding]:
+        branch_ranges = _if_branch_ranges(func)
+        stmt_of = _innermost_stmt_map(func)
+        loop_ranges = [
+            (node.lineno, getattr(node, "end_lineno", node.lineno))
+            for node in _walk_own(func)
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While))
+        ]
+        rebind_lines: dict[str, list[int]] = {}
+        for stmt in _walk_statements(func):
+            for name in _assigned_names(stmt):
+                rebind_lines.setdefault(name, []).append(
+                    getattr(stmt, "lineno", 0)
+                )
+        findings = []
+        donations: list[_Donation] = []
+        for node in _walk_own(func):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None:
+                continue
+            leaf = chain.rsplit(".", 1)[-1]
+            positions = donating.get(leaf)
+            stmt = stmt_of.get(id(node))
+            if positions is None or stmt is None or isinstance(stmt, ast.Return):
+                # a return-statement donation has no reachable
+                # same-function code after it on that path
+                continue
+            targets = _assigned_names(stmt)
+            end = getattr(node, "end_lineno", node.lineno)
+            exclusions = [
+                sibling for here, sibling in branch_ranges
+                if here[0] <= node.lineno <= here[1]
+            ]
+            for pos in positions:
+                if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                    name = node.args[pos].id
+                    if name in targets:
+                        continue  # rebound by this very statement
+                    donations.append(
+                        _Donation(name, end, leaf, exclusions=exclusions)
+                    )
+                    # loop-carried reuse: a donating call inside a loop
+                    # whose buffer is bound OUTSIDE the loop re-donates
+                    # the dead buffer on the second iteration — the
+                    # exact pattern the runtime DonationGuard trips on
+                    for lo, hi in loop_ranges:
+                        if not (lo <= node.lineno <= hi):
+                            continue
+                        if any(lo <= r <= hi for r in rebind_lines.get(name, ())):
+                            continue  # packed fresh inside this loop
+                        findings.append(ctx.make_finding(
+                            "DON001", node,
+                            (
+                                f"'{name}' is donated to '{leaf}' inside "
+                                f"a loop but bound outside it — the "
+                                f"second iteration re-donates a dead "
+                                f"buffer; pack a fresh buffer per "
+                                f"iteration"
+                            ),
+                            symbol=symbol, def_line=func.lineno,
+                        ))
+                        break
+        if not donations:
+            return findings
+        # kills: any later rebinding of the name ends the donation window
+        for stmt in _walk_statements(func):
+            names = _assigned_names(stmt)
+            line = getattr(stmt, "lineno", 0)
+            for don in donations:
+                if don.name in names and line > don.after_line:
+                    don.kills.append(line)
+        reported: set[tuple[str, int]] = set()
+        for node in _walk_own(func):
+            if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+                continue
+            for don in donations:
+                if node.id != don.name or node.lineno <= don.after_line:
+                    continue
+                if any(k <= node.lineno for k in don.kills):
+                    continue
+                if any(lo <= node.lineno <= hi for lo, hi in don.exclusions):
+                    continue  # mutually-exclusive if/else sibling branch
+                key = (don.name, node.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(ctx.make_finding(
+                    "DON001", node,
+                    (
+                        f"read of '{don.name}' after it was donated to "
+                        f"'{don.callee}' (donate_argnums) — the buffer "
+                        f"may be deallocated or reused by XLA; pack a "
+                        f"fresh buffer or read before the donating call"
+                    ),
+                    symbol=symbol, def_line=func.lineno,
+                ))
+        return findings
+
+
+# --------------------------------------------------------------- lattice
+
+
+class _Env:
+    """Per-SCOPE abstract environment: Name -> lattice point, built
+    lazily from the scope's own assignments and loop targets (nested
+    function bodies are pruned — they get their own env) with closure
+    fallback to the enclosing scope's env and a recursion guard
+    (self-referential assigns degrade to UNKNOWN)."""
+
+    def __init__(self, func, module_consts: dict[str, str],
+                 parent: "_Env | None" = None):
+        self.module_consts = module_consts
+        self.parent = parent
+        # name -> [(line, value expr)] in source order: classification
+        # is flow-sensitive (the binding LIVE at the reference line), so
+        # a rebinding after the call site cannot retroactively change —
+        # or launder — what the call saw
+        self.assigns: dict[str, list[tuple[int, ast.AST]]] = {}
+        self.loop_buckets: set[str] = set()
+        self.loop_runtime: set[str] = set()
+        self.params = {
+            a.arg for a in (
+                func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+            )
+        }
+        for node in _walk_own(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.assigns.setdefault(node.targets[0].id, []).append(
+                    (node.lineno, node.value)
+                )
+            elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                    and isinstance(node.target, ast.Name):
+                src = node.iter
+                src_chain = attr_chain(src)
+                src_leaf = src_chain.rsplit(".", 1)[-1] if src_chain else None
+                if src_leaf in BUCKET_CONSTANTS:
+                    self.loop_buckets.add(node.target.id)
+                elif _iterates_runtime_range(src):
+                    self.loop_runtime.add(node.target.id)
+        self._memo: dict[int, str] = {}
+        self._stack: set[str] = set()
+
+    def classify(self, node: ast.AST) -> str:
+        key = id(node)
+        if key in self._memo:
+            return self._memo[key]
+        out = self._classify(node)
+        self._memo[key] = out
+        return out
+
+    def _classify(self, node: ast.AST) -> str:  # noqa: C901 - one lattice
+        if isinstance(node, ast.Constant):
+            return CONST
+        if isinstance(node, ast.Name):
+            return self._classify_name(node.id, getattr(node, "lineno", 0))
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else None
+            if leaf in BUCKET_PRODUCERS:
+                return BUCKET
+            if leaf in RUNTIME_PRODUCERS:
+                return RUNTIME
+            if leaf in ("int", "abs", "min", "max"):
+                points = [self.classify(a) for a in node.args]
+                if RUNTIME in points:
+                    return RUNTIME
+                if points and all(p in (CONST, BUCKET) for p in points):
+                    return BUCKET if BUCKET in points else CONST
+            return UNKNOWN
+        if isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain is not None:
+                parts = chain.split(".")
+                if "config" in parts or parts[0] == "CONSTANTS":
+                    return CONST
+                if parts[-1].isupper():
+                    return CONST
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            src_chain = attr_chain(node.value)
+            src_leaf = src_chain.rsplit(".", 1)[-1] if src_chain else None
+            if src_leaf in BUCKET_CONSTANTS:
+                return BUCKET
+            return UNKNOWN
+        if isinstance(node, ast.BinOp):
+            left, right = self.classify(node.left), self.classify(node.right)
+            if RUNTIME in (left, right):
+                return RUNTIME
+            if left == CONST and right == CONST:
+                return CONST
+            return UNKNOWN
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.IfExp):
+            points = {self.classify(node.body), self.classify(node.orelse)}
+            if RUNTIME in points:
+                return RUNTIME
+            if points == {BUCKET}:
+                return BUCKET
+            if points <= {CONST, BUCKET}:
+                return BUCKET if BUCKET in points else CONST
+            return UNKNOWN
+        return UNKNOWN
+
+    def _classify_name(self, name: str, at_line: int) -> str:
+        if name in self.loop_buckets:
+            return BUCKET
+        if name in self.loop_runtime:
+            return RUNTIME
+        if name in self._stack:
+            return UNKNOWN
+        binding = self._binding_at(name, at_line)
+        if binding is not None:
+            self._stack.add(name)
+            try:
+                return self.classify(binding)
+            finally:
+                self._stack.discard(name)
+        if name in self.params:
+            return UNKNOWN  # own param shadows any enclosing binding
+        if self.parent is not None:
+            return self.parent._classify_name(name, at_line)  # closure read
+        if name in BUCKET_CONSTANTS:
+            return BUCKET
+        if name in self.module_consts:
+            return self.module_consts[name]
+        if name.isupper():
+            return CONST
+        return UNKNOWN
+
+    def _binding_at(self, name: str, at_line: int) -> ast.AST | None:
+        """The assignment LIVE at a reference line: the latest binding
+        at-or-before the line; a reference before any binding falls back
+        to the earliest one (loop back-edge reads)."""
+        bindings = self.assigns.get(name)
+        if not bindings:
+            return None
+        live = None
+        for line, value in bindings:  # collected in source order
+            if line <= at_line:
+                live = value
+        return live if live is not None else bindings[0][1]
+
+
+def _iterates_runtime_range(src: ast.AST) -> bool:
+    """True for ``range(len(...))``-shaped iteration sources."""
+    if not isinstance(src, ast.Call):
+        return False
+    chain = attr_chain(src.func)
+    if chain != "range":
+        return False
+    for arg in src.args:
+        for inner in ast.walk(arg):
+            if isinstance(inner, ast.Call):
+                inner_chain = attr_chain(inner.func)
+                if inner_chain and inner_chain.rsplit(".", 1)[-1] in RUNTIME_PRODUCERS:
+                    return True
+    return False
+
+
+def _is_runtime_slice(arg: ast.AST, env: _Env) -> bool:
+    """``x[a:b]`` where a bound is RUNTIME — a runtime-length array."""
+    if not (isinstance(arg, ast.Subscript) and isinstance(arg.slice, ast.Slice)):
+        return False
+    for bound in (arg.slice.lower, arg.slice.upper):
+        if bound is not None and env.classify(bound) == RUNTIME:
+            return True
+    return False
+
+
+# -------------------------------------------------------------- helpers
+
+
+def _module_constants(tree) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name in BUCKET_CONSTANTS:
+                out[name] = BUCKET
+            elif name.isupper() and isinstance(node.value, ast.Constant):
+                out[name] = CONST
+    return out
+
+
+def _collect_donating_defs(tree) -> dict[str, tuple[int, ...]]:
+    """leaf name -> donate_argnums for same-file jit defs carrying a
+    literal ``donate_argnums`` in their decorator."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                nums = _literal_int_tuple(kw.value)
+                if nums:
+                    out[node.name] = nums
+    return out
+
+
+def _literal_int_tuple(node: ast.AST) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        nums = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                nums.append(elt.value)
+            else:
+                return ()
+        return tuple(nums)
+    return ()
+
+
+def _donation_fixpoint(
+    functions: dict[str, ast.AST], donating: dict[str, tuple[int, ...]]
+) -> dict[str, tuple[int, ...]]:
+    """Functions that forward a parameter into a donated position of a
+    known donating callee donate that parameter themselves — iterated to
+    fixpoint so chains of forwarding layers are all covered. Parameter
+    indexes are non-self (call sites never pass self)."""
+    known = dict(donating)
+    for _ in range(len(functions) + 1):
+        changed = False
+        for qualname, func in functions.items():
+            leaf = qualname.rsplit(".", 1)[-1]
+            params = [
+                a.arg for a in (
+                    func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+                )
+                if a.arg != "self"
+            ]
+            current = set(known.get(leaf, ()))
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain is None:
+                    continue
+                callee = chain.rsplit(".", 1)[-1]
+                for pos in known.get(callee, ()):
+                    if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                        name = node.args[pos].id
+                        if name in params:
+                            current.add(params.index(name))
+            if current != set(known.get(leaf, ())):
+                known[leaf] = tuple(sorted(current))
+                changed = True
+        if not changed:
+            break
+    return known
+
+
+def _innermost_stmt_map(func) -> dict[int, ast.stmt]:
+    """id(expr node) -> the innermost statement containing it (nested
+    function bodies pruned — they map within their own scope)."""
+    out: dict[int, ast.stmt] = {}
+
+    def visit(stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                visit(child)
+            else:
+                for node in ast.walk(child):
+                    if isinstance(node, ast.stmt):
+                        continue  # claimed by its own statement visit
+                    out.setdefault(id(node), stmt)
+
+    for child in ast.iter_child_nodes(func):
+        if isinstance(child, ast.stmt):
+            visit(child)
+    return out
+
+
+def _if_branch_ranges(func) -> list[tuple[tuple[int, int], tuple[int, int]]]:
+    """For every if/else in `func` (own scope): ((body line range),
+    (orelse range)) and the mirror pair — used to exempt reads on the
+    mutually-exclusive sibling branch of a donating call."""
+    pairs = []
+    for node in _walk_own(func):
+        if not isinstance(node, ast.If) or not node.orelse:
+            continue
+        body = _stmt_range(node.body)
+        orelse = _stmt_range(node.orelse)
+        pairs.append((body, orelse))
+        pairs.append((orelse, body))
+    return pairs
+
+
+def _stmt_range(stmts: list[ast.stmt]) -> tuple[int, int]:
+    first = stmts[0].lineno
+    last = max(getattr(s, "end_lineno", s.lineno) for s in stmts)
+    return first, last
+
+
+def _walk_statements(func):
+    for node in _walk_own(func):
+        if isinstance(node, ast.stmt):
+            yield node
+
+
+def _assigned_names(stmt: ast.AST) -> set[str]:
+    names: set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = stmt.targets
+    else:
+        return names
+    for target in targets:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+    return names

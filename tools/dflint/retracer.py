@@ -1,0 +1,294 @@
+"""Runtime half of dfshape: retrace tripwire + donation guard.
+
+The static shape pass (tools/dflint/passes/shape.py) proves every call
+site feeds the serving jits a batch dim from the closed ``_EVAL_BUCKETS``
+set. This module is the dynamic backstop, mirroring PR-10's lockorder
+harness: the static pass argues the invariant, the tripwire makes tier-1
+fail if reality ever disagrees.
+
+- ``RetraceTripwire`` — validates every compile signature the
+  flight-recorder jit wrappers (telemetry/flight.py) have observed for
+  the serving entry points against the STATICALLY-derived allowed set
+  (``derive_static_signature_sets``: the ``_EVAL_BUCKETS`` constant
+  parsed out of cluster/scheduler.py by AST, so the runtime check and
+  the static pass share one source of truth and cannot drift apart).
+  conftest installs one per session and fails the suite on any
+  signature outside the proven set — a compile the static pass did not
+  predict is either a new unbucketed call site or a hole in the pass;
+  both must be fixed, not shrugged off.
+
+- ``DonationGuard`` — wraps the donating serving jits
+  (``donate_argnums`` staging buffers). In the default ``mark`` mode it
+  (a) raises ``UseAfterDonateError`` when a previously-donated host
+  buffer is passed in again (re-donation of a dead buffer), and (b)
+  freezes the donated np array (``writeable = False``) so any later
+  WRITE crashes loudly instead of silently racing XLA. In ``poison``
+  mode (dedicated tests) it additionally blocks on the result and fills
+  the donated buffer with a canary byte — a use-after-donate READ then
+  sees 0xDB garbage instead of plausible stale data, which is the
+  difference between a test that fails loudly and a heisenbug.
+  Poisoning only happens after ``block_until_ready`` because jax may
+  alias host numpy memory zero-copy on CPU: scribbling on the buffer
+  while the device call is still consuming it would corrupt the very
+  computation the tests assert on.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+import weakref
+from pathlib import Path
+
+POISON_BYTE = 0xDB
+
+BUCKET_SOURCE = "dragonfly2_tpu/cluster/scheduler.py"
+BUCKET_CONST = "_EVAL_BUCKETS"
+
+# The serving jit entry points whose compiled-signature set is proven
+# closed by the static pass; ``b_arg`` is the positional index of the
+# batch-bucket static dim in the wrapper's observed call signature.
+# (Keys are flight-recorder wrapper names: "<service>.<name>".)
+SERVING_B_ARGS: dict[str, int] = {
+    "scheduler.evaluator.schedule_from_packed": 1,
+    "scheduler.ml.schedule_from_packed": 4,
+}
+
+
+def load_eval_buckets(root: str | Path = ".") -> tuple[int, ...]:
+    """Parse ``_EVAL_BUCKETS`` out of cluster/scheduler.py WITHOUT
+    importing it (the lint/tripwire must not depend on jax import order
+    or pay scheduler import side effects)."""
+    path = Path(root) / BUCKET_SOURCE
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == BUCKET_CONST:
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                out = []
+                for elt in node.value.elts:
+                    if not (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, int)):
+                        raise ValueError(f"{BUCKET_CONST} holds a non-int")
+                    out.append(elt.value)
+                return tuple(out)
+    raise ValueError(f"{BUCKET_CONST} not found in {path}")
+
+
+def derive_static_signature_sets(
+    root: str | Path = ".",
+) -> dict[str, frozenset[int]]:
+    """wrapper name -> statically-proven allowed batch buckets. One
+    derivation feeds both the tier-1 tripwire and the compile-shape
+    stability test, so "the proven set" is a single artifact."""
+    buckets = frozenset(load_eval_buckets(root))
+    return {name: buckets for name in SERVING_B_ARGS}
+
+
+# ------------------------------------------------------------- tripwire
+
+
+def extract_batch_dim(sig: object, b_arg: int) -> int | None:
+    """Batch dim out of a JitWrapper signature tuple — the wrapper
+    records ``(_sig_of(args), _sig_of(sorted_kwargs))`` and tuples
+    collapse to ``("seq", (component, ...))``; static ints ride as
+    themselves."""
+    try:
+        args_sig = sig[0]
+        if not (isinstance(args_sig, tuple) and args_sig[0] == "seq"):
+            return None
+        value = args_sig[1][b_arg]
+    except (IndexError, TypeError):
+        return None
+    return value if isinstance(value, int) and not isinstance(value, bool) else None
+
+
+def observed_batch_buckets(wrapper, b_arg: int) -> set[int | None]:
+    """Distinct batch dims of every signature a wrapper has routed
+    (None entries = signatures the extractor could not read)."""
+    with wrapper._mu:
+        seen = list(wrapper._seen)
+    return {extract_batch_dim(sig, b_arg) for sig in seen}
+
+
+class RetraceTripwire:
+    """Session-scoped compile tripwire over the serving jit wrappers."""
+
+    def __init__(self, root: str | Path = ".",
+                 allowed: dict[str, frozenset[int]] | None = None,
+                 b_args: dict[str, int] | None = None):
+        self.allowed = (
+            derive_static_signature_sets(root) if allowed is None else allowed
+        )
+        self.b_args = dict(SERVING_B_ARGS) if b_args is None else b_args
+        self._armed: dict[str, int] = {}
+
+    def _wrappers(self) -> dict:
+        from dragonfly2_tpu.telemetry.flight import jit_wrappers
+
+        return {
+            name: w for name, w in jit_wrappers().items()
+            if name in self.allowed
+        }
+
+    def arm(self) -> None:
+        """Record the current per-wrapper signature counts (call after
+        warmup); ``new_signatures`` reports growth since this point."""
+        self._armed = {
+            name: w.stats()["signatures"] for name, w in self._wrappers().items()
+        }
+
+    def new_signatures(self) -> dict[str, int]:
+        out = {}
+        for name, wrapper in self._wrappers().items():
+            delta = wrapper.stats()["signatures"] - self._armed.get(name, 0)
+            if delta > 0:
+                out[name] = delta
+        return out
+
+    def violations(self) -> list[str]:
+        """Every observed serving-jit signature whose batch dim falls
+        outside the statically-proven bucket set. Empty = the runtime
+        compile history is exactly what the static pass predicted."""
+        problems = []
+        for name, wrapper in self._wrappers().items():
+            allowed = self.allowed[name]
+            b_arg = self.b_args[name]
+            for b in sorted(
+                observed_batch_buckets(wrapper, b_arg),
+                key=lambda v: (v is None, v),
+            ):
+                if b is None:
+                    problems.append(
+                        f"{name}: signature with no readable batch dim at "
+                        f"arg {b_arg} — call convention changed; update "
+                        f"tools/dflint/retracer.SERVING_B_ARGS"
+                    )
+                elif b not in allowed:
+                    problems.append(
+                        f"{name}: compiled batch dim {b} outside the "
+                        f"statically-proven bucket set {sorted(allowed)} — "
+                        f"an unbucketed call site reached the serving jit"
+                    )
+        return problems
+
+
+# ------------------------------------------------------- donation guard
+
+
+class UseAfterDonateError(RuntimeError):
+    """A host staging buffer was passed to a donating jit twice."""
+
+
+class DonationGuard:
+    """Callable wrapper enforcing the one-shot contract of donated host
+    staging buffers. Forwards attributes so flight-recorder stats and
+    ``.lower()`` callers see the wrapped jit unchanged."""
+
+    def __init__(self, fn, donate_argnums: tuple[int, ...], name: str,
+                 poison: bool = False):
+        self.__wrapped__ = fn
+        self.donate_argnums = tuple(donate_argnums)
+        self.guard_name = name
+        self.poison = poison
+        self._mu = threading.Lock()
+        self._donated: dict[int, weakref.ref] = {}
+        self.donations = 0
+        self.reuse_trips = 0
+
+    def __call__(self, *args, **kwargs):
+        import numpy as np
+
+        host_bufs = []
+        # positional-only on purpose: jax's donate_argnums donates ONLY
+        # positionally-passed arguments (a kwarg-passed buffer is simply
+        # not donated), so guarding kwargs would trip on calls that
+        # never give the buffer up
+        for pos in self.donate_argnums:
+            if pos < len(args) and isinstance(args[pos], np.ndarray):
+                host_bufs.append(args[pos])
+        # mark + freeze BEFORE dispatching: registering only after the
+        # call returns would leave a window the length of the device
+        # call in which a concurrent second donation of the same buffer
+        # (or a concurrent write) goes undetected — the exact races the
+        # guard exists to catch. A failed dispatch leaves the buffer
+        # marked donated, which is the conservative direction.
+        with self._mu:
+            for buf in host_bufs:
+                ref = self._donated.get(id(buf))
+                if ref is not None and ref() is buf:
+                    self.reuse_trips += 1
+                    raise UseAfterDonateError(
+                        f"{self.guard_name}: host buffer id={id(buf)} was "
+                        f"already donated to a previous call — donated "
+                        f"staging buffers are one-shot; pack a fresh "
+                        f"buffer per call"
+                    )
+            for buf in host_bufs:
+                self.donations += 1
+                key = id(buf)
+                self._donated[key] = weakref.ref(
+                    buf, lambda _ref, _key=key: self._donated.pop(_key, None)
+                )
+                try:
+                    buf.flags.writeable = False  # later writes crash loudly
+                except ValueError:
+                    pass  # borrowed-memory views cannot be frozen
+        out = self.__wrapped__(*args, **kwargs)
+        if host_bufs and self.poison:
+            # only scribble once the device result is materialized: jax
+            # may alias host numpy memory zero-copy on CPU
+            import jax
+
+            jax.block_until_ready(out)
+            for buf in host_bufs:
+                self._poison_fill(buf)
+        return out
+
+    @staticmethod
+    def _poison_fill(buf) -> None:
+        import numpy as np
+
+        try:
+            buf.flags.writeable = True  # guard froze it at donation time
+        except ValueError:
+            return  # borrowed-memory view: cannot poison safely
+        try:
+            buf.view(np.uint8)[...] = POISON_BYTE
+        except (ValueError, TypeError):
+            buf.fill(np.nan if np.issubdtype(buf.dtype, np.floating) else -1)
+        buf.flags.writeable = False
+
+    def __getattr__(self, item: str):
+        return getattr(self.__wrapped__, item)
+
+
+# guarded module attributes: (module path, attribute, donated argnums)
+GUARDED_SERVING_JITS: tuple[tuple[str, str, tuple[int, ...]], ...] = (
+    ("dragonfly2_tpu.ops.evaluator", "schedule_from_packed", (0,)),
+    ("dragonfly2_tpu.registry.serving", "_ml_schedule_from_packed", (3,)),
+)
+
+
+def install_donation_guards(poison: bool = False) -> list[tuple]:
+    """Wrap the donating serving jits in place; returns restore records
+    for ``uninstall_donation_guards``. Idempotent per install/uninstall
+    pair (an already-guarded attribute is left alone)."""
+    import importlib
+
+    installed = []
+    for module_name, attr, argnums in GUARDED_SERVING_JITS:
+        module = importlib.import_module(module_name)
+        fn = getattr(module, attr)
+        if isinstance(fn, DonationGuard):
+            continue
+        guard = DonationGuard(fn, argnums, f"{module_name}.{attr}", poison=poison)
+        setattr(module, attr, guard)
+        installed.append((module, attr, fn, guard))
+    return installed
+
+
+def uninstall_donation_guards(installed: list[tuple]) -> None:
+    for module, attr, fn, _guard in installed:
+        setattr(module, attr, fn)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""One-shot static-analysis gate: dflint + waiver audit + typecheck.
+
+``python -m tools.lint_all`` is THE entry point CI and the tier-1 gate
+share (tests/test_static_analysis.py invokes the same ``main``), so
+"the lint is green" means one thing everywhere:
+
+1. dflint's six passes over ``dragonfly2_tpu/`` report zero unwaived
+   findings and every waiver carries a substantive reason;
+2. the waiver audit finds no stale waivers (a ``waive[RULE]`` whose
+   rule no longer fires at that site);
+3. the mypy strict-core subset passes (or gates with the explicit
+   SKIPPED marker on rigs without mypy — tools/typecheck.py).
+
+``--json`` forwards dflint's machine-readable findings document.
+
+Exit 0 = all green; 1 = any stage failed.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="lint_all",
+        description="dflint (six passes, waiver audit) + mypy strict-core "
+                    "over the whole package — the one tier-1/CI gate",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit dflint's machine-readable document with "
+                             "the typecheck verdict merged in")
+    # no positional targets on purpose: the gate is all-or-nothing; a
+    # scoped lint is `python -m tools.dflint <paths>` — accepting paths
+    # here while silently linting the whole tree would misreport scope
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    as_json = args.as_json
+
+    from tools.dflint.__main__ import main as dflint_main
+    from tools.typecheck import SKIP_MARKER
+
+    dflint_args = ["--root", str(ROOT), "--audit-waivers"]
+    if as_json:
+        import contextlib
+        import io
+        import json
+
+        captured = io.StringIO()
+        with contextlib.redirect_stdout(captured):
+            rc_lint = dflint_main(dflint_args + ["--json"])
+        doc = json.loads(captured.getvalue())
+    else:
+        rc_lint = dflint_main(dflint_args)
+        print(f"lint_all: dflint+waiver-audit {'OK' if rc_lint == 0 else 'FAILED'}")
+
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "typecheck.py")],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+    )
+    failed = rc_lint != 0 or proc.returncode != 0
+    if as_json:
+        # one merged document: the overall `ok` covers BOTH stages (a
+        # dflint-only verdict would let a mypy failure ship green), and
+        # the typecheck output rides along so the failure detail is
+        # recoverable from the JSON alone
+        doc["typecheck"] = {
+            "returncode": proc.returncode,
+            "skipped": SKIP_MARKER in proc.stdout,
+            "output": (proc.stdout + proc.stderr).strip(),
+        }
+        doc["ok"] = not failed
+        print(json.dumps(doc, indent=2))
+    else:
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        print(f"lint_all: typecheck {'OK' if proc.returncode == 0 else 'FAILED'}")
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
