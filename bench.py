@@ -74,7 +74,13 @@ TRAINER_FUSION = 8
 # number of record.
 CPU_TORCH_SAMPLES_PER_SEC_FALLBACK = 1_840.0
 CPU_PROBE_STEPS = 2
-PEAK_TFLOPS_BF16 = 197.0  # TPU v5e per-chip peak
+# TPU v5e per-chip peak, derived from the shared roofline platform model
+# (telemetry/costcard.py — one source of truth with the cost-card
+# verdicts and train.gnn_roofline_bound; costcard imports no jax, so
+# this stays a light module-level import)
+from dragonfly2_tpu.telemetry.costcard import PEAK_FLOPS_BF16 as _PEAK_FLOPS
+
+PEAK_TFLOPS_BF16 = _PEAK_FLOPS / 1e12
 ATTN_SHAPE = (4, 8, 8192, 128)  # B, H, L, D for the MFU probes
 ATTN_CHAIN = 8
 # Retry threshold as a fraction of the ROOFLINE rate (chip peak FLOP/s /
@@ -419,11 +425,28 @@ def _trainer_submetrics() -> dict:
     bound["headroom_x"] = (
         round(bound["mfu_ceiling_pct"] / mfu, 2) if mfu > 0 else None
     )
+    # DEMOTED to a cross-check (perf observatory): the hand-rolled
+    # per-stage roofline stays published, but the verdict of record now
+    # comes from the compiler's own cost card below (gnn_costcard).
+    bound["role"] = "hand-model cross-check of gnn_costcard"
     out["gnn_bound_detail"] = bound
     out["gnn_bound"] = (
         f"ceiling {bound['mfu_ceiling_pct']}% vs achieved {round(mfu, 1)}%: "
         + bound["statement"]
     )
+    # CostCard-grounded verdicts (telemetry/costcard.py): the trainer
+    # step's card was registered from the SAME lowering the FLOP
+    # accounting pays for (train._epoch_flops), so flops/bytes here are
+    # the compiler's numbers for the exact program measured above. MFU
+    # of record = measured steady-state rate vs the card's FLOPs; the
+    # memory-bound verdict = the card's whole-program arithmetic
+    # intensity vs the chip ridge. Documented agreement tolerance vs the
+    # analytic matmul floor: an honest cost analysis counts every op,
+    # so card/analytic >= 1 is expected; ratios in [0.25, 4.0] are
+    # accepted because some PJRT backends under-count fused elementwise
+    # work (~0.3x observed on CPU), while below 0.25 is the r3 failure
+    # mode flops_basis already flags as invalid data.
+    out["gnn_costcard"] = _gnn_costcard_verdict(xla, analytic, mfu, steady)
     # Physical-sanity invariants (VERDICT r3): a violation marks the
     # whole sub-object invalid rather than publishing an impossible number.
     violations = []
@@ -465,6 +488,54 @@ def _trainer_submetrics() -> dict:
     return out
 
 
+COSTCARD_AGREEMENT_TOLERANCE = (0.25, 4.0)
+
+
+def _gnn_costcard_verdict(xla_flops_per_sample: float, analytic: float,
+                          analytic_mfu: float, steady: float) -> dict:
+    """Trainer-step verdicts recomputed from the cost-card ledger:
+    measured-time MFU against the card's FLOPs, memory-bound from the
+    card's arithmetic intensity, with the hand roofline as cross-check
+    (tolerance documented at the call site)."""
+    from dragonfly2_tpu.telemetry import costcard
+
+    cards = costcard.ledger().cards("trainer.trainer.epoch_indexed") \
+        or costcard.ledger().cards("trainer.trainer.epoch")
+    if not cards:
+        return {"error": "no trainer cost card captured"}
+    # the representative-scale program dominates any warmup/canary
+    # trains that share the process
+    card = max(cards, key=lambda c: c.flops)
+    mfu_cc = (
+        100.0 * xla_flops_per_sample * steady / (PEAK_TFLOPS_BF16 * 1e12)
+        if xla_flops_per_sample > 0 else None
+    )
+    lo, hi = COSTCARD_AGREEMENT_TOLERANCE
+    agreement = (
+        round(xla_flops_per_sample / analytic, 3)
+        if analytic > 0 and xla_flops_per_sample > 0 else None
+    )
+    return {
+        "entry": card.entry,
+        "signature": card.signature,
+        "flops_per_sample_xla": round(xla_flops_per_sample, 1),
+        "bytes_accessed": card.bytes_accessed,
+        "output_bytes": card.output_bytes,
+        "temp_bytes": card.temp_bytes,
+        "arithmetic_intensity": round(card.arithmetic_intensity(), 2),
+        "bound": card.bound(),
+        "mfu_pct_measured": round(mfu_cc, 3) if mfu_cc is not None else None,
+        "roofline_cross_check": {
+            "analytic_mfu_pct": round(analytic_mfu, 3),
+            "agreement_x": agreement,
+            "tolerance_x": list(COSTCARD_AGREEMENT_TOLERANCE),
+            "agrees_within_tolerance": (
+                agreement is not None and lo <= agreement <= hi
+            ),
+        },
+    }
+
+
 def _loop_submetrics() -> list:
     """Bounded configs[3] loop: replay -> train -> publish -> serve-ml."""
     from bench_loop import run
@@ -473,11 +544,19 @@ def _loop_submetrics() -> list:
 
 
 def main() -> int:
+    import argparse
+
     import jax
 
     from dragonfly2_tpu.ops import evaluator as ev
     from dragonfly2_tpu.records import synth
     from dragonfly2_tpu.records.features import downloads_to_eval_batch
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--artifact", default=None,
+                    help="also write the record as a BENCH artifact via "
+                         "the shared schema writer (tools/bench_schema.py)")
+    artifact_path = ap.parse_args().artifact
 
     # Build a 10k-host cluster and replay its traces as scoring requests.
     cluster = synth.make_cluster(NUM_HOSTS, seed=0)
@@ -584,21 +663,18 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         loop = [{"error": f"{type(e).__name__}: {e}"}]
 
-    print(
-        json.dumps(
-            {
-                "metric": "scheduler_parent_selection_p50_ms_1024x64",
-                "value": round(p50, 4),
-                "unit": "ms",
-                "vs_baseline": round(BASELINE_MS / p50, 2),
-                "method": method,
-                "samples": n_samples,
-                "measurements": measurements,
-                "trainer": trainer,
-                "loop": loop,
-            }
-        )
-    )
+    record = {
+        "metric": "scheduler_parent_selection_p50_ms_1024x64",
+        "value": round(p50, 4),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / p50, 2),
+        "method": method,
+        "samples": n_samples,
+        "measurements": measurements,
+        "trainer": trainer,
+        "loop": loop,
+    }
+    print(json.dumps(record))
     # Tail-safe summary (VERDICT r4 weak #1): the driver records only the
     # LAST 2000 chars of output, and r4's single JSON line outgrew that
     # window — the truncation kept the end of the line and cut the
@@ -617,6 +693,11 @@ def main() -> int:
                 "attention_fwd_mfu_pct"):
         if key in trainer:
             summary[key] = trainer[key]
+    # the cost-card-grounded MFU of record (perf observatory): measured
+    # steady-state rate against the compiler's FLOP count
+    cc = trainer.get("gnn_costcard")
+    if isinstance(cc, dict) and cc.get("mfu_pct_measured") is not None:
+        summary["gnn_mfu_pct_costcard"] = cc["mfu_pct_measured"]
     for leg in loop:
         m = leg.get("metric", "")
         if m == "full_loop_pieces_per_sec":
@@ -660,6 +741,14 @@ def main() -> int:
         summary.pop(optional.pop())
         line = json.dumps(summary)
     print(line)
+    if artifact_path:
+        # shared schema writer (tools/bench_schema.py): the full record
+        # plus the tail-safe summary land as a BENCH artifact with the
+        # platform block benchwatch fingerprints comparability on
+        from tools.bench_schema import write_artifact
+
+        write_artifact(artifact_path, ["python", "bench.py"] + sys.argv[1:],
+                       summary, extra={"record": record})
     return 0
 
 

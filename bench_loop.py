@@ -172,6 +172,14 @@ def run(
         "unit": "ms",
         "p95": round(sorted(tick_ms)[int(0.95 * len(tick_ms))], 3),
         "ticks": len(tick_ms),
+        # XLA cost cards for the packed serving programs this replay
+        # compiled (telemetry/costcard.py), each next to the MODEL'S
+        # prediction of its transfer bytes (ops/evaluator._packed_layout
+        # for the H2D staging buffer, the packed (B, limit, 2) f32
+        # selection for the D2H) — the one-H2D/one-D2H transport
+        # contract, now checked against the compiler's own
+        # memory_analysis instead of asserted in comments
+        "serving_costcards": _serving_costcards(svc),
         # Per-phase p50 breakdown (VERDICT r3 weak #5): host work vs the
         # device conversation. The pipelined tick (PR 4) splits the old
         # device_call into `dispatch` (pack -> async device call issued)
@@ -422,6 +430,69 @@ def run(
     return results
 
 
+def _serving_costcards(svc) -> list[dict]:
+    """Per-bucket model-vs-measured bytes for the packed serving call.
+
+    Model: the host-side pack layout total (exactly the H2D staging
+    buffer the tick ships per chunk) and the packed selection's D2H
+    size. Measured: the compiled program's memory_analysis argument/
+    output sizes plus its cost_analysis flops / bytes-accessed — read
+    from the cost-card ledger the serving jits populated at first
+    compile. A mismatch on the default path means the single-buffer
+    transport contract drifted from what XLA actually moves. The ml
+    entry's argument size additionally carries params + the embedding
+    table (device-resident by design), so only the default entry gets a
+    byte-for-byte H2D match."""
+    from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS
+    from dragonfly2_tpu.ops import evaluator as ev_ops
+    from dragonfly2_tpu.records.features import CandidateFeatures
+    from dragonfly2_tpu.telemetry import costcard
+
+    costcard.capture_pending()
+    k = svc.config.scheduler.filter_parent_limit
+    limit = svc.config.scheduler.candidate_parent_limit
+    fd = CandidateFeatures.zeros(1, k, svc.state.piece_cost_capacity).as_dict()
+    c = fd["piece_costs"].shape[-1]
+    l = fd["parent_location"].shape[-1]
+    n = fd["numeric"].shape[-1]
+    model_by_arg_bytes = {}
+    for bsz in _EVAL_BUCKETS:
+        _, total = ev_ops._packed_layout(bsz, k, c, l, n)
+        model_by_arg_bytes[total] = {
+            "bucket": bsz,
+            "h2d_bytes": total,
+            "d2h_bytes": 4 * bsz * limit * 2,  # packed f32 (B, limit, 2)
+        }
+    out = []
+    led = costcard.ledger()
+    for entry in ("scheduler.evaluator.schedule_from_packed",
+                  "scheduler.ml.schedule_from_packed"):
+        for card in led.cards(entry):
+            model = model_by_arg_bytes.get(card.argument_bytes)
+            row = {
+                "entry": entry,
+                "signature": card.signature,
+                "measured": {
+                    "flops": card.flops,
+                    "bytes_accessed": card.bytes_accessed,
+                    "argument_bytes": card.argument_bytes,
+                    "output_bytes": card.output_bytes,
+                    "temp_bytes": card.temp_bytes,
+                },
+                "bound": card.bound(),
+            }
+            if model is not None:
+                row["model"] = model
+                row["h2d_model_vs_measured"] = round(
+                    card.argument_bytes / max(model["h2d_bytes"], 1), 4
+                )
+                row["d2h_model_vs_measured"] = round(
+                    card.output_bytes / max(model["d2h_bytes"], 1), 4
+                )
+            out.append(row)
+    return out
+
+
 def _phase_p50(svc, control_ms: list[float] | None = None) -> dict:
     """Per-phase p50s read from the service's own flight recorder
     (telemetry/flight.PhaseRecorder — the same ring that feeds the
@@ -470,6 +541,15 @@ def summarize(results: list[dict]) -> dict:
                         "apply_selection", "report_ingest", "link_rtt_probe"):
                 if key in phases:
                     summary[key] = phases[key]
+            # model-vs-measured transfer bytes for the biggest matched
+            # serving bucket (1.0 = the pack layout IS what XLA moves)
+            matched = [r for r in leg.get("serving_costcards", [])
+                       if "h2d_model_vs_measured" in r]
+            if matched:
+                big = max(matched, key=lambda r: r["model"]["bucket"])
+                summary["serving_h2d_bytes_model_vs_measured"] = (
+                    big["h2d_model_vs_measured"]
+                )
         elif m == "full_loop_ab_piece_cost_ms":
             summary["ab_ml_vs_default_cost"] = leg.get("ml_vs_default")
     if "control_dispatch" in summary and "device_call" in summary:
@@ -501,21 +581,15 @@ def main() -> int:
     summary = summarize(results)
     print(json.dumps(summary))
     if args.artifact:
-        import platform
+        # the shared schema writer (tools/bench_schema.py): one artifact
+        # contract + platform block across every bench driver
+        from tools.bench_schema import write_artifact
 
-        import jax
-
-        with open(args.artifact, "w") as f:
-            json.dump({
-                "cmd": " ".join(["python", "bench_loop.py"] + __import__("sys").argv[1:]),
-                "platform": {
-                    "jax": jax.__version__,
-                    "devices": [str(d) for d in jax.devices()],
-                    "machine": platform.machine(),
-                },
-                "summary": summary,
-                "results": results,
-            }, f, indent=1)
+        write_artifact(
+            args.artifact,
+            ["python", "bench_loop.py"] + __import__("sys").argv[1:],
+            summary, results=results,
+        )
     return 0
 
 

@@ -48,8 +48,27 @@ def summarize(runs: list[dict]) -> dict:
             "fault_families": r["fault_families"],
             "quarantine": r["quarantine"],
             "failover": r["failover"],
+            # timeline-measured scheduler-kill recovery (megascale/soak):
+            # dip + simulated-minutes-to-recover per kill, not an
+            # end-of-run assertion
+            "kill_recovery": _kill_recovery_summary(r.get("recovery", [])),
         }
     return out
+
+
+def _kill_recovery_summary(recovery: list[dict]) -> dict:
+    recovered = [e for e in recovery if e.get("recovered")]
+    minutes = [e["recovery_sim_minutes"] for e in recovered
+               if e.get("recovery_sim_minutes") is not None]
+    return {
+        "kills": len(recovery),
+        "recovered": len(recovered),
+        "max_recovery_sim_minutes": max(minutes) if minutes else None,
+        "min_dip_ratio": min(
+            (e["dip_ratio"] for e in recovery if e.get("dip_ratio") is not None),
+            default=None,
+        ),
+    }
 
 
 def main() -> int:
@@ -103,22 +122,14 @@ def main() -> int:
     summary = summarize(runs)
     print("bench_megascale_summary " + json.dumps(summary))
     if args.artifact:
-        import platform
+        # the shared schema writer (tools/bench_schema.py): one artifact
+        # contract + platform block across every bench driver
+        from tools.bench_schema import write_artifact
 
-        import jax
-
-        with open(args.artifact, "w") as f:
-            json.dump({
-                "cmd": " ".join(["python", "bench_megascale.py"] + sys.argv[1:]),
-                "platform": {
-                    "jax": jax.__version__,
-                    "devices": [str(d) for d in jax.devices()],
-                    "machine": platform.machine(),
-                    "python": platform.python_version(),
-                },
-                "summary": summary,
-                "runs": runs,
-            }, f, indent=1)
+        write_artifact(
+            args.artifact, ["python", "bench_megascale.py"] + sys.argv[1:],
+            summary, runs=runs,
+        )
     return 0
 
 

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import pathlib
 import sys
 import time
 
@@ -82,8 +81,6 @@ def main() -> int:
                         log=lambda line: print(f"# {line}", file=sys.stderr))
     result["bench_wall_s"] = round(time.perf_counter() - t0, 1)
 
-    pathlib.Path(args.out).write_text(json.dumps(result, indent=1, sort_keys=True))
-
     # one JSON line per scenario (driver-friendly), then a compact summary
     for name, s in result["scenarios"].items():
         line = {
@@ -116,6 +113,13 @@ def main() -> int:
         "out": args.out,
         "wall_s": result["bench_wall_s"],
     }
+    # the shared schema writer (tools/bench_schema.py): schema_version +
+    # platform block join the matrix result (benchwatch validates both
+    # this shape and the legacy platform-less one)
+    from tools.bench_schema import write_artifact
+
+    write_artifact(args.out, ["python", "bench_scenarios.py"] + sys.argv[1:],
+                   summary, extra=result)
     print(json.dumps(summary))
     return 0
 

@@ -944,6 +944,16 @@ class SchedulerService:
                     buf, bsz, k, c, l, n, algorithm=algorithm, limit=limit
                 )
             np.asarray(out)  # force the compile + execution to finish
+        # Drain the cost-card captures the bucket compiles just queued
+        # (telemetry/costcard.py): warmup is ALREADY the designed
+        # blocking cold-start phase, so the one-time duplicate compile
+        # per signature lands here — never on a serving tick. On the
+        # D2H_ALLOWLIST (tools/dflint/passes/jit_hygiene.py): a
+        # capture/cost_analysis call in any OTHER hot function fails
+        # JIT003.
+        from dragonfly2_tpu.telemetry import costcard
+
+        costcard.capture_pending()
 
     def tick(self) -> list:
         """Run ONE batched scheduling round over every pending peer.

@@ -143,12 +143,36 @@ class EventBatchEngine(ClusterSimulator):
         from dragonfly2_tpu.telemetry import default_registry
         from dragonfly2_tpu.telemetry.flight import PhaseRecorder
         from dragonfly2_tpu.telemetry.series import megascale_series
+        from dragonfly2_tpu.telemetry.timeline import (
+            QuantileSketch,
+            TimelineRecorder,
+        )
 
         series = megascale_series(default_registry())
         self._piece_event_counter = series.piece_events.labels()
         self.recorder = PhaseRecorder(
             histogram=series.step_phase, maxlen=4096, name="megascale.step"
         )
+        # --- soak timeline (telemetry/timeline.py): one sample per round
+        # off the EVENT clock. Every sampled value is a pure function of
+        # the replay's counters, so paired-seed runs produce identical
+        # timeline arrays (pinned by the megascale determinism test).
+        day = (
+            scenario.traffic.day_rounds
+            if scenario is not None and scenario.traffic.day_rounds > 0
+            else 96
+        )
+        self.minutes_per_round = 24.0 * 60.0 / day
+        self.timeline = TimelineRecorder("megascale.timeline")
+        n_regions = int(self._region_of.max()) + 1 if self._region_of.size else 1
+        # per-region time-to-complete quantile sketches: bounded-error
+        # streaming percentiles ride every sample without retaining
+        # per-download arrays (1% relative accuracy)
+        self._ttc_sketch = [
+            QuantileSketch(relative_accuracy=0.01) for _ in range(n_regions)
+        ]
+        self._tl_prev: dict[str, float] = {}
+        self._crash_rounds: list[int] = []
 
     # ------------------------------------------------------------ columns
 
@@ -236,9 +260,13 @@ class EventBatchEngine(ClusterSimulator):
         recorder = self.recorder
         recorder.begin()
         self._round += 1
+        crashed = False
         if self.engine is not None:
             self._apply_host_churn()
             if self.engine.scheduler_crashed(self._round):
+                crashed = True
+                self._crash_rounds.append(self._round)
+                self.timeline.mark_event(self._round, "scheduler_crash")
                 self._apply_scheduler_crash()
             self._apply_partitions()
         recorder.mark("faults")
@@ -286,8 +314,66 @@ class EventBatchEngine(ClusterSimulator):
         recorder.mark("event_batch")
         self._retire_downloads()
         recorder.mark("retire")
+        self._timeline_sample(crashed)
+        recorder.mark("timeline")
         recorder.commit()
         return responses
+
+    def _timeline_sample(self, crashed: bool) -> None:
+        """One per-round timeline sample off the event clock: interval
+        deltas of the replay counters (pieces, completions, origin/p2p
+        bytes, re-announces, refused registrations), the quarantine
+        population, the process breaker census, and per-region TTC
+        percentiles from the streaming sketches. Deterministic in
+        (spec, seed, replay) — no wall-clock reads."""
+        from dragonfly2_tpu.rpc.resilience import open_breaker_census
+
+        st, mega = self.stats, self.mega
+        cur = {
+            "pieces": float(st.pieces),
+            "completed": float(st.completed),
+            "origin_bytes": float(mega.origin_bytes),
+            "p2p_bytes": float(mega.p2p_bytes),
+            "reannounced": float(st.crash_reannounced_peers),
+            "refused": float(mega.refused_registrations),
+            "corruptions": float(st.injected_corruptions),
+        }
+        prev = self._tl_prev
+        delta = {k: v - prev.get(k, 0.0) for k, v in cur.items()}
+        self._tl_prev = cur
+        bytes_total = delta["origin_bytes"] + delta["p2p_bytes"]
+        sample = {
+            "sim_minutes": round(self._round * self.minutes_per_round, 2),
+            "pieces": int(delta["pieces"]),
+            "completed": int(delta["completed"]),
+            "origin_fraction": (
+                round(delta["origin_bytes"] / bytes_total, 6)
+                if bytes_total > 0 else 0.0
+            ),
+            "quarantine_active": self.scheduler.quarantine.active_count(),
+            "breaker_open": open_breaker_census(),
+            "reannounce_backlog": int(delta["reannounced"]),
+            "refused_registrations": int(delta["refused"]),
+            "corruptions": int(delta["corruptions"]),
+            "scheduler_crash": 1 if crashed else 0,
+            "ttc_ms_p50": {
+                f"region-{r}": (
+                    None if (q := sk.quantile(0.5)) is None else round(q, 2)
+                )
+                for r, sk in enumerate(self._ttc_sketch)
+            },
+        }
+        self.timeline.sample(self._round, sample)
+
+    def _record_ttc(self, reg: int) -> None:
+        """Feed the completing download's virtual time-to-complete into
+        its region's streaming quantile sketch."""
+        host = int(self._col_host[reg])
+        if host < 0:
+            return
+        region = int(self._region_of[host])
+        if region < len(self._ttc_sketch):
+            self._ttc_sketch[region].add(float(self._col_cost_ns[reg]) / 1e6)
 
     # -------------------------------------------------------- event batch
 
@@ -504,6 +590,7 @@ class EventBatchEngine(ClusterSimulator):
         self.mega.refused_registrations += 1
         self._charge_origin_fetch(reg, int(req.content_length))
         self._col_done_round[reg] = self._round
+        self._record_ttc(reg)
         self.stats.completed += 1
         # never registered with the scheduler: nothing to retire, just
         # drop the sim-side identity maps
@@ -517,6 +604,7 @@ class EventBatchEngine(ClusterSimulator):
 
     def _complete(self, peer_id: str, reg: int) -> None:
         self._col_done_round[reg] = self._round
+        self._record_ttc(reg)
         self._retire_later(peer_id)
 
     def _back_to_source(self, peer_id: str) -> None:

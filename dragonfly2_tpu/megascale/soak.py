@@ -102,6 +102,27 @@ def run_megascale(
     wall = time.perf_counter() - t1
 
     st = sim.stats
+    # Scheduler-kill recovery, measured from timeline data rather than
+    # asserted from end aggregates: per kill round, the pieces-per-round
+    # dip and the simulated time until the rate recovers to >=90% of its
+    # pre-kill baseline (telemetry/timeline.recovery_time).
+    from dragonfly2_tpu.telemetry.timeline import recovery_time
+
+    tl = sim.timeline.timeline()
+    recovery = [
+        {
+            "round": r,
+            "sim_minutes": round(r * sim.minutes_per_round, 2),
+            **recovery_time(tl, "pieces", r, baseline_window=8,
+                            threshold=0.9),
+        }
+        for r in sim._crash_rounds
+    ]
+    for entry in recovery:
+        if entry["recovery_intervals"] is not None:
+            entry["recovery_sim_minutes"] = round(
+                entry["recovery_intervals"] * sim.minutes_per_round, 2
+            )
     report = {
         "scenario": scenario,
         "hosts": num_hosts,
@@ -114,6 +135,18 @@ def run_megascale(
         "mega": dataclasses.asdict(sim.mega),
         **sim.region_report(),
         "fault_schedule_digest": sim.fault_schedule_digest(),
+        # the per-round soak timeline (deterministic, event-clocked) +
+        # its annotated fault events and the measured kill recovery
+        "timeline": tl,
+        "timeline_events": list(sim.timeline.events),
+        # pure preview of the kill schedule (scenarios/engine.crash_rounds)
+        # — must equal the rounds the timeline actually marked, or the
+        # engine and the annotation have drifted
+        "expected_crash_rounds": (
+            sim.engine.crash_rounds(rounds) if sim.engine is not None else []
+        ),
+        "minutes_per_round": sim.minutes_per_round,
+        "recovery": recovery,
         "fault_families": {
             # the soak acceptance gate: every family nonzero in one run
             "chaos": st.injected_scheduler_crashes + st.injected_partition_drops,
@@ -140,11 +173,27 @@ def run_megascale(
             "tick_phases_p50_ms": svc.recorder.phase_p50s(),
             "peak_rss_mb": peak_rss_mb(),
         },
+        # compiler-measured cost cards for the serving programs this run
+        # compiled (telemetry/costcard.py; platform-dependent like
+        # `timing`, so deterministic_view strips it)
+        "costcards": _drained_costcards(),
     }
     return report
 
 
+def _drained_costcards() -> dict:
+    """Drain pending cost-card captures and return the ledger dump —
+    the report assembly is the megascale run's off-hot-path drain
+    point (the engine's tick path never compiles cost analyses)."""
+    from dragonfly2_tpu.telemetry import costcard
+
+    costcard.capture_pending()
+    return costcard.ledger().dump()
+
+
 def deterministic_view(report: dict) -> dict:
-    """The report minus wall-clock-dependent fields (same contract as
-    scenarios/ab.deterministic_view)."""
-    return {k: v for k, v in report.items() if k != "timing"}
+    """The report minus wall-clock/platform-dependent fields (same
+    contract as scenarios/ab.deterministic_view). The `timeline` array
+    STAYS — its samples are event-clocked by construction, and the
+    determinism test pinning this view is what keeps them that way."""
+    return {k: v for k, v in report.items() if k not in ("timing", "costcards")}

@@ -527,6 +527,11 @@ from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  #
 schedule_from_packed = _instrument_jit(
     schedule_from_packed, "evaluator.schedule_from_packed", service="scheduler",
     block=False,
+    # costcards: first compile of each bucket signature queues an XLA
+    # cost-card capture (telemetry/costcard.py) drained by warmup /
+    # /debug/flight / the bench report — the measured flops/bytes basis
+    # the perf-observatory verdicts are computed against
+    costcards=True,
 )
 
 

@@ -886,8 +886,14 @@ from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  #
 _ml_schedule_from_packed = _instrument_jit(
     _ml_schedule_from_packed, "ml.schedule_from_packed", service="scheduler",
     block=False,
+    # costcards=True: every SERVING_JIT_REGISTRY entry carries an XLA
+    # cost card per compiled signature (telemetry/costcard.py); the
+    # pending note stores avals only, so it cannot pin a params/table
+    # snapshot, and the capture drains off the hot path
+    costcards=True,
 )
-_gnn_embed = _instrument_jit(_gnn_embed, "ml.embed_hosts", service="scheduler")
+_gnn_embed = _instrument_jit(_gnn_embed, "ml.embed_hosts", service="scheduler",
+                             costcards=True)
 _gnn_embed_subset = _instrument_jit(
-    _gnn_embed_subset, "ml.embed_subset", service="scheduler"
+    _gnn_embed_subset, "ml.embed_subset", service="scheduler", costcards=True
 )

@@ -35,6 +35,7 @@ import contextlib
 import contextvars
 import threading
 import time
+import weakref
 
 from dragonfly2_tpu.utils import dferrors
 
@@ -239,6 +240,15 @@ class BreakerBoard:
         self.metrics = resilience_series(registry or default_registry(), service)
         self._mu = threading.Lock()
         self._breakers: dict[str, CircuitBreaker] = {}
+        _register_board(self)
+
+    def open_count(self) -> int:
+        """Breakers currently NOT closed (open or half-open probing) —
+        the per-board contribution to the process-wide census the soak
+        timeline samples (telemetry/timeline.py)."""
+        with self._mu:
+            breakers = list(self._breakers.values())
+        return sum(1 for b in breakers if b.state != "closed")
 
     def get(self, target: str) -> CircuitBreaker:
         with self._mu:
@@ -294,3 +304,24 @@ class BreakerBoard:
         with self._mu:
             if self._breakers.pop(target, None) is not None:
                 self.metrics.breaker_state.labels(target).set(0.0)
+
+
+# Weak census of live boards (boards stay per-client-object — no failure
+# state is shared through this; it only answers "how many breakers are
+# open anywhere in this process right now" for the soak timeline and the
+# /debug/flight surface).
+_BOARDS: "weakref.WeakSet[BreakerBoard]" = weakref.WeakSet()
+_boards_mu = threading.Lock()
+
+
+def _register_board(board: "BreakerBoard") -> None:
+    with _boards_mu:
+        _BOARDS.add(board)
+
+
+def open_breaker_census() -> int:
+    """Process-wide count of non-closed circuit breakers across every
+    live BreakerBoard."""
+    with _boards_mu:
+        boards = list(_BOARDS)
+    return sum(b.open_count() for b in boards)

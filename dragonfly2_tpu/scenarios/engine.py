@@ -222,6 +222,23 @@ class ScenarioEngine:
         self._record("sched_crash", epoch)
         return True
 
+    def crash_rounds(self, rounds: int) -> list[int]:
+        """Pure PREVIEW of every round ``scheduler_crashed`` will fire on
+        in ``[1, rounds]`` — same (spec, seed, epoch) arithmetic, but
+        WITHOUT recording into the schedule digest, so reports and tests
+        can annotate a soak timeline's kill schedule up front (the
+        megascale engine marks the live events as they land; this is the
+        expected-schedule cross-check)."""
+        control = self.spec.control
+        if control.scheduler_crash_rate <= 0:
+            return []
+        epoch_len = max(control.crash_epoch_rounds, 1)
+        return [
+            r for r in range(epoch_len, rounds + 1, epoch_len)
+            if _u(self.seed, "sched_crash", r // epoch_len)
+            < control.scheduler_crash_rate
+        ]
+
     def scheduler_crash_point(self, task_idx: int, n_pieces: int) -> int | None:
         """Real-socket chaos e2e: the piece count after which the task's
         hashring-primary scheduler is killed, or None when this task's

@@ -17,3 +17,12 @@ from dragonfly2_tpu.telemetry.flight import (  # noqa: F401
     PhaseRecorder,
     instrument_jit,
 )
+from dragonfly2_tpu.telemetry.costcard import (  # noqa: F401
+    CostCard,
+    CostCardLedger,
+)
+from dragonfly2_tpu.telemetry.timeline import (  # noqa: F401
+    QuantileSketch,
+    TimelineRecorder,
+    recovery_time,
+)

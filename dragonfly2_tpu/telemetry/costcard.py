@@ -1,0 +1,314 @@
+"""XLA cost-card ledger: compiler-measured flops/bytes per compiled
+serving/trainer program.
+
+The ROADMAP's perf targets (fused-tick control_dispatch < 2 ms, the GNN
+roofline gap) were argued against HAND-estimated FLOP counts
+(training/train.analytic_gnn_flops_per_sample, gnn_roofline_bound). The
+ledger grounds them in the compiler's own numbers instead: at first
+compile, every registered serving jit (tools/dflint/passes/shape.py
+SERVING_JIT_REGISTRY via the flight-recorder wrappers) and the trainer's
+epoch step capture ``compiled.cost_analysis()`` + ``memory_analysis()``
+into a per-(entry, signature) :class:`CostCard` — flops, bytes accessed,
+peak temp HBM, argument/output bytes — exported as
+``dragonfly_costcard_*`` Prometheus gauges, embedded in bench artifacts
+(bench.py / bench_loop.py / bench_megascale.py), and dumped through the
+``/debug/flight`` surface (telemetry/flight.dump).
+
+Capture discipline — OFF the hot path, machine-checked:
+
+- The flight-recorder :class:`~dragonfly2_tpu.telemetry.flight.JitWrapper`
+  only NOTES a pending capture when it routes a NEW signature (i.e. at
+  first compile); the note stores ``jax.ShapeDtypeStruct`` avals, never
+  live buffers, so a pending note cannot pin a donated staging buffer or
+  an embedding-table snapshot.
+- The actual ``lower().compile().cost_analysis()`` — a full XLA
+  compile, far costlier than a D2H sync — runs only at an explicit
+  drain point: ``SchedulerService.warmup()`` (already the designed
+  blocking cold-start phase), ``train_gnn``'s existing one-shot
+  ``_epoch_flops`` lowering, ``flight.dump()`` (operators pulling
+  ``/debug/flight``), and the bench drivers at report time.
+- dflint's jit-hygiene pass (JIT003) treats ``cost_analysis``/
+  ``memory_analysis``/``capture_pending`` as sync points in serving hot
+  functions: a capture call landing on the tick path fails tier-1
+  unless argued onto the D2H_ALLOWLIST (the warmup drain is).
+
+The tripwire contract: capture goes through the jit's AOT
+``lower(...).compile()`` path with abstract avals — it never CALLS the
+wrapped entry point, so it can add ZERO new compile signatures to the
+retrace tripwire's observed set (tools/dflint/retracer.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from typing import Any, Callable
+
+# TPU v5e per-chip peak / HBM bandwidth — THE roofline platform model
+# (one source of truth: bench.py's PEAK_TFLOPS_BF16 and
+# train.gnn_roofline_bound's defaults both derive from these; verdicts
+# computed from a CostCard use them unless the caller passes its own
+# platform numbers).
+PEAK_FLOPS_BF16 = 197.0e12
+HBM_BYTES_PER_S = 819.0e9
+
+
+@dataclasses.dataclass(frozen=True)
+class CostCard:
+    """One compiled program's compiler-measured cost profile."""
+
+    entry: str            # flight-recorder name, e.g. "scheduler.evaluator.schedule_from_packed"
+    signature: str        # stable short digest of the compile signature
+    signature_repr: str   # human-readable (shapes/dtypes/statics) form
+    flops: float          # XLA cost_analysis "flops" (0.0 when unreported)
+    bytes_accessed: float  # cost_analysis "bytes accessed" (HBM traffic model)
+    transcendentals: float
+    argument_bytes: int   # memory_analysis argument_size_in_bytes
+    output_bytes: int     # memory_analysis output_size_in_bytes
+    temp_bytes: int       # memory_analysis temp_size_in_bytes (peak temp HBM)
+    generated_code_bytes: int
+
+    def arithmetic_intensity(self) -> float:
+        """FLOPs per byte of modeled memory traffic."""
+        return self.flops / max(self.bytes_accessed, 1.0)
+
+    def bound(self, peak_flops: float = PEAK_FLOPS_BF16,
+              hbm_bytes_per_s: float = HBM_BYTES_PER_S) -> str:
+        """"compute" | "memory": which side of the roofline ridge this
+        program's arithmetic intensity falls on."""
+        ridge = peak_flops / hbm_bytes_per_s
+        return "compute" if self.arithmetic_intensity() >= ridge else "memory"
+
+    def mfu_pct(self, device_seconds: float,
+                peak_flops: float = PEAK_FLOPS_BF16) -> float:
+        """Measured-device-time MFU: the card's compiler-counted FLOPs
+        over what the chip could have done in the measured wall."""
+        if device_seconds <= 0:
+            return 0.0
+        return 100.0 * self.flops / (peak_flops * device_seconds)
+
+    def time_lower_bound_s(self, peak_flops: float = PEAK_FLOPS_BF16,
+                           hbm_bytes_per_s: float = HBM_BYTES_PER_S) -> float:
+        """Roofline time floor: max(compute time, memory time)."""
+        return max(self.flops / peak_flops,
+                   self.bytes_accessed / max(hbm_bytes_per_s, 1.0))
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["arithmetic_intensity"] = round(self.arithmetic_intensity(), 3)
+        d["bound"] = self.bound()
+        return d
+
+
+def _sig_repr(value: Any) -> str:
+    """Compact human-readable signature component."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(map(str, shape))}]"
+    if isinstance(value, dict):
+        return "{" + ",".join(
+            f"{k}:{_sig_repr(v)}" for k, v in sorted(value.items())
+        ) + "}"
+    if isinstance(value, (list, tuple)):
+        return "(" + ",".join(_sig_repr(v) for v in value) + ")"
+    return repr(value)
+
+
+def _avals(value: Any):
+    """Replace array leaves with ShapeDtypeStructs so a pending capture
+    retains SHAPES, never data: a donated staging buffer, a params
+    pytree, or an embedding table must not stay alive (or get re-traced
+    as a constant) because a cost capture is queued."""
+    import jax
+
+    def leaf(v):
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return v
+
+    return jax.tree_util.tree_map(leaf, value)
+
+
+@dataclasses.dataclass
+class _Pending:
+    entry: str
+    signature: str
+    signature_repr: str
+    lower: Callable          # the jit's AOT .lower (never __call__)
+    args: tuple
+    kwargs: dict
+
+
+class CostCardLedger:
+    """Process-wide per-(entry, signature) card store + capture queue."""
+
+    def __init__(self, registry=None):
+        self._mu = threading.Lock()
+        self._cards: dict[tuple[str, str], CostCard] = {}
+        self._pending: dict[tuple[str, str], _Pending] = {}
+        self._capture_errors: dict[tuple[str, str], str] = {}
+        self._registry = registry
+
+    # -------------------------------------------------------- producers
+
+    def note_pending(self, entry: str, lower: Callable, args: tuple,
+                     kwargs: dict, signature_repr: str | None = None) -> None:
+        """Queue a capture for a newly-compiled signature (called by the
+        flight-recorder wrapper at first compile). Cheap: one tree_map
+        to avals + a dict insert; the compile-heavy part waits for
+        :meth:`capture_pending`."""
+        try:
+            aval_args = _avals(args)
+            aval_kwargs = _avals(kwargs)
+        except Exception:  # noqa: BLE001 - telemetry must not break calls
+            return
+        # kwargs participate with their VALUES: two compiles differing
+        # only in a static kwarg (algorithm="default" vs "nt" at the
+        # same shapes) are distinct programs and must keep distinct
+        # cards (_sig_repr sorts dict items, so ordering is canonical)
+        rep = signature_repr or _sig_repr((args, dict(kwargs)))
+        sig = hashlib.blake2b(rep.encode(), digest_size=6).hexdigest()
+        key = (entry, sig)
+        with self._mu:
+            if key in self._cards:
+                return
+            self._pending[key] = _Pending(
+                entry, sig, rep, lower, aval_args, aval_kwargs
+            )
+
+    def capture_pending(self) -> list[CostCard]:
+        """Drain the queue: lower+compile each pending signature from its
+        avals and register the card. The ONE place the ledger pays an
+        XLA compile — callers are warmup / dump / bench report code, all
+        off the serving hot path (enforced by dflint JIT003)."""
+        with self._mu:
+            todo = list(self._pending.values())
+            self._pending.clear()
+        out = []
+        for p in todo:
+            try:
+                compiled = p.lower(*p.args, **p.kwargs).compile()
+                card = self.register_compiled(
+                    p.entry, compiled, signature_repr=p.signature_repr
+                )
+                out.append(card)
+            except Exception as e:  # noqa: BLE001 - a backend without AOT
+                # cost analysis must not fail warmup/dump; the miss is
+                # recorded so dumps show WHY a card is absent
+                with self._mu:
+                    self._capture_errors[(p.entry, p.signature)] = (
+                        f"{type(e).__name__}: {e}"
+                    )
+        return out
+
+    def register_compiled(self, entry: str, compiled,
+                          signature_repr: str = "") -> CostCard:
+        """Build + register a card from an already-compiled executable
+        (the trainer path: train.py lowers the epoch program once for
+        its FLOP accounting and hands the same executable here, so the
+        ledger costs it zero extra compiles)."""
+        analysis: dict = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            analysis = dict(ca or {})
+        except Exception:  # noqa: BLE001 - some backends report nothing
+            pass
+        arg_b = out_b = temp_b = code_b = 0
+        try:
+            ma = compiled.memory_analysis()
+            arg_b = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            out_b = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+            temp_b = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            code_b = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        except Exception:  # noqa: BLE001
+            pass
+        rep = signature_repr
+        sig = hashlib.blake2b(rep.encode(), digest_size=6).hexdigest()
+        card = CostCard(
+            entry=entry,
+            signature=sig,
+            signature_repr=rep,
+            flops=float(analysis.get("flops", 0.0) or 0.0),
+            bytes_accessed=float(analysis.get("bytes accessed", 0.0) or 0.0),
+            transcendentals=float(analysis.get("transcendentals", 0.0) or 0.0),
+            argument_bytes=arg_b,
+            output_bytes=out_b,
+            temp_bytes=temp_b,
+            generated_code_bytes=code_b,
+        )
+        with self._mu:
+            self._cards[(entry, sig)] = card
+            self._capture_errors.pop((entry, sig), None)
+        self._export(card)
+        return card
+
+    def _export(self, card: CostCard) -> None:
+        from dragonfly2_tpu.telemetry import metrics as _metrics
+        from dragonfly2_tpu.telemetry.series import costcard_series
+
+        reg = self._registry or _metrics.default_registry()
+        s = costcard_series(reg)
+        labels = (card.entry, card.signature)
+        s.flops.labels(*labels).set(card.flops)
+        s.bytes_accessed.labels(*labels).set(card.bytes_accessed)
+        s.output_bytes.labels(*labels).set(card.output_bytes)
+        s.temp_bytes.labels(*labels).set(card.temp_bytes)
+        s.captures.labels().inc()
+
+    # --------------------------------------------------------- consumers
+
+    def cards(self, entry: str | None = None) -> list[CostCard]:
+        with self._mu:
+            return [
+                c for (e, _), c in sorted(self._cards.items())
+                if entry is None or e == entry
+            ]
+
+    def card(self, entry: str, signature: str) -> CostCard | None:
+        with self._mu:
+            return self._cards.get((entry, signature))
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._pending)
+
+    def dump(self) -> dict:
+        """Plain-data snapshot for /debug/flight + bench artifacts."""
+        with self._mu:
+            cards = sorted(self._cards.values(),
+                           key=lambda c: (c.entry, c.signature))
+            errors = dict(self._capture_errors)
+            pending = len(self._pending)
+        return {
+            "cards": [c.as_dict() for c in cards],
+            "pending": pending,
+            "capture_errors": {
+                f"{e}@{s}": msg for (e, s), msg in sorted(errors.items())
+            },
+        }
+
+    def reset(self) -> None:
+        """Test hook: forget every card and pending note."""
+        with self._mu:
+            self._cards.clear()
+            self._pending.clear()
+            self._capture_errors.clear()
+
+
+_LEDGER = CostCardLedger()
+
+
+def ledger() -> CostCardLedger:
+    return _LEDGER
+
+
+def capture_pending() -> list[CostCard]:
+    """Module-level drain (the name dflint's JIT003 hot-path check knows:
+    a call to this from a serving hot function must be allowlisted)."""
+    return _LEDGER.capture_pending()

@@ -239,11 +239,20 @@ class JitWrapper:
     `._cache_size()` callers keep working."""
 
     def __init__(self, fn, name: str, service: str = "scheduler",
-                 registry=None, block: bool = True):
+                 registry=None, block: bool = True, costcards: bool = False):
         self.__wrapped__ = fn
         self.name = name
         self.service = service
         self._block = block
+        # cost-card capture at first compile (telemetry/costcard.py): a
+        # NEW signature queues a pending capture (avals only, no live
+        # buffers); the compile-heavy cost_analysis materializes at the
+        # next off-hot-path drain (warmup / flight dump / bench report).
+        # Opt-in per wrapper: safe only where .lower() is available and
+        # the entry's cost profile is worth a one-time duplicate compile
+        # (the serving jits; the trainer registers its card directly
+        # from the epoch lowering it already pays for).
+        self._costcards = costcards
         self._seen: set = set()
         self._mu = threading.Lock()
         reg = registry if registry is not None else _metrics.default_registry()
@@ -279,7 +288,27 @@ class JitWrapper:
         if new:
             self._retraces.inc()
             self._cache.set(self.cache_entries())
+            if self._costcards:
+                self._note_costcard(args, kwargs)
         return out
+
+    def _note_costcard(self, args, kwargs) -> None:
+        """Queue a cost-card capture for this first-compile signature.
+        Goes through the jit's AOT ``.lower`` (attribute-forwarded to
+        the wrapped fn), NEVER ``__call__`` — so the eventual capture
+        compiles the same program the call just did without routing a
+        new signature past the retrace tripwire."""
+        lower = getattr(self.__wrapped__, "lower", None)
+        if lower is None:
+            return
+        try:
+            from dragonfly2_tpu.telemetry import costcard
+
+            costcard.ledger().note_pending(
+                f"{self.service}.{self.name}", lower, args, kwargs
+            )
+        except Exception:  # noqa: BLE001 - telemetry must not break calls
+            pass
 
     def __getattr__(self, item: str):
         return getattr(self.__wrapped__, item)
@@ -302,11 +331,15 @@ class JitWrapper:
 
 
 def instrument_jit(fn, name: str, service: str = "scheduler",
-                   registry=None, block: bool = True) -> JitWrapper:
+                   registry=None, block: bool = True,
+                   costcards: bool = False) -> JitWrapper:
     """Wrap a jitted entry point with compile/retrace counters and the
     dispatch/device time split. Families land in `registry` (default:
-    the process default registry) under dragonfly_<service>_jit_*."""
-    return JitWrapper(fn, name, service=service, registry=registry, block=block)
+    the process default registry) under dragonfly_<service>_jit_*.
+    `costcards=True` additionally queues an XLA cost-card capture per
+    first-compile signature (telemetry/costcard.py)."""
+    return JitWrapper(fn, name, service=service, registry=registry,
+                      block=block, costcards=costcards)
 
 
 def jit_wrappers() -> dict[str, JitWrapper]:
@@ -367,9 +400,24 @@ def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
             spans.append(_span_summary(span))
         except RuntimeError:
             continue  # owner thread mutated attributes mid-copy; skip it
+    # Perf-observatory surfaces (additive keys — older consumers index
+    # only ticks/jit/active_spans): the cost-card ledger and any live
+    # soak timelines. A dump is an operator pulling /debug/flight — an
+    # explicitly off-hot-path moment, so it doubles as a cost-card
+    # capture drain (first compile queued the note; the compile-heavy
+    # cost_analysis lands here, in warmup, or at bench report time).
+    from dragonfly2_tpu.telemetry import costcard as _costcard
+    from dragonfly2_tpu.telemetry import timeline as _timeline
+
+    _costcard.ledger().capture_pending()
     return {
         "generated_at_ns": time.time_ns(),
         "ticks": ticks,
         "jit": {name: w.stats() for name, w in sorted(jit_wrappers().items())},
         "active_spans": spans,
+        "costcards": _costcard.ledger().dump(),
+        "timelines": {
+            name: rec.dump()
+            for name, rec in sorted(_timeline.live_timelines().items())
+        },
     }

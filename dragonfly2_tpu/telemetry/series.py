@@ -334,5 +334,63 @@ def jit_series(reg, service: str) -> _Namespace:
     )
 
 
+def costcard_series(reg) -> _Namespace:
+    """XLA cost-card ledger families (telemetry/costcard.py): per
+    (entry, signature) compiler-measured cost gauges captured at first
+    compile of every registered serving jit and the trainer epoch step —
+    the measured basis bench MFU/roofline verdicts are computed against
+    (hand-rolled FLOP estimates are demoted to cross-checks)."""
+    labels = ("entry", "signature")
+    return _Namespace(
+        flops=reg.gauge(
+            "dragonfly_costcard_flops",
+            "XLA cost_analysis FLOPs of one compiled program signature",
+            labels,
+        ),
+        bytes_accessed=reg.gauge(
+            "dragonfly_costcard_bytes_accessed",
+            "XLA cost_analysis modeled memory traffic (bytes) of one "
+            "compiled program signature",
+            labels,
+        ),
+        output_bytes=reg.gauge(
+            "dragonfly_costcard_output_bytes",
+            "XLA memory_analysis output buffer bytes of one compiled "
+            "program signature",
+            labels,
+        ),
+        temp_bytes=reg.gauge(
+            "dragonfly_costcard_temp_bytes",
+            "XLA memory_analysis peak temporary (scratch HBM) bytes of "
+            "one compiled program signature",
+            labels,
+        ),
+        captures=reg.counter(
+            "dragonfly_costcard_captures_total",
+            "cost cards captured (one per new (entry, signature) pair)",
+        ),
+    )
+
+
+def timeline_series(reg) -> _Namespace:
+    """Soak-timeline families (telemetry/timeline.py): the latest sample
+    of every per-interval series a TimelineRecorder tracks (pieces per
+    interval, origin fraction, quarantine population, breaker-open
+    count, re-announce backlog, per-region TTC quantiles), labeled by
+    recorder source — the live-scrape mirror of the deterministic
+    ``timeline`` array in BENCH_mega artifacts."""
+    return _Namespace(
+        value=reg.gauge(
+            "dragonfly_timeline_value",
+            "latest per-simulated-interval sample of a timeline series",
+            ("source", "metric"),
+        ),
+        samples=reg.counter(
+            "dragonfly_timeline_samples_total",
+            "timeline samples recorded", ("source",),
+        ),
+    )
+
+
 def register_version(reg, service: str) -> None:
     _version.register_version_gauge(reg, service)

@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dragonfly2_tpu.utils.jaxcompat import shard_map
 
 from dragonfly2_tpu.config.config import TrainerConfig
+from dragonfly2_tpu.telemetry import costcard as _costcard
 from dragonfly2_tpu.models.graphsage import GraphSAGERanker, RankBatch, listwise_rank_loss
 from dragonfly2_tpu.models.mlp import ProbeRTTRegressor
 from dragonfly2_tpu.models import metrics as M
@@ -143,8 +144,10 @@ def gnn_roofline_bound(
     pair_feat_dim: int,
     num_layers: int = 2,
     dense_adj: bool = True,
-    peak_flops: float = 197.0e12,   # TPU v5e bf16 per chip
-    hbm_bytes_per_s: float = 819.0e9,  # TPU v5e HBM bandwidth
+    # the shared roofline platform model (telemetry/costcard.py) — one
+    # source of truth with bench.py and the cost-card verdicts
+    peak_flops: float = _costcard.PEAK_FLOPS_BF16,
+    hbm_bytes_per_s: float = _costcard.HBM_BYTES_PER_S,
     compute_bytes: int = 2,         # bf16 activations/weights
 ) -> dict:
     """Per-train-step roofline for the GraphSAGERanker: which stages are
@@ -301,13 +304,35 @@ def analytic_attention_flops_per_sample(
 
 def _epoch_flops(jitted, *args) -> float:
     """Total FLOPs of one compiled epoch call per XLA's cost analysis;
-    the lowering is cached, so the real epoch call pays no extra compile."""
+    the lowering is cached, so the real epoch call pays no extra compile.
+    The SAME compiled executable also lands in the cost-card ledger
+    (telemetry/costcard.py) — the trainer step's per-(entry, signature)
+    CostCard costs zero extra compiles because this one-shot lowering
+    already exists for the FLOP accounting."""
     try:
-        analysis = jitted.lower(*args).compile().cost_analysis()
+        compiled = jitted.lower(*args).compile()
+    except Exception:  # noqa: BLE001 - metrics must never break training
+        return 0.0
+    try:
+        from dragonfly2_tpu.telemetry import costcard
+
+        entry = (
+            f"{jitted.service}.{jitted.name}"
+            if hasattr(jitted, "service") and hasattr(jitted, "name")
+            else "trainer.epoch"
+        )
+        card = costcard.ledger().register_compiled(
+            entry, compiled, signature_repr=costcard._sig_repr(args)
+        )
+        return card.flops
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        analysis = compiled.cost_analysis()
         if isinstance(analysis, list):
             analysis = analysis[0] if analysis else {}
         return float(analysis.get("flops", 0.0) or 0.0)
-    except Exception:  # noqa: BLE001 - metrics must never break training
+    except Exception:  # noqa: BLE001
         return 0.0
 
 

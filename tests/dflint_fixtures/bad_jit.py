@@ -1,8 +1,10 @@
 """dflint red fixture: one finding per jit-hygiene rule.
 
 JIT001 x2 (``.item()`` + ``float(tracer)``), JIT002 (``if`` on a
-tracer), JIT003 (un-allowlisted host sync in a hot function — the test
-configures ``hot_tick`` as hot), JIT004 (dynamic slice into a jit call).
+tracer), JIT003 x2 (un-allowlisted host sync + a cost-card
+``cost_analysis`` capture in a hot function — the test configures
+``hot_tick`` as hot; a capture pays a full XLA recompile, so the tick
+path may never run one), JIT004 (dynamic slice into a jit call).
 """
 
 import functools
@@ -20,8 +22,9 @@ def score(batch, limit):
     return batch * peak
 
 
-def hot_tick(packed):
+def hot_tick(packed, compiled):
     out = np.asarray(packed)  # <- JIT003 (not on the d2h allowlist)
+    compiled.cost_analysis()  # <- JIT003 (cost-card capture on the hot path)
     return out
 
 

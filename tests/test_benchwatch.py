@@ -1,0 +1,234 @@
+"""Perf observatory — bench registry gate (tools/benchwatch.py).
+
+Tier-1 half: every checked-in BENCH_*.json (all four artifact kinds plus
+the normalized trajectory) must parse against its schema and the
+repo-root --check gate must be green. Unit half: regression flagging is
+strict about comparability (same kind + fingerprint, strictly adjacent
+rounds, quarantined values never anchor a verdict) and an injected >10%
+regression exits nonzero."""
+
+import io
+import json
+from pathlib import Path
+
+import pytest
+
+from tools import benchwatch
+from tools.benchwatch import (
+    SchemaError,
+    check,
+    detect_kind,
+    find_regressions,
+    load_entries,
+    lower_is_better,
+    normalize,
+    validate,
+)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------- tier-1 gate
+
+
+def test_every_checked_in_artifact_parses_against_the_schema():
+    files = benchwatch.artifact_files(ROOT)
+    assert len(files) >= 8, files  # r01..r06 + mega + scenarios
+    entries, errors = load_entries(files)
+    assert errors == []
+    assert len(entries) == len(files)
+    kinds = {e["kind"] for e in entries}
+    assert {"driver", "loop", "mega", "scenarios"} <= kinds
+
+
+def test_checked_in_trajectory_validates():
+    errors = benchwatch.validate_trajectory_file(ROOT)
+    assert errors == []
+    doc = json.loads((ROOT / benchwatch.TRAJECTORY_FILE).read_text())
+    assert doc["schema_version"] == benchwatch.TRAJECTORY_SCHEMA_VERSION
+    assert len(doc["entries"]) >= 8
+
+
+def test_repo_root_check_gate_is_green():
+    out = io.StringIO()
+    assert check(ROOT, out=out) == 0, out.getvalue()
+
+
+# ------------------------------------------------------------ regression
+
+
+def _loop_artifact(pieces_per_sec=20_000.0, tick_p50=7.0, machine="x86_64"):
+    return {
+        "schema_version": 2,
+        "cmd": "python bench_loop.py",
+        "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                     "machine": machine, "python": "3.10"},
+        "summary": {"metric": "bench_loop_summary",
+                    "pieces_per_sec": pieces_per_sec,
+                    "tick_p50_ms": tick_p50},
+        "results": [{"metric": "full_loop_pieces_per_sec",
+                     "value": pieces_per_sec}],
+    }
+
+
+def _write(tmp_path, name, doc):
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_injected_regression_exits_nonzero(tmp_path):
+    """The acceptance gate: a crafted trajectory with a >10% drop in a
+    higher-is-better metric between adjacent rounds fails --check."""
+    _write(tmp_path, "BENCH_r01.json", _loop_artifact(20_000.0))
+    _write(tmp_path, "BENCH_r02.json", _loop_artifact(15_000.0))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION pieces_per_sec" in out.getvalue()
+
+
+def test_lower_is_better_regression_direction(tmp_path):
+    # pieces/s improves but tick p50 regresses 7 -> 12 ms
+    _write(tmp_path, "BENCH_r01.json", _loop_artifact(20_000.0, tick_p50=7.0))
+    _write(tmp_path, "BENCH_r02.json", _loop_artifact(25_000.0, tick_p50=12.0))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION tick_p50_ms" in out.getvalue()
+    assert "pieces_per_sec" not in out.getvalue().split("REGRESSION", 1)[1]
+
+
+def test_within_threshold_changes_pass(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _loop_artifact(20_000.0))
+    _write(tmp_path, "BENCH_r02.json", _loop_artifact(18_500.0))  # -7.5%
+    assert check(tmp_path, out=io.StringIO()) == 0
+
+
+def test_broken_round_chain_never_compares_across_the_gap(tmp_path):
+    """r03 vs r01 with r02 missing: no comparison — a missing or
+    corrupt intermediate round breaks the chain instead of silently
+    comparing across it (the BENCH_r04-is-truncated reality)."""
+    _write(tmp_path, "BENCH_r01.json", _loop_artifact(20_000.0))
+    _write(tmp_path, "BENCH_r03.json", _loop_artifact(5_000.0))
+    assert check(tmp_path, out=io.StringIO()) == 0
+
+
+def test_platform_fingerprint_gates_comparability(tmp_path):
+    """A rig move is not a regression: different machine fingerprints
+    never compare."""
+    _write(tmp_path, "BENCH_r01.json", _loop_artifact(20_000.0, machine="tpu-vm"))
+    _write(tmp_path, "BENCH_r02.json", _loop_artifact(5_000.0, machine="x86_64"))
+    assert check(tmp_path, out=io.StringIO()) == 0
+
+
+def test_quarantined_values_anchor_no_verdict(tmp_path):
+    """Physically invalid values (MFU > 100%, clamp-floor latencies) stay
+    visible in the trajectory but are excluded from comparison — the
+    BENCH_r03 corrupt-timing artifact must not make r04 look like a
+    10x regression."""
+    driver = {
+        "n": 3, "cmd": "python bench.py", "rc": 0, "tail": "...",
+        "parsed": {"metric": "m", "value": 0.01, "unit": "ms",
+                   "method": "pipelined_steady_state",
+                   "gnn_mfu_pct": 156.0},
+    }
+    entry = normalize(driver, "driver", "BENCH_r03.json")
+    assert "headline_p50_ms" not in entry["metrics"]
+    assert "gnn_mfu_pct" not in entry["metrics"]
+    assert set(entry["quarantined_metrics"]) == {
+        "headline_p50_ms", "gnn_mfu_pct"
+    }
+    honest = {
+        "n": 4, "cmd": "python bench.py", "rc": 0, "tail": "...",
+        "parsed": {"metric": "m", "value": 0.09, "unit": "ms",
+                   "method": "pipelined_steady_state",
+                   "gnn_mfu_pct": 24.6},
+    }
+    entry4 = normalize(honest, "driver", "BENCH_r04.json")
+    assert entry4["metrics"]["headline_p50_ms"] == 0.09
+    assert find_regressions([entry, entry4], threshold=0.10) == []
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_schema_errors_fail_the_gate(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    _write(tmp_path, "BENCH_r02.json", {"results": [], "summary": {}})  # no cmd
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    text = out.getvalue()
+    assert text.count("SCHEMA") == 2, text
+
+
+def test_detect_kind_and_validate_contracts():
+    assert detect_kind({"cmd": "", "rc": 0, "tail": "", "n": 1}, "x") == "driver"
+    assert detect_kind({"cmd": "", "platform": {}, "summary": {},
+                        "runs": []}, "x") == "mega"
+    assert detect_kind({"cmd": "", "platform": {}, "summary": {},
+                        "results": []}, "x") == "loop"
+    assert detect_kind({"scenarios": {}}, "x") == "scenarios"
+    with pytest.raises(SchemaError):
+        detect_kind({"what": 1}, "x")
+    with pytest.raises(SchemaError):
+        validate({"cmd": "", "rc": 0, "tail": "", "parsed": {"metric": "m"}},
+                 "driver", "x")  # parsed without value
+    # driver with parsed == null (the r04 truncation) is LEGAL
+    validate({"cmd": "", "rc": 1, "tail": "", "parsed": None}, "driver", "x")
+
+
+def test_direction_table():
+    assert lower_is_better("tick_p50_ms")
+    assert lower_is_better("headline_p50_ms")
+    assert lower_is_better("soak_100000_origin_traffic_fraction")
+    assert lower_is_better("control_dispatch")
+    assert not lower_is_better("pieces_per_sec")
+    assert not lower_is_better("gnn_mfu_pct")
+    assert not lower_is_better("ab_ml_vs_default_cost")
+
+
+def test_bench_py_artifact_kind_round_trips_the_gate(tmp_path):
+    """`python bench.py --artifact` writes {schema_version, cmd,
+    platform, summary, record}: the `bench` kind must validate,
+    normalize with the driver-record extraction (incl. quarantine
+    rules), and pass --check — a freshly produced artifact failing the
+    gate it feeds would be a workflow break."""
+    doc = {
+        "schema_version": 2,
+        "cmd": "python bench.py --artifact BENCH_r07.json",
+        "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                     "machine": "x86_64", "python": "3.10"},
+        "summary": {"metric": "scheduler_parent_selection_p50_ms_1024x64",
+                    "value": 0.08, "gnn_mfu_pct": 30.0},
+        "record": {"metric": "scheduler_parent_selection_p50_ms_1024x64",
+                   "value": 0.08, "unit": "ms", "method": "control_gated_p50",
+                   "trainer": {"gnn_mfu_pct": 30.0}},
+    }
+    assert detect_kind(doc, "BENCH_r07.json") == "bench"
+    validate(doc, "bench", "BENCH_r07.json")
+    entry = normalize(doc, "bench", "BENCH_r07.json")
+    assert entry["metrics"]["headline_p50_ms"] == 0.08
+    assert entry["metrics"]["gnn_mfu_pct"] == 30.0
+    _write(tmp_path, "BENCH_r07.json", doc)
+    assert check(tmp_path, out=io.StringIO()) == 0
+
+
+def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
+    """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
+    direction — they stay out of the normalized metrics entirely."""
+    art = _loop_artifact(20_000.0)
+    art["summary"]["serving_h2d_bytes_model_vs_measured"] = 1.0
+    entry = normalize(art, "loop", "BENCH_r01.json")
+    assert "serving_h2d_bytes_model_vs_measured" not in entry["metrics"]
+
+
+def test_new_writer_output_is_schema_valid(tmp_path):
+    """tools/bench_schema.write_artifact output round-trips the gate."""
+    from tools.bench_schema import SCHEMA_VERSION, write_artifact
+
+    body = write_artifact(
+        tmp_path / "BENCH_r09.json", ["python", "bench_loop.py"],
+        {"metric": "bench_loop_summary", "pieces_per_sec": 1.0},
+        results=[{"metric": "full_loop_pieces_per_sec", "value": 1.0}],
+    )
+    assert body["schema_version"] == SCHEMA_VERSION
+    entries, errors = load_entries([tmp_path / "BENCH_r09.json"])
+    assert errors == [] and entries[0]["kind"] == "loop"
+    assert entries[0]["schema_version"] == SCHEMA_VERSION

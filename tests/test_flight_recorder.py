@@ -14,12 +14,16 @@ from dragonfly2_tpu.cluster.scheduler import SchedulerService
 from dragonfly2_tpu.telemetry import metrics as m
 from dragonfly2_tpu.telemetry.flight import PhaseRecorder, instrument_jit
 from dragonfly2_tpu.telemetry.series import (
+    costcard_series,
     daemon_series,
     jit_series,
     manager_series,
+    megascale_series,
     register_version,
     resilience_series,
     scheduler_series,
+    serving_series,
+    timeline_series,
     trainer_series,
 )
 from dragonfly2_tpu.telemetry.tracing import Tracer
@@ -212,12 +216,19 @@ def test_metric_naming_convention_registry_walk():
     trainer_series(reg)
     jit_series(reg, "scheduler")
     jit_series(reg, "trainer")
+    # perf-observatory + lab families ride the same sweep: cost cards,
+    # soak timelines, serving activation gate, megascale engine
+    costcard_series(reg)
+    timeline_series(reg)
+    serving_series(reg)
+    megascale_series(reg)
     for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
         register_version(reg, svc)
         resilience_series(reg, svc)  # breaker-state + deadline families
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
-        r"^dragonfly_(scheduler|dfdaemon|manager|trainer)_[a-z0-9_]+$"
+        r"^dragonfly_(scheduler|dfdaemon|manager|trainer|costcard|timeline"
+        r"|serving|megascale)_[a-z0-9_]+$"
     )
     assert reg._metrics, "registry walk found nothing"
     for name, metric in reg._metrics.items():

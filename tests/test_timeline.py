@@ -1,0 +1,154 @@
+"""Perf observatory — soak timelines (telemetry/timeline.py).
+
+Pins the three measurement primitives the megascale soak assertions rest
+on: the quantile sketch's PROVABLE relative-error bound, the timeline
+recorder's plain-data ring + gauge mirror, and the recovery_time
+measurement (dip + intervals-to-recover) the soak test anchors on the
+scheduler-kill rounds."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.telemetry.timeline import (
+    QuantileSketch,
+    TimelineRecorder,
+    live_timelines,
+    recovery_time,
+)
+
+
+# -------------------------------------------------------- quantile sketch
+
+
+def _exact_quantile(sorted_vals: np.ndarray, q: float) -> float:
+    # the sketch's rank convention: value at rank q * (n - 1)
+    return float(sorted_vals[int(q * (len(sorted_vals) - 1))])
+
+
+@pytest.mark.parametrize("alpha", [0.01, 0.05])
+def test_sketch_relative_error_bound(alpha):
+    """THE bound: for every queried quantile, the sketch's answer is
+    within alpha relative error of the exact empirical quantile — the
+    DDSketch log-bucket guarantee, tested against lognormal data whose
+    tail spans four orders of magnitude (the TTC-like shape)."""
+    rng = np.random.default_rng(7)
+    values = np.exp(rng.normal(loc=3.0, scale=1.5, size=20_000))
+    sketch = QuantileSketch(relative_accuracy=alpha)
+    sketch.extend(values)
+    hi = np.sort(values)
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 0.999):
+        exact = _exact_quantile(hi, q)
+        got = sketch.quantile(q)
+        assert got is not None
+        rel = abs(got - exact) / exact
+        assert rel <= alpha + 1e-9, (q, exact, got, rel)
+
+
+def test_sketch_edge_cases_and_zero_bucket():
+    s = QuantileSketch()
+    assert s.quantile(0.5) is None
+    s.add(0.0)
+    s.add(-5.0)   # non-positive values collapse to the zero bucket
+    s.add(100.0)
+    assert s.count == 3
+    assert s.quantile(0.0) == 0.0
+    assert s.quantile(1.0) == pytest.approx(100.0, rel=0.011)
+    with pytest.raises(ValueError):
+        s.quantile(1.5)
+    with pytest.raises(ValueError):
+        QuantileSketch(relative_accuracy=0.0)
+
+
+def test_sketch_bucket_collapse_keeps_tail_accuracy():
+    """Memory stays bounded: beyond max_buckets the LOWEST buckets
+    collapse into zero, so tail quantiles keep their bound."""
+    s = QuantileSketch(relative_accuracy=0.01, max_buckets=64)
+    rng = np.random.default_rng(3)
+    values = np.exp(rng.uniform(-10, 10, size=5000))  # huge dynamic range
+    s.extend(values)
+    assert len(s._buckets) <= 64
+    exact = _exact_quantile(np.sort(values), 0.99)
+    assert s.quantile(0.99) == pytest.approx(exact, rel=0.011)
+
+
+def test_sketch_determinism():
+    def build():
+        s = QuantileSketch()
+        for i in range(1000):
+            s.add((i * 37 % 997) + 0.5)
+        return s.to_dict()
+
+    assert build() == build()
+
+
+# ------------------------------------------------------ timeline recorder
+
+
+def test_recorder_ring_gauges_and_registry():
+    from dragonfly2_tpu.telemetry.metrics import Registry
+
+    reg = Registry()
+    rec = TimelineRecorder("test.timeline", maxlen=4, registry=reg)
+    for t in range(6):
+        rec.sample(t, {"pieces": t * 10, "origin_fraction": 0.1,
+                       "nested": {"a": 1}})
+    rec.mark_event(3, "scheduler_crash")
+    tl = rec.timeline()
+    assert len(tl) == 4  # bounded ring
+    assert tl[-1] == {"t": 5, "pieces": 50, "origin_fraction": 0.1,
+                      "nested": {"a": 1}}
+    dump = rec.dump()
+    assert dump["events"] == [{"t": 3, "event": "scheduler_crash"}]
+    text = reg.expose()
+    # scalars mirror into the gauge; nested dicts ride the ring only
+    assert ('dragonfly_timeline_value{source="test.timeline",'
+            'metric="pieces"} 50.0') in text
+    assert 'metric="nested"' not in text
+    assert ('dragonfly_timeline_samples_total{source="test.timeline"} 6.0'
+            in text)
+    # the weak named registry serves the /debug/flight surface
+    assert live_timelines().get("test.timeline") is rec
+
+
+# -------------------------------------------------------- recovery_time
+
+
+def _tl(values, start=0):
+    return [{"t": start + i, "pieces": v} for i, v in enumerate(values)]
+
+
+def test_recovery_time_measures_dip_and_recovery():
+    # baseline 100, kill at t=8 dips to 40, recovers (>= 90) at t=11
+    tl = _tl([100] * 8 + [40, 60, 80, 95, 100])
+    r = recovery_time(tl, "pieces", event_t=8, baseline_window=4,
+                      threshold=0.9)
+    assert r["baseline"] == 100.0
+    assert r["dip"] == 40
+    assert r["dip_ratio"] == 0.4
+    assert r["recovered"] and r["recovery_t"] == 11
+    assert r["recovery_intervals"] == 3
+
+
+def test_recovery_time_never_recovers_within_horizon():
+    tl = _tl([100] * 8 + [40] * 10)
+    r = recovery_time(tl, "pieces", event_t=8, baseline_window=4,
+                      threshold=0.9, horizon=6)
+    assert not r["recovered"]
+    assert r["recovery_t"] is None and r["recovery_intervals"] is None
+    assert r["dip"] == 40
+
+
+def test_recovery_time_instant_recovery_and_empty_edges():
+    # value never drops below threshold: recovery at the event sample
+    tl = _tl([100] * 12)
+    r = recovery_time(tl, "pieces", event_t=6, baseline_window=4)
+    assert r["recovered"] and r["recovery_intervals"] == 0
+    # no pre-event samples -> unmeasurable, not a crash
+    r2 = recovery_time(tl, "pieces", event_t=0, baseline_window=4)
+    assert r2["baseline"] is None and not r2["recovered"]
+    # dip only counts until recovery: a later trough (next fault) is
+    # not THIS event's dip
+    tl3 = _tl([100] * 8 + [95, 100, 0, 0])
+    r3 = recovery_time(tl3, "pieces", event_t=8, baseline_window=4)
+    assert r3["recovered"] and r3["recovery_t"] == 8
+    assert r3["dip"] == 95

@@ -1,0 +1,69 @@
+"""One bench-artifact writer for every driver — schema_version + a shared
+platform block.
+
+Before this module each bench driver hand-rolled its own artifact dict
+(bench_loop/bench_megascale built near-identical ``{"cmd", "platform",
+...}`` bodies inline; bench.py printed JSON without ever writing a file;
+bench_scenarios wrote a third shape with no platform block at all), so
+the artifact contract lived in three copies that had already drifted
+(only bench_megascale recorded the python version). ``write_artifact``
+is now the single write path:
+
+- ``schema_version`` stamps every new artifact (tools/benchwatch.py
+  validates old, version-less artifacts under per-kind legacy schemas);
+- ``platform_block()`` is THE platform fingerprint benchwatch uses to
+  decide which artifacts are comparable for regression flagging;
+- drivers pass their own ``summary`` + payload sections (``results`` /
+  ``runs`` / any extra top-level keys) unchanged, so the per-kind
+  shapes stay what their consumers expect.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SCHEMA_VERSION = 2
+
+
+def platform_block() -> dict:
+    """The shared platform fingerprint: jax version, visible devices,
+    machine arch, python version."""
+    import platform
+
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "devices": [str(d) for d in jax.devices()],
+        "machine": platform.machine(),
+        "python": platform.python_version(),
+    }
+
+
+def artifact_body(cmd_argv: list[str], summary, *, results=None, runs=None,
+                  extra: dict | None = None) -> dict:
+    """Assemble the artifact dict without writing it (bench.py embeds the
+    same body in its stdout record)."""
+    body: dict = {
+        "schema_version": SCHEMA_VERSION,
+        "cmd": " ".join(cmd_argv),
+        "platform": platform_block(),
+        "summary": summary,
+    }
+    if results is not None:
+        body["results"] = results
+    if runs is not None:
+        body["runs"] = runs
+    if extra:
+        body.update(extra)
+    return body
+
+
+def write_artifact(path: str | Path, cmd_argv: list[str], summary, *,
+                   results=None, runs=None, extra: dict | None = None) -> dict:
+    """Write one BENCH_*.json artifact; returns the written body."""
+    body = artifact_body(cmd_argv, summary, results=results, runs=runs,
+                         extra=extra)
+    Path(path).write_text(json.dumps(body, indent=1, sort_keys=False) + "\n")
+    return body

@@ -1,0 +1,448 @@
+#!/usr/bin/env python
+"""benchwatch — one schema + a longitudinal registry for every bench
+artifact.
+
+The repo accumulates one BENCH artifact per growth round in four shapes
+(the driver-captured bench.py tail records r01..r05, the bench_loop
+BENCH_rXX format, BENCH_mega, BENCH_scenarios) and nothing ever read
+them TOGETHER: a perf regression between rounds was only caught if a
+human diffed JSON by hand. benchwatch gives them one registry:
+
+- every checked-in ``BENCH_*.json`` validates against a per-kind schema
+  (legacy shapes stay legal; new artifacts carry ``schema_version`` via
+  tools/bench_schema.py);
+- all artifacts normalize into ONE trajectory — per-artifact entries of
+  ``{round, kind, platform fingerprint, flat metrics}`` — written to
+  ``BENCH_trajectory.json`` (``--write``);
+- ``--check`` is the tier-1/CI gate (tools/lint_all.py runs it): any
+  unparseable/invalid artifact fails, and any metric that regressed
+  beyond ``--threshold`` (default 10%) between ADJACENT comparable
+  rounds fails.
+
+Comparability is deliberately strict — a flagged regression must mean
+"same benchmark, same platform, got worse", never "we moved rigs":
+
+- only entries of the same kind AND the same platform fingerprint
+  compare (fingerprint = machine + device class + jax version for
+  artifacts with a platform block; the measurement method for the
+  driver records, which predate the block);
+- only STRICTLY adjacent rounds compare (rN vs rN-1) — a corrupt or
+  missing intermediate round breaks the chain instead of silently
+  comparing across it;
+- physically invalid values are quarantined from comparison, not from
+  the record: an MFU above 100% (the BENCH_r03 block_until_ready
+  artifact corruption) or a latency equal to the bench's own clamp
+  floor (a bound, not a measurement — bench.py VERDICT r3 weak #2)
+  stays visible in the trajectory but anchors no regression verdict.
+
+Usage:
+    python tools/benchwatch.py --check [--root DIR] [--threshold 0.1]
+    python tools/benchwatch.py --write [--root DIR]
+    python tools/benchwatch.py [files...]        # normalize + print
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+TRAJECTORY_SCHEMA_VERSION = 1
+TRAJECTORY_FILE = "BENCH_trajectory.json"
+DEFAULT_THRESHOLD = 0.10
+
+# metrics where smaller is better; everything else is higher-better.
+# Suffix rules cover the families (latencies, fractions); exact names
+# pin the ambiguous ones.
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_fraction")
+_LOWER_BETTER_EXACT = {
+    "control_dispatch", "device_call", "candidate_fill", "apply_selection",
+    "report_ingest", "pack", "pre_schedule", "link_rtt_probe",
+}
+
+
+def lower_is_better(metric: str) -> bool:
+    return (
+        metric in _LOWER_BETTER_EXACT
+        or metric.endswith(_LOWER_BETTER_SUFFIXES)
+    )
+
+
+# ------------------------------------------------------------ validation
+
+
+class SchemaError(Exception):
+    pass
+
+
+def _require(doc: dict, key: str, types, where: str) -> None:
+    if key not in doc:
+        raise SchemaError(f"{where}: missing required key {key!r}")
+    if types is not None and not isinstance(doc[key], types):
+        raise SchemaError(
+            f"{where}: {key!r} must be {types}, got {type(doc[key]).__name__}"
+        )
+
+
+def detect_kind(doc: dict, name: str) -> str:
+    """driver | bench | loop | mega | scenarios — by structural
+    signature. `bench` is `python bench.py --artifact` (the schema-v2
+    successor of the driver-captured tail records: the same parsed
+    record, under `record`, plus the shared platform block)."""
+    if not isinstance(doc, dict):
+        raise SchemaError(f"{name}: artifact must be a JSON object")
+    keys = set(doc)
+    if {"cmd", "rc", "tail"} <= keys:
+        return "driver"
+    if "record" in keys:
+        return "bench"
+    if "runs" in keys:
+        return "mega"
+    if "results" in keys:
+        return "loop"
+    if "scenarios" in keys:
+        return "scenarios"
+    raise SchemaError(f"{name}: unrecognized artifact shape (keys={sorted(keys)})")
+
+
+def validate(doc: dict, kind: str, name: str) -> None:
+    """Raise SchemaError on the first contract violation."""
+    if kind == "driver":
+        _require(doc, "cmd", str, name)
+        _require(doc, "rc", int, name)
+        _require(doc, "tail", str, name)
+        parsed = doc.get("parsed")
+        if parsed is not None:
+            if not isinstance(parsed, dict):
+                raise SchemaError(f"{name}: parsed must be an object or null")
+            _require(parsed, "metric", str, f"{name}.parsed")
+            _require(parsed, "value", (int, float), f"{name}.parsed")
+        return
+    if kind in ("bench", "loop", "mega"):
+        _require(doc, "cmd", str, name)
+        _require(doc, "platform", dict, name)
+        _require(doc["platform"], "jax", str, f"{name}.platform")
+        _require(doc["platform"], "devices", list, f"{name}.platform")
+        _require(doc["platform"], "machine", str, f"{name}.platform")
+        _require(doc, "summary", dict, name)
+        if kind == "bench":
+            _require(doc, "record", dict, name)
+            _require(doc["record"], "metric", str, f"{name}.record")
+            _require(doc["record"], "value", (int, float), f"{name}.record")
+            return
+        if kind == "loop":
+            _require(doc, "results", list, name)
+            for i, leg in enumerate(doc["results"]):
+                if not isinstance(leg, dict):
+                    raise SchemaError(f"{name}.results[{i}]: must be an object")
+                _require(leg, "metric", str, f"{name}.results[{i}]")
+        else:
+            _require(doc, "runs", list, name)
+            for i, run in enumerate(doc["runs"]):
+                where = f"{name}.runs[{i}]"
+                for key, types in (("scenario", str), ("hosts", int),
+                                   ("stats", dict), ("timing", dict)):
+                    _require(run, key, types, where)
+        return
+    if kind == "scenarios":
+        _require(doc, "scenarios", dict, name)
+        for sname, s in doc["scenarios"].items():
+            if not isinstance(s, dict):
+                raise SchemaError(f"{name}.scenarios[{sname}]: must be an object")
+        return
+    raise SchemaError(f"{name}: unknown kind {kind!r}")
+
+
+# ---------------------------------------------------------- normalization
+
+
+_ROUND_RE = re.compile(r"BENCH_r0*(\d+)\.json$")
+
+
+def _round_of(name: str) -> int | None:
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def _device_class(device: str) -> str:
+    # "TFRT_CPU_0" -> "TFRT_CPU"; "axon:0" stays itself
+    return re.sub(r"_\d+$", "", device)
+
+
+def _fingerprint(doc: dict, kind: str) -> str:
+    platform = doc.get("platform")
+    if isinstance(platform, dict):
+        devices = platform.get("devices") or ["?"]
+        return "|".join((
+            kind, platform.get("machine", "?"),
+            _device_class(str(devices[0])), platform.get("jax", "?"),
+        ))
+    if kind == "driver":
+        parsed = doc.get("parsed") or {}
+        return f"driver|{parsed.get('method', 'unparsed')}"
+    return f"{kind}|legacy"
+
+
+def _put(metrics: dict, quarantined: dict, key: str, value,
+         invalid_reason: str | None = None) -> None:
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return
+    if invalid_reason:
+        quarantined[key] = {"value": float(value), "reason": invalid_reason}
+    else:
+        metrics[key] = float(value)
+
+
+def _normalize_driver(doc: dict, metrics: dict, quarantined: dict) -> None:
+    parsed = doc.get("parsed") or {}
+    value = parsed.get("value")
+    clamped = (
+        isinstance(value, (int, float)) and value <= 0.01
+        and parsed.get("method") == "pipelined_steady_state"
+    )
+    _put(metrics, quarantined, "headline_p50_ms", value,
+         "equals the 10us clamp floor — a bound, not a measurement"
+         if clamped else None)
+    trainer = parsed.get("trainer") or {}
+    flat = {**trainer, **{k: v for k, v in parsed.items() if k != "trainer"}}
+    for key in ("gnn_mfu_pct", "gnn_vs_cpu_torch", "attention_fwd_mfu_pct",
+                "attention_mfu_pct", "loop_pieces_per_sec",
+                "loop_tick_p50_ms", "recall", "ab_ml_vs_default_cost"):
+        v = flat.get(key)
+        invalid = None
+        if key.endswith("mfu_pct") and isinstance(v, (int, float)) and v > 100:
+            invalid = "MFU above 100% is physically impossible (corrupt timing)"
+        _put(metrics, quarantined, key, v, invalid)
+
+
+def _normalize_bench(doc: dict, metrics: dict, quarantined: dict) -> None:
+    # same record shape the driver tail parses — reuse its extraction
+    # (incl. the clamp-floor / >100%-MFU quarantine rules)
+    _normalize_driver({"parsed": doc.get("record")}, metrics, quarantined)
+
+
+def _normalize_loop(doc: dict, metrics: dict, quarantined: dict) -> None:
+    for key, v in (doc.get("summary") or {}).items():
+        if key in ("metric", "control_under_device"):
+            continue
+        if key.endswith("_model_vs_measured"):
+            # ratio-to-ideal metrics (perfect = 1.0) have no monotonic
+            # better-direction; drift is caught by the bench's own
+            # assertions, not the trajectory gate
+            continue
+        _put(metrics, quarantined, key, v)
+
+
+def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
+    for cell, s in (doc.get("summary") or {}).items():
+        if not isinstance(s, dict):
+            continue
+        _put(metrics, quarantined, f"{cell}_pieces_per_sec",
+             s.get("pieces_per_sec"))
+        _put(metrics, quarantined, f"{cell}_origin_traffic_fraction",
+             s.get("origin_traffic_fraction"))
+        _put(metrics, quarantined, f"{cell}_completed", s.get("completed"))
+
+
+def _normalize_scenarios(doc: dict, metrics: dict, quarantined: dict) -> None:
+    for sname, s in (doc.get("scenarios") or {}).items():
+        ratio = (s.get("ml_vs_default") or {}).get("mean")
+        _put(metrics, quarantined, f"{sname}_ml_vs_default", ratio)
+    model = doc.get("model") or {}
+    _put(metrics, quarantined, "model_recall", model.get("recall"))
+    _put(metrics, quarantined, "model_f1", model.get("f1"))
+
+
+def normalize(doc: dict, kind: str, name: str) -> dict:
+    """One trajectory entry: flat comparable metrics + provenance."""
+    metrics: dict = {}
+    quarantined: dict = {}
+    {
+        "driver": _normalize_driver,
+        "bench": _normalize_bench,
+        "loop": _normalize_loop,
+        "mega": _normalize_mega,
+        "scenarios": _normalize_scenarios,
+    }[kind](doc, metrics, quarantined)
+    return {
+        "source": name,
+        "kind": kind,
+        "round": _round_of(name),
+        "fingerprint": _fingerprint(doc, kind),
+        "schema_version": doc.get("schema_version"),
+        "metrics": metrics,
+        "quarantined_metrics": quarantined,
+    }
+
+
+# ------------------------------------------------------------ regression
+
+
+def find_regressions(entries: list[dict],
+                     threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
+    """Metric regressions between ADJACENT comparable rounds.
+
+    Two entries compare only when kind AND fingerprint match and their
+    rounds are strictly consecutive integers; each shared, unquarantined
+    metric is then checked directionally (lower_is_better) against the
+    threshold."""
+    by_series: dict[tuple[str, str], list[dict]] = {}
+    for e in entries:
+        if e["round"] is None:
+            continue
+        by_series.setdefault((e["kind"], e["fingerprint"]), []).append(e)
+    out: list[dict] = []
+    for series in by_series.values():
+        series.sort(key=lambda e: e["round"])
+        for prev, curr in zip(series, series[1:]):
+            if curr["round"] != prev["round"] + 1:
+                continue  # a broken chain never compares across the gap
+            for metric in sorted(set(prev["metrics"]) & set(curr["metrics"])):
+                a, b = prev["metrics"][metric], curr["metrics"][metric]
+                if a == 0:
+                    continue
+                change = (b - a) / abs(a)
+                worse = change > threshold if lower_is_better(metric) \
+                    else change < -threshold
+                if worse:
+                    out.append({
+                        "metric": metric,
+                        "from": {"source": prev["source"], "value": a},
+                        "to": {"source": curr["source"], "value": b},
+                        "change_pct": round(100.0 * change, 2),
+                        "direction": "lower_is_better"
+                        if lower_is_better(metric) else "higher_is_better",
+                    })
+    return out
+
+
+# --------------------------------------------------------------- registry
+
+
+def artifact_files(root: Path) -> list[Path]:
+    return sorted(
+        p for p in root.glob("BENCH_*.json") if p.name != TRAJECTORY_FILE
+    )
+
+
+def load_entries(files: list[Path]) -> tuple[list[dict], list[str]]:
+    entries, errors = [], []
+    for path in files:
+        try:
+            doc = json.loads(path.read_text())
+            kind = detect_kind(doc, path.name)
+            validate(doc, kind, path.name)
+            entries.append(normalize(doc, kind, path.name))
+        except (json.JSONDecodeError, SchemaError) as e:
+            errors.append(f"{path.name}: {e}")
+    return entries, errors
+
+
+def trajectory_body(entries: list[dict]) -> dict:
+    return {
+        "schema_version": TRAJECTORY_SCHEMA_VERSION,
+        "entries": sorted(
+            entries,
+            key=lambda e: (e["kind"], e["round"] if e["round"] is not None
+                           else 1 << 30, e["source"]),
+        ),
+    }
+
+
+def write_trajectory(root: Path, entries: list[dict]) -> Path:
+    path = root / TRAJECTORY_FILE
+    path.write_text(json.dumps(trajectory_body(entries), indent=1) + "\n")
+    return path
+
+
+def validate_trajectory_file(root: Path) -> list[str]:
+    path = root / TRAJECTORY_FILE
+    if not path.exists():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except json.JSONDecodeError as e:
+        return [f"{TRAJECTORY_FILE}: {e}"]
+    errors = []
+    if doc.get("schema_version") != TRAJECTORY_SCHEMA_VERSION:
+        errors.append(
+            f"{TRAJECTORY_FILE}: schema_version must be "
+            f"{TRAJECTORY_SCHEMA_VERSION}"
+        )
+    if not isinstance(doc.get("entries"), list):
+        errors.append(f"{TRAJECTORY_FILE}: entries must be a list")
+    else:
+        for i, e in enumerate(doc["entries"]):
+            for key in ("source", "kind", "fingerprint", "metrics"):
+                if key not in e:
+                    errors.append(
+                        f"{TRAJECTORY_FILE}: entries[{i}] missing {key!r}"
+                    )
+                    break
+    return errors
+
+
+def check(root: Path, threshold: float = DEFAULT_THRESHOLD,
+          out=sys.stdout) -> int:
+    """The gate: schema-validate every artifact, validate the checked-in
+    trajectory, flag adjacent-round regressions. Exit code 0/1."""
+    files = artifact_files(root)
+    entries, errors = load_entries(files)
+    errors.extend(validate_trajectory_file(root))
+    regressions = find_regressions(entries, threshold)
+    for err in errors:
+        print(f"benchwatch: SCHEMA {err}", file=out)
+    for r in regressions:
+        print(
+            f"benchwatch: REGRESSION {r['metric']} "
+            f"{r['from']['value']} -> {r['to']['value']} "
+            f"({r['change_pct']:+.1f}%, {r['direction']}) "
+            f"[{r['from']['source']} -> {r['to']['source']}]",
+            file=out,
+        )
+    ok = not errors and not regressions
+    print(
+        f"benchwatch: {len(files)} artifacts, {len(entries)} parsed, "
+        f"{len(errors)} schema errors, {len(regressions)} regressions "
+        f"(threshold {threshold:.0%}) -> {'OK' if ok else 'FAILED'}",
+        file=out,
+    )
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=".",
+                    help="directory holding BENCH_*.json (default: cwd)")
+    ap.add_argument("--check", action="store_true",
+                    help="schema + regression gate (exit 1 on failure)")
+    ap.add_argument("--write", action="store_true",
+                    help=f"(re)write {TRAJECTORY_FILE} from the artifacts")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression threshold as a fraction (default 0.10)")
+    ap.add_argument("files", nargs="*",
+                    help="normalize just these artifacts and print entries")
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+
+    if args.files:
+        entries, errors = load_entries([Path(f) for f in args.files])
+        print(json.dumps({"entries": entries, "errors": errors}, indent=1))
+        return 1 if errors else 0
+    if args.check:
+        return check(root, args.threshold)
+    entries, errors = load_entries(artifact_files(root))
+    if args.write:
+        path = write_trajectory(root, entries)
+        print(f"benchwatch: wrote {path} ({len(entries)} entries)")
+        for err in errors:
+            print(f"benchwatch: SCHEMA {err}")
+        return 1 if errors else 0
+    print(json.dumps(trajectory_body(entries), indent=1))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

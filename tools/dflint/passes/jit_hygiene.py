@@ -27,7 +27,13 @@ Rules:
   *documents* the pipeline design: the tick's single drain point, the
   warmup forcing, and the refresh worker's off-critical-path landing
   are intentional; anything new must be argued onto the list (or
-  waived inline).
+  waived inline). Compile-analysis calls (``cost_analysis`` /
+  ``memory_analysis`` / the cost-card ledger's ``capture_pending``)
+  count as syncs here too: a cost-card capture pays a full XLA
+  recompile, strictly worse than a D2H round-trip, so it may only run
+  at the allowlisted warmup drain — never per tick (the
+  telemetry/costcard.py capture discipline, pinned by the bad_jit
+  fixture).
 - ``JIT004`` dynamic shape entering a jit call: an argument sliced to
   a runtime-dependent length (``x[:n]``) at a direct call site of a
   known-jitted callable — the shape becomes a fresh signature and a
@@ -59,6 +65,11 @@ from tools.dflint.core import FileContext, Finding, attr_chain
 
 SYNC_CALL_LEAVES = {"asarray", "array", "device_get", "block_until_ready"}
 SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}
+# hot-path-only sync leaves (JIT003, never JIT001 — they are meaningless
+# inside a traced body): compile-analysis calls cost a full XLA
+# recompile, so a cost-card capture on the tick path is a worse stall
+# than any D2H; only the warmup drain is allowlisted
+COMPILE_SYNC_LEAVES = {"cost_analysis", "memory_analysis", "capture_pending"}
 CAST_FUNCS = {"float", "int", "bool"}
 NUMPY_ROOTS = {"np", "numpy", "onp"}
 # parameter names that carry mesh topology, not array data — static in
@@ -107,6 +118,12 @@ D2H_ALLOWLIST: dict[tuple[str, str, str], str] = {
     ("registry/serving.py", "_perform_refresh", "asarray"): (
         "host-side COO subgraph gather (numpy in, numpy out) feeding the "
         "jitted embed program; no device array is synced here"
+    ),
+    ("cluster/scheduler.py", "warmup", "capture_pending"): (
+        "THE cost-card capture drain (telemetry/costcard.py): warmup is "
+        "already the designed blocking cold-start phase, so the one-time "
+        "duplicate compile per bucket signature lands here — a capture "
+        "anywhere else on the serving path must fail JIT003"
     ),
 }
 
@@ -245,8 +262,11 @@ class JitHygienePass:
                 leaf, root = _callee_leaf_root(node)
                 is_sync = (
                     (leaf in SYNC_CALL_LEAVES and root in NUMPY_ROOTS | {"jax"})
-                    or (leaf in SYNC_ATTR_CALLS
+                    or (leaf in SYNC_ATTR_CALLS | COMPILE_SYNC_LEAVES
                         and isinstance(node.func, ast.Attribute))
+                    # bare-name capture_pending() (from-imported) is the
+                    # same recompile with the module prefix dropped
+                    or leaf == "capture_pending"
                 )
                 if not is_sync:
                     continue

@@ -10,7 +10,10 @@ share (tests/test_static_analysis.py invokes the same ``main``), so
 2. the waiver audit finds no stale waivers (a ``waive[RULE]`` whose
    rule no longer fires at that site);
 3. the mypy strict-core subset passes (or gates with the explicit
-   SKIPPED marker on rigs without mypy — tools/typecheck.py).
+   SKIPPED marker on rigs without mypy — tools/typecheck.py);
+4. benchwatch validates every checked-in ``BENCH_*.json`` against the
+   artifact schema and flags adjacent-round metric regressions beyond
+   its threshold (tools/benchwatch.py --check).
 
 ``--json`` forwards dflint's machine-readable findings document.
 
@@ -64,7 +67,17 @@ def main(argv: list[str] | None = None) -> int:
         [sys.executable, str(ROOT / "tools" / "typecheck.py")],
         cwd=ROOT, capture_output=True, text=True, timeout=600,
     )
-    failed = rc_lint != 0 or proc.returncode != 0
+
+    # bench-artifact registry gate: every BENCH_*.json parses against
+    # its schema and no adjacent-round metric regressed past threshold
+    import io
+
+    from tools.benchwatch import check as benchwatch_check
+
+    bench_out = io.StringIO()
+    rc_bench = benchwatch_check(ROOT, out=bench_out)
+
+    failed = rc_lint != 0 or proc.returncode != 0 or rc_bench != 0
     if as_json:
         # one merged document: the overall `ok` covers BOTH stages (a
         # dflint-only verdict would let a mypy failure ship green), and
@@ -75,12 +88,18 @@ def main(argv: list[str] | None = None) -> int:
             "skipped": SKIP_MARKER in proc.stdout,
             "output": (proc.stdout + proc.stderr).strip(),
         }
+        doc["benchwatch"] = {
+            "returncode": rc_bench,
+            "output": bench_out.getvalue().strip(),
+        }
         doc["ok"] = not failed
         print(json.dumps(doc, indent=2))
     else:
         sys.stdout.write(proc.stdout)
         sys.stderr.write(proc.stderr)
         print(f"lint_all: typecheck {'OK' if proc.returncode == 0 else 'FAILED'}")
+        sys.stdout.write(bench_out.getvalue())
+        print(f"lint_all: benchwatch {'OK' if rc_bench == 0 else 'FAILED'}")
 
     return 1 if failed else 0
 
