@@ -408,6 +408,12 @@ def run(
         ),
         "embed_refresh_blocking_s": ab["ml"].get("embed_refresh_blocking_s"),
         "phases_p50_ms": _phase_p50(svc_ml2),
+        # Decision provenance (telemetry/decisions.py): the ml arm's
+        # ledger ran with the rule blend shadow-scoring every tick, so
+        # this leg carries the measured ml-vs-rule divergence and, from
+        # the joined outcomes, per-arm regret — the per-decision answer
+        # next to the end-to-end A/B cost ratio below.
+        "decisions": _decision_block(svc_ml2),
     })
     results.append({
         "metric": "full_loop_ab_piece_cost_ms",
@@ -428,6 +434,14 @@ def run(
     })
 
     return results
+
+
+def _decision_block(svc) -> dict | None:
+    """Decision-ledger divergence/regret aggregates for the artifact —
+    the ledger's own flattened report (one layout across every bench
+    driver)."""
+    led = getattr(svc, "decisions", None)
+    return None if led is None else led.report()
 
 
 def _serving_costcards(svc) -> list[dict]:
@@ -550,6 +564,17 @@ def summarize(results: list[dict]) -> dict:
                 summary["serving_h2d_bytes_model_vs_measured"] = (
                     big["h2d_model_vs_measured"]
                 )
+        elif m == "full_loop_ml_tick_p50_ms":
+            summary["ml_tick_p50_ms"] = leg.get("value")
+            dec = leg.get("decisions") or {}
+            # divergence keys are direction-exempt in benchwatch (no
+            # monotonic better); regret compares lower-is-better
+            if dec.get("top1_disagreement") is not None:
+                summary["decision_top1_disagreement"] = dec["top1_disagreement"]
+            if dec.get("rank_corr") is not None:
+                summary["decision_rank_corr"] = dec["rank_corr"]
+            if dec.get("regret_ttc_ms") is not None:
+                summary["decision_regret_ms"] = dec["regret_ttc_ms"]
         elif m == "full_loop_ab_piece_cost_ms":
             summary["ab_ml_vs_default_cost"] = leg.get("ml_vs_default")
     if "control_dispatch" in summary and "device_call" in summary:

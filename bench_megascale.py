@@ -52,6 +52,17 @@ def summarize(runs: list[dict]) -> dict:
             # dip + simulated-minutes-to-recover per kill, not an
             # end-of-run assertion
             "kill_recovery": _kill_recovery_summary(r.get("recovery", [])),
+            # decision provenance: applied selections + shadow-arm
+            # divergence/regret (None when no inactive arm ran). The
+            # disagreement/rank-corr cells have no monotonic "better"
+            # and are excluded from benchwatch's regression directions.
+            "decisions": (r.get("decisions") or {}).get("decisions"),
+            "decision_top1_disagreement": (
+                (r.get("decisions") or {}).get("top1_disagreement")
+            ),
+            "decision_regret_fail_rate": (
+                (r.get("decisions") or {}).get("regret_fail_rate")
+            ),
         }
     return out
 

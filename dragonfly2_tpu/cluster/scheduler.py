@@ -30,6 +30,7 @@ from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.cluster.probes import ProbeStore
 from dragonfly2_tpu.cluster.quarantine import QuarantineBoard
 from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.config.constants import CONSTANTS
 from dragonfly2_tpu.graph.dag import TaskDAG
 from dragonfly2_tpu.ops import evaluator as ev
 from dragonfly2_tpu.ops.segment import pad_pow2
@@ -48,6 +49,13 @@ from dragonfly2_tpu.records.schema import (
 )
 from dragonfly2_tpu.records.storage import TraceStorage
 from dragonfly2_tpu.state.cluster import ClusterState
+from dragonfly2_tpu.telemetry.decisions import (
+    ARM_CODES,
+    OUTCOME_BACK_TO_SOURCE,
+    OUTCOME_COMPLETED,
+    OUTCOME_FAILED,
+    compact_features as _ledger_features,
+)
 from dragonfly2_tpu.state.fsm import (
     HostType,
     InvalidTransition,
@@ -247,6 +255,40 @@ class SchedulerService:
         # hosts are skipped by the tick's candidate fill until the score
         # cools (cluster/quarantine.py).
         self.quarantine = QuarantineBoard(metrics=series)
+        # Decision provenance ledger (telemetry/decisions.py): every
+        # applied selection's candidate set + feature rows + scores +
+        # chosen parent, joined to outcomes as terminal peer events
+        # land, with the inactive arm's counterfactual shadow ranking
+        # attached per tick. Resolvers bind to ClusterState only (no
+        # cycle through the service); the weak name registry serves the
+        # process-wide /debug/flight dump.
+        self.decisions = None
+        self._tick_counter = 0
+        self.shadow_scoring = bool(getattr(sched, "shadow_scoring", True))
+        # ml-as-shadow readiness gate: the ml packed program must be
+        # compiled OFF the tick path before the shadow arm may use it —
+        # warmup() warms it when a snapshot already serves; a snapshot
+        # committing LATER triggers a one-shot background warm instead
+        # of paying a multi-second XLA compile inside a serving tick.
+        self._shadow_ml_ready = False
+        self._shadow_warm_thread: threading.Thread | None = None
+        if getattr(sched, "decision_ledger", True):
+            from dragonfly2_tpu.telemetry.decisions import DecisionLedger
+
+            st = self.state  # resolvers bind the state, not the service
+            self.decisions = DecisionLedger(
+                capacity=getattr(sched, "decision_ledger_capacity", 4096),
+                k=sched.filter_parent_limit,
+                limit=sched.candidate_parent_limit,
+                registry=reg,
+                name="scheduler.decisions",
+                peer_resolver=lambda r: (
+                    st._peer_id[r] if 0 <= r < st.max_peers else None
+                ),
+                host_resolver=lambda h: (
+                    st.host_id_at(h) if h >= 0 else None
+                ),
+            )
 
     # ============================================================ messages
 
@@ -451,6 +493,10 @@ class SchedulerService:
             idx = self.state.peer_index(req.peer_id)
             if req.finished_pieces:
                 self.state.adopt_pieces(idx, req.finished_pieces)
+                if self.decisions is not None:
+                    # re-announce with kept progress = failover recovery;
+                    # mark it on the peer's latest recorded decision
+                    self.decisions.mark_failover(req.peer_id)
             if self.state.peer_state[idx] == int(PeerState.RUNNING):
                 self._pending.setdefault(
                     req.peer_id, _Pending(peer_id=req.peer_id, blocklist=set())
@@ -771,6 +817,10 @@ class SchedulerService:
                         self.quarantine.report(host_id, reason="corruption")
             if corrupt:
                 self._series.piece_corruption.labels().inc()
+                if self.decisions is not None and req.peer_id != req.parent_peer_id:
+                    # the child's decision handed it a digest-failing
+                    # parent — corruption attribution on the ledger row
+                    self.decisions.mark_corruption(req.peer_id)
                 if req.peer_id == req.parent_peer_id:
                     # SELF-report (upload verify-on-serve found local rot):
                     # the host stops being advertised via quarantine; there
@@ -792,6 +842,15 @@ class SchedulerService:
             self.state.peer_event(idx, PeerEvent.DOWNLOAD_SUCCEEDED)
             self._release_parent_slots(req.peer_id)
             self._pending.pop(req.peer_id, None)
+            if self.decisions is not None:
+                # flush valve: the cost label below reads the peer's
+                # piece-cost columns, which buffered reports feed
+                self._absorb_piece_reports()
+                self.decisions.join_outcome(
+                    req.peer_id, OUTCOME_COMPLETED,
+                    bytes_=getattr(req, "content_length", 0),
+                    cost_ns=self._reported_download_cost_ns(idx),
+                )
             self._write_download_record(req.peer_id, "Succeeded")
             return None
 
@@ -803,6 +862,8 @@ class SchedulerService:
             self.state.peer_event(idx, PeerEvent.DOWNLOAD_FAILED)
             self._release_parent_slots(req.peer_id)
             self._pending.pop(req.peer_id, None)
+            if self.decisions is not None:
+                self.decisions.join_outcome(req.peer_id, OUTCOME_FAILED)
             self._write_download_record(req.peer_id, "Failed")
             return None
 
@@ -815,6 +876,10 @@ class SchedulerService:
             task_idx = self.state.peer_task[idx]
             self.state.task_back_to_source_count[task_idx] += 1
             self._pending.pop(req.peer_id, None)
+            if self.decisions is not None:
+                # the peer abandoned its scheduled parents for the
+                # origin — the decision's measured outcome is "escalated"
+                self.decisions.join_outcome(req.peer_id, OUTCOME_BACK_TO_SOURCE)
             return None
 
     def back_to_source_finished(self, req: msg.DownloadPeerBackToSourceFinishedRequest):
@@ -925,6 +990,17 @@ class SchedulerService:
         if self.plugin_evaluator is not None:
             return  # plugin path keeps the dict transport; nothing to warm
         use_ml = self.ml_evaluator is not None and self.algorithm == "ml"
+        # Shadow-scoring warm: the inactive arm's program compiles here
+        # too, so the first shadowed tick never pays a compile. The rule
+        # twin is always warmable; the ml twin only once a snapshot has
+        # committed (before that the ml entry would just fall back to
+        # the rule program it cannot warm past).
+        shadow_on = self.decisions is not None and self.shadow_scoring
+        warm_rule_shadow = shadow_on and use_ml
+        warm_ml_shadow = (
+            shadow_on and not use_ml and self.ml_evaluator is not None
+            and self.ml_evaluator.serving_snapshot() is not None
+        )
         for bsz in _EVAL_BUCKETS:
             feats = CandidateFeatures.zeros(bsz, k, self.state.piece_cost_capacity)
             fd = feats.as_dict()
@@ -944,6 +1020,26 @@ class SchedulerService:
                     buf, bsz, k, c, l, n, algorithm=algorithm, limit=limit
                 )
             np.asarray(out)  # force the compile + execution to finish
+            if warm_rule_shadow or warm_ml_shadow:
+                # fresh staging buffer: the call above donated buf's
+                # device copy, and donated buffers are one-shot
+                sbuf = ev.pack_eval_batch(fd)
+                if warm_ml_shadow:
+                    out = self.ml_evaluator.schedule_from_packed(
+                        sbuf, bsz, k, c, l, n, limit=limit,
+                        record_used=False,
+                    )
+                else:
+                    fb = self.ml_evaluator.fallback
+                    out = ev.schedule_from_packed(
+                        sbuf, bsz, k, c, l, n,
+                        algorithm=fb if fb in ("default", "nt") else "default",
+                        limit=limit,
+                    )
+                np.asarray(out)
+        if warm_ml_shadow:
+            with self.mu:
+                self._shadow_ml_ready = True
         # Drain the cost-card captures the bucket compiles just queued
         # (telemetry/costcard.py): warmup is ALREADY the designed
         # blocking cold-start phase, so the one-time duplicate compile
@@ -954,6 +1050,50 @@ class SchedulerService:
         from dragonfly2_tpu.telemetry import costcard
 
         costcard.capture_pending()
+
+    def _ensure_shadow_warm(self) -> None:
+        """Spawn the one-shot background warm of the ml shadow entry
+        (caller holds service.mu). Idempotent: a live warm thread or a
+        ready flag makes this a no-op."""
+        t = self._shadow_warm_thread
+        if self._shadow_ml_ready or (t is not None and t.is_alive()):
+            return
+        t = threading.Thread(
+            target=self._warm_shadow_ml, name="eval-warmup-shadow",
+            daemon=True,
+        )
+        self._shadow_warm_thread = t
+        t.start()
+
+    def _warm_shadow_ml(self) -> None:
+        """Compile the ml packed program for every bucket on a
+        background thread (the warmup() discipline for a snapshot that
+        committed AFTER cold start): touches only zero-filled local
+        arrays + jax's compile cache, no service state; flips
+        _shadow_ml_ready under mu when every bucket is warm."""
+        from dragonfly2_tpu.records.features import CandidateFeatures
+
+        try:
+            k = self.config.scheduler.filter_parent_limit
+            limit = self.config.scheduler.candidate_parent_limit
+            for bsz in _EVAL_BUCKETS:
+                feats = CandidateFeatures.zeros(
+                    bsz, k, self.state.piece_cost_capacity
+                )
+                fd = feats.as_dict()
+                c = fd["piece_costs"].shape[-1]
+                l = fd["parent_location"].shape[-1]
+                n = fd["numeric"].shape[-1]
+                buf = ev.pack_eval_batch(fd)
+                out = self.ml_evaluator.schedule_from_packed(
+                    buf, bsz, k, c, l, n, limit=limit, record_used=False
+                )
+                np.asarray(out)  # land compile + execution off the tick
+        except Exception:  # noqa: BLE001 - shadow stays off; serving unaffected
+            logger.exception("background shadow warm failed")
+            return
+        with self.mu:
+            self._shadow_ml_ready = True
 
     def tick(self) -> list:
         """Run ONE batched scheduling round over every pending peer.
@@ -981,6 +1121,9 @@ class SchedulerService:
     def _tick_locked(self) -> list:
         recorder = self.recorder
         recorder.begin()
+        # replay-deterministic tick id — the decision ledger's rows and
+        # per-tick divergence entries key on it, never on wall time
+        self._tick_counter += 1
         # Absorb every piece report buffered since the last flush valve:
         # candidate scoring below reads the finished/cost/upload columns.
         self._absorb_piece_reports()
@@ -1031,6 +1174,15 @@ class SchedulerService:
             child_peer_idx, cand_peer_idx, cand_valid, avg_rtt, has_rtt
         )
         fd = feats.as_dict()
+        led = self.decisions
+        led_feats = None
+        if led is not None:
+            # compact per-candidate ledger feature rows, one vectorised
+            # stack for the whole batch (telemetry/decisions.py) — part
+            # of feature gathering, so it stays inside this phase mark
+            led_feats = _ledger_features(
+                fd, in_degree, CONSTANTS.MAX_LOCATION_ELEMENTS
+            )
         recorder.mark("feature_gather")
 
         # The jitted kernels specialize on (B, K). A raw B = len(pending)
@@ -1057,11 +1209,79 @@ class SchedulerService:
         # (pinning None keeps later chunks on the fallback path too).
         ml_snap = self.ml_evaluator.serving_snapshot() if use_ml else None
 
+        # Decision-ledger context + counterfactual shadow arm. The arm
+        # that actually scores this tick is attributed honestly: an ml
+        # tick without a committed snapshot serves the rule fallback and
+        # is recorded as such. The shadow arm is the INACTIVE one — the
+        # rule blend when ml serves, the committed ml snapshot when the
+        # rule does — re-scoring the same packed candidate batch;
+        # nothing when no inactive arm exists (rule active, no served
+        # snapshot) or on the plugin path (no packed transport).
+        if self.plugin_evaluator is not None:
+            arm_code = ARM_CODES["plugin"]
+        elif use_ml and ml_snap is not None:
+            arm_code = ARM_CODES["ml"]
+        else:
+            arm_code = ARM_CODES[
+                self.algorithm if self.algorithm in ("default", "nt")
+                else "default"
+            ]
+        shadow_mode = None
+        shadow_alg = "default"
+        shadow_snap = None
+        shadow_arm_code = -1
+        shadow_due = (
+            self._tick_counter
+            % max(int(getattr(self.config.scheduler, "shadow_every", 1)), 1)
+            == 0
+        )
+        if (
+            led is not None
+            and self.shadow_scoring
+            and shadow_due
+            and self.plugin_evaluator is None
+        ):
+            if use_ml and ml_snap is not None:
+                fb = self.ml_evaluator.fallback
+                shadow_alg = fb if fb in ("default", "nt") else "default"
+                shadow_mode = "rule"
+                shadow_arm_code = ARM_CODES[shadow_alg]
+            elif not use_ml and self.ml_evaluator is not None:
+                shadow_snap = self.ml_evaluator.serving_snapshot()
+                if shadow_snap is not None:
+                    if self._shadow_ml_ready:
+                        shadow_mode = "ml"
+                        shadow_arm_code = ARM_CODES["ml"]
+                    else:
+                        # snapshot committed after warmup (or warmup
+                        # never ran): compile the ml packed program on a
+                        # background thread; shadow stays off until the
+                        # warm lands — never a mid-tick XLA compile
+                        self._ensure_shadow_warm()
+        led_ctx = None
+        if led is not None:
+            led_ctx = {
+                "tick": self._tick_counter,
+                "arm": arm_code,
+                "feats": led_feats,
+                "child_peer_idx": child_peer_idx,
+                "child_host_slots": child_host_slots,
+                "cand_host_slots": cand_host_slots,
+                # per-row ledger ring slot + its seq, filled by the
+                # apply paths so the end-of-tick shadow drain can join
+                # row-for-row (the seq guards against a mid-tick ring
+                # wrap reassigning a slot to a later decision)
+                "slot_of_row": np.full(b, -1, np.int64),
+                "seq_of_row": np.full(b, -1, np.int64),
+            }
+        shadow_inflight: list[tuple[int, int, object]] = []
+
         def _dispatch_chunk(s: int, e: int):
             """Pack rows [s:e) and dispatch their device call WITHOUT
             blocking on the result (jax async dispatch): the returned
             value is an in-flight device array the drain step reads."""
             bsz = _bucket_rows(e - s)
+            sbuf = None
             if self.plugin_evaluator is not None:
                 # plugin scorers run host-side on the feature dict, so this
                 # path keeps the dict transport (plugin contract stability
@@ -1087,6 +1307,21 @@ class SchedulerService:
                     cand_host_slot=_pad_rows(cand_host_slots[s:e], bsz),
                 )
                 recorder.mark("pack")
+                if shadow_mode is not None:
+                    # The shadow arm scores the SAME packed batch from
+                    # its own staging buffer, copied BEFORE the active
+                    # call donates `buf`'s device allocation — donated
+                    # buffers are one-shot (dfshape DON001 / the runtime
+                    # DonationGuard), so reuse would be a contract
+                    # violation, not an optimization. Copy wall is
+                    # credited to the shadow_score phase, never to
+                    # pack/dispatch.
+                    t_sh = time.perf_counter()
+                    sbuf = buf.copy()
+                    recorder.add(
+                        "shadow_score", (time.perf_counter() - t_sh) * 1e3
+                    )
+                    recorder.sync()
                 if use_ml:
                     packed = self.ml_evaluator.schedule_from_packed(
                         buf, bsz, k, cost_c, loc_l, num_n, limit=limit,
@@ -1099,7 +1334,31 @@ class SchedulerService:
                         algorithm=algorithm, limit=limit,
                     )
             recorder.mark("dispatch")
-            return packed
+            shadow_packed = None
+            if sbuf is not None:
+                # Counterfactual dispatch AFTER the active chunk's async
+                # dispatch (the serving call keeps priority); its D2H
+                # waits for the end-of-tick drain valve (_drain_shadow).
+                # Routes only already-proven bucket signatures, so the
+                # retrace tripwire's observed set cannot grow.
+                t_sh = time.perf_counter()
+                if shadow_mode == "ml":
+                    # record_used=False: a counterfactual re-score must
+                    # not claim the ml version SERVED this tick
+                    shadow_packed = self.ml_evaluator.schedule_from_packed(
+                        sbuf, bsz, k, cost_c, loc_l, num_n, limit=limit,
+                        snap=shadow_snap, record_used=False,
+                    )
+                else:
+                    shadow_packed = ev.schedule_from_packed(
+                        sbuf, bsz, k, cost_c, loc_l, num_n,
+                        algorithm=shadow_alg, limit=limit,
+                    )
+                recorder.add(
+                    "shadow_score", (time.perf_counter() - t_sh) * 1e3
+                )
+                recorder.sync()
+            return packed, shadow_packed
 
         def _drain_chunk(s: int, e: int, packed, overlapped: bool) -> None:
             """Block on chunk [s:e)'s D2H, then apply its selections.
@@ -1122,12 +1381,14 @@ class SchedulerService:
                 self._apply_chunk_batch(
                     work, s, e, selected, selected_valid, selected_scores,
                     cand_peer_idx, cand_slots, cand_count, responses,
+                    led_ctx=led_ctx,
                 )
             else:
                 for row, i in enumerate(range(s, e)):
                     pending = work[i]
                     meta = self._peer_meta[pending.peer_id]
                     parents = []
+                    ranked_pos = []
                     for j in range(limit):
                         if not selected_valid[row, j]:
                             break
@@ -1138,6 +1399,7 @@ class SchedulerService:
                         if pid is None:
                             continue
                         parents.append((pid, float(selected_scores[row, j])))
+                        ranked_pos.append(int(selected[row, j]))
                     if not parents:
                         pending.retries += 1
                         continue  # stays pending for the next tick (retry loop)
@@ -1146,6 +1408,11 @@ class SchedulerService:
                         continue  # all selections DAG-rejected; stays pending
                     responses.append(response)
                     self._pending.pop(pending.peer_id, None)
+                    if led_ctx is not None:
+                        self._record_loop_decision(
+                            led_ctx, i, pending, meta, parents, ranked_pos,
+                            cand_peer_idx, cand_count, response,
+                        )
             dt = (time.perf_counter() - t0) * 1e3
             recorder.add("apply_selection", dt)
             if overlapped:
@@ -1167,7 +1434,11 @@ class SchedulerService:
         in_flight: tuple | None = None
         for s, e in spans:
             t0 = time.perf_counter()
-            packed = _dispatch_chunk(s, e)
+            packed, shadow_packed = _dispatch_chunk(s, e)
+            if shadow_packed is not None:
+                # in-flight counterfactual result; drained once, at the
+                # end-of-tick valve, never between chunks
+                shadow_inflight.append((s, e, shadow_packed))
             if in_flight is not None:
                 # this chunk's pack+dispatch ran while the previous
                 # chunk's device call was in flight — overlapped host work
@@ -1175,6 +1446,11 @@ class SchedulerService:
                 _drain_chunk(*in_flight, overlapped=True)
             in_flight = (s, e, packed)
         _drain_chunk(*in_flight, overlapped=False)
+        if shadow_inflight and led_ctx is not None:
+            self._drain_shadow(
+                shadow_inflight, led_ctx["slot_of_row"],
+                led_ctx["seq_of_row"], shadow_arm_code,
+            )
         # Aggregate phases for the operator-facing comparison (satellite:
         # control_dispatch is a REAL recorded phase now, not bench_loop's
         # trivial-dispatch link-RTT probe): control_dispatch sums the
@@ -1387,13 +1663,15 @@ class SchedulerService:
 
     def _apply_chunk_batch(self, work: list, s: int, e: int, selected,
                            selected_valid, selected_scores, cand_peer_idx,
-                           cand_slots, cand_count, responses: list) -> None:
+                           cand_slots, cand_count, responses: list,
+                           led_ctx: dict | None = None) -> None:
         """Batched selection apply for rows [s:e): DAG edges land through
         one grouped legality batch per task (graph/dag.add_edges_grouped,
         sequential-equivalent), upload-slot accounting through one
         scatter-add, and responses are emitted in row order (the same
         order the per-peer path produces, so downstream consumers see an
-        identical stream)."""
+        identical stream). With ``led_ctx`` every APPLIED row lands in
+        the decision ledger as one block record per chunk."""
         st = self.state
         limit = self.config.scheduler.candidate_parent_limit
         # pass 1: decode selections per row, group DAG edge adds per task
@@ -1403,7 +1681,7 @@ class SchedulerService:
             pending = work[i]
             meta = self._peer_meta[pending.peer_id]
             count = int(cand_count[i])
-            pslots, ppidx, pscores = [], [], []
+            pslots, ppidx, pscores, ppos = [], [], [], []
             for j in range(limit):
                 if not selected_valid[row, j]:
                     break
@@ -1413,10 +1691,11 @@ class SchedulerService:
                 pslots.append(int(cand_slots[i, pos]))
                 ppidx.append(int(cand_peer_idx[i, pos]))
                 pscores.append(float(selected_scores[row, j]))
+                ppos.append(pos)
             if not pslots:
                 pending.retries += 1
                 continue  # stays pending for the next tick (retry loop)
-            rows_sel[row] = (pending, meta, pslots, ppidx, pscores)
+            rows_sel[row] = (pending, meta, pslots, ppidx, pscores, ppos)
             by_task.setdefault(meta.task_id, []).append(row)
         # pass 2: one grouped edge-add batch per task (row order within a
         # task preserved; tasks have disjoint DAGs so cross-task order is
@@ -1432,20 +1711,33 @@ class SchedulerService:
                 accepted[r] = a
         # pass 3: responses + upload accounting, in row order
         upload_hosts: list[int] = []
+        rec_rows: list[int] = []
+        rec_sel_pos: list = []
+        rec_sel_scores: list = []
+        rec_sel_acc: list = []
+        rec_chosen: list[int] = []
+        rec_peer_ids: list = []
+        rec_task_ids: list = []
+        rec_chosen_ids: list = []
+        limit_pad = limit
         for row in range(e - s):
             entry = rows_sel[row]
             if entry is None:
                 continue
-            pending, meta, pslots, ppidx, pscores = entry
+            pending, meta, pslots, ppidx, pscores, ppos = entry
             acc = accepted.get(row)
             kept = []
+            kept_flags = []
             for pid_idx, score, ok in zip(ppidx, pscores, acc):
                 if not ok:
+                    kept_flags.append(False)
                     continue
                 pid = st._peer_id[pid_idx]
                 pmeta = self._peer_meta.get(pid) if pid is not None else None
                 if pmeta is None:
+                    kept_flags.append(False)
                     continue
+                kept_flags.append(True)
                 upload_hosts.append(int(st.peer_host[pid_idx]))
                 meta.held_parents.add(pid)
                 self._children_of_parent.setdefault(pid, set()).add(
@@ -1468,10 +1760,111 @@ class SchedulerService:
                 continue  # stays pending (all selections DAG-rejected)
             responses.append(self._finish_normal_response(pending, meta, kept))
             self._pending.pop(pending.peer_id, None)
+            if led_ctx is not None:
+                i = s + row
+                pad = limit_pad - len(ppos)
+                rec_rows.append(i)
+                rec_sel_pos.append(ppos[:limit_pad] + [-1] * max(pad, 0))
+                rec_sel_scores.append(
+                    pscores[:limit_pad] + [np.nan] * max(pad, 0)
+                )
+                rec_sel_acc.append(
+                    kept_flags[:limit_pad] + [False] * max(pad, 0)
+                )
+                first = next(
+                    p for p, f in zip(ppos, kept_flags) if f
+                )
+                rec_chosen.append(first)
+                rec_peer_ids.append(pending.peer_id)
+                rec_task_ids.append(meta.task_id)
+                rec_chosen_ids.append(kept[0].peer_id)
         if upload_hosts:
             np.add.at(
                 st.host_upload_used, np.asarray(upload_hosts, np.int64), 1
             )
+        if led_ctx is not None and rec_rows:
+            rows = np.asarray(rec_rows, np.int64)
+            slots, seqs = self.decisions.record_batch(
+                led_ctx["tick"], led_ctx["arm"],
+                led_ctx["child_peer_idx"][rows],
+                led_ctx["child_host_slots"][rows],
+                np.asarray(cand_peer_idx)[rows],
+                led_ctx["cand_host_slots"][rows],
+                np.asarray(cand_count)[rows],
+                led_ctx["feats"][rows],
+                np.asarray(rec_sel_pos, np.int64),
+                np.asarray(rec_sel_scores, np.float32),
+                np.asarray(rec_sel_acc, bool),
+                np.asarray(rec_chosen, np.int64),
+                rec_peer_ids, rec_task_ids, rec_chosen_ids,
+            )
+            led_ctx["slot_of_row"][rows] = slots
+            led_ctx["seq_of_row"][rows] = seqs
+
+    def _record_loop_decision(self, led_ctx: dict, i: int, pending: _Pending,
+                              meta: _PeerMeta, parents: list, ranked_pos: list,
+                              cand_peer_idx, cand_count, response) -> None:
+        """Decision-ledger record for the per-peer oracle path: the same
+        row `_apply_chunk_batch` writes on the vectorised path, built
+        from the loop fill's candidate arrays. The oracle path is the
+        decision-equivalence baseline, so its ledger rows must carry the
+        same provenance the production path records."""
+        limit = self.config.scheduler.candidate_parent_limit
+        kept_ids = {cp.peer_id for cp in response.candidate_parents}
+        flags = [pid in kept_ids for pid, _ in parents]
+        pad = limit - len(ranked_pos)
+        sel_pos = ranked_pos[:limit] + [-1] * max(pad, 0)
+        sel_scores = [sc for _, sc in parents][:limit] + [np.nan] * max(pad, 0)
+        sel_acc = flags[:limit] + [False] * max(pad, 0)
+        chosen = next(p for p, f in zip(ranked_pos, flags) if f)
+        rows = np.asarray([i], np.int64)
+        slots, seqs = self.decisions.record_batch(
+            led_ctx["tick"], led_ctx["arm"],
+            led_ctx["child_peer_idx"][rows],
+            led_ctx["child_host_slots"][rows],
+            np.asarray(cand_peer_idx)[rows],
+            led_ctx["cand_host_slots"][rows],
+            np.asarray(cand_count)[rows],
+            led_ctx["feats"][rows],
+            np.asarray([sel_pos], np.int64),
+            np.asarray([sel_scores], np.float32),
+            np.asarray([sel_acc], bool),
+            np.asarray([chosen], np.int64),
+            [pending.peer_id], [meta.task_id],
+            [response.candidate_parents[0].peer_id],
+        )
+        led_ctx["slot_of_row"][rows] = slots
+        led_ctx["seq_of_row"][rows] = seqs
+
+    def _drain_shadow(self, inflight: list, slot_of_row: np.ndarray,
+                      seq_of_row: np.ndarray, shadow_arm_code: int):
+        """End-of-tick drain valve for the counterfactual shadow arm's
+        in-flight device results: the ONLY place shadow selections come
+        back to the host. Runs strictly after the last serving chunk's
+        drain — the shadow D2H can never serialize the pipelined tick —
+        and its wall is credited to the `shadow_score` phase, outside
+        the control_dispatch/device_call aggregates. On the jit-hygiene
+        D2H_ALLOWLIST (tools/dflint/passes/jit_hygiene.py): a shadow
+        read-back anywhere else on the tick path fails JIT003."""
+        recorder = self.recorder
+        t0 = time.perf_counter()
+        limit = self.config.scheduler.candidate_parent_limit
+        b = slot_of_row.shape[0]
+        pos = np.full((b, limit), -1, np.int64)
+        scores = np.full((b, limit), np.nan, np.float32)
+        for s, e, packed in inflight:
+            arr = np.asarray(packed)[: e - s]
+            sel, valid, sc = ev.unpack_selection(arr)
+            ll = min(limit, sel.shape[1])
+            pos[s:e, :ll] = np.where(valid, sel, -1)[:, :ll]
+            scores[s:e, :ll] = np.where(valid, sc, np.nan)[:, :ll]
+        entry = self.decisions.record_shadow(
+            slot_of_row, seq_of_row, pos, scores, shadow_arm_code,
+            self._tick_counter,
+        )
+        recorder.add("shadow_score", (time.perf_counter() - t0) * 1e3)
+        recorder.sync()
+        return entry
 
     def _finish_normal_response(self, pending: _Pending, meta: _PeerMeta,
                                 kept: list) -> msg.NormalTaskResponse:
@@ -1563,6 +1956,22 @@ class SchedulerService:
             self._pending[pending.peer_id] = pending
             return None  # caller keeps the peer pending for the next tick
         return self._finish_normal_response(pending, meta, kept)
+
+    def _reported_download_cost_ns(self, idx) -> int:
+        """The peer's download cost summed from its REPORTED piece costs
+        (virtual time in replays, measured transfer time in production)
+        — the decision ledger's replay-safe outcome label basis. The
+        cost ring retains only the newest ``piece_cost_capacity``
+        entries, so the total is the retained mean scaled to the
+        finished-piece count. Caller must have flushed buffered piece
+        reports (the columns this reads)."""
+        st = self.state
+        retained = int(min(st.peer_piece_cost_count[idx],
+                           st.piece_cost_capacity))
+        if retained <= 0:
+            return 0
+        mean = float(st.peer_piece_costs[idx, :retained].mean())
+        return int(mean * max(int(st.peer_finished_count[idx]), retained))
 
     def _release_parent_slots(self, peer_id: str) -> None:
         """Free the upload slots this child holds on its parents' hosts.
@@ -1718,6 +2127,10 @@ class SchedulerService:
                     0, int(self.state.host_upload_used[host_idx]) - 1
                 )
         self._peer_meta.pop(peer_id, None)
+        if self.decisions is not None:
+            # drop the pending-join mapping so a recycled peer id can
+            # never join an outcome to the departed peer's decision
+            self.decisions.discard(peer_id)
         sent = self._chain_sent.get(meta.task_id)
         if sent is not None:
             sent.pop(peer_id, None)
@@ -1922,15 +2335,20 @@ class SchedulerService:
         c["tasks_with_digest_chain"] = len(self._task_piece_digests)
         return c
 
-    def flight_dump(self, last_n: int = 64) -> dict:
+    def flight_dump(self, last_n: int = 64, sections=None,
+                    max_bytes: int | None = None) -> dict:
         """Flight-recorder snapshot for THIS service (last-N tick phase
-        breakdowns + process-wide jit compile counters + open spans) —
-        served over the wire RPC (FlightRecorderRequest) and the manager
-        REST surface so an operator can diagnose a slow tick without
-        re-running the bench."""
+        breakdowns + process-wide jit compile counters + open spans +
+        cost cards / timelines / the decision ledger) — served over the
+        wire RPC (FlightRecorderRequest) and the manager REST surface so
+        an operator can diagnose a slow tick without re-running the
+        bench. `sections`/`max_bytes` bound the payload
+        (telemetry/flight.DUMP_SECTIONS / DUMP_MAX_BYTES)."""
         from dragonfly2_tpu.telemetry import flight
 
-        return flight.dump(last_n=last_n, recorder=self.recorder)
+        kwargs = {} if max_bytes is None else {"max_bytes": max_bytes}
+        return flight.dump(last_n=last_n, recorder=self.recorder,
+                           sections=sections, **kwargs)
 
     def serving_graph_arrays(self, consume_frontier: bool = True) -> dict:
         """Host graph for MLEvaluator.refresh_embeddings, built from this

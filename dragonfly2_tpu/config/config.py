@@ -80,6 +80,26 @@ class SchedulerConfig:
     # decision-equivalence oracle (tests/test_control_equivalence.py),
     # not as a production mode.
     vectorized_control: bool = True
+    # Decision provenance ledger (telemetry/decisions.py): a bounded
+    # columnar ring recording every applied selection's candidate set,
+    # feature rows, scores, chosen parent and joined outcome. On by
+    # default — recording is a handful of block column assigns per tick.
+    decision_ledger: bool = True
+    decision_ledger_capacity: int = 4096
+    # Counterfactual shadow scoring: the INACTIVE arm (rule when ml is
+    # active, the committed ml snapshot when the rule is) re-scores the
+    # already-packed device batch off the critical path, producing
+    # per-tick divergence and, once outcomes join, measured per-arm
+    # regret. No-ops when no inactive arm is available (rule active
+    # without a served ml snapshot), so the default costs nothing there.
+    shadow_scoring: bool = True
+    # Shadow every Nth tick (deterministic — keyed on the tick counter,
+    # never wall time). 1 = every tick. On a CPU-device rig the shadow
+    # pass shares host cores with the "device" and costs a real slice of
+    # the tick (measured ~3.8 ms at 10k hosts); a real accelerator pays
+    # only the staging-buffer copy + dispatch. Raise this to thin the
+    # counterfactual sample at 1/N of the cost.
+    shadow_every: int = 1
     # resource GC (scheduler/config/config.go GCConfig; pkg/gc/gc.go
     # interval runner semantics — swept from the live tick loop)
     peer_gc_interval_seconds: float = CONSTANTS.PEER_GC_INTERVAL_SECONDS

@@ -329,6 +329,8 @@ class EventBatchEngine(ClusterSimulator):
         from dragonfly2_tpu.rpc.resilience import open_breaker_census
 
         st, mega = self.stats, self.mega
+        led = getattr(self.scheduler, "decisions", None)
+        led_counters = led.counters() if led is not None else {}
         cur = {
             "pieces": float(st.pieces),
             "completed": float(st.completed),
@@ -337,6 +339,14 @@ class EventBatchEngine(ClusterSimulator):
             "reannounced": float(st.crash_reannounced_peers),
             "refused": float(mega.refused_registrations),
             "corruptions": float(st.injected_corruptions),
+            # decision-ledger cumulative counters (wall-free by
+            # construction — telemetry/decisions.counters), so the
+            # divergence columns below stay paired-seed deterministic
+            "decisions": float(led_counters.get("decisions", 0)),
+            "shadow_compared": float(led_counters.get("shadow_compared", 0)),
+            "shadow_disagree": float(
+                led_counters.get("shadow_top1_disagree", 0)
+            ),
         }
         prev = self._tl_prev
         delta = {k: v - prev.get(k, 0.0) for k, v in cur.items()}
@@ -356,6 +366,17 @@ class EventBatchEngine(ClusterSimulator):
             "refused_registrations": int(delta["refused"]),
             "corruptions": int(delta["corruptions"]),
             "scheduler_crash": 1 if crashed else 0,
+            # decision provenance columns: per-interval applied
+            # selections and, when a shadow arm ran, its top-1
+            # disagreement rate plus the deterministic failure-rate
+            # regret basis (the TTC-ms basis is wall-derived and
+            # deliberately excluded from the deterministic timeline)
+            "decisions": int(delta["decisions"]),
+            "shadow_divergence": (
+                round(delta["shadow_disagree"] / delta["shadow_compared"], 4)
+                if delta["shadow_compared"] > 0 else None
+            ),
+            "decision_regret_fail": self._regret_fail_sample(led),
             "ttc_ms_p50": {
                 f"region-{r}": (
                     None if (q := sk.quantile(0.5)) is None else round(q, 2)
@@ -364,6 +385,15 @@ class EventBatchEngine(ClusterSimulator):
             },
         }
         self.timeline.sample(self._round, sample)
+
+    @staticmethod
+    def _regret_fail_sample(led) -> float | None:
+        """Deterministic per-sample regret: the active arm's mean
+        failure-rate delta against the shadow pick on disagreement
+        decisions (the ledger report's fail_rate basis — counts only,
+        no wall reads). None until a disagreement has joined outcomes
+        on both hosts."""
+        return None if led is None else led.report()["regret_fail_rate"]
 
     def _record_ttc(self, reg: int) -> None:
         """Feed the completing download's virtual time-to-complete into

@@ -164,6 +164,12 @@ def run_megascale(
             "partition_drops": st.injected_partition_drops,
         },
         "scheduler_counts": svc.counts(),
+        # decision provenance (telemetry/decisions.py): deterministic
+        # counters + divergence/regret aggregates and the ledger's
+        # deterministic-column digest — the paired-seed determinism test
+        # pins the digest identical across runs (wall-clock columns are
+        # excluded from it by construction)
+        "decisions": _decision_report(svc),
         "timing": {
             "setup_s": round(setup_s, 2),
             "wall_s": round(wall, 2),
@@ -179,6 +185,24 @@ def run_megascale(
         "costcards": _drained_costcards(),
     }
     return report
+
+
+def _decision_report(svc) -> dict | None:
+    """Deterministic decision-ledger block for the megascale report:
+    the ledger's flattened report MINUS the wall-derived TTC keys (the
+    paired-seed determinism test compares this block), plus the
+    deterministic-column digest."""
+    led = getattr(svc, "decisions", None)
+    if led is None:
+        return None
+    r = led.report()
+    return {
+        key: r[key] for key in (
+            "decisions", "joined", "shadow_compared", "shadow_top1_disagree",
+            "top1_disagreement", "rank_corr", "n_disagreements",
+            "regret_fail_rate", "regret_fail_rate_by_arm",
+        )
+    } | {"columns_digest": led.deterministic_digest()}
 
 
 def _drained_costcards() -> dict:

@@ -695,6 +695,7 @@ class MLEvaluator:
         self, buf, b, k, c, l, n,
         limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
         snap: Any = _UNPINNED,
+        record_used: bool = True,
     ):
         """Single-buffer-transport twin of `schedule_packed` (the tick's
         one-H2D contract; ops/evaluator.pack_eval_batch). Falls back to
@@ -703,11 +704,18 @@ class MLEvaluator:
         call sequence — the scheduler passes one per tick so every chunk
         of a multi-chunk batch scores against the same committed table
         (pinning None pins the FALLBACK: a commit landing mid-tick must
-        not flip later chunks onto the ml path either)."""
+        not flip later chunks onto the ml path either). `record_used=
+        False` keeps `last_used_versions` untouched — the shadow-scoring
+        path uses it, because a counterfactual re-score must not claim
+        "this ml version SERVED" (last_used_versions is the refresh/serve
+        race audit trail and the rule-blend-served sentinel)."""
         if snap is _UNPINNED:
             snap = self._committed
         if snap is not None:
-            self.last_used_versions = (snap.params_version, snap.emb_version)
+            if record_used:
+                self.last_used_versions = (
+                    snap.params_version, snap.emb_version
+                )
             return _ml_schedule_from_packed(
                 snap.model, snap.params, snap.host_emb,
                 buf, b, k, c, l, n, limit, algorithm=self._base_alg,

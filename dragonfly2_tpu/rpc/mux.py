@@ -214,7 +214,8 @@ class MuxServer:
                         break
             except ValueError:
                 return
-            path = path.partition("?")[0].rstrip("/") or "/"
+            path, _, query = path.partition("?")
+            path = path.rstrip("/") or "/"
             if path == "/healthz":
                 ok = self._healthy()
                 status, body = (200, b"ok") if ok else (503, b"not serving")
@@ -223,10 +224,34 @@ class MuxServer:
             elif path == "/debug/flight":
                 import json
 
-                status, body = 200, json.dumps(self.flight_source()).encode()
+                from dragonfly2_tpu.telemetry.flight import parse_flight_query
+
+                try:
+                    kwargs = parse_flight_query(query)
+                except ValueError as e:
+                    status, body = 400, str(e).encode()
+                else:
+                    if kwargs:
+                        try:
+                            doc = self.flight_source(**kwargs)
+                        except TypeError:
+                            # explicit flight_source without the kwargs
+                            # surface: serve its whole body unchanged
+                            doc = self.flight_source()
+                    else:
+                        doc = self.flight_source()
+                    # compact separators: the dump's max_bytes cap is
+                    # measured against compact JSON — default separators
+                    # would overshoot the promised bound by ~20%
+                    status, body = 200, json.dumps(
+                        doc, separators=(",", ":"), default=str
+                    ).encode()
             else:
                 status, body = 404, b"not found"
-            reason = {200: "OK", 404: "Not Found", 503: "Service Unavailable"}[status]
+            reason = {
+                200: "OK", 400: "Bad Request", 404: "Not Found",
+                503: "Service Unavailable",
+            }[status]
             writer.write(
                 f"HTTP/1.1 {status} {reason}\r\nContent-Length: {len(body)}\r\n"
                 "Content-Type: text/plain\r\nConnection: close\r\n\r\n".encode() + body

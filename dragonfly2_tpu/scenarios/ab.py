@@ -174,6 +174,13 @@ def _run_arm(
         "retry_waves": st.retry_waves,
         "rounds": rounds,
         "schedule_digest": sim.engine.schedule_digest(),
+        # decision provenance counters (telemetry/decisions.py) —
+        # deterministic (counts only), so they ride the pinned view;
+        # the ml arm's shadow is the rule blend, so shadow_compared > 0
+        # there once a snapshot serves
+        "decisions": (
+            svc.decisions.counters() if svc.decisions is not None else None
+        ),
         # everything wall-clock-dependent lives under `timing` so the
         # determinism check can strip it in one pass
         "timing": {

@@ -145,7 +145,11 @@ class PhaseRecorder:
                 break
             except RuntimeError:
                 continue
-        return ticks if last_n is None else ticks[-last_n:]
+        if last_n is None:
+            return ticks
+        # last_n=0 must mean "no entries": [-0:] would return them all,
+        # and 0 is reachable from the /debug/flight query surface
+        return ticks[-last_n:] if last_n > 0 else []
 
     def phase_p50s(self, last_n: int | None = None) -> dict[str, float]:
         """Per-phase p50 ms over the retained ticks — the exact numbers
@@ -376,48 +380,174 @@ def _span_summary(span) -> dict:
     }
 
 
+# Every section a dump can carry; `section=` query params and the
+# `sections` kwarg select a subset (ticks/jit/active_spans stay the
+# backward-compatible core — older consumers index them directly).
+DUMP_SECTIONS = (
+    "ticks", "jit", "active_spans", "costcards", "timelines", "decisions",
+)
+# Hard payload bound for the HTTP debug surfaces: flight.dump has grown
+# costcards + timelines + decisions on top of the tick ring, and an
+# unbounded /debug/flight pull against a long soak could ship tens of MB
+# through a debug socket. Over the cap, the variable-length rings shed
+# oldest-first and the body carries a `truncated` marker.
+DUMP_MAX_BYTES = 2 << 20
+
+
+def _dump_nbytes(body: dict) -> int:
+    import json
+
+    return len(json.dumps(body, separators=(",", ":"), default=str))
+
+
+def _truncate_dump(body: dict, max_bytes: int) -> dict:
+    """Shrink the dump's ring-backed lists (oldest entries first) until
+    the JSON body fits ``max_bytes``; record what was dropped under the
+    ``truncated`` marker. The scalar sections (jit stats, counters) are
+    bounded by construction and never shed."""
+    dropped: dict[str, int] = {}
+
+    def _lists(b: dict):
+        out = []
+        ticks = b.get("ticks")
+        if isinstance(ticks, dict) and isinstance(ticks.get("last"), list):
+            out.append(("ticks.last", ticks, "last"))
+        for name, tl in (b.get("timelines") or {}).items():
+            if isinstance(tl, dict) and isinstance(tl.get("samples"), list):
+                out.append((f"timelines.{name}.samples", tl, "samples"))
+        for name, led in (b.get("decisions") or {}).items():
+            if isinstance(led, dict) and isinstance(led.get("rows"), list):
+                out.append((f"decisions.{name}.rows", led, "rows"))
+        spans = b.get("active_spans")
+        if isinstance(spans, list) and spans:
+            out.append(("active_spans", b, "active_spans"))
+        cards = b.get("costcards")
+        if isinstance(cards, dict) and isinstance(cards.get("cards"), list):
+            out.append(("costcards.cards", cards, "cards"))
+        return out
+
+    while _dump_nbytes(body) > max_bytes:
+        candidates = [
+            (key, holder, field) for key, holder, field in _lists(body)
+            if holder[field]
+        ]
+        if not candidates:
+            break  # nothing left to shed; scalar floor
+        # shed from the largest list first, oldest half at a time
+        key, holder, field = max(
+            candidates, key=lambda c: len(c[1][c[2]])
+        )
+        lst = holder[field]
+        keep = len(lst) // 2
+        dropped[key] = dropped.get(key, 0) + (len(lst) - keep)
+        holder[field] = lst[-keep:] if keep else []
+        body["truncated"] = {"max_bytes": max_bytes, "dropped": dict(dropped)}
+    return body
+
+
+def parse_flight_query(query: str) -> dict:
+    """``?last_n=&section=&max_bytes=`` → :func:`dump` kwargs — shared
+    by the mux and monitor ``/debug/flight`` routes so the two debug
+    surfaces cannot drift. Raises ValueError with a client-facing
+    message on bad input (the routes answer 400)."""
+    import urllib.parse as _up
+
+    kwargs: dict = {}
+    sections: list[str] = []
+    for key, value in _up.parse_qsl(query or ""):
+        if key == "last_n":
+            try:
+                kwargs["last_n"] = max(int(value), 0)
+            except ValueError:
+                raise ValueError("last_n must be an integer") from None
+        elif key == "section":
+            for name in value.split(","):
+                name = name.strip()
+                if not name:
+                    continue
+                if name not in DUMP_SECTIONS:
+                    raise ValueError(
+                        f"unknown section {name!r}; valid: "
+                        f"{', '.join(DUMP_SECTIONS)}"
+                    )
+                sections.append(name)
+        elif key == "max_bytes":
+            try:
+                # floor keeps the truncation loop meaningful: below ~1k
+                # even the scalar skeleton cannot fit
+                kwargs["max_bytes"] = max(int(value), 1024)
+            except ValueError:
+                raise ValueError("max_bytes must be an integer") from None
+    if sections:
+        kwargs["sections"] = tuple(sections)
+    return kwargs
+
+
 def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
-         registry_fallback: bool = True) -> dict:
+         registry_fallback: bool = True,
+         sections: "tuple[str, ...] | list[str] | None" = None,
+         max_bytes: int | None = DUMP_MAX_BYTES) -> dict:
     """The flight-recorder snapshot: last-N tick phase breakdowns, jit
-    compile/retrace counters, and spans currently open. Pure plain data
-    (dicts/lists/scalars) so it rides the wire codec and JSON as-is.
+    compile/retrace counters, spans currently open, cost cards, soak
+    timelines, and the decision ledger. Pure plain data (dicts/lists/
+    scalars) so it rides the wire codec and JSON as-is.
     `registry_fallback=False` skips the process-global recorder lookup —
     a service reporting about ITSELF (the manager's own section) must not
-    claim a co-located scheduler's tick ring as its own."""
-    if recorder is None and registry_fallback:
-        # the scheduler registers under this name; last registration wins,
-        # so a process-wide dump reads the live service's recorder
-        recorder = _live_recorders().get("scheduler.tick")
-    # shape-stable when no recorder exists: consumers index ["last"] /
-    # ["p50_ms"] without guarding a sometimes-empty dict
-    ticks = (
-        recorder.dump(last_n) if recorder is not None
-        else {"ticks_total": 0, "p50_ms": {}, "last": []}
-    )
-    spans = []
-    for span in default_tracer().active_spans():
-        try:
-            spans.append(_span_summary(span))
-        except RuntimeError:
-            continue  # owner thread mutated attributes mid-copy; skip it
+    claim a co-located scheduler's tick ring as its own.
+    `sections` selects a subset of :data:`DUMP_SECTIONS`; `max_bytes`
+    (None = unbounded) is a hard JSON-size cap enforced by shedding the
+    ring-backed lists oldest-first with a ``truncated`` marker."""
+    want = set(DUMP_SECTIONS if sections is None else sections)
+    body: dict = {"generated_at_ns": time.time_ns()}
+    if "ticks" in want:
+        if recorder is None and registry_fallback:
+            # the scheduler registers under this name; last registration
+            # wins, so a process-wide dump reads the live service's recorder
+            recorder = _live_recorders().get("scheduler.tick")
+        # shape-stable when no recorder exists: consumers index ["last"] /
+        # ["p50_ms"] without guarding a sometimes-empty dict
+        body["ticks"] = (
+            recorder.dump(last_n) if recorder is not None
+            else {"ticks_total": 0, "p50_ms": {}, "last": []}
+        )
+    if "jit" in want:
+        body["jit"] = {
+            name: w.stats() for name, w in sorted(jit_wrappers().items())
+        }
+    if "active_spans" in want:
+        spans = []
+        for span in default_tracer().active_spans():
+            try:
+                spans.append(_span_summary(span))
+            except RuntimeError:
+                continue  # owner thread mutated attributes mid-copy; skip
+        body["active_spans"] = spans
     # Perf-observatory surfaces (additive keys — older consumers index
-    # only ticks/jit/active_spans): the cost-card ledger and any live
-    # soak timelines. A dump is an operator pulling /debug/flight — an
-    # explicitly off-hot-path moment, so it doubles as a cost-card
-    # capture drain (first compile queued the note; the compile-heavy
-    # cost_analysis lands here, in warmup, or at bench report time).
-    from dragonfly2_tpu.telemetry import costcard as _costcard
-    from dragonfly2_tpu.telemetry import timeline as _timeline
+    # only ticks/jit/active_spans): the cost-card ledger, any live soak
+    # timelines, and the decision provenance ledger. A dump is an
+    # operator pulling /debug/flight — an explicitly off-hot-path
+    # moment, so it doubles as a cost-card capture drain (first compile
+    # queued the note; the compile-heavy cost_analysis lands here, in
+    # warmup, or at bench report time).
+    if "costcards" in want:
+        from dragonfly2_tpu.telemetry import costcard as _costcard
 
-    _costcard.ledger().capture_pending()
-    return {
-        "generated_at_ns": time.time_ns(),
-        "ticks": ticks,
-        "jit": {name: w.stats() for name, w in sorted(jit_wrappers().items())},
-        "active_spans": spans,
-        "costcards": _costcard.ledger().dump(),
-        "timelines": {
+        _costcard.ledger().capture_pending()
+        body["costcards"] = _costcard.ledger().dump()
+    if "timelines" in want:
+        from dragonfly2_tpu.telemetry import timeline as _timeline
+
+        body["timelines"] = {
             name: rec.dump()
             for name, rec in sorted(_timeline.live_timelines().items())
-        },
-    }
+        }
+    if "decisions" in want:
+        from dragonfly2_tpu.telemetry import decisions as _decisions
+
+        body["decisions"] = {
+            name: led.dump(last_n=last_n)
+            for name, led in sorted(_decisions.live_ledgers().items())
+        }
+    if max_bytes is not None and _dump_nbytes(body) > max_bytes:
+        body = _truncate_dump(body, max_bytes)
+    return body

@@ -343,7 +343,16 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> MonitorSer
 
                 from dragonfly2_tpu.telemetry import flight
 
-                body = json.dumps(flight.dump()).encode()
+                try:
+                    kwargs = flight.parse_flight_query(query)
+                except ValueError as e:
+                    self.send_error(400, str(e))
+                    return
+                # compact separators: the dump's max_bytes cap is
+                # measured against compact JSON
+                body = json.dumps(
+                    flight.dump(**kwargs), separators=(",", ":"), default=str
+                ).encode()
                 return self._send(body, "application/json")
             self.send_error(404)
 

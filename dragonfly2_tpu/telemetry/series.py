@@ -138,6 +138,59 @@ def scheduler_series(reg) -> _Namespace:
     )
 
 
+def decision_series(reg) -> _Namespace:
+    """Decision provenance ledger families (telemetry/decisions.py):
+    per-arm applied-selection counts, joined outcomes, counterfactual
+    shadow-scoring divergence (top-1 disagreement, rank correlation),
+    measured per-arm regret on disagreement decisions, ledger occupancy,
+    and the decision→outcome join latency."""
+    c = reg.counter
+    return _Namespace(
+        decisions=c(
+            "dragonfly_scheduler_decision_total",
+            "applied parent selections recorded in the decision ledger",
+            ("arm",),
+        ),
+        outcomes=c(
+            "dragonfly_scheduler_decision_outcome_total",
+            "terminal peer events joined to a recorded decision",
+            ("outcome",),
+        ),
+        shadow_scored=c(
+            "dragonfly_scheduler_decision_shadow_scored_total",
+            "decisions re-scored by the inactive (shadow) arm",
+        ),
+        top1_disagreement=reg.gauge(
+            "dragonfly_scheduler_decision_top1_disagreement",
+            "last tick's fraction of decisions where the shadow arm's "
+            "top-1 pick differed from the active arm's",
+        ),
+        rank_corr=reg.gauge(
+            "dragonfly_scheduler_decision_rank_correlation",
+            "last tick's mean rank correlation between the active arm's "
+            "ranked selection and the shadow arm's ranking of the same "
+            "candidate set",
+        ),
+        occupancy=reg.gauge(
+            "dragonfly_scheduler_decision_ledger_occupancy",
+            "decision-ledger ring slots currently holding a decision",
+        ),
+        regret=reg.gauge(
+            "dragonfly_scheduler_decision_regret_ms",
+            "measured regret of the active arm on disagreement decisions "
+            "(mean joined-outcome TTC delta, active minus shadow pick's "
+            "host; positive = the shadow pick's host did better)",
+            ("arm",),
+        ),
+        join_latency=reg.histogram(
+            "dragonfly_scheduler_decision_join_latency_seconds",
+            "wall time between a recorded decision and its joined "
+            "terminal outcome",
+            buckets=(.01, .05, .2, 1.0, 5.0, 30.0, 120.0, 600.0),
+        ),
+    )
+
+
 def serving_series(reg) -> _Namespace:
     """Guarded model activation (registry/serving.py): every new params
     version is gated — sha256 manifest at load, finite-leaves check, and

@@ -48,6 +48,111 @@ def rank_batches(
         )
 
 
+# --------------------------------------------------------------- decisions
+#
+# Ledger → training-trace exporter (telemetry/decisions.py): the decision
+# provenance ledger records, per applied selection, the candidate host
+# slots + pair features and — once the outcome joins — the chosen
+# parent's measured download. That is exactly the (child, candidates,
+# label) shape the ranker trains on, so scenario/soak decision logs are
+# directly ingestible without replaying traces through the CSV pipeline
+# (the ROADMAP item-5 continual-learning on-ramp). Host indices are the
+# scheduler's host SLOTS — the same node space serving_graph_arrays
+# feeds the embedding table — so a batch from here scores against the
+# serving host graph as-is.
+
+
+def decision_rows(doc) -> list[dict]:
+    """Every decision-ledger row reachable in a dump document (a raw
+    ledger dump, a flight dump, or a bench/megascale report embedding
+    one), in seq order — the shared walker from telemetry/decisions.py
+    (tools/dfwhy.py uses the same one)."""
+    from dragonfly2_tpu.telemetry.decisions import extract_dump_rows
+
+    return extract_dump_rows(doc)
+
+
+def decisions_to_rank_arrays(rows: list[dict]) -> dict:
+    """Ledger rows → fixed-shape ranking arrays.
+
+    Keeps only decisions with a joined COMPLETED outcome and a chosen
+    parent; the label is ``log1p(bytes/sec)`` of the measured download
+    (the trainer's throughput unit, records/features.py), attached at
+    the chosen candidate's position. The time basis is the outcome's
+    ``cost_ms`` — the download cost summed from REPORTED piece costs,
+    i.e. virtual time in a scenario/soak replay and measured transfer
+    time in production — never wall-clock TTC, which in a replay would
+    encode simulator host speed rather than parent quality (``ttc_ms``
+    is only a fallback for old dumps that predate the cost column).
+    Non-chosen candidates ride as context rows with ``mask=False`` —
+    logged-bandit data: one labeled action per decision, the rest
+    observed-but-untaken.
+
+    Returns ``{child_idx (N,), parent_idx (N,P), pair_feats (N,P,2),
+    throughput (N,P), mask (N,P)}`` with P = the max candidate count.
+    """
+    def _basis_ms(r: dict) -> float:
+        o = r.get("outcome") or {}
+        return float(o.get("cost_ms") or o.get("ttc_ms") or 0.0)
+
+    def _labeled(r: dict) -> bool:
+        o = r.get("outcome") or {}
+        return (
+            o.get("state") == "completed"
+            and r.get("chosen_pos", -1) >= 0
+            and _basis_ms(r) > 0
+            and bool(o.get("bytes"))
+        )
+
+    usable = [r for r in rows if _labeled(r)]
+    p = max((len(r.get("candidates", ())) for r in usable), default=0)
+    n = len(usable)
+    out = {
+        "child_idx": np.zeros(n, np.int32),
+        "parent_idx": np.zeros((n, p), np.int32),
+        "pair_feats": np.zeros((n, p, 2), np.float32),
+        "throughput": np.zeros((n, p), np.float32),
+        "mask": np.zeros((n, p), bool),
+    }
+    for i, r in enumerate(usable):
+        out["child_idx"][i] = int(r.get("child_host_slot", 0))
+        o = r["outcome"]
+        bps = float(o["bytes"]) / max(_basis_ms(r) / 1e3, 1e-9)
+        for c in r.get("candidates", ()):
+            j = int(c["pos"])
+            if j >= p:
+                continue
+            out["parent_idx"][i, j] = max(int(c.get("host_slot", 0)), 0)
+            feats = c.get("features", {})
+            out["pair_feats"][i, j, 0] = float(feats.get("same_idc", 0.0))
+            out["pair_feats"][i, j, 1] = float(feats.get("loc_match", 0.0))
+        chosen = int(r["chosen_pos"])
+        if chosen < p:
+            out["throughput"][i, chosen] = np.log1p(bps)
+            out["mask"][i, chosen] = True
+    return out
+
+
+def decision_rank_batches(
+    rows: list[dict], batch_size: int, rng: np.random.Generator,
+    shuffle: bool = True,
+) -> Iterator[RankBatch]:
+    """Ledger rows → :class:`RankBatch` minibatches (static shapes via
+    the same wrap-around bucketing as :func:`rank_batches`)."""
+    arrays = decisions_to_rank_arrays(rows)
+    n = arrays["child_idx"].shape[0]
+    if n == 0:
+        return
+    for idx in minibatches(n, batch_size, rng, shuffle):
+        yield RankBatch(
+            child_idx=arrays["child_idx"][idx],
+            parent_idx=arrays["parent_idx"][idx],
+            pair_feats=arrays["pair_feats"][idx],
+            throughput=arrays["throughput"][idx],
+            mask=arrays["mask"][idx],
+        )
+
+
 def graph_arrays(graph: HostGraph, pad_edges_to: int | None = None) -> dict:
     """HostGraph -> dict of arrays for GraphSAGERanker, with optional edge
     padding to a static bucket size (padded edges point at node 0 with zero

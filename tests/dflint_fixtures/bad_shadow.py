@@ -1,0 +1,28 @@
+"""dflint red fixture: an IN-TICK shadow-scoring D2H trips JIT003.
+
+The counterfactual shadow arm's packed selections may come back to the
+host ONLY at the end-of-tick drain valve (`_drain_shadow`, allowlisted in
+tools/dflint/passes/jit_hygiene.D2H_ALLOWLIST). This fixture's `tick`
+reads the shadow result back BETWEEN chunks — exactly the sync that
+would re-serialize the pipelined tick — and must fail JIT003; the
+`_drain_shadow` read is allowlisted by the test's config and stays
+silent.
+"""
+
+import numpy as np
+
+
+def tick(chunks, shadow_entry):
+    results = []
+    for buf, bsz in chunks:
+        shadow_packed = shadow_entry(buf.copy(), bsz)
+        # <- JIT003: in-tick shadow D2H (not the allowlisted drain valve)
+        results.append(np.asarray(shadow_packed))
+    return results
+
+
+def _drain_shadow(inflight):
+    out = []
+    for _s, _e, packed in inflight:
+        out.append(np.asarray(packed))  # allowlisted end-of-tick drain
+    return out

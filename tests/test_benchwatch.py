@@ -210,6 +210,64 @@ def test_bench_py_artifact_kind_round_trips_the_gate(tmp_path):
     assert check(tmp_path, out=io.StringIO()) == 0
 
 
+def test_decision_metrics_direction_table(tmp_path):
+    """ISSUE 13 red/green: divergence metrics (top-1 disagreement, rank
+    correlation) have NO monotonic better-direction — a big swing never
+    flags — while regret is a real lower-is-better verdict and must
+    flag. Covers the loop summary keys and the megascale cells."""
+    from tools.benchwatch import direction_exempt
+
+    # direction table entries for the new family
+    assert direction_exempt("decision_top1_disagreement")
+    assert direction_exempt("decision_rank_corr")
+    assert direction_exempt("soak_100000_shadow_divergence")
+    assert not direction_exempt("decision_regret_ms")
+    assert lower_is_better("decision_regret_ms")
+    assert lower_is_better("planet_100000_decision_regret_fail_rate")
+    assert lower_is_better("shadow_score")  # the tick phase, ms
+    # GREEN: disagreement jumping 9x between adjacent rounds flags nothing
+    a1 = _loop_artifact(20_000.0)
+    a1["summary"].update({"decision_top1_disagreement": 0.05,
+                          "decision_rank_corr": 0.9,
+                          "decision_regret_ms": 1.0})
+    a2 = _loop_artifact(20_000.0)
+    a2["summary"].update({"decision_top1_disagreement": 0.45,
+                          "decision_rank_corr": 0.2,
+                          "decision_regret_ms": 1.0})
+    _write(tmp_path, "BENCH_r01.json", a1)
+    _write(tmp_path, "BENCH_r02.json", a2)
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 0, out.getvalue()
+    entry = normalize(a2, "loop", "BENCH_r02.json")
+    assert "decision_top1_disagreement" not in entry["metrics"]
+    assert "decision_rank_corr" not in entry["metrics"]
+    assert entry["metrics"]["decision_regret_ms"] == 1.0
+    # RED: regret worsening 50% between adjacent rounds fails the gate
+    a3 = _loop_artifact(20_000.0)
+    a3["summary"].update({"decision_regret_ms": 1.5})
+    _write(tmp_path, "BENCH_r03.json", a3)
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION decision_regret_ms" in out.getvalue()
+    # megascale cells: regret compares, the divergence cell is dropped
+    mega = {
+        "schema_version": 2, "cmd": "python bench_megascale.py",
+        "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                     "machine": "x86_64", "python": "3.10"},
+        "summary": {"soak_1000": {
+            "pieces_per_sec": 1000.0, "completed": 10,
+            "origin_traffic_fraction": 0.05,
+            "decision_top1_disagreement": 0.3,
+            "decision_regret_fail_rate": 0.02,
+        }},
+        "runs": [{"scenario": "soak", "hosts": 1000, "stats": {},
+                  "timing": {}}],
+    }
+    m_entry = normalize(mega, "mega", "BENCH_mega.json")
+    assert m_entry["metrics"]["soak_1000_decision_regret_fail_rate"] == 0.02
+    assert "soak_1000_decision_top1_disagreement" not in m_entry["metrics"]
+
+
 def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
     """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
     direction — they stay out of the normalized metrics entirely."""

@@ -1,0 +1,582 @@
+"""Decision provenance ledger + counterfactual shadow scoring (ISSUE 13):
+per-decision candidate provenance, outcome joins, shadow divergence/
+regret, the dfwhy explainer, and the ledger→trainer exporter."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS, SchedulerService
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry.decisions import (
+    ARM_CODES,
+    OUTCOME_COMPLETED,
+    OUTCOME_FAILED,
+    DecisionLedger,
+)
+
+# ------------------------------------------------------------- helpers
+
+
+def _host(i, seed=False, idc="idc-a"):
+    return msg.HostInfo(
+        host_id=f"dc-h{i}", hostname=f"dc-n{i}", ip=f"10.21.{i // 250}.{i % 250}",
+        host_type="super" if seed else "normal", idc=idc,
+        location="na|zone|rack", concurrent_upload_limit=1000,
+    )
+
+
+def _register(svc, peer_id, h, task_id="dc-task", **kw):
+    return svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id=peer_id, task_id=task_id, host=h,
+            url="https://e.com/blob", content_length=4 * (4 << 20),
+            total_piece_count=4, **kw,
+        )
+    )
+
+
+def _seeded_service(reg=None, algorithm="default", ml=None):
+    cfg = Config()
+    cfg.evaluator.algorithm = algorithm
+    svc = SchedulerService(
+        config=cfg, metrics_registry=reg or m.Registry(), ml_evaluator=ml
+    )
+    _register(svc, "dc-seed", _host(0, seed=True))
+    svc.peer_finished(
+        msg.DownloadPeerFinishedRequest(peer_id="dc-seed", piece_count=4)
+    )
+    svc.tick()
+    return svc
+
+
+def _served_ml(tmp_path, feat_dim, hidden=16):
+    import jax
+
+    from dragonfly2_tpu.models.graphsage import GraphSAGERanker
+    from dragonfly2_tpu.registry import (
+        MLEvaluator,
+        ModelEvaluation,
+        ModelRegistry,
+        ModelServer,
+    )
+    from dragonfly2_tpu.registry.registry import MODEL_TYPE_GNN
+
+    model = GraphSAGERanker(hidden_dim=hidden)
+    graph = {
+        "node_feats": np.zeros((8, feat_dim), np.float32),
+        "edge_src": np.zeros(2, np.int32),
+        "edge_dst": np.zeros(2, np.int32),
+        "edge_feats": np.zeros((2, 2), np.float32),
+    }
+    params = model.init(
+        jax.random.key(0), graph, np.zeros(1, np.int32),
+        np.zeros((1, 2), np.int32), np.zeros((1, 2, 2), np.float32),
+    )
+    reg = ModelRegistry(tmp_path)
+    server = ModelServer(reg, "ranker", "h", MODEL_TYPE_GNN,
+                         template_params=params)
+    mv = reg.create_model_version(
+        "ranker", MODEL_TYPE_GNN, "h", params, ModelEvaluation(),
+        metadata={"hidden_dim": hidden},
+    )
+    reg.activate(mv.model_id, mv.version)
+    assert server.refresh()
+    return MLEvaluator(server)
+
+
+# ---------------------------------------------------------- core ledger
+
+
+def test_ledger_records_applied_selections_and_joins_outcomes():
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    for i in range(4):
+        _register(svc, f"dc-c{i}", _host(i + 1))
+        responses = svc.tick()
+        assert isinstance(responses[-1], msg.NormalTaskResponse)
+    led = svc.decisions
+    assert led is not None
+    assert led.counters()["decisions"] == 4
+    dump = led.dump()
+    row = next(r for r in dump["rows"] if r["peer"] == "dc-c3")
+    # the recorded chosen parent is the response's first kept parent
+    assert row["chosen_parent"] is not None
+    assert row["arm"] == "default"
+    assert row["candidates"], "candidate set missing"
+    ranked = [c for c in row["candidates"] if "rank" in c]
+    assert ranked, "no ranked candidates recorded"
+    chosen = next(c for c in row["candidates"] if c["pos"] == row["chosen_pos"])
+    assert chosen["accepted"] is True
+    # every candidate carries the compact feature row
+    for c in row["candidates"]:
+        assert set(c["features"]) == set(dump["features"])
+    # outcome join: completed with a measured TTC + bytes
+    assert row["outcome"]["state"] == "pending"
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(
+        peer_id="dc-c3", piece_count=4, content_length=1234,
+    ))
+    row2 = next(r for r in led.dump()["rows"] if r["peer"] == "dc-c3")
+    assert row2["outcome"]["state"] == "completed"
+    assert row2["outcome"]["bytes"] == 1234
+    assert row2["outcome"]["ttc_ms"] is not None
+    assert led.counters()["joined"] == 1
+    # metric families exported under the scheduler decision namespace
+    text = reg.expose()
+    assert 'dragonfly_scheduler_decision_total{arm="default"} 4' in text
+    assert 'dragonfly_scheduler_decision_outcome_total{outcome="completed"} 1' in text
+    assert "dragonfly_scheduler_decision_ledger_occupancy" in text
+    assert "dragonfly_scheduler_decision_join_latency_seconds" in text
+
+
+def test_ledger_outcome_variants_and_marks():
+    svc = _seeded_service()
+    for i, pid in enumerate(("dc-f", "dc-b", "dc-x")):
+        _register(svc, pid, _host(i + 1))
+        svc.tick()
+    led = svc.decisions
+    # corruption attribution marks the CHILD's decision
+    parent = next(
+        r["chosen_parent"] for r in led.dump()["rows"] if r["peer"] == "dc-x"
+    )
+    svc.piece_failed(msg.DownloadPieceFailedRequest(
+        peer_id="dc-x", parent_peer_id=parent, reason="corruption",
+    ))
+    svc.peer_failed(msg.DownloadPeerFailedRequest(peer_id="dc-f"))
+    svc.back_to_source_started(
+        msg.DownloadPeerBackToSourceStartedRequest(peer_id="dc-b")
+    )
+    rows = {r["peer"]: r for r in led.dump()["rows"]}
+    assert rows["dc-f"]["outcome"]["state"] == "failed"
+    assert rows["dc-b"]["outcome"]["state"] == "back_to_source"
+    assert rows["dc-x"]["outcome"]["corruption"] is True
+    # failover mark: a known peer re-announcing with kept pieces
+    _register(svc, "dc-x", _host(3), finished_pieces=[0, 1])
+    assert {r["peer"]: r for r in led.dump()["rows"]}["dc-x"]["outcome"][
+        "failover"
+    ] is True
+
+
+def test_ledger_ring_bound_and_eviction():
+    led = DecisionLedger(capacity=8, k=4, limit=2, registry=m.Registry())
+    one = lambda v: np.asarray([v])  # noqa: E731
+    for i in range(20):
+        led.record_batch(
+            1, ARM_CODES["default"], one(i), one(i),
+            np.asarray([[0, 1, 2, 3]]), np.asarray([[0, 1, 2, 3]]),
+            one(4), np.zeros((1, 4, 8), np.float32),
+            np.asarray([[0, 1]]), np.asarray([[1.0, 0.5]], np.float32),
+            np.asarray([[True, False]]), one(0),
+            [f"p{i}"], ["t"], [f"par{i}"],
+        )
+    assert led.counters()["decisions"] == 20
+    assert int((led.seq > 0).sum()) == 8
+    dump = led.dump()
+    assert [r["peer"] for r in dump["rows"]] == [f"p{i}" for i in range(12, 20)]
+    # evicted peers' join mappings are gone; live ones join fine
+    assert led.join_outcome("p3", OUTCOME_COMPLETED) is False
+    assert led.join_outcome("p19", OUTCOME_COMPLETED) is True
+
+
+def test_divergence_and_regret_math():
+    led = DecisionLedger(capacity=64, k=4, limit=3, registry=m.Registry())
+    n = 4
+    slots, seqs = led.record_batch(
+        7, ARM_CODES["ml"],
+        np.arange(n), np.arange(n),
+        np.tile(np.arange(4), (n, 1)),
+        # candidate HOSTS: candidate pos j lives on host j (all rows)
+        np.tile(np.arange(4), (n, 1)),
+        np.full(n, 4), np.zeros((n, 4, 8), np.float32),
+        # active ranking: every row picks pos 0 then 1 then 2
+        np.tile(np.asarray([0, 1, 2]), (n, 1)),
+        np.tile(np.asarray([3.0, 2.0, 1.0], np.float32), (n, 1)),
+        np.ones((n, 3), bool), np.zeros(n, np.int64),
+        [f"pr{i}" for i in range(n)], ["t"] * n, ["x"] * n,
+    )
+    # shadow: rows 0,1 agree on top-1; rows 2,3 pick pos 1 first
+    shadow_pos = np.asarray([
+        [0, 1, 2],      # identical -> rho 1.0
+        [0, 2, 1],      # same top-1, tail swapped
+        [1, 0, 2],      # top-1 disagrees
+        [1, 2, 0],      # top-1 disagrees
+    ])
+    entry = led.record_shadow(
+        slots, seqs, shadow_pos, np.zeros((n, 3), np.float32),
+        ARM_CODES["default"], 7,
+    )
+    assert entry["compared"] == 4
+    assert entry["top1_disagreement"] == 0.5
+    # rho per row: [1.0, corr([0,1,2],[0,2,1])=0.5, 0.5, corr([0,1,2],[2,0,1])=-0.5]
+    assert entry["rank_corr"] == pytest.approx((1.0 + 0.5 + 0.5 - 0.5) / 4)
+    # outcomes: host 0 (active pick) always fails; host 1 (shadow pick
+    # on the disagreements) completes — regret must surface positive
+    # fail-rate delta for the active (ml) arm
+    led.join_outcome("pr0", OUTCOME_FAILED)
+    led.join_outcome("pr1", OUTCOME_FAILED)
+    led.join_outcome("pr2", OUTCOME_FAILED)
+    # a separate decision whose CHOSEN host is 1, completing:
+    s2, _ = led.record_batch(
+        8, ARM_CODES["ml"], np.asarray([9]), np.asarray([9]),
+        np.asarray([[0, 1, 2, 3]]), np.asarray([[1, 1, 1, 1]]),
+        np.asarray([4]), np.zeros((1, 4, 8), np.float32),
+        np.asarray([[0, -1, -1]]), np.asarray([[1.0, np.nan, np.nan]], np.float32),
+        np.asarray([[True, False, False]]), np.asarray([0]),
+        ["pr9"], ["t"], ["y"],
+    )
+    assert s2.size == 1
+    led.join_outcome("pr9", OUTCOME_COMPLETED)
+    regret = led.regret()
+    assert regret["n_joined"] == 4
+    assert regret["n_disagreements"] == 2
+    arm = regret["by_arm"]["ml"]
+    # active picks host 0 (fail rate 1.0), shadow host 1 (fail rate 0.0)
+    assert arm["regret_fail_rate"] == pytest.approx(1.0)
+    # host 0 has NO completed download, so no TTC mean exists for it —
+    # the TTC basis must abstain rather than treat fast failures as
+    # fast downloads (review finding: failed rows' TTC inverted regret)
+    assert arm["regret_ttc_ms"] is None
+    assert led.divergence_summary()["top1_disagreement"] == 0.5
+
+
+def test_shadow_join_rejects_overwritten_slots():
+    """A tick recording more decisions than the ring capacity must not
+    cross-match shadow data onto recycled slots: record_shadow skips
+    rows whose (slot, seq) no longer agree."""
+    led = DecisionLedger(capacity=8, k=4, limit=2, registry=m.Registry())
+    args = lambda n, names: (  # noqa: E731
+        np.arange(n), np.arange(n),
+        np.tile(np.arange(4), (n, 1)), np.tile(np.arange(4), (n, 1)),
+        np.full(n, 4), np.zeros((n, 4, 8), np.float32),
+        np.tile(np.asarray([0, 1]), (n, 1)),
+        np.ones((n, 2), np.float32), np.ones((n, 2), bool),
+        np.zeros(n, np.int64), names, ["t"] * n, ["x"] * n,
+    )
+    slots1, seqs1 = led.record_batch(1, 0, *args(6, [f"a{i}" for i in range(6)]))
+    # second chunk of the SAME tick wraps the 8-slot ring over chunk 1
+    led.record_batch(1, 0, *args(6, [f"b{i}" for i in range(6)]))
+    entry = led.record_shadow(
+        slots1, seqs1, np.tile(np.asarray([1, 0]), (6, 1)),
+        np.zeros((6, 2), np.float32), 2, 1,
+    )
+    # only the chunk-1 rows NOT overwritten by chunk 2 compared
+    assert entry["compared"] == 2
+    # and no b-row silently acquired chunk-1 shadow data
+    for r in led.dump()["rows"]:
+        if r["peer"] and r["peer"].startswith("b"):
+            assert r["shadow_arm"] is None, r
+    # ONE batch larger than the whole ring: only the newest `capacity`
+    # rows survive, dropped rows return slot -1, and no dropped peer's
+    # mapping can cross-join an outcome onto a survivor's columns
+    led2 = DecisionLedger(capacity=8, k=4, limit=2, registry=m.Registry())
+    slots, seqs = led2.record_batch(
+        1, 0, *args(12, [f"c{i}" for i in range(12)])
+    )
+    assert slots.shape == (12,) and (slots[:4] == -1).all()
+    assert (slots[4:] >= 0).all() and len(set(slots[4:].tolist())) == 8
+    assert led2.join_outcome("c0", OUTCOME_COMPLETED) is False  # dropped
+    assert led2.join_outcome("c11", OUTCOME_COMPLETED) is True
+    assert [r["peer"] for r in led2.dump()["rows"]] == [
+        f"c{i}" for i in range(4, 12)
+    ]
+
+
+def test_ledger_deterministic_digest_stability():
+    def build():
+        led = DecisionLedger(capacity=16, k=4, limit=2, registry=m.Registry())
+        slots, seqs = led.record_batch(
+            3, ARM_CODES["default"], np.asarray([1, 2]), np.asarray([1, 2]),
+            np.asarray([[0, 1, 2, 3]] * 2), np.asarray([[4, 5, 6, 7]] * 2),
+            np.asarray([4, 3]), np.ones((2, 4, 8), np.float32),
+            np.asarray([[0, 1]] * 2), np.asarray([[1.0, 0.5]] * 2, np.float32),
+            np.asarray([[True, True]] * 2), np.asarray([0, 0]),
+            ["a", "b"], ["t", "t"], ["x", "y"],
+        )
+        led.record_shadow(
+            slots, seqs, np.asarray([[1, 0]] * 2),
+            np.asarray([[2.0, 1.0]] * 2, np.float32), ARM_CODES["ml"], 3,
+        )
+        return led
+
+    l1, l2 = build(), build()
+    assert l1.deterministic_digest() == l2.deterministic_digest()
+    # wall-clock columns differ between the two builds but are excluded
+    l2.join_outcome("a", OUTCOME_COMPLETED, bytes_=10)
+    assert l1.deterministic_digest() != l2.deterministic_digest()
+
+
+# ------------------------------------------------------- shadow scoring
+
+
+def test_shadow_rule_active_ml_counterfactual(tmp_path):
+    """Rule arm serving, committed ml snapshot shadow-scoring: every
+    applied decision gets a shadow ranking, per-tick divergence lands in
+    the ring, and the serving jits route ONLY the proven bucket set
+    (zero new compile signatures — the retrace-tripwire contract)."""
+    from tools.dflint.retracer import SERVING_B_ARGS, observed_batch_buckets
+
+    from dragonfly2_tpu.telemetry.flight import jit_wrappers
+
+    feat_dim = SchedulerService(
+        metrics_registry=m.Registry()
+    ).state.host_numeric.shape[1]
+    ml = _served_ml(tmp_path, feat_dim)
+    try:
+        svc = _seeded_service(algorithm="default", ml=ml)
+        ml.refresh_embeddings(svc.serving_graph_arrays(), wait=True)
+        assert ml.serving_snapshot() is not None
+        svc.warmup()  # warms the ml SHADOW entry too -> shadow-ready
+        for i in range(5):
+            _register(svc, f"dc-s{i}", _host(i + 1))
+            svc.tick()
+        led = svc.decisions
+        c = led.counters()
+        assert c["decisions"] == 5 and c["shadow_compared"] == 5
+        assert led.divergence_ring, "no per-tick divergence entries"
+        row = led.dump()["rows"][-1]
+        assert row["arm"] == "default" and row["shadow_arm"] == "ml"
+        assert row["shadow_agrees_top1"] is not None
+        shadow_ranked = [c_ for c_ in row["candidates"] if "shadow_rank" in c_]
+        assert shadow_ranked, "shadow ranking missing from the dump"
+        # the counterfactual must not claim the ml version SERVED: the
+        # rule blend served every tick, so the refresh/serve audit trail
+        # stays on its rule-served sentinel (review finding)
+        assert ml.last_used_versions is None
+        # last_n=0 means NO rows, not all of them
+        assert led.dump(last_n=0)["rows"] == []
+        # compile-signature discipline: both serving entries observed
+        # only statically-proven buckets
+        for name, b_arg in SERVING_B_ARGS.items():
+            w = jit_wrappers().get(name)
+            if w is None:
+                continue
+            observed = observed_batch_buckets(w, b_arg) - {None}
+            assert observed <= set(_EVAL_BUCKETS), (name, observed)
+    finally:
+        ml.close()
+
+
+def test_shadow_ml_active_rule_counterfactual(tmp_path):
+    feat_dim = SchedulerService(
+        metrics_registry=m.Registry()
+    ).state.host_numeric.shape[1]
+    ml = _served_ml(tmp_path, feat_dim)
+    try:
+        svc = _seeded_service(algorithm="ml", ml=ml)
+        ml.refresh_embeddings(svc.serving_graph_arrays(), wait=True)
+        for i in range(4):
+            _register(svc, f"dc-m{i}", _host(i + 1))
+            svc.tick()
+        led = svc.decisions
+        assert led.counters()["shadow_compared"] == 4
+        row = led.dump()["rows"][-1]
+        assert row["arm"] == "ml" and row["shadow_arm"] == "default"
+        # the shadow_score phase is recorded and excluded from the
+        # control/device aggregates
+        last_tick = svc.recorder.ring[-1]
+        assert last_tick.get("shadow_score", 0.0) > 0.0
+        assert "shadow_score" in svc.recorder.phase_p50s()
+    finally:
+        ml.close()
+
+
+def test_shadow_disabled_paths():
+    # config off: ledger records, no shadow
+    cfg = Config()
+    cfg.scheduler.shadow_scoring = False
+    svc = SchedulerService(config=cfg, metrics_registry=m.Registry())
+    _register(svc, "dc-seed", _host(0, seed=True))
+    svc.peer_finished(
+        msg.DownloadPeerFinishedRequest(peer_id="dc-seed", piece_count=4)
+    )
+    svc.tick()
+    _register(svc, "dc-nsh", _host(1))
+    svc.tick()
+    assert svc.decisions.counters()["shadow_compared"] == 0
+    # ledger off entirely: tick still works, no ledger attached
+    cfg2 = Config()
+    cfg2.scheduler.decision_ledger = False
+    svc2 = SchedulerService(config=cfg2, metrics_registry=m.Registry())
+    assert svc2.decisions is None
+    _register(svc2, "dc-seed2", _host(0, seed=True))
+    svc2.peer_finished(
+        msg.DownloadPeerFinishedRequest(peer_id="dc-seed2", piece_count=4)
+    )
+    svc2.tick()
+    _register(svc2, "dc-off", _host(1))
+    assert any(
+        isinstance(r, msg.NormalTaskResponse) for r in svc2.tick()
+    )
+
+
+def test_shadow_every_thins_the_counterfactual_cadence(tmp_path):
+    """shadow_every=N shadows every Nth tick, keyed on the deterministic
+    tick counter — the 1/N-cost sampling knob for CPU-device rigs."""
+    feat_dim = SchedulerService(
+        metrics_registry=m.Registry()
+    ).state.host_numeric.shape[1]
+    ml = _served_ml(tmp_path, feat_dim)
+    try:
+        cfg = Config()
+        cfg.scheduler.shadow_every = 2
+        svc = SchedulerService(
+            config=cfg, metrics_registry=m.Registry(), ml_evaluator=ml
+        )
+        _register(svc, "dc-seed", _host(0, seed=True))
+        svc.peer_finished(
+            msg.DownloadPeerFinishedRequest(peer_id="dc-seed", piece_count=4)
+        )
+        svc.tick()
+        ml.refresh_embeddings(svc.serving_graph_arrays(), wait=True)
+        svc.warmup()
+        for i in range(6):
+            _register(svc, f"dc-e{i}", _host(i + 1))
+            svc.tick()
+        c = svc.decisions.counters()
+        assert c["decisions"] == 6
+        assert 0 < c["shadow_compared"] < 6
+    finally:
+        ml.close()
+
+
+def test_late_snapshot_commit_warms_shadow_off_the_tick(tmp_path):
+    """A snapshot committing AFTER cold start must not compile the ml
+    shadow program inside a serving tick: shadow stays off, a one-shot
+    background warm runs, and shadow engages once it lands (review
+    finding: the mid-tick XLA compile stall)."""
+    feat_dim = SchedulerService(
+        metrics_registry=m.Registry()
+    ).state.host_numeric.shape[1]
+    ml = _served_ml(tmp_path, feat_dim)
+    try:
+        svc = _seeded_service(algorithm="default", ml=ml)
+        # warmup BEFORE any snapshot: the ml shadow entry is not warm
+        svc.warmup()
+        assert not svc._shadow_ml_ready
+        ml.refresh_embeddings(svc.serving_graph_arrays(), wait=True)
+        _register(svc, "dc-l0", _host(1))
+        svc.tick()  # shadow unavailable -> skipped; background warm spawns
+        assert svc.decisions.counters()["shadow_compared"] == 0
+        t = svc._shadow_warm_thread
+        assert t is not None
+        t.join(timeout=30)
+        assert svc._shadow_ml_ready
+        _register(svc, "dc-l1", _host(2))
+        svc.tick()
+        assert svc.decisions.counters()["shadow_compared"] == 1
+    finally:
+        ml.close()
+
+
+def test_oracle_path_records_equivalent_provenance():
+    """vectorized_control=False (the decision-equivalence oracle) must
+    record the same provenance shape the production path does."""
+    cfg = Config()
+    cfg.scheduler.vectorized_control = False
+    svc = SchedulerService(config=cfg, metrics_registry=m.Registry())
+    _register(svc, "dc-seed", _host(0, seed=True))
+    svc.peer_finished(
+        msg.DownloadPeerFinishedRequest(peer_id="dc-seed", piece_count=4)
+    )
+    svc.tick()
+    for i in range(3):
+        _register(svc, f"dc-o{i}", _host(i + 1))
+        svc.tick()
+    led = svc.decisions
+    assert led.counters()["decisions"] == 3
+    row = led.dump()["rows"][-1]
+    assert row["chosen_parent"] is not None
+    assert any("rank" in c for c in row["candidates"])
+
+
+# ------------------------------------------- dfwhy + trainer exporter
+
+
+def _scenario_lab_dump(tmp_path):
+    """A small scenario-lab replay's ledger dump written to disk — the
+    artifact dfwhy and the trainer exporter consume."""
+    from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+    from dragonfly2_tpu.scenarios.spec import builtin_scenarios
+
+    spec = builtin_scenarios()["bandwidth_skew"]
+    svc = SchedulerService(metrics_registry=m.Registry())
+    sim = ClusterSimulator(svc, num_hosts=48, num_tasks=4, seed=5, scenario=spec)
+    rounds = 0
+    while svc.decisions.counters()["joined"] < 8 and rounds < 400:
+        sim.run_round(8)
+        rounds += 1
+    dump = svc.decisions.dump(last_n=256)
+    path = tmp_path / "decisions.json"
+    path.write_text(json.dumps(dump))
+    return svc, dump, path
+
+
+def test_dfwhy_reconstructs_candidate_explanation(tmp_path, capsys):
+    from tools import dfwhy
+
+    _svc, dump, path = _scenario_lab_dump(tmp_path)
+    target = next(
+        r for r in reversed(dump["rows"]) if r["chosen_parent"] is not None
+    )
+    rc = dfwhy.main([str(path), "--peer", target["peer"], "--last"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"peer={target['peer']}" in out
+    assert target["chosen_parent"] in out
+    assert "cand[" in out and "score=" in out
+    assert "outcome=" in out
+    # every candidate in the record appears in the explanation
+    assert out.count("cand[") == len(target["candidates"])
+    # --parent narrows to decisions involving that parent
+    rc2 = dfwhy.main([
+        str(path), "--peer", target["peer"], "--parent",
+        target["chosen_parent"],
+    ])
+    assert rc2 == 0
+    # unknown peer exits 1; a rows-free file exits 2
+    assert dfwhy.main([str(path), "--peer", "nope"]) == 1
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert dfwhy.main([str(empty), "--peer", "x"]) == 2
+
+
+def test_ledger_to_trainer_exporter(tmp_path):
+    from dragonfly2_tpu.training.data import (
+        decision_rank_batches,
+        decision_rows,
+        decisions_to_rank_arrays,
+    )
+
+    _svc, dump, _path = _scenario_lab_dump(tmp_path)
+    rows = decision_rows(dump)
+    assert rows, "exporter found no rows in the ledger dump"
+    arrays = decisions_to_rank_arrays(rows)
+    n, p = arrays["parent_idx"].shape
+    assert n > 0, "no joined completed decisions to export"
+    assert arrays["child_idx"].shape == (n,)
+    assert arrays["pair_feats"].shape == (n, p, 2)
+    # logged-bandit labeling: exactly one labeled action per decision
+    assert (arrays["mask"].sum(axis=1) == 1).all()
+    labeled = arrays["throughput"][arrays["mask"]]
+    assert np.isfinite(labeled).all() and (labeled > 0).all()
+    # the label basis is the replay-safe reported-piece-cost column, not
+    # wall TTC (a replay's wall interval measures the host, not the
+    # parent): completed rows carry it in the dump
+    completed = [r for r in rows if r["outcome"]["state"] == "completed"]
+    assert completed and all(
+        r["outcome"]["cost_ms"] and r["outcome"]["cost_ms"] > 0
+        for r in completed
+    )
+    batches = list(
+        decision_rank_batches(rows, batch_size=4, rng=np.random.default_rng(0))
+    )
+    assert batches
+    assert batches[0].pair_feats.shape == (4, p, 2)
+    # the flight dump embeds the same rows — exporter reads it too
+    from dragonfly2_tpu.telemetry import flight
+
+    rows2 = decision_rows(flight.dump(max_bytes=None))
+    assert {r["seq"] for r in rows2} >= {r["seq"] for r in rows[-8:]}

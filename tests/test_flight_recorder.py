@@ -16,6 +16,7 @@ from dragonfly2_tpu.telemetry.flight import PhaseRecorder, instrument_jit
 from dragonfly2_tpu.telemetry.series import (
     costcard_series,
     daemon_series,
+    decision_series,
     jit_series,
     manager_series,
     megascale_series,
@@ -217,11 +218,17 @@ def test_metric_naming_convention_registry_walk():
     jit_series(reg, "scheduler")
     jit_series(reg, "trainer")
     # perf-observatory + lab families ride the same sweep: cost cards,
-    # soak timelines, serving activation gate, megascale engine
+    # soak timelines, serving activation gate, megascale engine, and the
+    # decision provenance ledger (dragonfly_scheduler_decision_*)
     costcard_series(reg)
     timeline_series(reg)
     serving_series(reg)
     megascale_series(reg)
+    decision_series(reg)
+    assert any(
+        name.startswith("dragonfly_scheduler_decision_")
+        for name in reg._metrics
+    ), "decision ledger families missing from the sweep"
     for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
         register_version(reg, svc)
         resilience_series(reg, svc)  # breaker-state + deadline families
@@ -265,6 +272,104 @@ def test_metrics_server_graceful_shutdown():
     with pytest.raises(OSError):
         urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=1)
     server.shutdown()  # idempotent
+
+
+def test_flight_dump_sections_and_size_cap():
+    """Satellite (ISSUE 13): flight.dump has grown costcards + timelines
+    + decisions — section selection and a HARD byte cap with a
+    truncation marker bound the /debug/flight payload."""
+    import json
+
+    from dragonfly2_tpu.telemetry import flight
+
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    for i in range(32):
+        _register(svc, f"fl-cap-{i}", _host(i + 1))
+        svc.tick()
+    # section selection: only the asked-for sections ride
+    only_ticks = flight.dump(recorder=svc.recorder, sections=("ticks",))
+    assert "ticks" in only_ticks and "jit" not in only_ticks
+    assert "decisions" not in only_ticks and "costcards" not in only_ticks
+    full = flight.dump(recorder=svc.recorder, max_bytes=None)
+    assert "decisions" in full, "decision ledger missing from the dump"
+    led_dump = full["decisions"].get("scheduler.decisions")
+    assert led_dump and led_dump["rows"], "no decision rows in the dump"
+    full_size = len(json.dumps(full, separators=(",", ":"), default=str))
+    assert full_size > 4096, "fixture dump too small to exercise the cap"
+    # the cap is HARD: the body fits and carries the truncation marker
+    capped = flight.dump(recorder=svc.recorder, max_bytes=4096)
+    capped_size = len(json.dumps(capped, separators=(",", ":"), default=str))
+    assert capped_size <= 4096, capped_size
+    assert capped["truncated"]["max_bytes"] == 4096
+    assert capped["truncated"]["dropped"], "marker records nothing dropped"
+    # scalar sections survive truncation; a generous cap truncates nothing
+    assert "jit" in capped
+    roomy = flight.dump(recorder=svc.recorder, max_bytes=64 << 20)
+    assert "truncated" not in roomy
+    # last_n=0 is "no entries", not the [-0:] everything-slice
+    zero = flight.dump(recorder=svc.recorder, last_n=0, max_bytes=None)
+    assert zero["ticks"]["last"] == []
+    assert all(led["rows"] == [] for led in zero["decisions"].values())
+    # query-param parsing shared by the mux/monitor routes
+    kwargs = flight.parse_flight_query("last_n=4&section=ticks,jit&max_bytes=5000")
+    assert kwargs == {"last_n": 4, "sections": ("ticks", "jit"),
+                      "max_bytes": 5000}
+    with pytest.raises(ValueError):
+        flight.parse_flight_query("last_n=banana")
+    with pytest.raises(ValueError):
+        flight.parse_flight_query("section=nope")
+
+
+def test_mux_flight_route_honours_query_params():
+    """/debug/flight?last_n=&section= reaches the default dump source;
+    bad input answers 400, explicit zero-arg sources keep working."""
+    import asyncio
+
+    from dragonfly2_tpu.rpc.mux import MuxServer
+
+    reg = m.Registry()
+    svc = _seeded_service(reg)
+    for i in range(4):
+        _register(svc, f"fl-mx-{i}", _host(i + 1))
+        svc.tick()
+
+    async def run():
+        async def rpc_handler(reader, writer):
+            writer.close()
+
+        srv = MuxServer(rpc_handler)
+        host, port = await srv.start()
+        try:
+            def get(path):
+                return urllib.request.urlopen(
+                    f"http://{host}:{port}{path}"
+                ).read()
+
+            body = json.loads(await asyncio.to_thread(
+                get, "/debug/flight?last_n=2&section=ticks"
+            ))
+            assert "ticks" in body and "jit" not in body
+            assert len(body["ticks"]["last"]) <= 2
+            with pytest.raises(urllib.error.HTTPError) as e:
+                await asyncio.to_thread(get, "/debug/flight?last_n=x")
+            assert e.value.code == 400
+        finally:
+            await srv.stop()
+        # an explicit flight_source without kwargs still serves untouched
+        srv2 = MuxServer(rpc_handler, flight_source=lambda: {"ok": True})
+        host, port = await srv2.start()
+        try:
+            body = json.loads(await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/flight?last_n=1"
+                ).read()
+            ))
+            assert body == {"ok": True}
+        finally:
+            await srv2.stop()
+
+    asyncio.run(run())
 
 
 def test_manager_rest_serves_flight_recorder_dump():

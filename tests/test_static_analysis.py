@@ -148,6 +148,48 @@ def test_jit_hygiene_fixtures():
     assert "JIT003" not in report3.by_rule()
 
 
+def test_shadow_scoring_drain_discipline_fixture():
+    """An in-tick shadow-scoring D2H trips JIT003; the same read at the
+    allowlisted `_drain_shadow` end-of-tick valve is silent — the
+    capture discipline the decision ledger's counterfactual arm lives
+    under (telemetry/decisions.py). Also pins that the REAL repo
+    allowlist carries the argued `_drain_shadow` entry, so the
+    production drain point cannot silently fall off the design
+    document."""
+    from tools.dflint.passes.jit_hygiene import D2H_ALLOWLIST
+
+    shadow_pass = JitHygienePass(
+        hot_functions={
+            ("bad_shadow.py", "tick"),
+            ("bad_shadow.py", "_drain_shadow"),
+        },
+        allowlist={
+            ("bad_shadow.py", "_drain_shadow", "asarray"):
+                "fixture: the designed end-of-tick shadow drain valve",
+        },
+    )
+    report, _ = _lint([shadow_pass], "bad_shadow.py")
+    jit003 = report.by_rule().get("JIT003", [])
+    assert len(jit003) == 1, [f.render() for f in report.findings]
+    assert jit003[0].symbol == "tick", jit003[0].render()
+    # allowlisting the in-tick read too silences the fixture entirely
+    allowed = JitHygienePass(
+        hot_functions={
+            ("bad_shadow.py", "tick"),
+            ("bad_shadow.py", "_drain_shadow"),
+        },
+        allowlist={
+            ("bad_shadow.py", "_drain_shadow", "asarray"): "fixture",
+            ("bad_shadow.py", "tick", "asarray"): "fixture",
+        },
+    )
+    report2, _ = _lint([allowed], "bad_shadow.py")
+    assert "JIT003" not in report2.by_rule()
+    # the production drain point is on the real allowlist, argued
+    key = ("cluster/scheduler.py", "_drain_shadow", "asarray")
+    assert key in D2H_ALLOWLIST and len(D2H_ALLOWLIST[key]) >= 20
+
+
 def test_determinism_fixtures():
     det = DeterminismPass(
         decision_suffixes=("bad_det.py", "good_det.py"),

@@ -55,12 +55,28 @@ DEFAULT_THRESHOLD = 0.10
 
 # metrics where smaller is better; everything else is higher-better.
 # Suffix rules cover the families (latencies, fractions); exact names
-# pin the ambiguous ones.
-_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_fraction")
+# pin the ambiguous ones. `_regret_fail_rate` precedes the `_fraction`-
+# style reasoning: regret is the active arm's outcome delta vs the
+# shadow pick, and less of it is better.
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_fraction", "_regret_fail_rate")
 _LOWER_BETTER_EXACT = {
     "control_dispatch", "device_call", "candidate_fill", "apply_selection",
     "report_ingest", "pack", "pre_schedule", "link_rtt_probe",
+    "shadow_score",
 }
+
+# Metrics with NO monotonic better-direction — excluded from regression
+# comparison entirely (normalizers drop them): ratio-to-ideal numbers
+# (perfect = 1.0) and the decision-ledger divergence family (top-1
+# disagreement / rank correlation measure WHERE the arms differ, not
+# which is right — the directional verdict is the regret metric).
+_NO_DIRECTION_SUFFIXES = (
+    "_model_vs_measured", "_disagreement", "_divergence", "_rank_corr",
+)
+
+
+def direction_exempt(metric: str) -> bool:
+    return metric.endswith(_NO_DIRECTION_SUFFIXES)
 
 
 def lower_is_better(metric: str) -> bool:
@@ -227,10 +243,10 @@ def _normalize_loop(doc: dict, metrics: dict, quarantined: dict) -> None:
     for key, v in (doc.get("summary") or {}).items():
         if key in ("metric", "control_under_device"):
             continue
-        if key.endswith("_model_vs_measured"):
-            # ratio-to-ideal metrics (perfect = 1.0) have no monotonic
-            # better-direction; drift is caught by the bench's own
-            # assertions, not the trajectory gate
+        if direction_exempt(key):
+            # no monotonic better-direction (ratio-to-ideal numbers,
+            # divergence/disagreement rates); drift is caught by the
+            # bench's own assertions, not the trajectory gate
             continue
         _put(metrics, quarantined, key, v)
 
@@ -244,6 +260,10 @@ def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
         _put(metrics, quarantined, f"{cell}_origin_traffic_fraction",
              s.get("origin_traffic_fraction"))
         _put(metrics, quarantined, f"{cell}_completed", s.get("completed"))
+        # decision-ledger cells: regret compares directionally (lower is
+        # better); the disagreement rate is direction-exempt and skipped
+        _put(metrics, quarantined, f"{cell}_decision_regret_fail_rate",
+             s.get("decision_regret_fail_rate"))
 
 
 def _normalize_scenarios(doc: dict, metrics: dict, quarantined: dict) -> None:

@@ -85,6 +85,8 @@ DEFAULT_HOT_FUNCTIONS = {
     ("cluster/scheduler.py", "tick"),
     ("cluster/scheduler.py", "_dispatch_chunk"),
     ("cluster/scheduler.py", "_drain_chunk"),
+    ("cluster/scheduler.py", "_drain_shadow"),
+    ("cluster/scheduler.py", "_warm_shadow_ml"),
     ("cluster/scheduler.py", "warmup"),
     ("registry/serving.py", "_perform_refresh"),
 }
@@ -124,6 +126,21 @@ D2H_ALLOWLIST: dict[tuple[str, str, str], str] = {
         "already the designed blocking cold-start phase, so the one-time "
         "duplicate compile per bucket signature lands here — a capture "
         "anywhere else on the serving path must fail JIT003"
+    ),
+    ("cluster/scheduler.py", "_warm_shadow_ml", "asarray"): (
+        "the late-commit twin of warmup's forcing: when an ml snapshot "
+        "commits AFTER cold start, the shadow entry compiles on this "
+        "dedicated background thread (never a serving tick) and blocking "
+        "on the zero-filled result is how the compile is forced to land "
+        "before _shadow_ml_ready flips"
+    ),
+    ("cluster/scheduler.py", "_drain_shadow", "asarray"): (
+        "THE counterfactual shadow-scoring drain (telemetry/decisions.py): "
+        "the inactive arm's packed selections are read back ONCE, at the "
+        "end-of-tick valve strictly after the last serving chunk's "
+        "d2h_wait, so the shadow D2H can never re-serialize the pipelined "
+        "tick — an in-tick shadow read-back anywhere else fails JIT003 "
+        "(pinned by the bad_shadow fixture)"
     ),
 }
 

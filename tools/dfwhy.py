@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""dfwhy — answer "why did peer X get parent Y" from a decision-ledger
+dump.
+
+Input: any JSON document carrying decision-ledger rows
+(telemetry/decisions.DecisionLedger.dump): a raw ledger dump, a
+``flight.dump()`` / ``/debug/flight`` body (rows under
+``decisions.<name>.rows``), or a megascale/scenario report embedding a
+ledger dump. For each matching decision it reconstructs the full
+candidate-set explanation: every candidate's feature row, the active
+arm's rank/score and DAG verdict, the shadow arm's counterfactual
+ranking, the chosen parent, and the joined outcome.
+
+Usage:
+    python tools/dfwhy.py DUMP.json --peer PEER_ID [--parent PARENT_ID]
+    python tools/dfwhy.py DUMP.json --peer PEER_ID --json   # machine form
+    python tools/dfwhy.py DUMP.json --list                  # peers seen
+
+Exit codes: 0 = explanation printed, 1 = no matching decision, 2 = the
+input carries no ledger rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from dragonfly2_tpu.telemetry.decisions import (  # noqa: E402
+    extract_dump_rows as extract_rows,
+)
+
+
+def matches(row: dict, peer: str, parent: str | None) -> bool:
+    if row.get("peer") != peer:
+        return False
+    if parent is None:
+        return True
+    if row.get("chosen_parent") == parent:
+        return True
+    return any(c.get("peer") == parent for c in row.get("candidates", ()))
+
+
+def _fmt_features(feats: dict) -> str:
+    return " ".join(f"{k}={v:g}" for k, v in feats.items())
+
+
+def explain(row: dict, out=sys.stdout) -> None:
+    arm = row.get("arm") or "?"
+    print(
+        f"decision seq={row.get('seq')} tick={row.get('tick')} "
+        f"arm={arm} peer={row.get('peer')} task={row.get('task')} "
+        f"child_host={row.get('child_host') or row.get('child_host_slot')}",
+        file=out,
+    )
+    chosen = row.get("chosen_pos")
+    for c in row.get("candidates", ()):
+        marks = []
+        if c.get("pos") == chosen:
+            marks.append("CHOSEN")
+        if "rank" in c:
+            acc = "accepted" if c.get("accepted") else "dag-rejected"
+            marks.append(f"rank={c['rank']} score={c['score']} {acc}")
+        else:
+            marks.append("filtered/unranked")
+        if "shadow_rank" in c:
+            marks.append(
+                f"shadow_rank={c['shadow_rank']} "
+                f"shadow_score={c['shadow_score']}"
+            )
+        peer = c.get("peer") or f"row:{c.get('peer_row')}"
+        host = c.get("host") or f"slot:{c.get('host_slot')}"
+        print(
+            f"  cand[{c.get('pos')}] {peer} @ {host}  "
+            f"{_fmt_features(c.get('features', {}))}  "
+            f"[{' | '.join(marks)}]",
+            file=out,
+        )
+    print(
+        f"  chosen_parent={row.get('chosen_parent')} "
+        f"(pos={chosen})",
+        file=out,
+    )
+    shadow_arm = row.get("shadow_arm")
+    if shadow_arm:
+        agrees = row.get("shadow_agrees_top1")
+        verdict = (
+            "agrees with the active top-1" if agrees
+            else "DISAGREES with the active top-1" if agrees is not None
+            else "no comparable top-1"
+        )
+        print(
+            f"  shadow arm={shadow_arm} top1_pos={row.get('shadow_top1_pos')} "
+            f"— {verdict}",
+            file=out,
+        )
+    else:
+        print("  shadow: not scored (no inactive arm available)", file=out)
+    o = row.get("outcome") or {}
+    extras = [k for k in ("corruption", "failover") if o.get(k)]
+    print(
+        f"  outcome={o.get('state')} ttc_ms={o.get('ttc_ms')} "
+        f"bytes={o.get('bytes')}"
+        + (f" [{', '.join(extras)}]" if extras else ""),
+        file=out,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="JSON file carrying decision-ledger rows")
+    ap.add_argument("--peer", help="child peer id to explain")
+    ap.add_argument("--parent", default=None,
+                    help="restrict to decisions involving this parent")
+    ap.add_argument("--last", action="store_true",
+                    help="only the newest matching decision")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the matching rows as JSON")
+    ap.add_argument("--list", action="store_true", dest="list_peers",
+                    help="list peers with recorded decisions and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        doc = json.loads(open(args.dump).read())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"dfwhy: cannot read {args.dump}: {e}", file=sys.stderr)
+        return 2
+    rows = extract_rows(doc)
+    if not rows:
+        print(f"dfwhy: no decision-ledger rows in {args.dump}",
+              file=sys.stderr)
+        return 2
+    if args.list_peers:
+        peers = sorted({r.get("peer") for r in rows if r.get("peer")})
+        for p in peers:
+            print(p)
+        return 0
+    if not args.peer:
+        print("dfwhy: --peer is required (or --list)", file=sys.stderr)
+        return 2
+    hits = [r for r in rows if matches(r, args.peer, args.parent)]
+    if not hits:
+        print(
+            f"dfwhy: no decision for peer {args.peer!r}"
+            + (f" with parent {args.parent!r}" if args.parent else "")
+            + f" among {len(rows)} ledger rows",
+            file=sys.stderr,
+        )
+        return 1
+    if args.last:
+        hits = hits[-1:]
+    if args.as_json:
+        print(json.dumps(hits, indent=1))
+        return 0
+    for row in hits:
+        explain(row)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
