@@ -63,6 +63,16 @@ def summarize(runs: list[dict]) -> dict:
             "decision_regret_fail_rate": (
                 (r.get("decisions") or {}).get("regret_fail_rate")
             ),
+            # SLO verdict plane (telemetry/slo.py): alert counts and
+            # worst-case budget burn are lower-is-better benchwatch
+            # cells; the verdict state is a category, direction-exempt.
+            "slo_pages_fired": (r.get("slo") or {}).get("pages_fired"),
+            "slo_tickets_fired": (r.get("slo") or {}).get("tickets_fired"),
+            "slo_alerts_fired": (r.get("slo") or {}).get("alerts_fired"),
+            "slo_budget_burn": (r.get("slo") or {}).get("budget_burn"),
+            "slo_verdict_state": (
+                (r.get("slo") or {}).get("verdict_code_final")
+            ),
         }
     return out
 

@@ -272,6 +272,28 @@ class SchedulerService:
         # of paying a multi-second XLA compile inside a serving tick.
         self._shadow_ml_ready = False
         self._shadow_warm_thread: threading.Thread | None = None
+        # Streaming SLO engine on the WALL clock (telemetry/slo.py):
+        # tick-latency, shadow-regret and breaker-census SLIs observed
+        # per tick under mu, burn-rate alerts feeding the process
+        # /debug/health verdict plane. The megascale lab runs its OWN
+        # engine on the event clock (megascale/engine.py) — this one is
+        # the live service's and never rides deterministic surfaces.
+        self.slo = None
+        self._slo_tick_budget_ms = float(
+            getattr(sched, "slo_tick_budget_ms", 250.0)
+        )
+        self._slo_prev_shadow = (0, 0)  # (compared, disagree) counters
+        self._slo_regret_losing = False
+        if getattr(sched, "slo_enabled", True):
+            from dragonfly2_tpu.telemetry.slo import SLOEngine, scheduler_slo_specs
+
+            self.slo = SLOEngine(
+                scheduler_slo_specs(self._slo_tick_budget_ms),
+                name="scheduler.slo",
+                minutes_per_unit=1.0,
+                bucket_minutes=0.25,
+                registry=reg,
+            )
         if getattr(sched, "decision_ledger", True):
             from dragonfly2_tpu.telemetry.decisions import DecisionLedger
 
@@ -1115,8 +1137,81 @@ class SchedulerService:
         tests) safe against concurrent handlers, which the LOCK001 sweep
         showed they were not.
         """
+        t0 = time.perf_counter()
+        refresh_regret = False
         with self.mu:
-            return self._tick_locked()
+            responses = self._tick_locked()
+            if self.slo is not None:
+                try:
+                    refresh_regret = self._observe_slo(
+                        (time.perf_counter() - t0) * 1e3
+                    )
+                except Exception:  # noqa: BLE001 - telemetry must not break the tick
+                    refresh_regret = False
+        if refresh_regret:
+            try:
+                self._refresh_slo_regret()
+            except Exception:  # noqa: BLE001 - telemetry must not break the tick
+                pass
+        return responses
+
+    def _observe_slo(self, tick_ms: float) -> bool:
+        """Feed the live SLO engine one tick's SLIs (caller holds mu —
+        the delta bookkeeping below must stay single-writer under the
+        same lock that serializes ticks).
+
+        - tick_latency: the whole-tick wall time against the configured
+          budget (the PhaseRecorder ring carries the same tick's phase
+          split; this is its end-to-end sum including lock wait — the
+          latency a caller actually observed);
+        - shadow_regret: new shadow comparisons from the decision
+          ledger; disagreements count against the budget only while the
+          measured fail-rate regret says the active arm is LOSING;
+        - breakers: the process-wide open-breaker census.
+
+        Stepped on the wall clock in minutes (perf_counter — the one
+        DET-exempt clock; this engine never rides replay surfaces).
+        Returns True when the regret sign is due for re-estimation —
+        that ledger ring scan is too heavy for this critical section,
+        so tick() runs it AFTER releasing mu (_refresh_slo_regret)."""
+        slo = self.slo
+        over = tick_ms > self._slo_tick_budget_ms
+        slo.observe("tick_latency", good=0 if over else 1, bad=1 if over else 0)
+        refresh = False
+        led = self.decisions
+        if led is not None:
+            c = led.counters()
+            compared, disagree = (
+                c["shadow_compared"], c["shadow_top1_disagree"]
+            )
+            prev_c, prev_d = self._slo_prev_shadow
+            d_comp, d_dis = compared - prev_c, disagree - prev_d
+            self._slo_prev_shadow = (compared, disagree)
+            if d_comp > 0:
+                bad = d_dis if self._slo_regret_losing else 0
+                slo.observe(
+                    "shadow_regret", good=max(d_comp - bad, 0), bad=bad
+                )
+            refresh = self._tick_counter % 64 == 0
+        from dragonfly2_tpu.rpc.resilience import open_breaker_census
+
+        open_b = open_breaker_census()
+        slo.observe("breakers", good=0 if open_b else 1, bad=open_b)
+        slo.step(time.perf_counter() / 60.0)
+        return refresh
+
+    def _refresh_slo_regret(self) -> None:
+        """Re-estimate the shadow-regret sign OUTSIDE mu: the ledger
+        report walks the divergence/outcome rings (a real scan at 4096
+        capacity), the ledger has its own lock, and the result is one
+        GIL-atomic bool the next tick's _observe_slo reads — a one-tick
+        lag in the sign is harmless, a ring scan inside the serving
+        critical section is not."""
+        led = self.decisions
+        if led is None:
+            return
+        regret = led.report().get("regret_fail_rate")
+        self._slo_regret_losing = regret is not None and regret > 0.0
 
     def _tick_locked(self) -> list:
         recorder = self.recorder

@@ -100,6 +100,15 @@ class SchedulerConfig:
     # only the staging-buffer copy + dispatch. Raise this to thin the
     # counterfactual sample at 1/N of the cost.
     shadow_every: int = 1
+    # Streaming SLO engine (telemetry/slo.py): the live scheduler keeps
+    # sliding good/bad counters for tick latency (against the budget
+    # below), shadow regret and the breaker census, evaluated on the
+    # wall clock with multi-window burn-rate alerts feeding the
+    # /debug/health verdict. Recording is a few dict ops per tick.
+    slo_enabled: bool = True
+    # a tick slower than this counts against the tick_latency error
+    # budget (generous on CPU rigs; a real accelerator tick p50 is ms)
+    slo_tick_budget_ms: float = 250.0
     # resource GC (scheduler/config/config.go GCConfig; pkg/gc/gc.go
     # interval runner semantics — swept from the live tick loop)
     peer_gc_interval_seconds: float = CONSTANTS.PEER_GC_INTERVAL_SECONDS

@@ -173,6 +173,19 @@ class EventBatchEngine(ClusterSimulator):
         ]
         self._tl_prev: dict[str, float] = {}
         self._crash_rounds: list[int] = []
+        # --- streaming SLO engine (telemetry/slo.py) on the EVENT clock:
+        # fed one timeline sample per round (a PURE function of the
+        # sample, so tools/dfslo.py replays the identical alert timeline
+        # offline from the recorded samples), stepping burn-rate alert
+        # state machines whose transitions annotate the timeline and
+        # whose verdict columns ride every sample.
+        from dragonfly2_tpu.telemetry.slo import SLOEngine, megascale_slo_specs
+
+        self.slo = SLOEngine(
+            megascale_slo_specs([f"region-{r}" for r in range(n_regions)]),
+            name="megascale.slo",
+            minutes_per_unit=self.minutes_per_round,
+        )
 
     # ------------------------------------------------------------ columns
 
@@ -383,7 +396,32 @@ class EventBatchEngine(ClusterSimulator):
                 )
                 for r, sk in enumerate(self._ttc_sketch)
             },
+            "ttc_ms_p95": {
+                f"region-{r}": (
+                    None if (q := sk.quantile(0.95)) is None else round(q, 2)
+                )
+                for r, sk in enumerate(self._ttc_sketch)
+            },
         }
+        # SLO evaluation: derive every SLI from THIS sample and step the
+        # engine at the event clock. The returned verdict columns ride
+        # the sample (deterministic — pinned by the paired-seed test);
+        # alert fire/clear transitions annotate the timeline next to the
+        # scheduler_crash marks they judge.
+        from dragonfly2_tpu.telemetry.slo import feed_megascale_sample
+
+        step = feed_megascale_sample(
+            self.slo, {**sample, "t": float(self._round)}
+        )
+        sample["slo_verdict"] = step["verdict_code"]
+        sample["slo_alerts_firing"] = step["alerts_firing"]
+        sample["slo_pages_fired"] = step["pages_fired"]
+        sample["slo_tickets_fired"] = step["tickets_fired"]
+        for tr in step["transitions"]:
+            self.timeline.mark_event(
+                self._round,
+                f"slo_{tr['event']}:{tr['severity']}:{tr['slo']}:{tr['rule']}",
+            )
         self.timeline.sample(self._round, sample)
 
     @staticmethod

@@ -170,6 +170,13 @@ def run_megascale(
         # pins the digest identical across runs (wall-clock columns are
         # excluded from it by construction)
         "decisions": _decision_report(svc),
+        # SLO engine output (telemetry/slo.py): final verdict, alert
+        # fire/clear log on the event clock, per-objective budget
+        # remaining — deterministic in (scenario, hosts, seed), so it
+        # rides deterministic_view and the paired-seed test pins it;
+        # tools/dfslo.py reproduces the same block offline from the
+        # `timeline` array above
+        "slo": _slo_report(sim),
         "timing": {
             "setup_s": round(setup_s, 2),
             "wall_s": round(wall, 2),
@@ -185,6 +192,15 @@ def run_megascale(
         "costcards": _drained_costcards(),
     }
     return report
+
+
+def _slo_report(sim) -> dict:
+    """The megascale run's SLO block: the engine's flattened report
+    (telemetry/slo.slo_report) — verdict, pages/tickets fired, budget
+    burn, the alert transition log keyed by event-clock round."""
+    from dragonfly2_tpu.telemetry.slo import slo_report
+
+    return slo_report(sim.slo)
 
 
 def _decision_report(svc) -> dict | None:

@@ -246,6 +246,29 @@ class MuxServer:
                     status, body = 200, json.dumps(
                         doc, separators=(",", ":"), default=str
                     ).encode()
+            elif path == "/debug/health":
+                import json
+
+                from dragonfly2_tpu.telemetry import slo as _slo
+
+                try:
+                    kwargs = _slo.parse_health_query(query)
+                except ValueError as e:
+                    status, body = 400, str(e).encode()
+                else:
+                    # the machine-readable health verdict plane
+                    # (telemetry/slo.health_verdict): every live SLO
+                    # engine merged worst-wins. 503 on `critical` so a
+                    # load balancer can act on the same answer an
+                    # operator reads; compact JSON — the max_bytes cap
+                    # is measured against the bytes actually shipped.
+                    doc = _slo.health_verdict(**kwargs)
+                    status = (
+                        503 if doc["state"] == _slo.VERDICT_CRITICAL else 200
+                    )
+                    body = json.dumps(
+                        doc, separators=(",", ":"), default=str
+                    ).encode()
             else:
                 status, body = 404, b"not found"
             reason = {

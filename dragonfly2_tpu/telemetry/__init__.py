@@ -26,3 +26,9 @@ from dragonfly2_tpu.telemetry.timeline import (  # noqa: F401
     TimelineRecorder,
     recovery_time,
 )
+from dragonfly2_tpu.telemetry.slo import (  # noqa: F401
+    BurnRateRule,
+    SLOEngine,
+    SLOSpec,
+    health_verdict,
+)

@@ -385,6 +385,7 @@ def _span_summary(span) -> dict:
 # backward-compatible core — older consumers index them directly).
 DUMP_SECTIONS = (
     "ticks", "jit", "active_spans", "costcards", "timelines", "decisions",
+    "slo",
 )
 # Hard payload bound for the HTTP debug surfaces: flight.dump has grown
 # costcards + timelines + decisions on top of the tick ring, and an
@@ -418,6 +419,9 @@ def _truncate_dump(body: dict, max_bytes: int) -> dict:
         for name, led in (b.get("decisions") or {}).items():
             if isinstance(led, dict) and isinstance(led.get("rows"), list):
                 out.append((f"decisions.{name}.rows", led, "rows"))
+        for name, eng in (b.get("slo") or {}).items():
+            if isinstance(eng, dict) and isinstance(eng.get("alert_log"), list):
+                out.append((f"slo.{name}.alert_log", eng, "alert_log"))
         spans = b.get("active_spans")
         if isinstance(spans, list) and spans:
             out.append(("active_spans", b, "active_spans"))
@@ -547,6 +551,13 @@ def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
         body["decisions"] = {
             name: led.dump(last_n=last_n)
             for name, led in sorted(_decisions.live_ledgers().items())
+        }
+    if "slo" in want:
+        from dragonfly2_tpu.telemetry import slo as _slo
+
+        body["slo"] = {
+            name: eng.dump(last_n=last_n)
+            for name, eng in sorted(_slo.live_engines().items())
         }
     if max_bytes is not None and _dump_nbytes(body) > max_bytes:
         body = _truncate_dump(body, max_bytes)

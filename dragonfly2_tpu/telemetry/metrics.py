@@ -306,6 +306,8 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> MonitorSer
       process, like the pprof CPU profile does)
     - `/debug/flight` — flight-recorder dump (telemetry/flight.py: last-N
       tick phase breakdowns, jit compile counters, open spans) as JSON
+    - `/debug/health` — the SLO health verdict plane (telemetry/slo.py:
+      ok/degraded/critical with firing-alert causes; 503 on critical)
 
     Returns the server (.server_address for the bound port, .shutdown()
     to stop — graceful: joins the serving thread and closes the socket)."""
@@ -354,10 +356,34 @@ def serve_metrics(registry: Registry | None = None, port: int = 0) -> MonitorSer
                     flight.dump(**kwargs), separators=(",", ":"), default=str
                 ).encode()
                 return self._send(body, "application/json")
+            if path == "/debug/health":
+                import json
+
+                from dragonfly2_tpu.telemetry import slo as _slo
+
+                try:
+                    kwargs = _slo.parse_health_query(query)
+                except ValueError as e:
+                    self.send_error(400, str(e))
+                    return
+                # the machine-readable verdict plane (same body as the
+                # mux route — telemetry/slo.health_verdict): 503 on
+                # `critical` for probes, compact JSON so the max_bytes
+                # cap is the bytes actually shipped
+                doc = _slo.health_verdict(**kwargs)
+                body = json.dumps(
+                    doc, separators=(",", ":"), default=str
+                ).encode()
+                return self._send(
+                    body, "application/json",
+                    status=503 if doc["state"] == _slo.VERDICT_CRITICAL
+                    else 200,
+                )
             self.send_error(404)
 
-        def _send(self, body: bytes, ctype: str = "text/plain"):
-            self.send_response(200)
+        def _send(self, body: bytes, ctype: str = "text/plain",
+                  status: int = 200):
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -445,5 +445,50 @@ def timeline_series(reg) -> _Namespace:
     )
 
 
+def slo_series(reg) -> _Namespace:
+    """Streaming SLO engine families (telemetry/slo.py): per-objective
+    error-budget remaining, multi-window burn rates, alert state and
+    fire transitions, SLI event accounting, and the engine's three-state
+    health verdict — the live-scrape mirror of the `/debug/health`
+    verdict plane and the deterministic alert timelines in megascale
+    artifacts."""
+    return _Namespace(
+        budget_remaining=reg.gauge(
+            "dragonfly_slo_budget_remaining",
+            "fraction of the SLO's error budget remaining over its "
+            "accounting window (1.0 = untouched, below 0 = overspent)",
+            ("source", "slo"),
+        ),
+        burn_rate=reg.gauge(
+            "dragonfly_slo_burn_rate",
+            "error-budget burn rate over one alert-rule window "
+            "(1.0 = consuming exactly the budget)",
+            ("source", "slo", "rule", "window"),
+        ),
+        alert_state=reg.gauge(
+            "dragonfly_slo_alert_state",
+            "multi-window burn-rate alert state (1 = firing: both the "
+            "rule's windows burn at or above its factor)",
+            ("source", "slo", "rule", "severity"),
+        ),
+        alerts_fired=reg.counter(
+            "dragonfly_slo_alerts_fired_total",
+            "burn-rate alert fire transitions",
+            ("source", "slo", "rule", "severity"),
+        ),
+        verdict_state=reg.gauge(
+            "dragonfly_slo_verdict_state",
+            "health verdict of one SLO engine "
+            "(0=ok, 1=degraded, 2=critical)",
+            ("source",),
+        ),
+        sli_events=reg.counter(
+            "dragonfly_slo_sli_events_total",
+            "good/bad SLI events accounted by the streaming SLO engine",
+            ("source", "sli", "outcome"),
+        ),
+    )
+
+
 def register_version(reg, service: str) -> None:
     _version.register_version_gauge(reg, service)

@@ -83,16 +83,16 @@ class Tracer:
         self.add_exporter(spans.append)
         return spans
 
-    def export_to_file(self, path: str | pathlib.Path) -> None:
-        path = pathlib.Path(path)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        lock = threading.Lock()
-
-        def write(span: Span) -> None:
-            with lock, open(path, "a") as f:
-                f.write(json.dumps(span.to_dict()) + "\n")
-
-        self.add_exporter(write)
+    def export_to_file(self, path: str | pathlib.Path) -> "FileSpanExporter":
+        """Attach a JSONL file exporter holding ONE open handle with
+        locked writes (the old closure reopened the file once per span —
+        measurable fd churn on a busy tracer). Returns the exporter so
+        the caller can ``close()`` it (and ``remove_exporter`` it) when
+        done; the JSONL format is byte-identical to the per-span-open
+        implementation."""
+        exporter = FileSpanExporter(path)
+        self.add_exporter(exporter)
+        return exporter
 
     @contextlib.contextmanager
     def span(self, name: str, remote_parent: dict | None = None, **attributes):
@@ -140,6 +140,34 @@ class Tracer:
         """Snapshot of spans currently open (started, not ended)."""
         with self._lock:
             return list(self._active.values())
+
+
+class FileSpanExporter:
+    """JSONL span sink over one held file handle.
+
+    Writes are serialized by a lock and flushed per span (the per-span
+    reopen it replaces flushed implicitly on close, and external readers
+    tail the file). After ``close()`` further spans are dropped silently
+    — an exporter must never break the traced path."""
+
+    def __init__(self, path: str | pathlib.Path):
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def __call__(self, span: Span) -> None:
+        line = json.dumps(span.to_dict()) + "\n"
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
 
 
 def current_span() -> Span | None:
@@ -230,8 +258,12 @@ class OTLPExporter:
     a daemon worker thread so span-end NEVER blocks the caller (the tracer
     runs inside asyncio handlers — a slow collector must not stall the
     event loop, the reference's BatchSpanProcessor makes the same call).
-    `flush()` posts synchronously (shutdown/tests). Network failures drop
-    the batch with a log line, never break the traced path."""
+    `flush()` posts the partial buffer AND drains batches already queued
+    to the worker (shutdown/tests — a queued-but-unposted batch must not
+    be lost just because the daemon worker hadn't gotten to it);
+    `close()` flushes, then stops the worker via a sentinel with a
+    bounded join. Network failures drop the batch with a log line, never
+    break the traced path."""
 
     def __init__(self, endpoint: str, service: str = "dragonfly2-tpu",
                  batch_size: int = 64, timeout: float = 10.0):
@@ -243,8 +275,9 @@ class OTLPExporter:
         self.timeout = timeout
         self._buf: list[Span] = []
         self._lock = threading.Lock()
-        self._queue: "queue.Queue[list[Span]]" = queue.Queue(maxsize=16)
+        self._queue: "queue.Queue[list[Span] | None]" = queue.Queue(maxsize=16)
         self._worker: threading.Thread | None = None
+        self._closed = False
 
     def _ensure_worker(self) -> None:
         if self._worker is None or not self._worker.is_alive():
@@ -255,10 +288,15 @@ class OTLPExporter:
 
     def _drain(self) -> None:
         while True:
-            self._post(self._queue.get())
+            batch = self._queue.get()
+            if batch is None:  # close() sentinel
+                return
+            self._post(batch)
 
     def export(self, span: Span) -> None:
         with self._lock:
+            if self._closed:
+                return  # spans after close drop silently, like a full queue
             self._buf.append(span)
             if len(self._buf) < self.batch_size:
                 return
@@ -274,10 +312,51 @@ class OTLPExporter:
             )
 
     def flush(self) -> None:
+        """Synchronously post everything buffered ANYWHERE in the
+        exporter: batches already handed to the daemon worker's queue
+        (drained here, not abandoned to a thread that may never run
+        again) and then the partial in-progress buffer."""
+        import queue
+
+        while True:
+            try:
+                batch = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if batch is None:
+                # close()'s shutdown sentinel: hand it back to the worker
+                # and stop — swallowing it here would leave the worker
+                # blocked in get() forever (and close() burning its full
+                # join timeout). Nothing can be queued behind it: close()
+                # enqueues it only after _closed blocks further exports.
+                try:
+                    self._queue.put_nowait(None)
+                except Exception:  # noqa: BLE001 - full queue: worker will still see EOF via close()'s retry
+                    pass
+                break
+            self._post(batch)
         with self._lock:
             batch, self._buf = self._buf, []
         if batch:
             self._post(batch)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Bounded shutdown: flush every queued/partial span, then stop
+        the worker via sentinel and join it for at most ``timeout``
+        seconds. Idempotent; later export() calls drop silently."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.flush()
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            try:
+                self._queue.put_nowait(None)
+            except Exception:  # noqa: BLE001 - full queue: join is still bounded
+                pass
+            worker.join(timeout)
+        self._worker = None
 
     def _post(self, batch: list[Span]) -> None:
         import logging

@@ -1,0 +1,23 @@
+"""dflint red fixture: DET002 (wall-clock read on an SLO replay
+evaluation path) + DET003 (set-ordered iteration over firing alerts) —
+in a file the test configures as a decision module, the way
+telemetry/slo.py is in the real DET domain."""
+
+import time
+
+
+class BadSLOEngine:
+    def __init__(self):
+        self.firing = set()
+
+    def step(self, good, bad):
+        # stamping the evaluation off the wall clock makes the alert
+        # timeline depend on machine load, not the replay
+        t = time.time()  # <- DET002
+        return {"t": t, "good": good, "bad": bad}
+
+    def causes(self):
+        out = []
+        for name in self.firing:  # <- DET003 (alert order differs per process)
+            out.append({"slo": name})
+        return out

@@ -1,0 +1,22 @@
+"""dflint green twin of bad_slo.py: the caller stamps the clock (or the
+exempt perf_counter measures), and firing alerts report in sorted
+order — zero findings."""
+
+import time
+
+
+class GoodSLOEngine:
+    def __init__(self):
+        self.firing = set()
+
+    def step(self, t, good, bad):
+        # the REPLAY clock arrives from the caller; perf_counter is the
+        # one exempt clock (measuring, never deciding)
+        wall = time.perf_counter()
+        return {"t": t, "good": good, "bad": bad, "eval_wall_s": wall}
+
+    def causes(self):
+        out = []
+        for name in sorted(self.firing):
+            out.append({"slo": name})
+        return out

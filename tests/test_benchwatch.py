@@ -268,6 +268,54 @@ def test_decision_metrics_direction_table(tmp_path):
     assert "soak_1000_decision_top1_disagreement" not in m_entry["metrics"]
 
 
+def test_slo_metrics_direction_table(tmp_path):
+    """ISSUE 14 red/green: SLO alert counts and error-budget burn are
+    lower-is-better cells (an adjacent-round alert-noise increase fails
+    the gate); the categorical verdict state is direction-exempt and
+    never normalizes into a comparable metric."""
+    from tools.benchwatch import direction_exempt
+
+    assert lower_is_better("soak_100000_slo_pages_fired")
+    assert lower_is_better("soak_100000_slo_tickets_fired")
+    assert lower_is_better("soak_100000_slo_alerts_fired")
+    assert lower_is_better("planet_100000_slo_budget_burn")
+    assert direction_exempt("soak_100000_slo_verdict_state")
+    assert not lower_is_better("pieces_per_sec")
+
+    def mega(pages, burn, verdict):
+        return {
+            "schema_version": 2, "cmd": "python bench_megascale.py",
+            "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                         "machine": "x86_64", "python": "3.10"},
+            "summary": {"soak_1000": {
+                "pieces_per_sec": 1000.0, "completed": 10,
+                "origin_traffic_fraction": 0.05,
+                "slo_pages_fired": pages, "slo_tickets_fired": pages,
+                "slo_alerts_fired": 2 * pages, "slo_budget_burn": burn,
+                "slo_verdict_state": verdict,
+            }},
+            "runs": [{"scenario": "soak", "hosts": 1000, "stats": {},
+                      "timing": {}}],
+        }
+
+    # GREEN: verdict category flips 0 -> 2, alerts/burn steady — passes
+    _write(tmp_path, "BENCH_r01.json", mega(pages=2, burn=0.5, verdict=0))
+    _write(tmp_path, "BENCH_r02.json", mega(pages=2, burn=0.5, verdict=2))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 0, out.getvalue()
+    entry = normalize(mega(2, 0.5, 2), "mega", "BENCH_r02.json")
+    assert "soak_1000_slo_verdict_state" not in entry["metrics"]
+    assert entry["metrics"]["soak_1000_slo_pages_fired"] == 2.0
+    assert entry["metrics"]["soak_1000_slo_budget_burn"] == 0.5
+    # RED: alert noise doubles between adjacent rounds — the gate fails
+    _write(tmp_path, "BENCH_r03.json", mega(pages=4, burn=0.8, verdict=2))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    text = out.getvalue()
+    assert "REGRESSION soak_1000_slo_pages_fired" in text
+    assert "REGRESSION soak_1000_slo_budget_burn" in text
+
+
 def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
     """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
     direction — they stay out of the normalized metrics entirely."""

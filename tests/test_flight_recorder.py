@@ -24,6 +24,7 @@ from dragonfly2_tpu.telemetry.series import (
     resilience_series,
     scheduler_series,
     serving_series,
+    slo_series,
     timeline_series,
     trainer_series,
 )
@@ -225,17 +226,26 @@ def test_metric_naming_convention_registry_walk():
     serving_series(reg)
     megascale_series(reg)
     decision_series(reg)
+    # the SLO verdict plane (dragonfly_slo_*: budget remaining, burn
+    # rates, alert state/fire transitions, SLI events, verdict)
+    slo_series(reg)
     assert any(
         name.startswith("dragonfly_scheduler_decision_")
         for name in reg._metrics
     ), "decision ledger families missing from the sweep"
+    for family in ("dragonfly_slo_budget_remaining", "dragonfly_slo_burn_rate",
+                   "dragonfly_slo_alert_state",
+                   "dragonfly_slo_alerts_fired_total",
+                   "dragonfly_slo_verdict_state",
+                   "dragonfly_slo_sli_events_total"):
+        assert family in reg._metrics, f"{family} missing from the sweep"
     for svc in ("scheduler", "dfdaemon", "manager", "trainer"):
         register_version(reg, svc)
         resilience_series(reg, svc)  # breaker-state + deadline families
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
         r"^dragonfly_(scheduler|dfdaemon|manager|trainer|costcard|timeline"
-        r"|serving|megascale)_[a-z0-9_]+$"
+        r"|serving|megascale|slo)_[a-z0-9_]+$"
     )
     assert reg._metrics, "registry walk found nothing"
     for name, metric in reg._metrics.items():
@@ -370,6 +380,132 @@ def test_mux_flight_route_honours_query_params():
             await srv2.stop()
 
     asyncio.run(run())
+
+
+def _slo_engine_with_page(name):
+    """A live SLO engine (isolated metrics registry; weak-registered
+    under `name`) with one page-severity burn alert firing."""
+    from dragonfly2_tpu.telemetry.slo import SLOEngine, SLOSpec
+
+    eng = SLOEngine(
+        [SLOSpec("probe", sli="s", objective=0.999)],
+        name=name, minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    for t in range(1, 9):
+        eng.observe("s", good=100)
+        eng.step(t)
+    eng.observe("s", good=10, bad=90)
+    eng.step(9)
+    assert eng.verdict()["state"] == "critical"
+    return eng
+
+
+def test_flight_dump_slo_section_round_trip():
+    """Satellite (ISSUE 14): the `slo` section rides flight.dump behind
+    the existing section/max_bytes query machinery — parse_flight_query
+    round-trips it, the dump carries live engines' verdicts, and the
+    byte cap sheds the alert log with the truncation marker."""
+    import gc
+
+    from dragonfly2_tpu.telemetry import flight
+
+    kwargs = flight.parse_flight_query("section=slo&last_n=6")
+    assert kwargs == {"last_n": 6, "sections": ("slo",)}
+    eng = _slo_engine_with_page("test.flight-slo")
+    try:
+        body = flight.dump(**kwargs)
+        assert "slo" in body and "ticks" not in body and "jit" not in body
+        section = body["slo"]["test.flight-slo"]
+        assert section["verdict"]["state"] == "critical"
+        assert section["pages_fired"] == 1
+        assert [e["event"] for e in section["alert_log"]].count("fired") >= 1
+        # the slo alert log is ring-backed: the cap sheds it too —
+        # alternating bad/clean intervals generates fire/clear pairs
+        for i in range(600):
+            if i % 2 == 0:
+                eng.observe("s", good=10, bad=90)
+            else:
+                eng.observe("s", good=100)
+            eng.step(eng._last_t + 1)
+        capped = flight.dump(sections=("slo",), max_bytes=2048, last_n=1024)
+        size = len(json.dumps(capped, separators=(",", ":"), default=str))
+        assert size <= 2048, size
+    finally:
+        del eng
+        gc.collect()
+
+
+def test_mux_and_monitor_serve_debug_health():
+    """Satellite (ISSUE 14): /debug/health on BOTH debug surfaces —
+    verdict schema, 400 on bad query params, the hard payload cap, and
+    503 when a page-severity alert makes the verdict critical."""
+    import asyncio
+    import gc
+
+    from dragonfly2_tpu.rpc.mux import MuxServer
+
+    eng = _slo_engine_with_page("test.health-slo")
+
+    def check_surface(get):
+        # schema: machine-readable verdict with causes and sources
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/debug/health")
+        assert e.value.code == 503  # a firing page = critical = 503
+        body = json.loads(e.value.read())
+        assert body["state"] == "critical" and body["state_code"] == 2
+        assert {"state", "state_code", "causes", "slos", "alert_log",
+                "sources"} <= set(body)
+        assert "test.health-slo" in body["sources"]
+        cause = next(
+            c for c in body["causes"] if c["source"] == "test.health-slo"
+        )
+        assert cause["severity"] == "page" and cause["slo"] == "probe"
+        assert body["slos"]["test.health-slo"]["pages_fired"] == 1
+        # 400 on bad query params (shared parse_health_query contract)
+        for bad in ("last_n=banana", "max_bytes=x"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(f"/debug/health?{bad}")
+            assert e.value.code == 400
+        # the hard payload cap is the bytes actually shipped
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/debug/health?max_bytes=1200&last_n=512")
+        assert e.value.code == 503
+        assert len(e.value.read()) <= 1200
+
+    # monitor surface (telemetry/metrics.serve_metrics)
+    server = m.serve_metrics(m.Registry(), port=0)
+    try:
+        port = server.server_address[1]
+
+        def get_monitor(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ).read()
+
+        check_surface(get_monitor)
+    finally:
+        server.shutdown()
+
+    # mux surface (rpc/mux.MuxServer HTTP sniffing)
+    async def run():
+        async def rpc_handler(reader, writer):
+            writer.close()
+
+        srv = MuxServer(rpc_handler)
+        host, port = await srv.start()
+        try:
+            def get_mux(path):
+                return urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=5
+                ).read()
+
+            await asyncio.to_thread(check_surface, get_mux)
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+    del eng
+    gc.collect()
 
 
 def test_manager_rest_serves_flight_recorder_dump():

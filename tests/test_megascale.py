@@ -133,6 +133,16 @@ def test_megascale_determinism_same_seed():
         and "decision_regret_fail" in s
         for s in r1["timeline"]
     )
+    # SLO verdict plane (ISSUE 14): the slo block (alert log, verdict,
+    # budget burn) and the per-sample verdict columns are paired-seed
+    # IDENTICAL — the alert timeline is a pure function of the replay
+    assert r1["slo"] == r2["slo"]
+    assert r1["slo"]["pages_fired"] > 0  # the kills paged (see below)
+    assert all(
+        "slo_verdict" in s and "slo_alerts_firing" in s
+        and "slo_pages_fired" in s and "ttc_ms_p95" in s
+        for s in r1["timeline"]
+    )
 
 
 def test_megascale_seed_sensitivity():
@@ -201,6 +211,75 @@ def test_soak_timeline_shows_scheduler_kill_and_measured_recovery():
     # just as a final count
     assert any(s["quarantine_active"] > 0 for s in tl)
     assert any(s["corruptions"] > 0 for s in tl)
+
+
+def test_soak_scheduler_kill_pages_and_clears_from_slo_output():
+    """THE SLO soak gate (ISSUE 14): every mid-day scheduler kill fires
+    a page-severity burn-rate alert (announce_stability: the kill's
+    re-announce wave burns the error budget on both alert windows) AT
+    the kill round, and the page clears within the measured recovery
+    window plus one short-window drain — asserted from SLO output, not
+    hand-picked aggregate counters."""
+    r = _mega_run()
+    kills = r["expected_crash_rounds"]
+    assert kills, "soak spec produced no scheduler kill"
+    log = r["slo"]["alert_log"]
+    pages = [e for e in log
+             if e["severity"] == "page" and e["event"] == "fired"]
+    page_rounds = {e["t"] for e in pages}
+    day = 96
+    mid_day_kills = [k for k in kills if k <= int(day * 0.75)]
+    assert mid_day_kills
+    for k in mid_day_kills:
+        assert float(k) in page_rounds, (
+            f"kill at round {k} fired no page; pages at {sorted(page_rounds)}"
+        )
+    # each page clears within (measured recovery + short-window drain +
+    # one interval); recovery for these kills measured 0 simulated
+    # minutes (same-round re-announce adoption), so the bound is tight
+    recovery_by_round = {e["round"]: e for e in r["recovery"]}
+    mpr = r["minutes_per_round"]
+    for e in pages:
+        clear = next(
+            (c for c in log
+             if c["event"] == "cleared" and c["slo"] == e["slo"]
+             and c["rule"] == e["rule"] and c["t"] > e["t"]),
+            None,
+        )
+        assert clear is not None, f"page at t={e['t']} never cleared"
+        rec = recovery_by_round.get(int(e["t"]))
+        rec_minutes = (
+            rec["recovery_sim_minutes"]
+            if rec and rec.get("recovery_sim_minutes") is not None else 0.0
+        )
+        clear_minutes = (clear["t"] - e["t"]) * mpr
+        # short window (5m) drains within one 15-minute interval
+        assert clear_minutes <= rec_minutes + mpr + 5.0, (e, clear)
+    # the in-run judgment is reproducible offline from the timeline
+    # (the dfslo contract; the checked-in-artifact gate lives in
+    # tests/test_slo.py)
+    from dragonfly2_tpu.telemetry.slo import replay_timeline
+
+    replay = replay_timeline(r["timeline"], mpr)
+    assert replay["pages_fired"] == r["slo"]["pages_fired"]
+    assert replay["alert_log"][-len(r["slo"]["alert_log"]):] == \
+        r["slo"]["alert_log"]
+
+
+def test_planet_clean_day_fires_zero_alerts():
+    """The alert-noise gate (ISSUE 14): a clean planet day — WAN scale,
+    diurnal arrivals, flash crowds, NO fault injection — fires ZERO
+    burn-rate alerts of any severity. An SLO plane that pages on a
+    healthy day is worse than none."""
+    r = run_megascale(
+        "planet", num_hosts=1500, num_tasks=32, seed=7,
+        arrivals_per_round=24, retire_after_rounds=24,
+    )
+    assert r["slo"]["pages_fired"] == 0, r["slo"]["alert_log"]
+    assert r["slo"]["tickets_fired"] == 0, r["slo"]["alert_log"]
+    assert r["slo"]["alert_log"] == []
+    assert r["slo"]["verdict_final"] == "ok"
+    assert all(s["slo_verdict"] == 0 for s in r["timeline"])
 
 
 @pytest.mark.soak

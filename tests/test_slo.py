@@ -1,0 +1,422 @@
+"""Streaming SLO engine, multi-window burn-rate alerting, and the health
+verdict plane (ISSUE 14): window math, alert state machines, megascale
+SLI derivation + offline replay (tools/dfslo.py), the /debug/health
+merge, and the live scheduler wiring."""
+
+import json
+import pathlib
+
+import pytest
+
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry.slo import (
+    DEFAULT_BURN_RULES,
+    HEALTH_MAX_BYTES,
+    MEGASCALE_TTC_P95_MS,
+    SEVERITY_PAGE,
+    SEVERITY_TICKET,
+    BurnRateRule,
+    SLOEngine,
+    SLOSpec,
+    _SlidingCounter,
+    feed_megascale_sample,
+    health_verdict,
+    megascale_slo_specs,
+    parse_health_query,
+    replay_timeline,
+    scheduler_slo_specs,
+    slo_report,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _engine(objective=0.999, minutes_per_unit=15.0, **spec_kw):
+    return SLOEngine(
+        [SLOSpec("x", sli="s", objective=objective, **spec_kw)],
+        minutes_per_unit=minutes_per_unit,
+        registry=m.Registry(),
+    )
+
+
+def _warm(eng, rounds=8, good=100):
+    for t in range(1, rounds + 1):
+        eng.observe("s", good=good)
+        eng.step(t)
+    return rounds
+
+
+# ------------------------------------------------------------ window math
+
+
+def test_sliding_counter_window_sums_and_pruning():
+    c = _SlidingCounter(bucket_minutes=15.0, max_minutes=60.0)
+    for t, (g, b) in enumerate([(10, 0), (10, 0), (10, 5), (10, 0)]):
+        c.observe(t * 15.0, g, b)
+    assert c.totals(60.0, 45.0) == (40, 5)
+    assert c.totals(30.0, 45.0) == (20, 5)
+    # a window narrower than one bucket still reads the current bucket
+    assert c.totals(5.0, 45.0) == (10, 0)
+    # pruning: buckets older than max_minutes drop on append
+    c.observe(200.0, 1, 0)
+    assert c.totals(1000.0, 200.0) == (1, 0)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec("bad", sli="s", objective=1.5)
+    with pytest.raises(ValueError):
+        SLOSpec("bad", sli="s", objective=0.99, window_minutes=0)
+    with pytest.raises(ValueError):
+        SLOEngine(
+            [SLOSpec("dup", sli="s", objective=0.9),
+             SLOSpec("dup", sli="s", objective=0.9)],
+            registry=m.Registry(),
+        )
+    assert SLOSpec("b", sli="s", objective=0.99).budget == pytest.approx(0.01)
+
+
+# ------------------------------------------------- burn-rate state machine
+
+
+def test_page_fires_only_when_both_windows_burn():
+    """The multi-window property: a hot long window alone (spike already
+    past) must NOT page — both windows at/above the factor fire it, and
+    the short window draining clears it."""
+    eng = _engine()
+    t = _warm(eng)
+    # spike: one interval burns far past 14.4x on both windows -> page
+    eng.observe("s", good=50, bad=50)
+    out = eng.step(t + 1)
+    assert out["verdict"] == "critical"
+    fired = [e for e in eng.alert_log if e["event"] == "fired"]
+    assert {(e["rule"], e["severity"]) for e in fired} == {
+        ("fast_burn", SEVERITY_PAGE), ("slow_burn", SEVERITY_TICKET)
+    }
+    # next interval is clean: the 5m short window drains -> page clears
+    # even though the 1h long window still contains the whole spike
+    eng.observe("s", good=100)
+    out = eng.step(t + 2)
+    assert not any(
+        c["severity"] == SEVERITY_PAGE for c in eng.verdict()["causes"]
+    )
+    cleared = [e for e in eng.alert_log if e["event"] == "cleared"]
+    assert ("fast_burn",) in {(e["rule"],) for e in cleared}
+    assert eng.pages_fired == 1
+
+
+def test_min_events_abstains_on_thin_windows():
+    eng = _engine(min_events=64)
+    _warm(eng, rounds=2, good=4)
+    eng.observe("s", bad=4)  # 100% errors, but only 12 events in window
+    out = eng.step(3)
+    assert out["verdict"] == "ok" and out["alerts_firing"] == 0
+
+
+def test_budget_accounting():
+    eng = _engine(objective=0.9, window_minutes=24 * 60.0)
+    _warm(eng, rounds=4, good=90)
+    eng.observe("s", good=0, bad=18)  # 18 bad of 378 total; allowed 37.8
+    eng.step(5)
+    ev = eng.dump()["evaluations"]["x"]
+    assert ev["budget_remaining"] == pytest.approx(1 - 18 / 37.8, abs=1e-3)
+    assert ev["error_rate"] == pytest.approx(18 / 378, abs=1e-4)
+    report = slo_report(eng)
+    assert report["budget_burn"] == pytest.approx(18 / 37.8, abs=1e-3)
+    assert report["verdict_final"] in ("ok", "degraded", "critical")
+
+
+def test_ticket_only_is_degraded():
+    rules = (BurnRateRule("slow_burn", SEVERITY_TICKET, 360.0, 30.0, 6.0),)
+    eng = SLOEngine(
+        [SLOSpec("x", sli="s", objective=0.99, burn_rules=rules)],
+        minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    t = _warm(eng, rounds=2)
+    eng.observe("s", good=50, bad=50)
+    out = eng.step(t + 1)
+    assert out["verdict"] == "degraded" and out["tickets_fired"] == 1
+    causes = eng.verdict()["causes"]
+    assert causes and causes[0]["severity"] == SEVERITY_TICKET
+
+
+def test_engine_determinism_same_feed_same_alert_timeline():
+    def run():
+        eng = _engine()
+        feed = [(100, 0)] * 8 + [(60, 40)] + [(100, 0)] * 6 + [(70, 30)]
+        for t, (g, b) in enumerate(feed, start=1):
+            eng.observe("s", good=g, bad=b)
+            eng.step(t)
+        return eng.dump()
+
+    assert run() == run()
+
+
+def test_metrics_exported():
+    reg = m.Registry()
+    eng = SLOEngine(
+        [SLOSpec("x", sli="s", objective=0.999)],
+        name=None, minutes_per_unit=15.0, registry=reg,
+    )
+    t = _warm(eng)
+    eng.observe("s", good=10, bad=90)
+    eng.step(t + 1)
+    text = reg.expose()
+    assert 'dragonfly_slo_verdict_state{source="slo"} 2.0' in text
+    assert 'dragonfly_slo_alerts_fired_total{source="slo",slo="x",rule="fast_burn",severity="page"} 1.0' in text
+    assert 'dragonfly_slo_budget_remaining{source="slo",slo="x"}' in text
+    assert 'dragonfly_slo_sli_events_total{source="slo",sli="s",outcome="bad"} 90.0' in text
+    assert 'window="short"' in text and 'window="long"' in text
+
+
+# --------------------------------------------------- megascale derivation
+
+
+def _clean_sample(t, regions=("region-0", "region-1")):
+    return {
+        "t": float(t),
+        "pieces": 1000, "completed": 60, "corruptions": 0,
+        "origin_fraction": 0.05, "reannounce_backlog": 0,
+        "breaker_open": 0,
+        "ttc_ms_p95": {r: 4000.0 for r in regions},
+    }
+
+
+def test_megascale_feed_clean_day_zero_alerts():
+    eng = SLOEngine(
+        megascale_slo_specs(["region-0", "region-1"]),
+        minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    for t in range(1, 30):
+        feed_megascale_sample(eng, _clean_sample(t))
+    assert eng.pages_fired == 0 and eng.tickets_fired == 0
+    assert eng.verdict()["state"] == "ok"
+    assert not eng.alert_log
+
+
+def test_megascale_feed_kill_spike_pages_and_clears():
+    """A re-announce spike (the scheduler-kill signature) fires the
+    announce_stability fast-burn page at the spike interval and clears
+    it within one interval of the short window draining."""
+    samples = [_clean_sample(t) for t in range(1, 30)]
+    samples[14]["reannounce_backlog"] = 50  # t=15: kill wipes peers
+    result = replay_timeline(samples, minutes_per_unit=15.0)
+    fired = [e for e in result["alert_log"]
+             if e["event"] == "fired" and e["severity"] == SEVERITY_PAGE]
+    assert [e["t"] for e in fired] == [15.0]
+    assert fired[0]["slo"] == "announce_stability"
+    cleared = [e for e in result["alert_log"]
+               if e["event"] == "cleared" and e["rule"] == "fast_burn"]
+    assert cleared and cleared[0]["t"] <= 17.0
+    assert result["paged"] and result["pages_fired"] == 1
+    # the verdict columns ride every sample; the spike interval is the
+    # critical one
+    by_t = {c["t"]: c for c in result["samples"]}
+    assert by_t[15.0]["slo_verdict"] == 2
+    assert by_t[14.0]["slo_verdict"] == 0
+
+
+def test_megascale_ttc_and_breaker_slis():
+    eng = SLOEngine(
+        megascale_slo_specs(["region-0"]),
+        minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    for t in range(1, 10):
+        s = _clean_sample(t, regions=("region-0",))
+        if t >= 5:
+            s["ttc_ms_p95"]["region-0"] = MEGASCALE_TTC_P95_MS * 3
+            s["breaker_open"] = 3
+        feed_megascale_sample(eng, s)
+    d = eng.dump()
+    assert d["evaluations"]["ttc_region-0"]["bad_events"] > 0
+    assert d["evaluations"]["breaker_health"]["bad_events"] > 0
+    # sustained breaker-open intervals page the breaker SLO
+    assert any(
+        e["slo"] == "breaker_health" and e["event"] == "fired"
+        for e in eng.alert_log
+    )
+
+
+def test_replay_timeline_deterministic_and_pure():
+    samples = [_clean_sample(t) for t in range(1, 20)]
+    samples[9]["reannounce_backlog"] = 40
+    r1 = replay_timeline(samples, 15.0)
+    r2 = replay_timeline(samples, 15.0)
+    assert r1 == r2
+    # replay ignores any recorded slo_* columns (pure function of the
+    # raw sample columns): pre-annotated samples replay identically
+    annotated = [dict(s, slo_verdict=1, slo_pages_fired=9) for s in samples]
+    assert replay_timeline(annotated, 15.0) == r1
+
+
+# ------------------------------------------------------ the verdict plane
+
+
+def test_parse_health_query():
+    assert parse_health_query("") == {}
+    assert parse_health_query("last_n=4&max_bytes=4096") == {
+        "last_n": 4, "max_bytes": 4096,
+    }
+    assert parse_health_query("max_bytes=10")["max_bytes"] == 1024  # floor
+    with pytest.raises(ValueError):
+        parse_health_query("last_n=banana")
+    with pytest.raises(ValueError):
+        parse_health_query("max_bytes=nope")
+
+
+def test_health_verdict_merges_worst_wins_and_caps():
+    import gc
+
+    ok_eng = SLOEngine(
+        [SLOSpec("fine", sli="s", objective=0.9)],
+        name="test.hv-ok", minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    ok_eng.observe("s", good=100)
+    ok_eng.step(1)
+    bad_eng = _engine()
+    bad_eng.name = "test.hv-bad"
+    from dragonfly2_tpu.telemetry.slo import register_engine
+
+    register_engine("test.hv-bad", bad_eng)
+    t = _warm(bad_eng)
+    for i in range(400):  # fire/clear churn to grow the alert log
+        bad_eng.observe("s", good=10, bad=90) if i % 2 == 0 \
+            else bad_eng.observe("s", good=100)
+        bad_eng.step(t + 1 + i)
+    # leave the page FIRING so the merged verdict is critical
+    bad_eng.observe("s", good=10, bad=90)
+    bad_eng.step(t + 401)
+    assert bad_eng.verdict()["state"] == "critical"
+    try:
+        body = health_verdict(last_n=256, max_bytes=None)
+        assert body["state"] == "critical"  # worst of {ok, critical} wins
+        assert "test.hv-ok" in body["sources"]
+        assert "test.hv-bad" in body["sources"]
+        assert body["slos"]["test.hv-ok"]["state"] == "ok"
+        # the hard cap sheds the alert log oldest-first with a marker
+        capped = health_verdict(last_n=512, max_bytes=2048)
+        size = len(json.dumps(capped, separators=(",", ":"), default=str))
+        assert size <= 2048, size
+        assert capped["truncated"]["dropped_alert_log"] > 0
+        assert capped["state"] == "critical"  # verdict survives shedding
+        roomy = health_verdict(max_bytes=HEALTH_MAX_BYTES)
+        assert "truncated" not in roomy
+    finally:
+        del ok_eng, bad_eng
+        gc.collect()
+
+
+# --------------------------------------------------- live scheduler wiring
+
+
+def test_scheduler_tick_feeds_live_slo_engine():
+    """The live service keeps tick-latency/shadow-regret/breaker SLIs on
+    the wall clock: ticks observe into scheduler.slo, the engine rides
+    the flight dump's slo section, and a healthy loop reads ok."""
+    from dragonfly2_tpu.cluster import messages as msg
+    from dragonfly2_tpu.cluster.scheduler import SchedulerService
+
+    svc = SchedulerService(metrics_registry=m.Registry())
+    assert svc.slo is not None
+    h = msg.HostInfo(
+        host_id="slo-h0", hostname="slo-n0", ip="10.9.9.1",
+        host_type="super", idc="idc", location="na|z|r",
+    )
+    svc.register_peer(msg.RegisterPeerRequest(
+        peer_id="slo-seed", task_id="slo-task", host=h,
+        url="https://e.com/blob", content_length=4 * (4 << 20),
+        total_piece_count=4,
+    ))
+    svc.peer_finished(msg.DownloadPeerFinishedRequest(
+        peer_id="slo-seed", piece_count=4
+    ))
+    for i in range(6):
+        hi = msg.HostInfo(
+            host_id=f"slo-h{i+1}", hostname=f"slo-n{i+1}",
+            ip=f"10.9.9.{i+2}", host_type="normal", idc="idc",
+            location="na|z|r",
+        )
+        svc.register_peer(msg.RegisterPeerRequest(
+            peer_id=f"slo-p{i}", task_id="slo-task", host=hi,
+            url="https://e.com/blob", content_length=4 * (4 << 20),
+            total_piece_count=4,
+        ))
+        svc.tick()
+    d = svc.slo.dump()
+    assert d["evaluations"]["tick_latency"]["events"] >= 6
+    assert {"tick_latency", "shadow_regret", "breaker_health"} == set(
+        svc.slo.specs
+    )
+    assert set(s.name for s in scheduler_slo_specs(250.0)) == set(svc.slo.specs)
+    # healthy loop: nothing fires
+    assert d["verdict"]["state"] == "ok"
+    # the engine rides the service's flight dump behind the section knob
+    dump = svc.flight_dump(sections=("slo",))
+    assert "scheduler.slo" in dump["slo"]
+    # config off-switch
+    from dragonfly2_tpu.config.config import Config
+
+    cfg = Config()
+    cfg.scheduler.slo_enabled = False
+    svc2 = SchedulerService(config=cfg, metrics_registry=m.Registry())
+    assert svc2.slo is None
+    svc2.tick()  # no SLO engine, no crash
+
+
+# ------------------------------------------------------- offline judgment
+
+
+def test_dfslo_judges_synthetic_artifacts(tmp_path):
+    import tools.dfslo as dfslo
+
+    clean = {
+        "runs": [{
+            "scenario": "planet", "hosts": 64, "minutes_per_round": 15.0,
+            "timeline": [_clean_sample(t) for t in range(1, 20)],
+        }],
+    }
+    rc, results = dfslo.judge(clean)
+    assert rc == 0 and not results[0]["paged"]
+    spiky = {
+        "scenario": "soak", "hosts": 64, "minutes_per_round": 15.0,
+        "timeline": [_clean_sample(t) for t in range(1, 20)],
+    }
+    spiky["timeline"][9]["reannounce_backlog"] = 40
+    rc, results = dfslo.judge({"runs": [spiky]})
+    assert rc == 2 and results[0]["paged"]
+    # CLI contract: exit code rides out of main(), drift is detected
+    path = tmp_path / "mega.json"
+    path.write_text(json.dumps({"runs": [spiky]}))
+    assert dfslo.main([str(path)]) == 2
+    # a doctored recorded judgment is reported as drift (exit 2)
+    doctored = dict(spiky)
+    doctored["slo"] = {"pages_fired": 0, "tickets_fired": 0,
+                       "verdict_final": "ok", "alert_log": []}
+    rc, results = dfslo.judge({"runs": [doctored]})
+    assert rc == 2 and results[0]["recorded_drift"]
+
+
+def test_dfslo_reproduces_checked_in_bench_mega_verdicts():
+    """THE acceptance gate (ISSUE 14): the checked-in BENCH_mega
+    artifact replays offline to the same verdicts the runs recorded —
+    the soak's scheduler kills paged, the clean planet day fired ZERO
+    alerts (the alert-noise gate), and neither run drifts from its
+    recorded judgment."""
+    import tools.dfslo as dfslo
+
+    doc = json.loads((ROOT / "BENCH_mega.json").read_text())
+    rc, results = dfslo.judge(doc)
+    by_scenario = {}
+    for r in results:
+        by_scenario[r["run"].rsplit("_", 1)[0]] = r
+    assert "planet" in by_scenario and "soak" in by_scenario, by_scenario
+    planet, soak = by_scenario["planet"], by_scenario["soak"]
+    # alert-noise gate: a clean planet day fires NOTHING
+    assert planet["pages_fired"] == 0 and planet["tickets_fired"] == 0, planet
+    assert planet["verdict_final"] == "ok"
+    # the soak's mid-day scheduler kills page
+    assert soak["pages_fired"] > 0 and soak["paged"]
+    assert rc == 2
+    # offline replay == recorded judgment, bit for bit
+    assert not planet["recorded_drift"], planet["recorded_drift"]
+    assert not soak["recorded_drift"], soak["recorded_drift"]

@@ -205,6 +205,39 @@ def test_determinism_fixtures():
     ]
 
 
+def test_slo_determinism_fixtures_and_domain():
+    """ISSUE 14 satellite: telemetry/slo.py is a DET domain (the replay
+    evaluation path may never read the wall clock — paired-seed alert
+    timelines depend on it; perf_counter stays exempt), pinned by a
+    red/green fixture pair shaped like the SLO engine."""
+    from tools.dflint.passes.determinism import DEFAULT_DECISION_SUFFIXES
+
+    assert any(
+        s.endswith("telemetry/slo.py") for s in DEFAULT_DECISION_SUFFIXES
+    ), DEFAULT_DECISION_SUFFIXES
+    det = DeterminismPass(
+        decision_suffixes=("bad_slo.py", "good_slo.py"),
+        set_iter_suffixes=("bad_slo.py", "good_slo.py"),
+    )
+    report, _ = _lint([det], "bad_slo.py", "good_slo.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"DET002": 1, "DET003": 1}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    # the green twin (caller-stamped clock, perf_counter measuring,
+    # sorted alert iteration) stays silent
+    assert not any("good_slo" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_slo" in f.path
+    ]
+    # and the real module is clean under the default domain set
+    real = run_dflint(
+        ROOT,
+        files=[ROOT / "dragonfly2_tpu" / "telemetry" / "slo.py"],
+        passes=[DeterminismPass()],
+    )[0]
+    assert real.unwaived() == [], [f.render() for f in real.unwaived()]
+
+
 def test_shape_donation_fixtures():
     report, _ = _lint(
         [ShapeDonationPass()],
@@ -511,6 +544,7 @@ def test_typecheck_runner_gates_or_passes():
     assert subset() == [
         "dragonfly2_tpu/state", "dragonfly2_tpu/graph", "dragonfly2_tpu/ops",
         "dragonfly2_tpu/telemetry/flight.py",
+        "dragonfly2_tpu/telemetry/slo.py",
         "dragonfly2_tpu/cluster/quarantine.py",
         "dragonfly2_tpu/scenarios/spec.py",
     ]

@@ -1,6 +1,7 @@
 """Telemetry: metrics exposition + span tracing (SURVEY.md §5)."""
 
 import json
+import time
 import urllib.request
 
 from dragonfly2_tpu.telemetry import metrics as m
@@ -83,7 +84,7 @@ def test_tracing_nesting_and_export(tmp_path):
     tracer = tracing.Tracer("scheduler")
     spans = tracer.export_to_memory()
     path = tmp_path / "spans.jsonl"
-    tracer.export_to_file(path)
+    file_exporter = tracer.export_to_file(path)
 
     with tracer.span("announce_peer", peer_id="p1") as outer:
         with tracer.span("schedule_tick") as inner:
@@ -99,6 +100,7 @@ def test_tracing_nesting_and_export(tmp_path):
     assert parent.duration_ms() is not None
     lines = [json.loads(l) for l in path.read_text().splitlines()]
     assert len(lines) == 2 and lines[1]["name"] == "announce_peer"
+    file_exporter.close()
 
 
 def test_tracing_error_status():
@@ -335,3 +337,121 @@ def test_otlp_exporter_ships_ingestible_batches(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_otlp_flush_drains_worker_queued_batches():
+    """ISSUE 14 satellite: flush() must post batches already handed to
+    the daemon worker's queue, not only the partial buffer — with the
+    worker prevented from running (the crash/teardown race), a full
+    queued batch previously vanished on flush."""
+    from dragonfly2_tpu.telemetry.tracing import OTLPExporter, Span
+
+    posted = []
+    exporter = OTLPExporter("http://127.0.0.1:1", batch_size=2)
+    exporter._post = posted.append  # no network; record batches
+    exporter._ensure_worker = lambda: None  # worker never runs
+
+    def span(i):
+        return Span(name=f"s{i}", trace_id="t", span_id=f"i{i}",
+                    parent_id=None, start_ns=1, end_ns=2)
+
+    for i in range(5):  # two full batches queued + one partial buffered
+        exporter.export(span(i))
+    assert exporter._queue.qsize() == 2 and len(exporter._buf) == 1
+    exporter.flush()
+    flat = [s.name for batch in posted for s in batch]
+    assert flat == ["s0", "s1", "s2", "s3", "s4"], flat
+    assert exporter._queue.qsize() == 0 and exporter._buf == []
+
+
+def test_otlp_close_is_bounded_and_stops_the_worker():
+    """close(): flush everything, stop the worker via sentinel, join
+    bounded, and drop (never crash on) post-close exports."""
+    import threading as _threading
+
+    from dragonfly2_tpu.telemetry.tracing import OTLPExporter, Span
+
+    posted = []
+    exporter = OTLPExporter("http://127.0.0.1:1", batch_size=1)
+    exporter._post = posted.append
+
+    s = Span(name="one", trace_id="t", span_id="i", parent_id=None,
+             start_ns=1, end_ns=2)
+    exporter.export(s)  # full batch -> worker starts and posts it
+    deadline = time.time() + 5
+    while not posted and time.time() < deadline:
+        time.sleep(0.01)
+    worker = exporter._worker
+    assert worker is not None and worker.is_alive()
+    exporter.close(timeout=5)
+    assert not worker.is_alive(), "close() left the otlp worker running"
+    assert exporter._worker is None
+    n = len(posted)
+    exporter.export(s)  # post-close exports drop silently
+    exporter.flush()
+    assert len(posted) == n
+    exporter.close()  # idempotent
+    assert not any(
+        t.name == "otlp-exporter" and t.is_alive()
+        for t in _threading.enumerate()
+    )
+
+
+def test_otlp_flush_preserves_close_sentinel():
+    """A concurrent flush() racing close() must hand the None shutdown
+    sentinel back to the queue instead of swallowing it — a stolen
+    sentinel left the worker blocked in get() forever and close()
+    burning its full join timeout."""
+    from dragonfly2_tpu.telemetry.tracing import OTLPExporter, Span
+
+    posted = []
+    exporter = OTLPExporter("http://127.0.0.1:1", batch_size=8)
+    exporter._post = posted.append
+    s = Span(name="one", trace_id="t", span_id="i", parent_id=None,
+             start_ns=1, end_ns=2)
+    exporter.export(s)
+    exporter._queue.put_nowait(None)  # close()'s sentinel, worker not yet at it
+    exporter.flush()
+    # partial buffer posted, sentinel back on the queue for the worker
+    assert [sp.name for b in posted for sp in b] == ["one"]
+    assert exporter._queue.qsize() == 1
+    assert exporter._queue.get_nowait() is None
+
+
+def test_file_exporter_holds_one_handle_with_locked_writes(tmp_path, monkeypatch):
+    """export_to_file keeps ONE held handle (the old closure reopened
+    the file per span), writes byte-identical JSONL, and closes
+    explicitly — post-close spans drop instead of raising."""
+    import builtins
+    import json as _json
+
+    from dragonfly2_tpu.telemetry import tracing
+
+    path = tmp_path / "spans.jsonl"
+    tracer = tracing.Tracer("scheduler")
+    opens = []
+    real_open = builtins.open
+
+    def counting_open(file, *a, **kw):
+        if str(file) == str(path):
+            opens.append(file)
+        return real_open(file, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    exporter = tracer.export_to_file(path)
+    try:
+        for i in range(8):
+            with tracer.span(f"span-{i}"):
+                pass
+        assert len(opens) == 1, f"{len(opens)} opens for 8 spans"
+        lines = [_json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["name"] for l in lines] == [f"span-{i}" for i in range(8)]
+        # byte-identical JSONL: same serializer the per-open version used
+        assert path.read_text().splitlines()[0] == _json.dumps(lines[0])
+    finally:
+        monkeypatch.undo()
+        exporter.close()
+        tracer.remove_exporter(exporter)
+    with tracer.span("after-close"):
+        pass  # dropped silently, no ValueError from a closed file
+    assert len(path.read_text().splitlines()) == 8

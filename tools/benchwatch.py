@@ -58,7 +58,12 @@ DEFAULT_THRESHOLD = 0.10
 # pin the ambiguous ones. `_regret_fail_rate` precedes the `_fraction`-
 # style reasoning: regret is the active arm's outcome delta vs the
 # shadow pick, and less of it is better.
-_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_fraction", "_regret_fail_rate")
+_LOWER_BETTER_SUFFIXES = (
+    "_ms", "_s", "_fraction", "_regret_fail_rate",
+    # SLO verdict plane (telemetry/slo.py): alerts fired and error-budget
+    # burn are failure accounting — less is strictly better
+    "_pages_fired", "_tickets_fired", "_alerts_fired", "_budget_burn",
+)
 _LOWER_BETTER_EXACT = {
     "control_dispatch", "device_call", "candidate_fill", "apply_selection",
     "report_ingest", "pack", "pre_schedule", "link_rtt_probe",
@@ -72,6 +77,9 @@ _LOWER_BETTER_EXACT = {
 # which is right — the directional verdict is the regret metric).
 _NO_DIRECTION_SUFFIXES = (
     "_model_vs_measured", "_disagreement", "_divergence", "_rank_corr",
+    # verdict states are categories (0=ok/1=degraded/2=critical), not a
+    # magnitude — the directional cells are the alert/budget ones above
+    "_verdict_state",
 )
 
 
@@ -264,6 +272,15 @@ def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
         # better); the disagreement rate is direction-exempt and skipped
         _put(metrics, quarantined, f"{cell}_decision_regret_fail_rate",
              s.get("decision_regret_fail_rate"))
+        # SLO cells: alert counts + budget burn compare lower-is-better;
+        # the categorical verdict state is direction-exempt and skipped
+        for key in ("slo_pages_fired", "slo_tickets_fired",
+                    "slo_alerts_fired", "slo_budget_burn",
+                    "slo_verdict_state"):
+            metric = f"{cell}_{key}"
+            if direction_exempt(metric):
+                continue
+            _put(metrics, quarantined, metric, s.get(key))
 
 
 def _normalize_scenarios(doc: dict, metrics: dict, quarantined: dict) -> None:

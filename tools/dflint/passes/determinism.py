@@ -42,6 +42,12 @@ DEFAULT_DECISION_SUFFIXES = (
     "megascale/engine.py",
     "megascale/topology.py",
     "megascale/soak.py",
+    # the SLO engine's replay evaluation path: megascale feeds it on the
+    # event clock and paired-seed runs must produce identical alert
+    # timelines — a wall-clock read here would make "did this run page?"
+    # depend on machine load (perf_counter stays exempt: live engines
+    # use it for window arithmetic, never for replay decisions)
+    "telemetry/slo.py",
 )
 # DET003 also guards the scheduler: the selection/response stream it
 # produces is exactly what the paired-seed oracles compare

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""dfslo — replay a recorded timeline against an SLO config and answer
+"would this run have paged?".
+
+The megascale lab's SLO engine (telemetry/slo.py) derives every SLI from
+the per-round timeline sample it just recorded, so the judgment is a
+PURE function of the timeline array: this tool re-runs the exact same
+evaluation offline over any artifact that carries one —
+
+- a ``BENCH_mega.json`` (``{"runs": [...]}``; every run replays),
+- a single ``run_megascale`` report (``{"timeline": [...], ...}``),
+- or a bare ``{"timeline": [...], "minutes_per_round": N}`` dump
+
+— and prints per-run verdicts with the full burn-rate alert log. When
+the artifact already carries the in-run ``slo`` block / per-sample
+``slo_*`` columns, the replay is cross-checked against them and any
+drift is reported loudly (the recorded judgment and the offline one can
+only differ if the SLI derivation changed since the run).
+
+Usage:
+    python tools/dfslo.py BENCH_mega.json [--run soak] [--json]
+
+Exit codes: 0 = no alerts fired in any selected run, 1 = ticket-severity
+alerts only, 2 = at least one page fired (or the artifact/replay
+disagree — a page you can't trust offline is still a page).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def _extract_runs(doc: dict, which: str | None) -> list[dict]:
+    if isinstance(doc.get("runs"), list):
+        runs = [r for r in doc["runs"] if isinstance(r, dict)]
+    elif isinstance(doc.get("timeline"), list):
+        runs = [doc]
+    else:
+        raise SystemExit(
+            "dfslo: artifact carries neither 'runs' nor a 'timeline' array"
+        )
+    if which is not None:
+        runs = [
+            r for r in runs
+            if str(r.get("scenario", "")) == which
+            or f"{r.get('scenario')}_{r.get('hosts')}" == which
+        ]
+        if not runs:
+            raise SystemExit(f"dfslo: no run matches --run {which!r}")
+    out = []
+    for r in runs:
+        if not r.get("timeline"):
+            print(
+                f"dfslo: skipping {r.get('scenario', '?')} "
+                f"(no timeline array — artifact predates the SLO plane)",
+                file=sys.stderr,
+            )
+            continue
+        out.append(r)
+    if not out:
+        raise SystemExit("dfslo: no selected run carries a timeline array")
+    return out
+
+
+def _check_recorded(run: dict, replay: dict) -> list[str]:
+    """Cross-check the offline replay against what the run recorded:
+    the report's slo block and the per-sample slo_* columns."""
+    drift: list[str] = []
+    recorded = run.get("slo")
+    if isinstance(recorded, dict):
+        for key in ("pages_fired", "tickets_fired", "verdict_final"):
+            if key in recorded and recorded[key] != replay[key]:
+                drift.append(
+                    f"{key}: recorded {recorded[key]!r} != "
+                    f"replayed {replay[key]!r}"
+                )
+        rec_log = recorded.get("alert_log")
+        if isinstance(rec_log, list):
+            # the report's log is a bounded tail (slo_report last_n);
+            # compare against the same-length tail of the replayed log
+            tail = replay["alert_log"][-len(rec_log):] if rec_log else []
+            if rec_log != tail:
+                drift.append(
+                    f"alert_log: recorded {len(rec_log)} entries != "
+                    f"replayed {len(replay['alert_log'])} (or contents "
+                    f"differ)"
+                )
+    by_t = {c["t"]: c for c in replay["samples"]}
+    for sample in run["timeline"]:
+        if "slo_verdict" not in sample:
+            continue
+        col = by_t.get(sample["t"])
+        if col is None:
+            continue
+        for key in ("slo_verdict", "slo_alerts_firing",
+                    "slo_pages_fired", "slo_tickets_fired"):
+            if key in sample and sample[key] != col[key]:
+                drift.append(
+                    f"t={sample['t']} {key}: recorded {sample[key]} != "
+                    f"replayed {col[key]}"
+                )
+    return drift
+
+
+def judge(doc: dict, which: str | None = None) -> tuple[int, list[dict]]:
+    """Replay every selected run; return (exit_code, per-run results)."""
+    from dragonfly2_tpu.telemetry.slo import replay_timeline
+
+    results: list[dict] = []
+    worst = 0
+    for run in _extract_runs(doc, which):
+        mpr = float(run.get("minutes_per_round") or 15.0)
+        replay = replay_timeline(run["timeline"], mpr)
+        drift = _check_recorded(run, replay)
+        if replay["pages_fired"] > 0 or drift:
+            rc = 2
+        elif replay["tickets_fired"] > 0:
+            rc = 1
+        else:
+            rc = 0
+        worst = max(worst, rc)
+        results.append({
+            "run": f"{run.get('scenario', '?')}_{run.get('hosts', '?')}",
+            "minutes_per_round": mpr,
+            "samples": len(run["timeline"]),
+            "paged": replay["paged"],
+            "pages_fired": replay["pages_fired"],
+            "tickets_fired": replay["tickets_fired"],
+            "verdict_final": replay["verdict_final"],
+            "worst_verdict": replay["worst_verdict"],
+            "budget_remaining": replay["budget_remaining"],
+            "alert_log": replay["alert_log"],
+            "recorded_drift": drift,
+            "exit_code": rc,
+        })
+    return worst, results
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifact", help="BENCH_mega.json / run report / timeline dump")
+    ap.add_argument("--run", default=None,
+                    help="select one run by scenario name or scenario_hosts")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable results on stdout")
+    args = ap.parse_args(argv)
+
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    rc, results = judge(doc, args.run)
+    if args.as_json:
+        print(json.dumps({"exit_code": rc, "runs": results}, indent=1))
+        return rc
+    for r in results:
+        verdict = (
+            "PAGED" if r["pages_fired"] else
+            ("TICKETED" if r["tickets_fired"] else "clean")
+        )
+        print(
+            f"dfslo: {r['run']}: {verdict} — {r['pages_fired']} page(s), "
+            f"{r['tickets_fired']} ticket(s) over {r['samples']} intervals; "
+            f"final verdict {r['verdict_final']} "
+            f"(worst {r['worst_verdict']})"
+        )
+        for e in r["alert_log"]:
+            print(
+                f"  t={e['t']:g} {e['slo']}/{e['rule']} "
+                f"[{e['severity']}] {e['event']} "
+                f"(burn long {e['burn_long']:g}x / short {e['burn_short']:g}x)"
+            )
+        for d in r["recorded_drift"]:
+            print(f"  DRIFT vs recorded judgment: {d}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
