@@ -137,6 +137,18 @@ class PeerTaskConductor:
                 self.shaper.register_task(self.task_id)
             await self._drive(ts, queue)
             if self._error is not None:
+                # dfget LeavePeer parity (dflint WIRE001 surfaced the
+                # missing producer): a failed attempt's peer leaves the
+                # swarm NOW — candidate fill would otherwise keep
+                # advertising a peer that will never serve until GC
+                # reaps it. Success stays registered: finished peers ARE
+                # the swarm's parents.
+                try:
+                    await self.conn.send(
+                        msg.LeavePeerRequest(peer_id=self.peer_id)
+                    )
+                except (OSError, RuntimeError):
+                    pass  # the stream died with the download; GC reaps it
                 raise self._error
             return ts
         finally:

@@ -379,9 +379,3 @@ class TrainEndRequest:
 class TrainResponse:
     ok: bool
     description: str = ""
-
-
-@dataclasses.dataclass
-class RPCError:
-    code: str
-    description: str = ""

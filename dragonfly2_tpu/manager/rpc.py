@@ -179,7 +179,17 @@ class ManagerRPCServer:
                 request = await wire.read_frame(reader)
                 if request is None:
                     return
-                response = await asyncio.to_thread(self._dispatch, request)
+                # Wire-envelope propagation (dflint WIRE003) via the
+                # shared mux.dispatch_anchored: a preheat job's budget
+                # now bounds the manager-side work it triggers and its
+                # trace continues across this hop. Replies always go out
+                # — the manager edge is strict request/response
+                # (keepalive loops, certify flows) and a dropped Ack
+                # would wedge the caller on a shared connection.
+                response = await asyncio.to_thread(
+                    mux.dispatch_anchored, self._dispatch, request,
+                    "manager.rpc",
+                )
                 if response is not None:
                     wire.write_frame(writer, response)
                     await writer.drain()

@@ -52,11 +52,20 @@ def run_megascale(
     probe_every: int = 0,
     drain_rounds: int = 12,
     max_peers_per_task: int | None = None,
+    wire_skew: dict | None = None,
 ) -> dict:
     """One megascale replay. `arrivals_per_round` defaults to ~1.5 total
     downloads per host spread over the day; `rounds` defaults to one
     compressed day plus `drain_rounds` of trailing arrivals-light rounds
-    so in-flight downloads finish. Returns the report dict."""
+    so in-flight downloads finish. Returns the report dict.
+
+    `wire_skew` (a golden wire-schema dict, tools/dfwire_schema.json)
+    turns on the mixed-version soak mode: every message-shaped
+    control-plane exchange round-trips the real codec degraded to the
+    N-1 snapshot (tools/dflint/wirefuzz.SkewProxy) — the rolling-upgrade
+    soak then replays the whole compressed day over cross-version frames
+    and the report grows a `wire_skew` block (frame counts per type +
+    any codec mismatches) the skew gate asserts empty."""
     spec = resolve_scenario(scenario)
     day = spec.traffic.day_rounds or 96
     if rounds is None:
@@ -87,9 +96,18 @@ def run_megascale(
         num_hosts, num_tasks=num_tasks, max_live_peers=max_live,
         algorithm=algorithm, seed=seed, max_peers_per_task=max_peers_per_task,
     )
+    driver = svc
+    if wire_skew is not None:
+        # Deliberate tooling import inside the opt-in skew mode ONLY
+        # (ISSUE 15 places the skew harness with the rest of dfwire in
+        # tools/dflint/): production replays never enter this branch,
+        # so a deployment without the repo's tools/ tree is unaffected.
+        from tools.dflint.wirefuzz import SkewProxy
+
+        driver = SkewProxy(svc, wire_skew)
     t0 = time.perf_counter()
     sim = EventBatchEngine(
-        svc, num_hosts=num_hosts, num_tasks=num_tasks, seed=seed,
+        driver, num_hosts=num_hosts, num_tasks=num_tasks, seed=seed,
         scenario=spec, retire_after_rounds=retire_after_rounds,
     )
     setup_s = time.perf_counter() - t0
@@ -191,6 +209,11 @@ def run_megascale(
         # `timing`, so deterministic_view strips it)
         "costcards": _drained_costcards(),
     }
+    if wire_skew is not None:
+        # mixed-version wire evidence: which frame types actually crossed
+        # the skewed codec, and any round-trip mismatch (must be empty —
+        # the skew soak gate asserts on it)
+        report["wire_skew"] = driver.report()
     return report
 
 
@@ -235,5 +258,11 @@ def deterministic_view(report: dict) -> dict:
     """The report minus wall-clock/platform-dependent fields (same
     contract as scenarios/ab.deterministic_view). The `timeline` array
     STAYS — its samples are event-clocked by construction, and the
-    determinism test pinning this view is what keeps them that way."""
-    return {k: v for k, v in report.items() if k not in ("timing", "costcards")}
+    determinism test pinning this view is what keeps them that way.
+    `wire_skew` is excluded too: the block is deterministic but only a
+    skew-mode run carries it, and the documented contract is that a
+    skew run's view compares EQUAL to the plain run's."""
+    return {
+        k: v for k, v in report.items()
+        if k not in ("timing", "costcards", "wire_skew")
+    }

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import typing
 
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import mux, resilience, wire
@@ -25,7 +26,9 @@ wire.register_module(msg)
 logger = logging.getLogger(__name__)
 
 
-async def _bounded_wait(awaitable, timeout: float | None, what: str, metrics=None):
+async def _bounded_wait(awaitable: typing.Awaitable[typing.Any],
+                        timeout: float | None, what: str,
+                        metrics: typing.Any = None) -> typing.Any:
     """await with the caller's timeout bounded by the ambient deadline
     budget (rpc/resilience.py). A timeout that was BUDGET-bound surfaces
     as DeadlineExceeded (and counts in the deadline family), a plain
@@ -52,8 +55,8 @@ class SchedulerConnection:
     """One long-lived announce stream to a scheduler (AnnouncePeer
     semantics: requests flow up, scheduling responses flow back async)."""
 
-    def __init__(self, host: str, port: int, ssl_context=None,
-                 resilience_metrics=None):
+    def __init__(self, host: str, port: int, ssl_context: typing.Any = None,
+                 resilience_metrics: typing.Any = None):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context  # ssl.SSLContext for mTLS, None = plaintext
@@ -158,7 +161,7 @@ class SchedulerConnection:
                 else:
                     logger.debug("dropping response for unknown peer %s", peer_id)
 
-    async def send(self, request) -> None:
+    async def send(self, request: typing.Any) -> None:
         assert self._writer is not None
         async with self._send_lock:
             wire.write_frame(self._writer, request)
@@ -218,7 +221,8 @@ class SchedulerClientPool:
     """Task-affine scheduler selection over a scheduler set (the
     consistent-hashing balancer + resolver pair)."""
 
-    def __init__(self, addresses: list[tuple[str, int]], ssl_context=None,
+    def __init__(self, addresses: list[tuple[str, int]],
+                 ssl_context: typing.Any = None,
                  breaker_failure_threshold: int = 2,
                  breaker_open_ttl: float = 5.0):
         if not addresses:
@@ -442,7 +446,7 @@ class TrainerClient:
 
     DIAL_TIMEOUT_S = 5.0
 
-    def __init__(self, host: str, port: int, ssl_context=None):
+    def __init__(self, host: str, port: int, ssl_context: typing.Any = None):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
@@ -552,8 +556,8 @@ class SyncSchedulerClient:
     error, so a scheduler restart costs one failed call, not a stuck
     manager."""
 
-    def __init__(self, host: str, port: int, ssl_context=None, timeout: float = 5.0,
-                 dial_failure_ttl: float = 5.0):
+    def __init__(self, host: str, port: int, ssl_context: typing.Any = None,
+                 timeout: float = 5.0, dial_failure_ttl: float = 5.0):
         self.host = host
         self.port = port
         self.ssl_context = ssl_context
@@ -573,7 +577,7 @@ class SyncSchedulerClient:
         self._sock = None
         self._mu = threading.Lock()
 
-    def _connect(self):
+    def _connect(self) -> typing.Any:
         import socket as _socket
 
         timeout = resilience.bound_timeout(self.timeout)
@@ -616,7 +620,7 @@ class SyncSchedulerClient:
             raise
         self.breakers.record_outcome(self._target, None)
 
-    def call(self, request):
+    def call(self, request: typing.Any) -> typing.Any:
         """Send one frame, read one frame. Raises ConnectionError on any
         transport failure (after closing the cached socket), Unavailable
         when the breaker is open, DeadlineExceeded when the ambient budget
@@ -664,7 +668,7 @@ class SyncSchedulerClient:
                     except OSError:
                         pass
 
-    def _recv_exact(self, sock, n: int) -> bytes:
+    def _recv_exact(self, sock: typing.Any, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
             chunk = sock.recv(n - len(buf))

@@ -194,9 +194,20 @@ class InferenceRPCServer:
                 request = await wire.read_frame(reader)
                 if request is None:
                     return
+                # Wire-envelope propagation (dflint WIRE003) via the
+                # shared mux.dispatch_anchored: a remote scorer's budget
+                # bounds the device call and its trace continues through
+                # this hop. The response is ALWAYS written even when the
+                # budget expired mid-infer — inference is strict
+                # request/response on a shared connection, so dropping a
+                # reply would wedge the caller forever (unlike the
+                # scheduler's stream edge, where shedding is safe).
                 # jit apply fns release the GIL during device execution;
                 # off-loop keeps one slow infer from stalling other conns
-                response = await asyncio.to_thread(self._dispatch, request)
+                response = await asyncio.to_thread(
+                    mux.dispatch_anchored, self._dispatch, request,
+                    "inference.rpc",
+                )
                 if response is not None:
                     wire.write_frame(writer, response)
                     await writer.drain()

@@ -19,10 +19,12 @@ registers it.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import logging
 
-from dragonfly2_tpu.rpc import wire
+from dragonfly2_tpu.rpc import resilience, wire
+from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.utils.conntrack import ConnTracker
 
 logger = logging.getLogger(__name__)
@@ -285,6 +287,28 @@ class MuxServer:
             pass
         finally:
             writer.close()
+
+
+def dispatch_anchored(dispatch, request, span_prefix: str):
+    """Run one decoded frame through ``dispatch`` with the wire envelope
+    re-anchored (the PR-3 "dl" contract, dflint WIRE003): the frame's
+    remaining deadline budget restarts on this host's clock so onward
+    frames carry what is left, and the caller's trace context continues
+    through a ``{span_prefix}.<Type>`` span. The ONE implementation
+    every request/response serve loop shares — the dfwire pass blesses
+    call sites of this helper as satisfying both halves, so a new RPC
+    server routes through here instead of hand-rolling the scopes."""
+    budget = getattr(request, "deadline_s", None)
+    remote_ctx = getattr(request, "trace_context", None)
+    with contextlib.ExitStack() as stack:
+        if remote_ctx is not None:
+            stack.enter_context(default_tracer().span(
+                f"{span_prefix}.{type(request).__name__}",
+                remote_parent=remote_ctx,
+            ))
+        if budget is not None:
+            stack.enter_context(resilience.deadline(budget))
+        return dispatch(request)
 
 
 def handle_health_request(request, health_check=None):

@@ -98,6 +98,7 @@ class SchedulerRPCServer:
         self._host_conn: dict[str, asyncio.StreamWriter] = {}
         self._writers: set[asyncio.StreamWriter] = set()
         self._tick_task: asyncio.Task | None = None
+        self._warmup_thread: threading.Thread | None = None
         self._trigger_deadline: dict[str, float] = {}
         self._pending_triggers: list = []
         self._lock = asyncio.Lock()
@@ -151,9 +152,10 @@ class SchedulerRPCServer:
         # Pre-compile the per-bucket serving programs off-loop so the
         # first real peers don't eat a multi-second XLA compile; READY is
         # not delayed (warmup touches no service state — scheduler.py).
-        threading.Thread(
+        self._warmup_thread = threading.Thread(
             target=self._safe_warmup, name="eval-warmup", daemon=True
-        ).start()
+        )
+        self._warmup_thread.start()
         logger.info("scheduler rpc listening on %s:%d", self.host, self.port)
         return self.host, self.port
 
@@ -182,6 +184,15 @@ class SchedulerRPCServer:
             await self._vsock_server.wait_closed()
         for w in list(self._writers):
             w.close()
+        # Join any in-flight warmup compile before the interpreter can
+        # finalize: XLA's compile pool aborts the whole process
+        # ("terminate called without an active exception") if a daemon
+        # compile thread is still alive when C++ static destructors run —
+        # a SIGTERM inside the cold-start window would exit -6, not 0.
+        warm = getattr(self.service, "_shadow_warm_thread", None)
+        for t in (self._warmup_thread, warm):
+            if t is not None and t.is_alive():
+                await asyncio.to_thread(t.join)
 
     # ---------------------------------------------------------- connection
 

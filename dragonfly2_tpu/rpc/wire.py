@@ -30,19 +30,50 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 256 << 20  # trainer dataset chunks are 128 MiB (announcer.go:40)
 
 
+class WireDecodeError(TypeError):
+    """A frame's payload cannot instantiate its message type — a
+    required (no-default) field is absent. Distinct from a codec bug:
+    the skew replayer (tools/dflint/wirefuzz.py) treats this as "the
+    frame is from an incompatible schema generation", anything else as
+    a defect. Subclasses TypeError so pre-existing callers that caught
+    the bare ``cls(**kwargs)`` TypeError keep working."""
+
+    def __init__(self, message_type: str, missing: list[str]):
+        self.message_type = message_type
+        self.missing = list(missing)
+        super().__init__(
+            f"cannot decode {message_type}: required field(s) "
+            f"{', '.join(missing)} absent from the frame — the sender "
+            f"speaks an incompatible schema generation"
+        )
+
+
 def register_messages(*classes: type) -> None:
+    """Register top-level frame types by ``__name__``. Re-registering
+    the SAME class is an idempotent no-op (servers and clients both
+    import-register their message modules); a DIFFERENT class under an
+    already-taken name raises — silent overwrite would alias two
+    message types in the name-keyed registry and misroute every frame
+    of the loser."""
     for cls in classes:
+        existing = _REGISTRY.get(cls.__name__)
+        if existing is not None and existing is not cls:
+            raise TypeError(
+                f"wire message name collision: {cls.__name__!r} is "
+                f"already registered by {existing.__module__}; refusing "
+                f"to alias {cls.__module__}.{cls.__qualname__} onto it"
+            )
         _REGISTRY[cls.__name__] = cls
 
 
-def register_module(module) -> None:
+def register_module(module: types.ModuleType) -> None:
     for name in dir(module):
         obj = getattr(module, name)
         if dataclasses.is_dataclass(obj) and isinstance(obj, type):
-            _REGISTRY[obj.__name__] = obj
+            register_messages(obj)
 
 
-def _to_plain(value):
+def _to_plain(value: typing.Any) -> typing.Any:
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
         return {
             f.name: _to_plain(getattr(value, f.name)) for f in dataclasses.fields(value)
@@ -56,7 +87,7 @@ def _to_plain(value):
     return value
 
 
-def _from_plain(hint, value):
+def _from_plain(hint: typing.Any, value: typing.Any) -> typing.Any:
     origin = typing.get_origin(hint)
     if origin in (list, tuple):
         (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
@@ -76,16 +107,27 @@ def _from_plain(hint, value):
     return value
 
 
-def _instantiate(cls: type, fields: dict):
+def _instantiate(cls: type, fields: dict) -> typing.Any:
     hints = typing.get_type_hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
         if f.name in fields:
             kwargs[f.name] = _from_plain(hints.get(f.name, typing.Any), fields[f.name])
+    missing = [
+        f.name for f in dataclasses.fields(cls)
+        if f.name not in kwargs
+        and f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    ]
+    if missing:
+        # typed, not the bare TypeError out of cls(**kwargs): the skew
+        # replayer needs "incompatible frame" distinguishable from a
+        # codec bug, and operators need the message type in the error
+        raise WireDecodeError(cls.__name__, missing)
     return cls(**kwargs)
 
 
-def encode(message, trace_context: dict | None = None,
+def encode(message: typing.Any, trace_context: dict | None = None,
            deadline_s: float | None = None) -> bytes:
     """Frame one message. Trace context ({"trace_id", "span_id"}) rides
     the envelope — the explicit argument wins, else the ambient span's
@@ -118,7 +160,7 @@ def encode(message, trace_context: dict | None = None,
     return _LEN.pack(len(payload)) + payload
 
 
-def decode(payload: bytes):
+def decode(payload: bytes) -> typing.Any:
     obj = msgpack.unpackb(payload, raw=False)
     cls = _REGISTRY.get(obj.get("t"))
     if cls is None:
@@ -156,5 +198,6 @@ async def read_frame(reader: asyncio.StreamReader) -> object | None:
     return decode(payload)
 
 
-def write_frame(writer, message, trace_context: dict | None = None) -> None:
+def write_frame(writer: asyncio.StreamWriter, message: typing.Any,
+                trace_context: dict | None = None) -> None:
     writer.write(encode(message, trace_context=trace_context))

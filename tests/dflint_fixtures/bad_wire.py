@@ -1,0 +1,138 @@
+"""Known-bad wire-contract idioms — every WIRE001-004 shape fires.
+
+Expected findings (tests/test_static_analysis.py pins the counts):
+WIRE001 x4  (unregistered send, consumer-less send, dead registered
+             type, dispatch arm without a producer)
+WIRE002 x4  (set field, multi-element tuple, dataclass union,
+             dataclass inside a dict value)
+WIRE003 x2  (serve loop drops the deadline budget AND the trace)
+WIRE004 x3  (declared v1 type without an arm, unreachable arm,
+             untranslated scheduling response)
+"""
+
+import dataclasses
+
+from dragonfly2_tpu.rpc import wire
+
+
+@dataclasses.dataclass
+class GoodMsg:
+    x: int = 0
+
+
+@dataclasses.dataclass
+class OrphanMsg:  # registered below, constructed nowhere: dead type
+    y: int = 0
+
+
+@dataclasses.dataclass
+class UnregisteredMsg:  # sent below without ever being registered
+    z: int = 0
+
+
+@dataclasses.dataclass
+class NoArmMsg:  # registered and sent, but nothing dispatches it
+    q: int = 0
+
+
+@dataclasses.dataclass
+class GhostMsg:  # armed in _dispatch below, constructed nowhere
+    g: int = 0
+
+
+@dataclasses.dataclass
+class AltA:
+    a: int = 0
+
+
+@dataclasses.dataclass
+class AltB:
+    b: int = 0
+
+
+@dataclasses.dataclass
+class BadFieldMsg:
+    tags: set[str] = dataclasses.field(default_factory=set)
+    pair: tuple[int, str] = (0, "")
+    either: AltA | AltB | None = None
+    lookup: dict[str, AltA] = dataclasses.field(default_factory=dict)
+
+
+wire.register_messages(GoodMsg, OrphanMsg, NoArmMsg, BadFieldMsg)
+
+
+def make_payload() -> BadFieldMsg:
+    return BadFieldMsg()
+
+
+def client_send(writer) -> None:
+    wire.write_frame(writer, GoodMsg(x=1))
+    wire.write_frame(writer, UnregisteredMsg(z=1))  # WIRE001: unregistered
+    wire.write_frame(writer, NoArmMsg(q=2))  # WIRE001: nobody consumes it
+
+
+def _dispatch(request):
+    if isinstance(request, GoodMsg):
+        return GoodMsg(x=request.x + 1)
+    if isinstance(request, GhostMsg):  # WIRE001: no live producer
+        return None
+    return None
+
+
+async def _serve_conn(reader, writer):  # WIRE003 x2: no budget, no trace
+    while True:
+        request = await wire.read_frame(reader)
+        if request is None:
+            return
+        response = _dispatch(request)
+        if response is not None:
+            wire.write_frame(writer, response)
+
+
+# ---------------------------------------------------------- v1 dialect
+
+
+@dataclasses.dataclass
+class V1AReq:
+    task_id: str = ""
+
+
+@dataclasses.dataclass
+class V1BReq:
+    task_id: str = ""
+
+
+@dataclasses.dataclass
+class V1CReq:
+    task_id: str = ""
+
+
+@dataclasses.dataclass
+class NormalT:
+    peer_id: str = ""
+
+
+@dataclasses.dataclass
+class FailT:
+    peer_id: str = ""
+
+
+V1_REQUEST_TYPES = (V1AReq, V1BReq)  # WIRE004: V1BReq has no arm below
+
+
+def v1_producer():
+    return [V1AReq(task_id="t"), V1CReq(task_id="t")]
+
+
+def _dispatch_v1(request):
+    if isinstance(request, V1AReq):
+        return NormalT(peer_id="p")
+    if isinstance(request, V1CReq):  # WIRE004: not in V1_REQUEST_TYPES
+        return None
+    return None
+
+
+def to_peer_packet(response):  # WIRE004: FailT never translated
+    if isinstance(response, NormalT):
+        return {"src_pid": response.peer_id}
+    return None

@@ -1,0 +1,116 @@
+"""Known-good wire-contract idioms — the dfwire pass must stay silent.
+
+The closed loop: every registered type is produced, sent, and armed;
+fields stay inside the codec lattice (scalars, Optional, list[T],
+nested dataclass, enum, dict-of-scalars); the serve loop re-anchors the
+propagated deadline budget and continues the trace; the v1 dialect's
+request tuple, dispatch arms and response translations are exhaustive.
+"""
+
+import dataclasses
+import enum
+
+from dragonfly2_tpu.rpc import resilience, wire
+from dragonfly2_tpu.telemetry.tracing import default_tracer
+
+
+class Kind(enum.IntEnum):
+    A = 0
+    B = 1
+
+
+@dataclasses.dataclass
+class Inner:
+    name: str = ""
+    score: float = 0.0
+
+
+@dataclasses.dataclass
+class PingMsg:
+    peer_id: str
+    kind: Kind = Kind.A
+    parents: list[Inner] = dataclasses.field(default_factory=list)
+    note: str | None = None
+    detail: dict = dataclasses.field(default_factory=dict)
+    window: tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class PongMsg:
+    peer_id: str
+    inner: Inner = dataclasses.field(default_factory=Inner)
+
+
+wire.register_messages(PingMsg, PongMsg)
+
+
+def client_send(writer) -> None:
+    wire.write_frame(writer, PingMsg(peer_id="p"))
+
+
+def client_consume(response) -> str:
+    if isinstance(response, PongMsg):
+        return response.peer_id
+    return ""
+
+
+def _dispatch(request):
+    if isinstance(request, PingMsg):
+        return PongMsg(peer_id=request.peer_id)
+    return None
+
+
+async def _serve_conn(reader, writer):
+    while True:
+        request = await wire.read_frame(reader)
+        if request is None:
+            return
+        budget = getattr(request, "deadline_s", None)
+        remote_ctx = getattr(request, "trace_context", None)
+        with default_tracer().span("rpc", remote_parent=remote_ctx):
+            if budget is not None:
+                with resilience.deadline(budget):
+                    response = _dispatch(request)
+            else:
+                response = _dispatch(request)
+        if response is not None:
+            wire.write_frame(writer, response)
+
+
+# ---------------------------------------------------------- v1 dialect
+
+
+@dataclasses.dataclass
+class V1GoodReq:
+    task_id: str = ""
+
+
+@dataclasses.dataclass
+class NormalT:
+    peer_id: str = ""
+
+
+@dataclasses.dataclass
+class FailT:
+    peer_id: str = ""
+
+
+V1_REQUEST_TYPES = (V1GoodReq,)
+
+
+def v1_producer() -> V1GoodReq:
+    return V1GoodReq(task_id="t")
+
+
+def _dispatch_v1(request):
+    if isinstance(request, V1GoodReq):
+        return NormalT(peer_id="p")
+    return None
+
+
+def to_peer_packet(response):
+    if isinstance(response, NormalT):
+        return {"src_pid": response.peer_id, "code": 200}
+    if isinstance(response, FailT):
+        return {"src_pid": response.peer_id, "code": 5000}
+    return None

@@ -22,6 +22,7 @@ from tools.dflint.passes.flush_valve import FlushValvePass
 from tools.dflint.passes.jit_hygiene import JitHygienePass
 from tools.dflint.passes.lock_discipline import LockDisciplinePass
 from tools.dflint.passes.shape import ShapeDonationPass
+from tools.dflint.passes.wire import WirePass
 
 ROOT = Path(__file__).resolve().parents[1]
 FIXTURES = Path(__file__).parent / "dflint_fixtures"
@@ -259,6 +260,69 @@ def test_shape_donation_fixtures():
     ]
 
 
+def test_wire_contract_fixtures():
+    """dfwire red/green goldens (ISSUE 15): every WIRE001-004 shape
+    fires exactly once per crafted defect in bad_wire.py — unregistered
+    send, consumer-less send, dead registered type, producer-less
+    dispatch arm (WIRE001 x4); set/multi-tuple/union/dict-of-dataclass
+    hints outside the codec lattice (WIRE002 x4); a serve loop dropping
+    the deadline budget and the trace (WIRE003 x2); a declared-but-
+    unarmed v1 type, an unreachable arm, an untranslated scheduling
+    response (WIRE004 x3) — and the good twin's closed loop stays
+    silent. Fixtures are linted separately: the pass is whole-program
+    (finalize hook), so the red file's producers must not feed the
+    green file's closure."""
+    bad_pass = WirePass(
+        dispatch_sites=frozenset({("bad_wire.py", "_dispatch"),
+                                  ("bad_wire.py", "_dispatch_v1")}),
+        external_producers={}, external_consumers={},
+        translated_responses=("NormalT", "FailT"),
+        dialect_suffix="bad_wire.py",
+    )
+    report, _ = _lint([bad_pass], "bad_wire.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"WIRE001": 4, "WIRE002": 4, "WIRE003": 2,
+                       "WIRE004": 3}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    # finding ids are stable (file+symbol) for the CI annotator
+    assert "WIRE001@tests/dflint_fixtures/bad_wire.py:OrphanMsg" in {
+        f.finding_id for f in report.findings
+    }
+    good_pass = WirePass(
+        dispatch_sites=frozenset({("good_wire.py", "_dispatch"),
+                                  ("good_wire.py", "_dispatch_v1")}),
+        external_producers={}, external_consumers={},
+        translated_responses=("NormalT", "FailT"),
+        dialect_suffix="good_wire.py",
+    )
+    report2, _ = _lint([good_pass], "good_wire.py")
+    assert report2.findings == [], [f.render() for f in report2.findings]
+
+
+def test_wire_pass_registries_argue_their_case():
+    """The pass's external producer/consumer registries follow the
+    D2H_ALLOWLIST discipline: every entry carries a substantive reason,
+    and every entry names a REAL registered message (a stale entry for
+    a deleted type would silently exempt the next name collision)."""
+    import json
+
+    from tools.dflint.passes.wire import (
+        EXTERNAL_CONSUMERS, EXTERNAL_PRODUCERS, V1_TRANSLATED_RESPONSES,
+    )
+
+    snapshot = json.loads(
+        (ROOT / "tools" / "dfwire_schema.json").read_text()
+    )
+    for name, reason in {**EXTERNAL_PRODUCERS, **EXTERNAL_CONSUMERS}.items():
+        assert len(reason) >= 20, (name, reason)
+        assert name in snapshot["messages"], (
+            f"registry entry {name!r} is not in the wire schema — stale"
+        )
+    for name in V1_TRANSLATED_RESPONSES:
+        assert name in snapshot["messages"], name
+
+
 def test_collective_fixtures():
     report, _ = _lint([CollectivePass()], "bad_coll.py", "good_coll.py")
     by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
@@ -338,8 +402,12 @@ def test_cli_json_output_and_audit_exit_codes(tmp_path, capsys):
 
 
 def test_lint_all_entry_point_is_green():
-    """Satellite: the single gate CI and tier-1 share — dflint with the
-    waiver audit plus the typecheck runner — passes on this tree."""
+    """Satellite: the single gate CI and tier-1 share — dflint (seven
+    passes) with the waiver audit, the typecheck runner, benchwatch,
+    and the dfwire breaking gate — passes on this tree. The breaking
+    stage runs in a fresh interpreter, so the throwaway message types
+    other tests register in THIS process cannot leak into the schema
+    extraction."""
     from tools.lint_all import main
 
     assert main([]) == 0
@@ -547,6 +615,8 @@ def test_typecheck_runner_gates_or_passes():
         "dragonfly2_tpu/telemetry/slo.py",
         "dragonfly2_tpu/cluster/quarantine.py",
         "dragonfly2_tpu/scenarios/spec.py",
+        "dragonfly2_tpu/rpc/wire.py",
+        "dragonfly2_tpu/rpc/client.py",
     ]
     proc = subprocess.run(
         [sys.executable, "tools/typecheck.py"],

@@ -1,0 +1,363 @@
+"""dfwire schema + skew harness (ISSUE 15): the ``buf breaking`` analog
+over the hand-rolled codec, the N-1<->live skew replayer, and the codec
+satellites (registration collisions, typed decode errors).
+
+The breaking-gate red tests work on COPIES of the live extraction with
+one injected mutation each (field rename, field type change, enum
+edit, required-field add), pinning that exactly those evolutions exit
+nonzero while add-field-with-default stays green — the proto3 rule the
+tentpole encodes.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+from pathlib import Path
+
+import pytest
+
+# importing the servers registers every message set with the codec
+import dragonfly2_tpu.manager.rpc  # noqa: F401
+import dragonfly2_tpu.rpc.inference  # noqa: F401
+import dragonfly2_tpu.rpc.server  # noqa: F401
+from dragonfly2_tpu.rpc import wire
+from tools.dflint import wirefuzz, wireschema
+
+ROOT = Path(__file__).resolve().parents[1]
+SNAPSHOT = ROOT / "tools" / "dfwire_schema.json"
+
+
+@pytest.fixture(scope="module")
+def live_schema() -> dict:
+    return wireschema.extract()
+
+
+@pytest.fixture(scope="module")
+def golden() -> dict:
+    return json.loads(SNAPSHOT.read_text())
+
+
+# ------------------------------------------------------------ extraction
+
+
+def test_snapshot_is_checked_in_and_current(live_schema, golden):
+    """The golden snapshot exists and the LIVE extraction is breaking-
+    free against it (compatible adds are legal mid-PR; breaks must
+    regenerate with --write). Message coverage includes every codec
+    registry member plus nested records."""
+    changes = wireschema.diff(golden, live_schema)
+    breaking = [c for c in changes if c.breaking]
+    assert breaking == [], [c.render() for c in breaking]
+    for name in wire._REGISTRY:
+        # throwaway types other tests register in this process are not
+        # part of the checked-in contract
+        if name in golden["messages"]:
+            assert "fields" in golden["messages"][name]
+    for expected in ("RegisterPeerRequest", "NormalTaskResponse",
+                     "HostInfo", "CPUStat", "V1PeerPacket",
+                     "ModelInferRequest", "HealthCheckRequest"):
+        assert expected in golden["messages"], expected
+    assert golden["enums"]["SizeScope"] == {
+        "NORMAL": 0, "SMALL": 1, "TINY": 2, "EMPTY": 3,
+    }
+    assert golden["codes"]["CODE_SCHED_NEED_BACK_SOURCE"] == 5001
+
+
+def test_breaking_gate_green_on_clean_tree():
+    assert wireschema.check_breaking() == 0
+
+
+def test_normalized_types_cover_the_lattice(golden):
+    fields = golden["messages"]["RegisterPeerRequest"]["fields"]
+    assert fields["peer_id"] == {"type": "str", "required": True}
+    assert fields["host"] == {"type": "message:HostInfo", "required": True}
+    assert fields["finished_pieces"]["type"] == "optional[list[int]]"
+    assert golden["messages"]["NormalTaskResponse"]["fields"][
+        "candidate_parents"]["type"] == "list[message:CandidateParent]"
+
+
+# --------------------------------------------------------- breaking gate
+
+
+def _expect_breaking(golden, mutate, needle: str):
+    old = copy.deepcopy(golden)
+    mutate(old)
+    # diff FROM the mutated snapshot TO the live schema: the mutation
+    # plays the N-1 generation the live tree evolved away from
+    changes = wireschema.diff(old, wireschema.extract())
+    breaking = [c for c in changes if c.breaking]
+    assert breaking, f"mutation {needle!r} was not flagged"
+    assert any(needle in c.detail for c in breaking), [
+        c.render() for c in breaking
+    ]
+
+
+def test_breaking_on_field_rename(golden):
+    def mutate(old):
+        fields = old["messages"]["RegisterPeerRequest"]["fields"]
+        fields["peer_identifier"] = fields.pop("peer_id")
+
+    # the live tree "renamed" peer_identifier -> peer_id: the old name
+    # is removed (breaking) and the new one is added-required (breaking)
+    _expect_breaking(golden, mutate, "peer_identifier")
+
+
+def test_breaking_on_field_type_change(golden):
+    def mutate(old):
+        old["messages"]["DownloadPieceFinishedRequest"]["fields"][
+            "piece_number"]["type"] = "str"
+
+    _expect_breaking(golden, mutate, "piece_number' type changed")
+
+
+def test_breaking_on_enum_edit(golden):
+    def mutate(old):
+        old["enums"]["SizeScope"]["EMPTY"] = 9
+
+    _expect_breaking(golden, mutate, "SizeScope.EMPTY' value changed")
+
+
+def test_breaking_on_enum_member_removed(golden):
+    def mutate(old):
+        del old["enums"]["SizeScope"]["TINY"]
+
+    # live has TINY, mutated N-1 does not: live ADDED a member an N-1
+    # decoder cannot parse
+    _expect_breaking(golden, mutate, "SizeScope.TINY' added")
+
+
+def test_breaking_on_wire_code_change(golden):
+    def mutate(old):
+        old["codes"]["CODE_SUCCESS"] = 0
+
+    _expect_breaking(golden, mutate, "CODE_SUCCESS")
+
+
+def test_breaking_on_required_field_add(golden):
+    def mutate(old):
+        del old["messages"]["RegisterPeerRequest"]["fields"]["task_id"]
+
+    # the live tree added required task_id relative to the mutated N-1:
+    # an N-1 sender omits it and the live decoder hard-errors
+    _expect_breaking(golden, mutate, "task_id' added WITHOUT a default")
+
+
+def test_add_field_with_default_is_compatible(golden):
+    old = copy.deepcopy(golden)
+    # N-1 did not know this defaulted field; the live tree adds it
+    del old["messages"]["RegisterPeerRequest"]["fields"]["priority"]
+    changes = wireschema.diff(old, wireschema.extract())
+    assert all(not c.breaking for c in changes), [
+        c.render() for c in changes if c.breaking
+    ]
+    assert any("priority' added with a default" in c.detail
+               for c in changes)
+
+
+def test_breaking_cli_exit_codes(tmp_path, golden):
+    """The CLI contract the CI stage relies on: exit 0 against a clean
+    snapshot, exit 1 against a mutated one, exit 1 with no snapshot."""
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(wireschema.extract()))
+    assert wireschema.check_breaking(clean) == 0
+    mutated = json.loads(clean.read_text())
+    mutated["messages"]["StatResponse"]["fields"]["found"]["type"] = "int"
+    broken = tmp_path / "broken.json"
+    broken.write_text(json.dumps(mutated))
+    assert wireschema.check_breaking(broken) == 1
+    assert wireschema.check_breaking(tmp_path / "missing.json") == 1
+
+
+def test_write_snapshot_bumps_version_on_break(tmp_path):
+    """--write records the intentional-break acknowledgement: same
+    schema -> version stays; breaking diff vs the previous snapshot ->
+    version bumps."""
+    path = tmp_path / "snap.json"
+    assert wireschema.write_snapshot(path) == 0
+    assert json.loads(path.read_text())["schema_version"] == 1
+    assert wireschema.write_snapshot(path) == 0  # idempotent, no bump
+    assert json.loads(path.read_text())["schema_version"] == 1
+    doc = json.loads(path.read_text())
+    doc["messages"]["StatResponse"]["fields"]["found"]["type"] = "int"
+    path.write_text(json.dumps(doc))
+    assert wireschema.write_snapshot(path) == 0
+    assert json.loads(path.read_text())["schema_version"] == 2
+
+
+# ---------------------------------------------------------- skew replay
+
+
+def test_skew_replay_against_golden_snapshot(golden):
+    """Acceptance: N-1-schema frames decode against the live registry
+    (and live frames satisfy the N-1 required set) for every message in
+    the snapshot."""
+    problems = wirefuzz.replay_skew(golden)
+    assert problems == [], problems
+
+
+def test_skew_replay_catches_incompatible_generations(golden):
+    """Red halves of the replayer: (a) an N-1 schema missing a field
+    the live side REQUIRES -> WireDecodeError surfaces as
+    'INCOMPATIBLE'; (b) a live schema missing a field the N-1 side
+    requires -> 'strands N-1 decoders'."""
+    old = copy.deepcopy(golden)
+    fields = old["messages"]["RegisterPeerRequest"]["fields"]
+    del fields["task_id"]  # live requires it; N-1 frames omit it
+    problems = wirefuzz.replay_skew(old)
+    assert any("RegisterPeerRequest" in p and "INCOMPATIBLE" in p
+               for p in problems), problems
+
+    old2 = copy.deepcopy(golden)
+    old2["messages"]["RegisterPeerRequest"]["fields"]["from_the_past"] = {
+        "type": "str", "required": True,
+    }
+    problems2 = wirefuzz.replay_skew(old2)
+    assert any("strands N-1 decoders" in p and "from_the_past" in p
+               for p in problems2), problems2
+
+
+def test_degrade_payload_drops_unknown_and_recurses(golden):
+    from dragonfly2_tpu.cluster import messages as msg
+
+    request = msg.RegisterPeerRequest(
+        peer_id="p", task_id="t",
+        host=msg.HostInfo(host_id="h", ip="1.2.3.4"),
+    )
+    payload = wire._to_plain(request)
+    payload["field_from_the_future"] = 42
+    payload["host"]["future_host_field"] = "x"
+    degraded = wirefuzz.degrade_payload(payload, golden,
+                                        "RegisterPeerRequest")
+    assert "field_from_the_future" not in degraded
+    assert "future_host_field" not in degraded["host"]
+    assert degraded["peer_id"] == "p"
+    assert degraded["host"]["host_id"] == "h"
+
+
+# ------------------------------------------------- satellites: registry
+
+
+def test_register_collision_raises_and_idempotent_reregister_is_legal():
+    @dataclasses.dataclass
+    class WireContractProbeMsg:
+        x: int = 0
+
+    wire.register_messages(WireContractProbeMsg)
+    # same class again: no-op (server+client both import-register)
+    wire.register_messages(WireContractProbeMsg)
+    assert wire._REGISTRY["WireContractProbeMsg"] is WireContractProbeMsg
+
+    @dataclasses.dataclass
+    class Impostor:
+        y: str = ""
+
+    Impostor.__name__ = "WireContractProbeMsg"
+    Impostor.__qualname__ = "WireContractProbeMsg"
+    with pytest.raises(TypeError, match="name collision"):
+        wire.register_messages(Impostor)
+    # the loser did NOT alias the registry entry
+    assert wire._REGISTRY["WireContractProbeMsg"] is WireContractProbeMsg
+
+
+def test_register_module_collision_raises(tmp_path):
+    import types as types_mod
+
+    @dataclasses.dataclass
+    class ModProbeA:
+        x: int = 0
+
+    module = types_mod.ModuleType("fake_wire_module")
+    module.ModProbeA = ModProbeA
+    wire.register_module(module)
+
+    @dataclasses.dataclass
+    class ModProbeB:
+        y: int = 0
+
+    ModProbeB.__name__ = "ModProbeA"
+    module2 = types_mod.ModuleType("fake_wire_module_2")
+    module2.ModProbeA = ModProbeB
+    with pytest.raises(TypeError, match="name collision"):
+        wire.register_module(module2)
+
+
+# ------------------------------------------ satellites: WireDecodeError
+
+
+def test_missing_required_field_raises_typed_wire_decode_error():
+    import msgpack
+
+    broken = msgpack.packb(
+        {"t": "RegisterPeerRequest", "d": {"peer_id": "p1"}},
+        use_bin_type=True,
+    )
+    with pytest.raises(wire.WireDecodeError) as exc_info:
+        wire.decode(broken)
+    err = exc_info.value
+    assert err.message_type == "RegisterPeerRequest"
+    assert err.missing == ["task_id", "host"]
+    assert "incompatible schema generation" in str(err)
+    # and it still IS a TypeError (pre-existing catch sites keep working)
+    assert isinstance(err, TypeError)
+
+
+def test_well_formed_frame_does_not_raise_despite_extra_fields():
+    import msgpack
+
+    from dragonfly2_tpu.cluster import messages as msg
+
+    frame = msgpack.packb(
+        {"t": "StatPeerRequest",
+         "d": {"peer_id": "p", "new_field_from_future": 1}},
+        use_bin_type=True,
+    )
+    assert wire.decode(frame) == msg.StatPeerRequest(peer_id="p")
+
+
+# --------------------------------------------- megascale skew soak gate
+
+
+def test_rolling_upgrade_soak_with_wire_skew_loses_zero_downloads(golden):
+    """THE skew soak acceptance (ISSUE 15): the rolling-upgrade soak
+    replayed with every control-plane exchange round-tripping the
+    N-1-degraded codec (SkewProxy) produces a BIT-IDENTICAL
+    deterministic report to the plain run — zero lost downloads, zero
+    diverging decisions across mixed-version rounds — with zero codec
+    mismatches, real frame traffic on the register/response handshake
+    types, and rolling-upgrade churn actually exercised."""
+    from dragonfly2_tpu.megascale.soak import (
+        deterministic_view, run_megascale,
+    )
+
+    kwargs = dict(num_hosts=800, num_tasks=24, seed=7,
+                  arrivals_per_round=16, retire_after_rounds=24)
+    plain = run_megascale("soak", **kwargs)
+    skew = run_megascale("soak", wire_skew=golden, **kwargs)
+    ws = skew.pop("wire_skew")
+    assert ws["mismatches"] == [], ws["mismatches"][:5]
+    # the mixed-version handshake really happened, on both directions
+    assert ws["frames_total"] > 1000
+    for handshake in ("RegisterPeerRequest", "NormalTaskResponse",
+                      "DownloadPeerFinishedRequest"):
+        assert ws["frames"].get(handshake, 0) > 0, ws["frames"]
+    # rolling upgrades ran, so cross-version rounds existed
+    assert skew["mega"]["upgrade_host_restarts"] > 0
+    # zero lost downloads: the skewed wire changed NOTHING downstream —
+    # completions, failures, per-region aggregates, decision ledger,
+    # SLO verdicts are all bit-identical to the plain run
+    assert deterministic_view(skew) == deterministic_view(plain)
+    assert skew["stats"]["completed"] > 0
+    assert skew["stats"]["completed"] == plain["stats"]["completed"]
+
+
+# ------------------------------------------------------- property pins
+
+
+def test_roundtrip_registry_is_clean():
+    """Seeded structural fuzz over EVERY registered message type via the
+    shared wirefuzz core (the test-side twin is test_wire_property) —
+    deterministic: crc32-of-name seeds, no hash()."""
+    problems = wirefuzz.roundtrip_registry()
+    assert problems == [], problems[:10]

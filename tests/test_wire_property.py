@@ -4,13 +4,14 @@ RPC, inference RPC, health) must roundtrip decode(encode(x)) == x for
 randomized field values generated from its own type hints — so a new or
 changed message type is covered the moment it is registered, without a
 hand-written roundtrip test (the reference gets this from protobuf
-codegen; this repo's codec is hand-rolled, so the property stands in)."""
+codegen; this repo's codec is hand-rolled, so the property stands in).
 
-import dataclasses
-import enum
-import typing
+The generator lives in tools/dflint/wirefuzz.py — ONE structural fuzz
+core shared by this test, the skew replayer, and the megascale skew
+soak, so "randomized instance of message X" means the same thing in
+every harness. Seeds derive from crc32(name): DET-clean, reproducible
+across processes (str hash() is salted per process)."""
 
-import numpy as np
 import pytest
 
 # importing the servers registers every message set with the codec
@@ -18,54 +19,7 @@ import dragonfly2_tpu.manager.rpc  # noqa: F401
 import dragonfly2_tpu.rpc.inference  # noqa: F401
 import dragonfly2_tpu.rpc.server  # noqa: F401
 from dragonfly2_tpu.rpc import wire
-
-
-def _random_value(hint, rng: np.random.Generator, depth: int = 0):
-    origin = typing.get_origin(hint)
-    if origin is typing.Union:  # Optional[X]
-        args = [a for a in typing.get_args(hint) if a is not type(None)]
-        if not args or rng.random() < 0.3:
-            return None
-        return _random_value(args[0], rng, depth)
-    if origin in (list, tuple):
-        (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
-        n = 0 if depth > 2 else int(rng.integers(0, 3))
-        seq = [_random_value(inner, rng, depth + 1) for _ in range(n)]
-        return seq if origin is list else tuple(seq)
-    if origin is dict:
-        kt, vt = (typing.get_args(hint) + (typing.Any, typing.Any))[:2]
-        if depth > 2:
-            return {}
-        return {
-            str(_random_value(str, rng, depth + 1)) + str(i):
-                _random_value(vt, rng, depth + 1)
-            for i in range(int(rng.integers(0, 3)))
-        }
-    if isinstance(hint, type):
-        if dataclasses.is_dataclass(hint):
-            return _random_instance(hint, rng, depth + 1)
-        if issubclass(hint, enum.Enum):
-            members = list(hint)
-            return members[int(rng.integers(len(members)))]
-        if hint is bool:
-            return bool(rng.random() < 0.5)
-        if hint is int:
-            return int(rng.integers(-(1 << 40), 1 << 40))
-        if hint is float:
-            return float(np.round(rng.standard_normal() * 1e6, 6))
-        if hint is str:
-            return "s" + str(int(rng.integers(1 << 30)))
-        if hint is bytes:
-            return bytes(rng.integers(0, 256, int(rng.integers(0, 16)), dtype=np.uint8))
-    return None  # typing.Any and anything unhandled
-
-
-def _random_instance(cls, rng: np.random.Generator, depth: int = 0):
-    hints = typing.get_type_hints(cls)
-    kwargs = {}
-    for f in dataclasses.fields(cls):
-        kwargs[f.name] = _random_value(hints.get(f.name, typing.Any), rng, depth)
-    return cls(**kwargs)
+from tools.dflint import wirefuzz
 
 
 def _registered_types():
@@ -75,13 +29,9 @@ def _registered_types():
 
 @pytest.mark.parametrize("name,cls", _registered_types(), ids=lambda v: v if isinstance(v, str) else "")
 def test_every_registered_message_roundtrips(name, cls):
-    import zlib
-
-    # crc32, not hash(): str hashing is salted per process, which would
-    # make a failing case unreproducible across runs
-    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    rng = wirefuzz.message_rng(name)
     for _ in range(5):
-        msg = _random_instance(cls, rng)
+        msg = wirefuzz.fuzz_instance(cls, rng)
         try:
             encoded = wire.encode(msg)
         except ValueError as e:
@@ -90,6 +40,31 @@ def test_every_registered_message_roundtrips(name, cls):
             raise
         decoded = wire.decode(encoded[4:])
         assert decoded == msg, f"{name} failed roundtrip"
+
+
+def test_fuzz_covers_the_structural_shapes():
+    """The generator actually exercises nested dataclasses, enums,
+    Optionals and 0-length lists (a fuzz that silently degenerated to
+    scalars would hollow out the whole property)."""
+    from dragonfly2_tpu.cluster import messages as msg
+
+    rng = wirefuzz.message_rng("RegisterPeerRequest")
+    saw_nested = saw_none = saw_empty_list = saw_filled_list = False
+    for _ in range(40):
+        m = wirefuzz.fuzz_instance(msg.RegisterPeerRequest, rng)
+        if isinstance(m.host, msg.HostInfo):
+            saw_nested = True
+        if m.finished_pieces is None:
+            saw_none = True
+        elif m.finished_pieces == []:
+            saw_empty_list = True
+        elif m.finished_pieces:
+            saw_filled_list = True
+    assert saw_nested and saw_none and saw_empty_list and saw_filled_list
+    rng2 = wirefuzz.message_rng("SizeScope-probe")
+    assert isinstance(
+        wirefuzz.fuzz_value(msg.SizeScope, rng2), msg.SizeScope
+    )
 
 
 def test_registry_covers_the_known_surfaces():
@@ -101,3 +76,45 @@ def test_registry_covers_the_known_surfaces():
     ):
         assert expected in names, expected
     assert len(names) > 40, sorted(names)
+
+
+# ------------------------------------------------------ typed-error pins
+
+
+def test_unknown_envelope_type_raises_typed_error():
+    """An unknown `"t"` is a TypeError (but NOT a WireDecodeError — that
+    one means 'known type, incompatible payload'; the skew replayer
+    relies on the distinction)."""
+    import msgpack
+
+    frame = msgpack.packb({"t": "NoSuchMessageEver", "d": {}},
+                          use_bin_type=True)
+    with pytest.raises(TypeError) as exc_info:
+        wire.decode(frame)
+    assert not isinstance(exc_info.value, wire.WireDecodeError)
+    assert "unknown message type" in str(exc_info.value)
+
+
+def test_oversize_frame_raises_value_error_both_directions(monkeypatch):
+    """Encode refuses to build a frame over MAX_FRAME, and read_frame
+    refuses a length prefix over it — neither path silently truncates.
+    MAX_FRAME is shrunk for the encode half: the branch is identical
+    and a real 256 MiB+1 payload would spike ~0.5 GB transient RSS."""
+    import asyncio
+
+    from dragonfly2_tpu.cluster import messages as msg
+
+    monkeypatch.setattr(wire, "MAX_FRAME", 1 << 16)
+    big = msg.TrainRequest(host_id="h", ip="i", hostname="n",
+                           dataset="download",
+                           chunk=b"\x00" * ((1 << 16) + 1))
+    with pytest.raises(ValueError, match="frame too large"):
+        wire.encode(big)
+
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(wire._LEN.pack(wire.MAX_FRAME + 1) + b"x")
+        with pytest.raises(ValueError, match="exceeds cap"):
+            await wire.read_frame(reader)
+
+    asyncio.run(run())

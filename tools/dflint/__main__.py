@@ -16,6 +16,15 @@ into a muzzle for the next unrelated finding.
 ``--json`` emits one machine-readable document (findings with stable
 ``rule@file:symbol`` ids, stale/reason-less waiver lists, scan stats)
 for CI annotators; the human rendering is suppressed.
+
+Wire-schema mode (the ``buf`` analog, tools/dflint/wireschema.py):
+``--wire-schema`` prints the live extraction as JSON; ``--breaking``
+diffs it against the checked-in ``tools/dfwire_schema.json`` and exits
+1 on schema-breaking changes (add-field-with-default is the only
+compatible evolution); ``--write`` (alone, or as the canonical
+``--breaking --write`` spelling) regenerates the snapshot, bumping its
+recorded ``schema_version`` when the change was breaking. These modes
+run INSTEAD of the lint passes.
 """
 
 from __future__ import annotations
@@ -41,7 +50,30 @@ def main(argv: list[str] | None = None) -> int:
                         help="fail on waivers whose rule no longer fires")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable findings on stdout")
+    parser.add_argument("--wire-schema", action="store_true",
+                        help="print the live wire-schema extraction as JSON")
+    parser.add_argument("--breaking", action="store_true",
+                        help="diff the live wire schema against the "
+                             "checked-in snapshot; exit 1 on breaking "
+                             "changes")
+    parser.add_argument("--write", action="store_true",
+                        help="regenerate the wire-schema snapshot "
+                             "(records a schema_version bump on breaks; "
+                             "usable alone or as --breaking --write)")
     args = parser.parse_args(argv)
+
+    if args.wire_schema or args.breaking or args.write:
+        from tools.dflint import wireschema
+
+        if args.write:
+            return wireschema.write_snapshot()
+        if args.wire_schema:
+            snapshot = wireschema.load_snapshot()
+            version = (snapshot or {}).get("schema_version", 1)
+            print(json.dumps(wireschema.extract(schema_version=version),
+                             indent=1, sort_keys=True))
+            return 0
+        return wireschema.check_breaking()
 
     root = Path(args.root).resolve()
     files: list[Path] | None = None

@@ -269,6 +269,7 @@ def default_passes():
     from tools.dflint.passes.jit_hygiene import JitHygienePass
     from tools.dflint.passes.lock_discipline import LockDisciplinePass
     from tools.dflint.passes.shape import ShapeDonationPass
+    from tools.dflint.passes.wire import WirePass
 
     return [
         LockDisciplinePass(),
@@ -277,6 +278,7 @@ def default_passes():
         DeterminismPass(),
         ShapeDonationPass(),
         CollectivePass(),
+        WirePass(),
     ]
 
 
@@ -300,6 +302,13 @@ def run_dflint(
     for ctx in contexts:
         for lint_pass in passes:
             findings.extend(lint_pass.run(ctx))
+    # Cross-file passes (dfwire's producer/consumer closure needs the
+    # whole parsed tree at once) emit from an optional finalize hook
+    # after every context has been seen; per-file passes simply lack it.
+    for lint_pass in passes:
+        finalize = getattr(lint_pass, "finalize", None)
+        if finalize is not None:
+            findings.extend(finalize(contexts))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return (
         LintReport(findings, len(contexts), time.perf_counter() - t0),

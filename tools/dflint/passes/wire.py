@@ -1,0 +1,833 @@
+"""WIRE001-WIRE004 — dfwire: static wire-contract verification of the
+hand-rolled msgpack codec edge.
+
+The reference repo's control plane is protobuf: ``buf lint`` + ``buf
+breaking`` on the d7y.io api module give it message-closure and
+schema-evolution safety for free. This repo's codec (rpc/wire.py) is a
+dataclass-name-keyed registry with type-hint-driven conversion — no
+codegen, no schema artifact — so the same guarantees have to be
+machine-checked here. This pass is the ``buf lint`` half; the schema
+snapshot + ``--breaking`` diff (tools/dflint/wireschema.py) is the
+``buf breaking`` half; the skew replayer (tools/dflint/wirefuzz.py) is
+the runtime tripwire, the PR-10/11 static-pass + runtime-backstop
+pattern.
+
+This is dflint's first CROSS-FILE pass: per-file ``run()`` collects
+nothing, and everything happens in the ``finalize(contexts)`` hook the
+core runner calls after all files are parsed — the producer/consumer
+closure is a whole-program property.
+
+Rules:
+
+- ``WIRE001`` — producer/consumer closure. Four findings share the id:
+  (a) a message constructed directly into a frame-sender call
+  (``encode``/``write_frame``/``send``/``call``/``_call``) whose class
+  is a package dataclass but never statically registered with the
+  codec; (b) a registered top-level message type (not nested inside
+  another message's fields) that is constructed nowhere in the package
+  — a dead frame type; (c) a directly-sent registered type with no
+  dispatch arm (``isinstance`` or dispatch-table key) anywhere — a
+  frame nobody can consume; (d) an arm in one of the designated
+  dispatch sites whose type has no live producer in the package. The
+  v1 dialect's requests are produced — and its replies consumed — by
+  the external v1 client generation, so those ride the argued
+  ``EXTERNAL_PRODUCERS``/``EXTERNAL_CONSUMERS`` registries below (the
+  D2H_ALLOWLIST idiom: every entry argues its case).
+- ``WIRE002`` — codec representability. Every registered message
+  field's type hint must land in the ``_to_plain``/``_from_plain``
+  lattice (scalar / bytes / dataclass / enum / ``list[T]`` /
+  ``tuple[T]`` or ``tuple[T, ...]`` / dict-of-scalars / Optional).
+  Hints the decoder passes through unconverted — ``set``, ndarray,
+  multi-element ``tuple[int, str]``, dataclass-vs-dataclass unions,
+  ``dict`` values holding dataclasses/enums — are silent
+  wrong-round-trip bugs and fail here before a frame ever travels.
+  Nested message dataclasses are checked transitively.
+- ``WIRE003`` — envelope propagation, the PR-3 "dl" re-anchor contract
+  machine-checked: a serve loop that reads frames
+  (``read_frame``) and routes them through a ``_dispatch*`` handler
+  must re-anchor the propagated deadline budget
+  (``resilience.deadline``/``deadline_s``) and continue the wire trace
+  context (``trace_context``/``remote_parent``) somewhere in its
+  enclosing class — otherwise every frame the handlers re-encode
+  onward silently drops the budget and breaks the trace at this hop.
+  Routing dispatch through the shared ``rpc/mux.dispatch_anchored``
+  helper satisfies both halves at once (and is the preferred spelling
+  for new request/response servers).
+- ``WIRE004`` — v1-translation exhaustiveness: every member of the
+  dialect's ``V1_REQUEST_TYPES`` tuple has an ``isinstance`` arm in
+  ``_dispatch_v1`` (and no arm is unreachable — frames only reach it
+  through that tuple's gate), and every scheduling response type the
+  tick can emit (``V1_TRANSLATED_RESPONSES``) has a translation arm in
+  ``to_peer_packet`` — the reference serves both protocol generations
+  off one resource layer, and a response with no v1 translation is a
+  v1 peer that silently never hears its scheduling verdict.
+
+Like every dflint pass this lints a discipline, not a proof system:
+producers/consumers are matched by class LEAF name (the codec's own
+``__name__`` keying — satellite-enforced collision-free), and only
+direct-constructor sends are producer sites. The wirefuzz roundtrip +
+skew replay are the runtime backstop for what the approximation lets
+through.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dflint.core import FileContext, Finding, attr_chain
+from tools.dflint.passes.collective import _functions_with_symbols, _walk_own
+
+# frame-sender callable leaf -> positional index of the message argument
+SENDER_ARG: dict[str, int] = {
+    "encode": 0, "write_frame": 1, "send": 0, "call": 0, "_call": 0,
+}
+
+# Designated dispatch sites: (file suffix, function leaf name). These are
+# THE consumption points of the wire protocol — rule (d) requires every
+# arm here to have a live producer, and WIRE004 reads _dispatch_v1 from
+# this set. A new RPC server adds its dispatch function here, which is
+# what makes its arms part of the checked closure.
+DISPATCH_SITES: frozenset[tuple[str, str]] = frozenset({
+    ("rpc/server.py", "_dispatch"),
+    ("rpc/server.py", "_dispatch_v1"),
+    ("rpc/server.py", "_serve_conn"),
+    ("rpc/inference.py", "_dispatch"),
+    ("manager/rpc.py", "_dispatch"),
+    ("rpc/mux.py", "handle_health_request"),
+    ("cluster/scheduler.py", "handle"),
+    ("rpc/client.py", "_read_loop"),
+})
+
+# Message types whose PRODUCER lives outside this package: the v1
+# dialect's requests come from external v1-generation daemons (the
+# compat surface exists exactly for peers this repo does not build), and
+# the manager's CreateModel is driven by external publishers. Every
+# entry argues its case; the fixture tests pin that an unargued orphan
+# still fails.
+EXTERNAL_PRODUCERS: dict[str, str] = {
+    "V1PeerTaskRequest": "produced by external v1-generation daemons "
+                         "(scheduler_client v1); tests/test_service_v1.py "
+                         "drives the dialect end to end",
+    "V1PieceResult": "external v1 daemons stream these "
+                     "(ReportPieceResult); exercised by test_service_v1",
+    "V1PeerResult": "external v1 daemons report final results; "
+                    "exercised by test_service_v1",
+    "V1PeerTarget": "external v1 daemons send LeaveTask; exercised by "
+                    "test_service_v1",
+    "V1AnnounceTaskRequest": "external dfcache-style importers announce "
+                             "complete replicas; exercised by "
+                             "test_service_v1",
+    "CreateModelRequest": "external trainer publishers push models over "
+                          "the manager edge (manager_server_v1.go:802 "
+                          "parity); exercised by test_manager",
+}
+
+# Message types whose CONSUMER is the remote end of an external dialect:
+# the v1 replies are decoded by v1-generation clients outside this repo.
+EXTERNAL_CONSUMERS: dict[str, str] = {
+    "V1RegisterResult": "decoded by external v1 clients "
+                        "(RegisterPeerTask reply); pinned by "
+                        "test_service_v1",
+    "V1PeerPacket": "decoded by external v1 clients (the PeerPacket "
+                    "scheduling stream); pinned by test_service_v1",
+    "V1Task": "decoded by external v1 clients (StatTask reply); pinned "
+              "by test_service_v1",
+}
+
+# The v2 scheduling responses svc.tick()/register can emit toward a
+# peer — each MUST have a to_peer_packet translation arm or a v1 peer
+# never hears its verdict (WIRE004). This is the design document the
+# fixture pins; extend it when the tick grows a new response type.
+V1_TRANSLATED_RESPONSES: tuple[str, ...] = (
+    "NormalTaskResponse",
+    "NeedBackToSourceResponse",
+    "EmptyTaskResponse",
+    "ScheduleFailure",
+)
+
+_SCALAR_HINTS = frozenset({
+    "str", "int", "float", "bool", "bytes", "None", "object", "Any",
+})
+_LIST_HINTS = frozenset({"list", "List", "tuple", "Tuple", "Sequence"})
+_DICT_HINTS = frozenset({"dict", "Dict", "Mapping"})
+_BAD_HINTS = frozenset({
+    "set", "Set", "frozenset", "FrozenSet", "ndarray", "Array",
+    "complex", "Callable",
+})
+_ENUM_BASES = frozenset({"Enum", "IntEnum", "StrEnum", "IntFlag", "Flag"})
+_OPTIONAL_HINTS = frozenset({"Optional"})
+_UNION_HINTS = frozenset({"Union"})
+
+
+class _ClassInfo:
+    __slots__ = ("ctx", "node", "kind")
+
+    def __init__(self, ctx: FileContext, node: ast.ClassDef, kind: str):
+        self.ctx = ctx
+        self.node = node
+        self.kind = kind  # "dataclass" | "enum" | "plain"
+
+
+class WirePass:
+    name = "wire-contract"
+    rules = ("WIRE001", "WIRE002", "WIRE003", "WIRE004")
+
+    def __init__(
+        self,
+        dispatch_sites: frozenset[tuple[str, str]] | None = None,
+        external_producers: dict[str, str] | None = None,
+        external_consumers: dict[str, str] | None = None,
+        translated_responses: tuple[str, ...] | None = None,
+        dialect_suffix: str = "cluster/service_v1.py",
+    ):
+        self.dispatch_sites = (
+            DISPATCH_SITES if dispatch_sites is None else dispatch_sites
+        )
+        self.external_producers = (
+            EXTERNAL_PRODUCERS if external_producers is None
+            else external_producers
+        )
+        self.external_consumers = (
+            EXTERNAL_CONSUMERS if external_consumers is None
+            else external_consumers
+        )
+        self.translated_responses = (
+            V1_TRANSLATED_RESPONSES if translated_responses is None
+            else translated_responses
+        )
+        self.dialect_suffix = dialect_suffix
+
+    # ------------------------------------------------------------- runner
+
+    def run(self, ctx: FileContext) -> list[Finding]:
+        # every rule is a whole-program property; see finalize()
+        return []
+
+    def finalize(self, contexts: list[FileContext]) -> list[Finding]:
+        facts = _Facts(contexts, self)
+        findings: list[Finding] = []
+        findings.extend(self._closure(facts))          # WIRE001
+        findings.extend(self._representability(facts))  # WIRE002
+        findings.extend(self._envelope(facts))         # WIRE003
+        findings.extend(self._v1_exhaustive(facts))    # WIRE004
+        return findings
+
+    # ------------------------------------------------------------ WIRE001
+
+    def _closure(self, facts: "_Facts") -> list[Finding]:
+        findings = []
+        # (a) sent-but-unregistered + (c) sent-but-unconsumed
+        for ctx, node, leaf, symbol, def_line in facts.send_sites:
+            info = facts.classes.get(leaf)
+            if info is None or info.kind != "dataclass":
+                continue  # not a package dataclass; out of scope
+            if leaf not in facts.registered:
+                findings.append(ctx.make_finding(
+                    "WIRE001", node,
+                    f"message '{leaf}' is encoded into a frame here but "
+                    f"never registered with the wire codec "
+                    f"(register_messages/register_module) — the remote "
+                    f"decoder will reject the envelope",
+                    symbol=symbol, def_line=def_line,
+                ))
+            elif leaf not in facts.consumed and \
+                    leaf not in self.external_consumers:
+                findings.append(ctx.make_finding(
+                    "WIRE001", node,
+                    f"message '{leaf}' is sent here but no dispatch arm "
+                    f"or isinstance consumer exists anywhere in the "
+                    f"package — a frame nobody can act on; add the arm "
+                    f"or argue an EXTERNAL_CONSUMERS entry",
+                    symbol=symbol, def_line=def_line,
+                ))
+        # (b) registered top-level types nobody constructs: dead frames
+        for leaf, (reg_ctx, reg_node) in sorted(facts.registered.items()):
+            if leaf in facts.nested_refs or leaf in self.external_producers:
+                continue
+            if leaf not in facts.constructed:
+                findings.append(reg_ctx.make_finding(
+                    "WIRE001", reg_node,
+                    f"registered message type '{leaf}' is constructed "
+                    f"nowhere in the package — a dead wire type; delete "
+                    f"it or argue an EXTERNAL_PRODUCERS entry",
+                    symbol=leaf,
+                ))
+        # (d) dispatch arms without a live producer
+        for ctx, node, leaf, symbol, def_line in facts.dispatch_arms:
+            if leaf in facts.constructed or leaf in self.external_producers:
+                continue
+            if leaf not in facts.classes:
+                continue  # not a package class (typing gate etc.)
+            findings.append(ctx.make_finding(
+                "WIRE001", node,
+                f"dispatch arm for '{leaf}' has no live producer in the "
+                f"package — dead dispatch code; remove the arm or argue "
+                f"an EXTERNAL_PRODUCERS entry",
+                symbol=symbol, def_line=def_line,
+            ))
+        return findings
+
+    # ------------------------------------------------------------ WIRE002
+
+    def _representability(self, facts: "_Facts") -> list[Finding]:
+        findings: list[Finding] = []
+        seen: set[str] = set()
+        queue = sorted(facts.registered)
+        while queue:
+            leaf = queue.pop()
+            if leaf in seen:
+                continue
+            seen.add(leaf)
+            info = facts.classes.get(leaf)
+            if info is None or info.kind != "dataclass":
+                continue
+            for stmt in info.node.body:
+                if not isinstance(stmt, ast.AnnAssign) or \
+                        not isinstance(stmt.target, ast.Name):
+                    continue
+                problems, nested = _check_hint(stmt.annotation, facts)
+                for nested_leaf in nested:
+                    if nested_leaf not in seen:
+                        queue.append(nested_leaf)
+                for problem in problems:
+                    findings.append(info.ctx.make_finding(
+                        "WIRE002", stmt,
+                        f"field '{leaf}.{stmt.target.id}': {problem}",
+                        symbol=f"{leaf}.{stmt.target.id}",
+                        def_line=info.node.lineno,
+                    ))
+        return findings
+
+    # ------------------------------------------------------------ WIRE003
+
+    def _envelope(self, facts: "_Facts") -> list[Finding]:
+        findings = []
+        for ctx, func, symbol, scope_refs in facts.serve_loops:
+            if "deadline" not in scope_refs:
+                findings.append(ctx.make_finding(
+                    "WIRE003", func,
+                    f"serve loop '{symbol}' dispatches decoded frames "
+                    f"without re-anchoring the propagated deadline "
+                    f"budget (rpc/wire.py \"dl\") — wrap the dispatch "
+                    f"in resilience.deadline(getattr(request, "
+                    f"'deadline_s', ...)) so onward frames carry the "
+                    f"remaining budget",
+                    symbol=symbol, def_line=func.lineno,
+                ))
+            if "trace" not in scope_refs:
+                findings.append(ctx.make_finding(
+                    "WIRE003", func,
+                    f"serve loop '{symbol}' dispatches decoded frames "
+                    f"without continuing the wire trace context — open "
+                    f"the handler span with remote_parent=getattr("
+                    f"request, 'trace_context', None) or the trace "
+                    f"breaks at this hop",
+                    symbol=symbol, def_line=func.lineno,
+                ))
+        return findings
+
+    # ------------------------------------------------------------ WIRE004
+
+    def _v1_exhaustive(self, facts: "_Facts") -> list[Finding]:
+        findings: list[Finding] = []
+        if facts.v1_request_types is None:
+            return findings  # no dialect tuple in the scanned set
+        tuple_ctx, tuple_node, declared = facts.v1_request_types
+        arms = facts.v1_dispatch_arms
+        if arms is not None:
+            arm_ctx, arm_func, armed = arms
+            for leaf in sorted(declared - set(armed)):
+                findings.append(tuple_ctx.make_finding(
+                    "WIRE004", tuple_node,
+                    f"v1 request type '{leaf}' is declared in "
+                    f"V1_REQUEST_TYPES but has no isinstance arm in "
+                    f"_dispatch_v1 — the frame passes the gate and "
+                    f"falls through untranslated",
+                    symbol="V1_REQUEST_TYPES",
+                ))
+            for leaf, node in sorted(armed.items()):
+                if leaf not in declared:
+                    findings.append(arm_ctx.make_finding(
+                        "WIRE004", node,
+                        f"_dispatch_v1 arm for '{leaf}' is unreachable "
+                        f"— frames only reach it through the "
+                        f"V1_REQUEST_TYPES gate, which does not list "
+                        f"this type",
+                        symbol="_dispatch_v1", def_line=arm_func.lineno,
+                    ))
+        if facts.to_peer_packet is not None:
+            pp_ctx, pp_func, translated = facts.to_peer_packet
+            for leaf in self.translated_responses:
+                if leaf not in translated:
+                    findings.append(pp_ctx.make_finding(
+                        "WIRE004", pp_func,
+                        f"scheduling response '{leaf}' has no "
+                        f"to_peer_packet translation arm — a v1 peer "
+                        f"owed this verdict never hears it",
+                        symbol="to_peer_packet", def_line=pp_func.lineno,
+                    ))
+        return findings
+
+
+# ------------------------------------------------------- fact collection
+
+
+class _Facts:
+    """One whole-program scan: registered set, class index, producer and
+    consumer sites, serve loops, and the v1 dialect tables."""
+
+    def __init__(self, contexts: list[FileContext], conf: WirePass):
+        self.conf = conf
+        self.classes: dict[str, _ClassInfo] = {}
+        # leaf -> (ctx, ClassDef) of the registration's class definition
+        self.registered: dict[str, tuple[FileContext, ast.ClassDef]] = {}
+        self.constructed: set[str] = set()
+        self.consumed: set[str] = set()
+        self.nested_refs: set[str] = set()
+        # (ctx, node, leaf, symbol, def_line)
+        self.send_sites: list = []
+        self.dispatch_arms: list = []
+        # (ctx, func, symbol, scope_refs)
+        self.serve_loops: list = []
+        self.v1_request_types: tuple | None = None
+        self.v1_dispatch_arms: tuple | None = None
+        self.to_peer_packet: tuple | None = None
+
+        self._index_classes(contexts)
+        self._resolve_registrations(contexts)
+        for ctx in contexts:
+            self._scan_file(ctx)
+        self._collect_nested_refs()
+
+    # -- class index ------------------------------------------------------
+
+    def _index_classes(self, contexts: list[FileContext]) -> None:
+        for ctx in contexts:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                kind = "plain"
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    chain = attr_chain(target) or ""
+                    if chain.rsplit(".", 1)[-1] == "dataclass":
+                        kind = "dataclass"
+                for base in node.bases:
+                    chain = attr_chain(base) or ""
+                    if chain.rsplit(".", 1)[-1] in _ENUM_BASES:
+                        kind = "enum"
+                self.classes.setdefault(node.name, _ClassInfo(ctx, node, kind))
+
+    # -- registration resolution -----------------------------------------
+
+    def _resolve_registrations(self, contexts: list[FileContext]) -> None:
+        by_suffix = {ctx.rel: ctx for ctx in contexts}
+
+        def module_ctx(dotted: str) -> FileContext | None:
+            suffix = dotted.replace(".", "/") + ".py"
+            for rel, ctx in by_suffix.items():
+                if rel.endswith(suffix):
+                    return ctx
+            return None
+
+        for ctx in contexts:
+            # import aliases: name -> dotted module path
+            aliases: dict[str, str] = {}
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        aliases[alias.asname or alias.name] = (
+                            f"{node.module}.{alias.name}"
+                        )
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        aliases[alias.asname or alias.name] = alias.name
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                leaf = chain.rsplit(".", 1)[-1] if chain else None
+                if leaf == "register_messages":
+                    for arg in node.args:
+                        name = (attr_chain(arg) or "").rsplit(".", 1)[-1]
+                        info = self.classes.get(name)
+                        if info is not None:
+                            self.registered.setdefault(
+                                name, (info.ctx, info.node)
+                            )
+                elif leaf == "register_module":
+                    target = self._registered_module(node, ctx, aliases,
+                                                     module_ctx)
+                    if target is None:
+                        continue
+                    for cnode in ast.walk(target.tree):
+                        if isinstance(cnode, ast.ClassDef):
+                            info = self.classes.get(cnode.name)
+                            if info is not None and info.kind == "dataclass":
+                                self.registered.setdefault(
+                                    cnode.name, (info.ctx, info.node)
+                                )
+
+    @staticmethod
+    def _registered_module(node: ast.Call, ctx: FileContext,
+                           aliases: dict[str, str], module_ctx):
+        if not node.args:
+            return None
+        arg = node.args[0]
+        # the self-registration idiom: register_module(_sys.modules[__name__])
+        if isinstance(arg, ast.Subscript):
+            chain = attr_chain(arg.value) or ""
+            if chain.rsplit(".", 1)[-1] == "modules":
+                return ctx
+            return None
+        name = attr_chain(arg)
+        if name is None:
+            return None
+        dotted = aliases.get(name, name)
+        return module_ctx(dotted)
+
+    # -- per-file scan ----------------------------------------------------
+
+    def _scan_file(self, ctx: FileContext) -> None:
+        designated = {
+            fn for suffix, fn in self.conf.dispatch_sites
+            if ctx.rel.endswith(suffix)
+        }
+        is_dialect = ctx.rel.endswith(self.conf.dialect_suffix)
+        if is_dialect:
+            self._scan_dialect_tuple(ctx)
+        for func, symbol, _anc in _functions_with_symbols(ctx.tree):
+            fn_leaf = symbol.rsplit(".", 1)[-1]
+            refs = self._function_refs(func)
+            if "read_frame" in refs["calls"] and refs["dispatch_ref"]:
+                self.serve_loops.append(
+                    (ctx, func, symbol, self._scope_refs(ctx, func))
+                )
+            arms = self._isinstance_arms(func)
+            table = self._dispatch_table_keys(func)
+            for leaf, node in {**arms, **table}.items():
+                self.consumed.add(leaf)
+                if fn_leaf in designated:
+                    self.dispatch_arms.append(
+                        (ctx, node, leaf, symbol, func.lineno)
+                    )
+            if fn_leaf == "_dispatch_v1" and fn_leaf in designated:
+                self.v1_dispatch_arms = (ctx, func, arms)
+            if fn_leaf == "to_peer_packet" and is_dialect:
+                self.to_peer_packet = (ctx, func, set(arms))
+            self._scan_sends(ctx, func, symbol)
+        # module-scope construction/sends (rare, but registration files
+        # construct defaults at import time)
+        self._scan_constructions(ctx.tree)
+
+    def _scan_dialect_tuple(self, ctx: FileContext) -> None:
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "V1_REQUEST_TYPES" \
+                    and isinstance(node.value, ast.Tuple):
+                leaves = {
+                    (attr_chain(elt) or "").rsplit(".", 1)[-1]
+                    for elt in node.value.elts
+                }
+                self.v1_request_types = (ctx, node, leaves - {""})
+
+    def _scan_constructions(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is not None:
+                    self.constructed.add(chain.rsplit(".", 1)[-1])
+
+    def _scan_sends(self, ctx: FileContext, func, symbol: str) -> None:
+        for node in _walk_own(func):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            leaf = chain.rsplit(".", 1)[-1] if chain else None
+            arg_pos = SENDER_ARG.get(leaf or "")
+            if arg_pos is None or arg_pos >= len(node.args):
+                continue
+            arg = node.args[arg_pos]
+            if not isinstance(arg, ast.Call):
+                continue
+            msg_chain = attr_chain(arg.func)
+            if msg_chain is None:
+                continue
+            msg_leaf = msg_chain.rsplit(".", 1)[-1]
+            if msg_leaf in self.classes:
+                self.send_sites.append(
+                    (ctx, arg, msg_leaf, symbol, func.lineno)
+                )
+
+    @staticmethod
+    def _function_refs(func) -> dict:
+        calls: set[str] = set()
+        dispatch_ref = False
+        for node in _walk_own(func):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain:
+                    calls.add(chain.rsplit(".", 1)[-1])
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                leaf = (attr_chain(node) or "").rsplit(".", 1)[-1]
+                if leaf.startswith("_dispatch"):
+                    dispatch_ref = True
+        return {"calls": calls, "dispatch_ref": dispatch_ref}
+
+    def _scope_refs(self, ctx: FileContext, func) -> set[str]:
+        """{"deadline", "trace"} satisfied anywhere in the function's
+        enclosing class (the re-anchor may live in the _dispatch helper
+        the loop hands frames to), else in the function itself."""
+        scope: ast.AST = func
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in ast.walk(node):
+                    if stmt is func:
+                        scope = node
+                        break
+        refs: set[str] = set()
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if node.value == "deadline_s":
+                    refs.add("deadline")
+                elif node.value == "trace_context":
+                    refs.add("trace")
+            elif isinstance(node, ast.Attribute):
+                if node.attr == "deadline_s":
+                    refs.add("deadline")
+                elif node.attr == "trace_context":
+                    refs.add("trace")
+            elif isinstance(node, ast.Call):
+                leaf = (attr_chain(node.func) or "").rsplit(".", 1)[-1]
+                if leaf == "deadline":
+                    refs.add("deadline")
+            elif isinstance(node, ast.keyword) and node.arg == "remote_parent":
+                refs.add("trace")
+            # the blessed shared helper (rpc/mux.dispatch_anchored)
+            # satisfies BOTH halves — one implementation to audit. It is
+            # commonly passed as a to_thread callable, so a bare
+            # reference counts, not just a direct call.
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if (attr_chain(node) or "").rsplit(".", 1)[-1] == \
+                        "dispatch_anchored":
+                    refs.update(("deadline", "trace"))
+        return refs
+
+    @staticmethod
+    def _isinstance_arms(func) -> dict[str, ast.AST]:
+        arms: dict[str, ast.AST] = {}
+        for node in _walk_own(func):
+            if not (isinstance(node, ast.Call)
+                    and (attr_chain(node.func) or "") == "isinstance"
+                    and len(node.args) == 2):
+                continue
+            second = node.args[1]
+            elts = second.elts if isinstance(second, ast.Tuple) else [second]
+            for elt in elts:
+                chain = attr_chain(elt)
+                if chain is None:
+                    continue
+                arms.setdefault(chain.rsplit(".", 1)[-1], node)
+        return arms
+
+    def _dispatch_table_keys(self, func) -> dict[str, ast.AST]:
+        """Keys of handler-table dict literals (``{msg.X: self.handler}``)
+        — a dict counts only when EVERY key resolves to a known class."""
+        out: dict[str, ast.AST] = {}
+        for node in _walk_own(func):
+            if not isinstance(node, ast.Dict) or not node.keys:
+                continue
+            leaves = []
+            for key in node.keys:
+                chain = attr_chain(key) if key is not None else None
+                leaf = chain.rsplit(".", 1)[-1] if chain else None
+                if leaf is None or leaf not in self.classes:
+                    leaves = []
+                    break
+                leaves.append((leaf, key))
+            for leaf, key in leaves:
+                out.setdefault(leaf, key)
+        return out
+
+    # -- nested field refs ------------------------------------------------
+
+    def _collect_nested_refs(self) -> None:
+        queue = sorted(self.registered)
+        seen: set[str] = set()
+        while queue:
+            leaf = queue.pop()
+            if leaf in seen:
+                continue
+            seen.add(leaf)
+            info = self.classes.get(leaf)
+            if info is None or info.kind != "dataclass":
+                continue
+            for stmt in info.node.body:
+                if not isinstance(stmt, ast.AnnAssign):
+                    continue
+                for name in _hint_class_leaves(stmt.annotation):
+                    if name in self.classes and name != leaf:
+                        self.nested_refs.add(name)
+                        queue.append(name)
+
+
+# ------------------------------------------------ hint lattice (WIRE002)
+
+
+def _hint_class_leaves(node: ast.AST) -> set[str]:
+    """Every Name/Attribute leaf referenced anywhere in a type hint."""
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            # string annotations ("np.ndarray") re-parse as hints
+            try:
+                out |= _hint_class_leaves(
+                    ast.parse(sub.value, mode="eval").body
+                )
+            except SyntaxError:
+                pass
+    return out
+
+
+def _check_hint(node: ast.AST, facts: _Facts,
+                inside_dict: bool = False) -> tuple[list[str], set[str]]:
+    """(problems, nested dataclass leaves to check transitively).
+    ``inside_dict`` marks positions the decoder passes through raw —
+    a dataclass/enum there never converts back."""
+    problems: list[str] = []
+    nested: set[str] = set()
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return problems, nested
+        if isinstance(node.value, str):
+            try:
+                parsed = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return problems, nested
+            return _check_hint(parsed, facts, inside_dict)
+        return problems, nested
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        parts = _flatten_union(node)
+        return _check_union(parts, facts, inside_dict)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        leaf = (attr_chain(node) or "").rsplit(".", 1)[-1]
+        return _check_leaf(leaf, node, facts, inside_dict)
+    if isinstance(node, ast.Subscript):
+        leaf = (attr_chain(node.value) or "").rsplit(".", 1)[-1]
+        args = (
+            list(node.slice.elts) if isinstance(node.slice, ast.Tuple)
+            else [node.slice]
+        )
+        if leaf in _OPTIONAL_HINTS:
+            return _check_hint(args[0], facts, inside_dict)
+        if leaf in _UNION_HINTS:
+            return _check_union(args, facts, inside_dict)
+        if leaf in _LIST_HINTS:
+            if leaf in ("tuple", "Tuple") and len(args) > 1 and not (
+                len(args) == 2 and isinstance(args[1], ast.Constant)
+                and args[1].value is Ellipsis
+            ):
+                problems.append(
+                    "multi-element tuple hint — _from_plain converts "
+                    "only the FIRST element type; model the record as a "
+                    "nested dataclass instead"
+                )
+                return problems, nested
+            sub_p, sub_n = _check_hint(args[0], facts, inside_dict)
+            return problems + sub_p, nested | sub_n
+        if leaf in _DICT_HINTS:
+            if len(args) >= 2:
+                sub_p, sub_n = _check_hint(args[1], facts, inside_dict=True)
+                problems += sub_p
+                nested |= sub_n
+            return problems, nested
+        if leaf in _BAD_HINTS:
+            problems.append(
+                f"'{leaf}' is outside the codec lattice — the decoder "
+                f"passes it through unconverted (silent wrong "
+                f"round-trip); use list/dict/dataclass shapes"
+            )
+            return problems, nested
+        return problems, nested  # unknown generic: benefit of the doubt
+    return problems, nested
+
+
+def _flatten_union(node: ast.BinOp) -> list[ast.AST]:
+    parts: list[ast.AST] = []
+    for side in (node.left, node.right):
+        if isinstance(side, ast.BinOp) and isinstance(side.op, ast.BitOr):
+            parts.extend(_flatten_union(side))
+        else:
+            parts.append(side)
+    return parts
+
+
+def _check_union(parts: list[ast.AST], facts: _Facts,
+                 inside_dict: bool) -> tuple[list[str], set[str]]:
+    problems: list[str] = []
+    nested: set[str] = set()
+    non_none = [
+        p for p in parts
+        if not (isinstance(p, ast.Constant) and p.value is None)
+        and (attr_chain(p) or "") != "None"
+    ]
+    if len(non_none) > 1:
+        problems.append(
+            "union of multiple payload types — _from_plain resolves "
+            "Optional by taking the FIRST non-None arg, so the second "
+            "alternative silently decodes as the first; split into "
+            "distinct message fields"
+        )
+        return problems, nested
+    for part in non_none:
+        sub_p, sub_n = _check_hint(part, facts, inside_dict)
+        problems += sub_p
+        nested |= sub_n
+    return problems, nested
+
+
+def _check_leaf(leaf: str, node: ast.AST, facts: _Facts,
+                inside_dict: bool) -> tuple[list[str], set[str]]:
+    problems: list[str] = []
+    nested: set[str] = set()
+    if leaf in _SCALAR_HINTS:
+        return problems, nested
+    if leaf in _BAD_HINTS:
+        problems.append(
+            f"'{leaf}' is outside the codec lattice — the decoder "
+            f"passes it through unconverted (silent wrong round-trip); "
+            f"use list/dict/dataclass shapes"
+        )
+        return problems, nested
+    if leaf in _LIST_HINTS or leaf in _DICT_HINTS:
+        return problems, nested  # bare list/dict: scalar payload
+    info = facts.classes.get(leaf)
+    if info is None:
+        return problems, nested  # unresolvable external: stay silent
+    if info.kind == "dataclass":
+        if inside_dict:
+            problems.append(
+                f"dataclass '{leaf}' inside a dict value — _from_plain "
+                f"does not recurse into dict hints, so it decodes as a "
+                f"plain dict; lift it into a typed field or a list"
+            )
+        else:
+            nested.add(leaf)
+        return problems, nested
+    if info.kind == "enum":
+        if inside_dict:
+            problems.append(
+                f"enum '{leaf}' inside a dict value — decodes as its "
+                f"raw value, not the enum; lift it into a typed field"
+            )
+        return problems, nested
+    problems.append(
+        f"class '{leaf}' is neither a dataclass nor an enum — the codec "
+        f"cannot reconstruct it; wrap the payload in a dataclass"
+    )
+    return problems, nested

@@ -1,0 +1,317 @@
+"""dfwire runtime half: structural codec fuzz + version-skew replay.
+
+The static pass (tools/dflint/passes/wire.py) argues the wire contract;
+the breaking gate (tools/dflint/wireschema.py) pins its evolution. This
+module is the runtime tripwire, the PR-10/11 pattern's third leg:
+
+- ``fuzz_instance``/``roundtrip_registry`` — seeded structural fuzz:
+  every registered message gets randomized field values generated from
+  its own type hints (nested dataclasses, enums, Optionals, 0-length
+  lists) and must satisfy ``decode(encode(x)) == x``. Seeds derive from
+  the message NAME (crc32, never ``hash()`` — salted per process), so a
+  failure reproduces across runs: DET-clean by construction.
+
+- ``replay_skew`` — the version-skew replayer: for every message in the
+  golden snapshot (tools/dfwire_schema.json), synthesize the N-1 wire
+  both ways. Old→new: a frame holding ONLY the snapshot's fields (any
+  field added since is absent, so the live decoder must default it) is
+  driven through the live ``wire.decode``; a ``WireDecodeError`` here
+  means an incompatible frame, anything else a codec bug — the typed
+  error is what makes the two distinguishable. New→old: a live
+  instance's payload is filtered the way an N-1 decoder would see it
+  (unknown fields dropped), then validated against the snapshot's
+  required-field set — a required field the live encoder no longer
+  emits strands every N-1 peer.
+
+- ``SkewProxy`` — the megascale soak's skew mode
+  (``run_megascale(wire_skew=...)``): wraps a SchedulerService so every
+  message-shaped control-plane exchange (registrations, report
+  handlers, the tick's scheduling responses) round-trips through
+  encode → degrade-to-snapshot → decode before it is acted on — the
+  rolling-upgrade soak then replays a full compressed day over the
+  mixed-version wire and must lose zero downloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+import types
+import typing
+import zlib
+
+import msgpack
+import numpy as np
+
+from dragonfly2_tpu.rpc import wire
+
+
+def ensure_registered() -> None:
+    """Import every registering module so ``wire._REGISTRY`` holds the
+    full message surface — the soak drives the scheduler in-proc and
+    never imports the RPC servers on its own, which would leave the
+    skew codec silently passing everything through."""
+    from tools.dflint import wireschema
+
+    for name in wireschema.REGISTERING_MODULES:
+        importlib.import_module(name)
+
+
+# ------------------------------------------------------ structural fuzz
+
+
+def fuzz_value(hint, rng: np.random.Generator, depth: int = 0):
+    """Randomized value for a type hint, mirroring the codec lattice."""
+    origin = typing.get_origin(hint)
+    # Optional[X] and X | None (PEP 604 reports types.UnionType)
+    if origin is typing.Union or origin is getattr(types, "UnionType", ()):
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if not args or rng.random() < 0.3:
+            return None
+        return fuzz_value(args[0], rng, depth)
+    if origin in (list, tuple):
+        (inner,) = typing.get_args(hint)[:1] or (typing.Any,)
+        n = 0 if depth > 2 else int(rng.integers(0, 3))
+        seq = [fuzz_value(inner, rng, depth + 1) for _ in range(n)]
+        return seq if origin is list else tuple(seq)
+    if origin is dict:
+        vt = (typing.get_args(hint) + (typing.Any, typing.Any))[1]
+        if depth > 2:
+            return {}
+        return {
+            f"k{i}-{int(rng.integers(1 << 20))}": fuzz_value(vt, rng, depth + 1)
+            for i in range(int(rng.integers(0, 3)))
+        }
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            return fuzz_instance(hint, rng, depth + 1)
+        if issubclass(hint, enum.Enum):
+            members = list(hint)
+            return members[int(rng.integers(len(members)))]
+        if hint is bool:
+            return bool(rng.random() < 0.5)
+        if hint is int:
+            return int(rng.integers(-(1 << 40), 1 << 40))
+        if hint is float:
+            return float(np.round(rng.standard_normal() * 1e6, 6))
+        if hint is str:
+            return "s" + str(int(rng.integers(1 << 30)))
+        if hint is bytes:
+            return bytes(
+                rng.integers(0, 256, int(rng.integers(0, 16)), dtype=np.uint8)
+            )
+    return None  # typing.Any and anything unhandled
+
+
+def fuzz_instance(cls: type, rng: np.random.Generator, depth: int = 0):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        kwargs[f.name] = fuzz_value(hints.get(f.name, typing.Any), rng, depth)
+    return cls(**kwargs)
+
+
+def message_rng(name: str, salt: int = 0) -> np.random.Generator:
+    """crc32-of-name seeding (never ``hash()`` — salted per process), so
+    a failing case reproduces across runs and machines."""
+    return np.random.default_rng(zlib.crc32(name.encode()) + salt)
+
+
+def roundtrip_registry(iterations: int = 5) -> list[str]:
+    """decode(encode(x)) == x for every registered message; returns the
+    list of failures (empty = clean)."""
+    problems: list[str] = []
+    ensure_registered()
+    for name, cls in sorted(wire._REGISTRY.items()):
+        rng = message_rng(name)
+        for _ in range(iterations):
+            message = fuzz_instance(cls, rng)
+            try:
+                frame = wire.encode(message)
+            except ValueError as e:
+                if "frame too large" in str(e):
+                    continue  # randomized payload overshot the frame cap
+                problems.append(f"{name}: encode failed: {e}")
+                continue
+            try:
+                decoded = wire.decode(frame[4:])
+            except Exception as e:  # noqa: BLE001 - collected as findings
+                problems.append(f"{name}: decode failed: {e}")
+                continue
+            if decoded != message:
+                problems.append(f"{name}: wrong round-trip: "
+                                f"{decoded!r} != {message!r}")
+    return problems
+
+
+# -------------------------------------------------------- skew replayer
+
+
+def _schema_fields(schema: dict, name: str) -> dict | None:
+    message = schema.get("messages", {}).get(name)
+    return None if message is None else message["fields"]
+
+
+def degrade_payload(payload: dict, schema: dict, name: str) -> dict:
+    """The N-1 view of a live payload: fields the snapshot does not know
+    are dropped (that is all an old decoder does with them); nested
+    message fields degrade recursively along the snapshot's own types."""
+    fields = _schema_fields(schema, name)
+    if fields is None:
+        return payload
+    out = {}
+    for key, value in payload.items():
+        spec = fields.get(key)
+        if spec is None:
+            continue  # unknown to N-1: dropped
+        ftype = spec["type"]
+        if ftype.startswith("optional["):
+            ftype = ftype[len("optional["):-1]
+        if ftype.startswith("message:") and isinstance(value, dict):
+            value = degrade_payload(value, schema, ftype.split(":", 1)[1])
+        elif ftype.startswith(("list[message:", "tuple[message:")) \
+                and isinstance(value, list):
+            inner = ftype.split("message:", 1)[1][:-1]
+            value = [
+                degrade_payload(v, schema, inner) if isinstance(v, dict)
+                else v
+                for v in value
+            ]
+        out[key] = value
+    return out
+
+
+def replay_skew(schema: dict, iterations: int = 3) -> list[str]:
+    """Both skew directions for every snapshot message that still exists
+    in the live registry. Returns problems (empty = compatible)."""
+    problems: list[str] = []
+    ensure_registered()
+    for name in sorted(schema.get("messages", {})):
+        cls = wire._REGISTRY.get(name)
+        fields = _schema_fields(schema, name)
+        if cls is None:
+            # nested records never key the envelope; only top-level
+            # registry members replay as frames
+            continue
+        rng = message_rng(name, salt=101)
+        for _ in range(iterations):
+            message = fuzz_instance(cls, rng)
+            payload = wire._to_plain(message)
+            # N-1 -> live: the old sender's frame (snapshot fields only)
+            old_frame = msgpack.packb(
+                {"t": name, "d": degrade_payload(payload, schema, name)},
+                use_bin_type=True,
+            )
+            try:
+                decoded = wire.decode(old_frame)
+            except wire.WireDecodeError as e:
+                problems.append(
+                    f"{name}: N-1 frame INCOMPATIBLE with live decoder "
+                    f"(a field added since the snapshot has no default): "
+                    f"{e}"
+                )
+                continue
+            except Exception as e:  # noqa: BLE001 - collected as findings
+                problems.append(f"{name}: N-1 frame crashed the live "
+                                f"decoder: {type(e).__name__}: {e}")
+                continue
+            if type(decoded) is not cls:
+                problems.append(f"{name}: N-1 frame decoded as "
+                                f"{type(decoded).__name__}")
+            # live -> N-1: what the old decoder sees after dropping
+            # unknown fields must still satisfy its required set
+            seen = set(degrade_payload(payload, schema, name))
+            missing = [
+                fname for fname, spec in sorted(fields.items())
+                if spec["required"] and fname not in seen
+            ]
+            if missing:
+                problems.append(
+                    f"{name}: live frame strands N-1 decoders — "
+                    f"required snapshot fields {missing} absent from "
+                    f"the live payload"
+                )
+    return problems
+
+
+# ------------------------------------------------------- soak skew mode
+
+
+class SkewProxy:
+    """Service wrapper for the megascale soak's mixed-version mode:
+    every message-shaped exchange round-trips the real codec and the
+    N-1 degrade before it is acted on — requests on the way in, the
+    tick's scheduling responses on the way out. Attribute access
+    delegates, so the engine drives it exactly like the bare service;
+    the columnar bulk APIs (``pieces_finished_batch`` etc.) pass
+    through untouched — they are in-process arrays, not frames."""
+
+    #: request-bearing entry points whose (single) argument is a message
+    _REQUEST_METHODS = (
+        "handle", "register_peer", "piece_finished", "piece_failed",
+        "peer_finished", "peer_failed", "back_to_source_started",
+        "back_to_source_finished", "back_to_source_failed",
+    )
+
+    #: the proxy's own state; every other attribute read AND write
+    #: delegates to the wrapped service (the simulator swap-assigns
+    #: ``svc.seed_triggers`` — a write landing on the proxy would fork
+    #: the trigger queue)
+    _INTERNAL = ("_svc", "_schema", "frames_by_type", "mismatches")
+
+    def __init__(self, service, schema: dict):
+        ensure_registered()
+        object.__setattr__(self, "_svc", service)
+        object.__setattr__(self, "_schema", schema)
+        object.__setattr__(self, "frames_by_type", {})
+        object.__setattr__(self, "mismatches", [])
+
+    def __setattr__(self, name, value):
+        if name in SkewProxy._INTERNAL:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._svc, name, value)
+
+    # -- codec round-trip -------------------------------------------------
+
+    def _skew(self, message):
+        name = type(message).__name__
+        if name not in wire._REGISTRY:
+            return message  # not a wire type (None, packets, arrays)
+        self.frames_by_type[name] = self.frames_by_type.get(name, 0) + 1
+        try:
+            env = msgpack.unpackb(wire.encode(message)[4:], raw=False)
+            env["d"] = degrade_payload(env.get("d", {}), self._schema, name)
+            return wire.decode(msgpack.packb(env, use_bin_type=True))
+        except Exception as e:  # noqa: BLE001 - a skew failure is the finding
+            self.mismatches.append(f"{name}: {type(e).__name__}: {e}")
+            return message
+
+    # -- message-shaped entry points --------------------------------------
+
+    def __getattr__(self, item):
+        if item in SkewProxy._REQUEST_METHODS:
+            method = getattr(self._svc, item)
+
+            def call(request, _method=method):
+                return self._skew(_method(self._skew(request)))
+
+            return call
+        return getattr(self._svc, item)
+
+    def register_peers_batch(self, reqs) -> list:
+        responses = self._svc.register_peers_batch(
+            [self._skew(r) for r in reqs]
+        )
+        return [self._skew(r) for r in responses]
+
+    def tick(self) -> list:
+        return [self._skew(r) for r in self._svc.tick()]
+
+    def report(self) -> dict:
+        return {
+            "frames": dict(sorted(self.frames_by_type.items())),
+            "frames_total": sum(self.frames_by_type.values()),
+            "mismatches": list(self.mismatches),
+        }

@@ -1,0 +1,351 @@
+"""dfwire schema half: extraction + the ``buf breaking`` analog.
+
+The codec (rpc/wire.py) has no .proto artifact, so schema evolution has
+nothing to diff against — until this module extracts one: ``extract()``
+imports every module that registers wire messages and walks the live
+``_REGISTRY`` into a canonical JSON document
+
+    {"schema_version": N,
+     "messages": {name: {field: {"type": <normalized>, "required": bool}}},
+     "enums":    {name: {member: value}},
+     "codes":    {name: value}}          # v1 dialect wire constants
+
+covering the registered messages plus every dataclass/enum reachable
+through their field hints (nested records like HostInfo/CPUStat are part
+of the wire shape even though only top-level names key the envelope).
+
+``diff(old, new)`` classifies changes under the proto3-style rule the
+tentpole pins: **add-field-with-default is the only compatible
+evolution**. Breaking: removed/renamed message, removed/renamed field,
+changed field type, a field turning required, a field ADDED required
+(an N-1 sender omits it and the live decoder hard-errors), any enum
+member or wire-code change (an N-1 decoder feeds unknown enum values to
+``Enum(value)`` and raises). Compatible: added message, added enum,
+added code, added field with a default.
+
+CLI (tools/dflint/__main__.py):
+
+- ``--wire-schema``  print the live extraction as JSON
+- ``--breaking``     diff live extraction against the checked-in
+  ``tools/dfwire_schema.json``; exit 1 on any breaking change
+- ``--breaking --write``  regenerate the snapshot (schema_version bumps
+  iff the diff against the previous snapshot had breaking rows — the
+  recorded version bump IS the intentional-break acknowledgement)
+
+The tier-1 gate (tools/lint_all.py stage 5) runs ``--breaking`` in a
+fresh interpreter so test-registered message types never leak into the
+extraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+import json
+import types
+import typing
+from pathlib import Path
+
+SNAPSHOT_PATH = Path(__file__).resolve().parents[1] / "dfwire_schema.json"
+
+# Every module that registers wire messages at import time. A new RPC
+# surface adds itself here, which is what puts its message set under the
+# breaking gate. (rpc.server transitively registers cluster.messages and
+# cluster.service_v1.)
+REGISTERING_MODULES: tuple[str, ...] = (
+    "dragonfly2_tpu.rpc.mux",
+    "dragonfly2_tpu.rpc.inference",
+    "dragonfly2_tpu.rpc.server",
+    "dragonfly2_tpu.manager.rpc",
+)
+
+# modules whose UPPERCASE int constants are wire-visible codes (the v1
+# dialect's common.proto Code values + piece sentinels)
+CODE_MODULES: tuple[str, ...] = ("dragonfly2_tpu.cluster.service_v1",)
+CODE_PREFIXES: tuple[str, ...] = ("CODE_", "BEGIN_OF_PIECE", "END_OF_PIECE")
+
+
+# ------------------------------------------------------------ extraction
+
+
+def _normalize(hint: object, walk: "list[type] | None" = None) -> str:
+    """Canonical string for a type hint; nested dataclasses/enums are
+    appended to ``walk`` so the extraction covers the full wire shape."""
+    origin = typing.get_origin(hint)
+    if origin in (list, tuple):
+        kind = "list" if origin is list else "tuple"
+        args = [a for a in typing.get_args(hint) if a is not Ellipsis]
+        if not args:
+            return kind
+        return f"{kind}[{_normalize(args[0], walk)}]"
+    if origin is dict:
+        args = typing.get_args(hint)
+        if not args:
+            return "dict"
+        return (
+            f"dict[{_normalize(args[0], walk)},{_normalize(args[1], walk)}]"
+        )
+    if origin is typing.Union or origin is getattr(types, "UnionType", ()):
+        non_none = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(non_none) == 1:
+            return f"optional[{_normalize(non_none[0], walk)}]"
+        inner = "|".join(sorted(_normalize(a, walk) for a in non_none))
+        return f"union[{inner}]"
+    if isinstance(hint, type):
+        if dataclasses.is_dataclass(hint):
+            if walk is not None:
+                walk.append(hint)
+            return f"message:{hint.__name__}"
+        if issubclass(hint, enum.Enum):
+            if walk is not None:
+                walk.append(hint)
+            return f"enum:{hint.__name__}"
+        if hint is type(None):
+            return "none"
+        if hint in (str, int, float, bool, bytes, dict, list, tuple, object):
+            return hint.__name__
+        return hint.__name__
+    if hint is typing.Any:
+        return "any"
+    return str(hint)
+
+
+def _message_fields(cls: type, walk: list[type]) -> dict:
+    hints = typing.get_type_hints(cls)
+    out: dict[str, dict] = {}
+    for f in dataclasses.fields(cls):
+        required = (
+            f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        )
+        out[f.name] = {
+            "type": _normalize(hints.get(f.name, typing.Any), walk),
+            "required": required,
+        }
+    return out
+
+
+def extract(schema_version: int = 1) -> dict:
+    """The live wire schema: registered messages + transitively reachable
+    nested dataclasses/enums + the v1 dialect's wire codes."""
+    for name in REGISTERING_MODULES:
+        importlib.import_module(name)
+    from dragonfly2_tpu.rpc import wire
+
+    messages: dict[str, dict] = {}
+    enums: dict[str, dict] = {}
+    walk: list[type] = list(wire._REGISTRY.values())
+    seen: dict[str, type] = {}
+    while walk:
+        cls = walk.pop()
+        prior = seen.get(cls.__name__)
+        if prior is cls:
+            continue
+        if prior is not None:
+            # the codec's collision guard covers only REGISTERED types;
+            # nested records ride on bare __name__ too, and two distinct
+            # classes collapsing to one schema entry would mis-drive
+            # both --breaking and the skew degrader
+            raise ValueError(
+                f"wire schema name collision: {cls.__name__!r} names both "
+                f"{prior.__module__}.{prior.__qualname__} and "
+                f"{cls.__module__}.{cls.__qualname__}"
+            )
+        seen[cls.__name__] = cls
+        if dataclasses.is_dataclass(cls):
+            messages[cls.__name__] = {"fields": _message_fields(cls, walk)}
+        elif isinstance(cls, type) and issubclass(cls, enum.Enum):
+            enums[cls.__name__] = {m.name: m.value for m in cls}
+    # enums defined alongside registered messages are wire-visible even
+    # when no field hint names them (SizeScope travels as a raw int) —
+    # sweep the DEFINING modules of every registered class, not just the
+    # registering entry points (register_module hides the message home)
+    message_homes = sorted({
+        cls.__module__ for cls in wire._REGISTRY.values()
+        if cls.__module__.startswith("dragonfly2_tpu.")
+    })
+    for name in dict.fromkeys(
+        message_homes + list(REGISTERING_MODULES + CODE_MODULES)
+    ):
+        module = importlib.import_module(name)
+        for attr in dir(module):
+            obj = getattr(module, attr)
+            if isinstance(obj, type) and issubclass(obj, enum.Enum) \
+                    and obj.__module__ == module.__name__:
+                enums.setdefault(
+                    obj.__name__, {m.name: m.value for m in obj}
+                )
+    codes: dict[str, int] = {}
+    for name in CODE_MODULES:
+        module = importlib.import_module(name)
+        for attr in dir(module):
+            if attr.startswith(CODE_PREFIXES):
+                value = getattr(module, attr)
+                if isinstance(value, int):
+                    codes[attr] = value
+    return {
+        "schema_version": schema_version,
+        "messages": {k: messages[k] for k in sorted(messages)},
+        "enums": {k: enums[k] for k in sorted(enums)},
+        "codes": {k: codes[k] for k in sorted(codes)},
+    }
+
+
+# ------------------------------------------------------------------ diff
+
+
+@dataclasses.dataclass(frozen=True)
+class Change:
+    breaking: bool
+    detail: str
+
+    def render(self) -> str:
+        tag = "BREAKING" if self.breaking else "compatible"
+        return f"[{tag}] {self.detail}"
+
+
+def diff(old: dict, new: dict) -> list[Change]:
+    """Changes from ``old`` (the checked-in snapshot, the N-1 contract)
+    to ``new`` (the live extraction)."""
+    changes: list[Change] = []
+    old_msgs, new_msgs = old.get("messages", {}), new.get("messages", {})
+    for name in sorted(old_msgs.keys() - new_msgs.keys()):
+        changes.append(Change(True, f"message '{name}' removed — N-1 "
+                                    f"peers still send it"))
+    for name in sorted(new_msgs.keys() - old_msgs.keys()):
+        changes.append(Change(False, f"message '{name}' added"))
+    for name in sorted(old_msgs.keys() & new_msgs.keys()):
+        changes.extend(_diff_fields(
+            name, old_msgs[name]["fields"], new_msgs[name]["fields"]
+        ))
+    old_enums, new_enums = old.get("enums", {}), new.get("enums", {})
+    for name in sorted(old_enums.keys() - new_enums.keys()):
+        changes.append(Change(True, f"enum '{name}' removed"))
+    for name in sorted(new_enums.keys() - old_enums.keys()):
+        changes.append(Change(False, f"enum '{name}' added"))
+    for name in sorted(old_enums.keys() & new_enums.keys()):
+        ov, nv = old_enums[name], new_enums[name]
+        for member in sorted(ov.keys() - nv.keys()):
+            changes.append(Change(
+                True, f"enum '{name}.{member}' removed — N-1 peers "
+                      f"still send value {ov[member]!r}"
+            ))
+        for member in sorted(nv.keys() - ov.keys()):
+            changes.append(Change(
+                True, f"enum '{name}.{member}' added — an N-1 decoder "
+                      f"raises on the unknown value {nv[member]!r}"
+            ))
+        for member in sorted(ov.keys() & nv.keys()):
+            if ov[member] != nv[member]:
+                changes.append(Change(
+                    True, f"enum '{name}.{member}' value changed "
+                          f"{ov[member]!r} -> {nv[member]!r}"
+                ))
+    old_codes, new_codes = old.get("codes", {}), new.get("codes", {})
+    for name in sorted(old_codes.keys() - new_codes.keys()):
+        changes.append(Change(True, f"wire code '{name}' removed"))
+    for name in sorted(new_codes.keys() - old_codes.keys()):
+        changes.append(Change(False, f"wire code '{name}' added"))
+    for name in sorted(old_codes.keys() & new_codes.keys()):
+        if old_codes[name] != new_codes[name]:
+            changes.append(Change(
+                True, f"wire code '{name}' changed "
+                      f"{old_codes[name]} -> {new_codes[name]}"
+            ))
+    return changes
+
+
+def _diff_fields(msg: str, old: dict, new: dict) -> list[Change]:
+    changes: list[Change] = []
+    for field in sorted(old.keys() - new.keys()):
+        changes.append(Change(
+            True, f"field '{msg}.{field}' removed/renamed — N-1 peers "
+                  f"still send it and expect it back"
+        ))
+    for field in sorted(new.keys() - old.keys()):
+        if new[field]["required"]:
+            changes.append(Change(
+                True, f"field '{msg}.{field}' added WITHOUT a default — "
+                      f"an N-1 sender omits it and the live decoder "
+                      f"hard-errors (WireDecodeError)"
+            ))
+        else:
+            changes.append(Change(
+                False, f"field '{msg}.{field}' added with a default"
+            ))
+    for field in sorted(old.keys() & new.keys()):
+        if old[field]["type"] != new[field]["type"]:
+            changes.append(Change(
+                True, f"field '{msg}.{field}' type changed "
+                      f"{old[field]['type']!r} -> {new[field]['type']!r}"
+            ))
+        if not old[field]["required"] and new[field]["required"]:
+            changes.append(Change(
+                True, f"field '{msg}.{field}' became required — N-1 "
+                      f"senders relying on the default hard-error"
+            ))
+    return changes
+
+
+# ------------------------------------------------------------- CLI hooks
+
+
+def load_snapshot(path: Path | None = None) -> dict | None:
+    path = SNAPSHOT_PATH if path is None else path
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def check_breaking(path: Path | None = None, out=None) -> int:
+    """Exit-code semantics of ``--breaking``: 0 = compatible (or
+    identical), 1 = breaking changes against the snapshot (or no
+    snapshot to diff against — an ungated codec is itself a failure)."""
+    import sys
+
+    out = sys.stdout if out is None else out
+    snapshot = load_snapshot(path)
+    if snapshot is None:
+        print("dfwire: no schema snapshot checked in — run "
+              "`python -m tools.dflint --breaking --write`", file=out)
+        return 1
+    live = extract(schema_version=snapshot.get("schema_version", 1))
+    changes = diff(snapshot, live)
+    breaking = [c for c in changes if c.breaking]
+    for change in changes:
+        print(f"dfwire: {change.render()}", file=out)
+    if breaking:
+        print(
+            f"dfwire: {len(breaking)} breaking change(s) vs snapshot "
+            f"v{snapshot.get('schema_version')} — if intentional, "
+            f"regenerate with --breaking --write (records a schema "
+            f"version bump)", file=out,
+        )
+        return 1
+    print(
+        f"dfwire: schema compatible with snapshot "
+        f"v{snapshot.get('schema_version')} "
+        f"({len(live['messages'])} messages, "
+        f"{len(changes)} compatible change(s))", file=out,
+    )
+    return 0
+
+
+def write_snapshot(path: Path | None = None, out=None) -> int:
+    import sys
+
+    out = sys.stdout if out is None else out
+    path = SNAPSHOT_PATH if path is None else path
+    previous = load_snapshot(path)
+    version = 1
+    if previous is not None:
+        version = previous.get("schema_version", 1)
+    doc = extract(schema_version=version)
+    if previous is not None and any(c.breaking for c in diff(previous, doc)):
+        version += 1  # the recorded acknowledgement of the break
+        doc["schema_version"] = version
+    path.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    print(f"dfwire: wrote {path} (schema_version {version}, "
+          f"{len(doc['messages'])} messages)", file=out)
+    return 0

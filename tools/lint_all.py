@@ -5,7 +5,7 @@
 share (tests/test_static_analysis.py invokes the same ``main``), so
 "the lint is green" means one thing everywhere:
 
-1. dflint's six passes over ``dragonfly2_tpu/`` report zero unwaived
+1. dflint's seven passes over ``dragonfly2_tpu/`` report zero unwaived
    findings and every waiver carries a substantive reason;
 2. the waiver audit finds no stale waivers (a ``waive[RULE]`` whose
    rule no longer fires at that site);
@@ -13,7 +13,15 @@ share (tests/test_static_analysis.py invokes the same ``main``), so
    SKIPPED marker on rigs without mypy — tools/typecheck.py);
 4. benchwatch validates every checked-in ``BENCH_*.json`` against the
    artifact schema and flags adjacent-round metric regressions beyond
-   its threshold (tools/benchwatch.py --check).
+   its threshold (tools/benchwatch.py --check);
+5. the dfwire breaking gate (``python -m tools.dflint --breaking``):
+   the live wire-schema extraction is compatible with the checked-in
+   ``tools/dfwire_schema.json`` snapshot — add-field-with-default is
+   the only compatible evolution, everything else needs an intentional
+   ``--breaking --write`` regeneration with its schema_version bump.
+   Runs in a FRESH interpreter so message types registered by the test
+   process (codec tests register throwaway dataclasses) never leak
+   into the extraction.
 
 ``--json`` forwards dflint's machine-readable findings document.
 
@@ -22,6 +30,7 @@ Exit 0 = all green; 1 = any stage failed.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -34,8 +43,9 @@ def main(argv: list[str] | None = None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="lint_all",
-        description="dflint (six passes, waiver audit) + mypy strict-core "
-                    "over the whole package — the one tier-1/CI gate",
+        description="dflint (seven passes, waiver audit) + mypy strict-core "
+                    "+ benchwatch + the dfwire breaking gate — the one "
+                    "tier-1/CI gate",
     )
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit dflint's machine-readable document with "
@@ -77,7 +87,19 @@ def main(argv: list[str] | None = None) -> int:
     bench_out = io.StringIO()
     rc_bench = benchwatch_check(ROOT, out=bench_out)
 
-    failed = rc_lint != 0 or proc.returncode != 0 or rc_bench != 0
+    # dfwire breaking gate in a fresh interpreter: the test process has
+    # registered throwaway message types (codec tests), and an in-proc
+    # extraction would report them as schema adds
+    wire_proc = subprocess.run(
+        [sys.executable, "-m", "tools.dflint", "--breaking"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+    failed = (
+        rc_lint != 0 or proc.returncode != 0 or rc_bench != 0
+        or wire_proc.returncode != 0
+    )
     if as_json:
         # one merged document: the overall `ok` covers BOTH stages (a
         # dflint-only verdict would let a mypy failure ship green), and
@@ -92,6 +114,10 @@ def main(argv: list[str] | None = None) -> int:
             "returncode": rc_bench,
             "output": bench_out.getvalue().strip(),
         }
+        doc["wire_breaking"] = {
+            "returncode": wire_proc.returncode,
+            "output": (wire_proc.stdout + wire_proc.stderr).strip(),
+        }
         doc["ok"] = not failed
         print(json.dumps(doc, indent=2))
     else:
@@ -100,6 +126,10 @@ def main(argv: list[str] | None = None) -> int:
         print(f"lint_all: typecheck {'OK' if proc.returncode == 0 else 'FAILED'}")
         sys.stdout.write(bench_out.getvalue())
         print(f"lint_all: benchwatch {'OK' if rc_bench == 0 else 'FAILED'}")
+        sys.stdout.write(wire_proc.stdout)
+        sys.stderr.write(wire_proc.stderr)
+        print(f"lint_all: dfwire-breaking "
+              f"{'OK' if wire_proc.returncode == 0 else 'FAILED'}")
 
     return 1 if failed else 0
 
