@@ -73,8 +73,42 @@ def summarize(runs: list[dict]) -> dict:
             "slo_verdict_state": (
                 (r.get("slo") or {}).get("verdict_code_final")
             ),
+            # tail plane (telemetry/tailtrace.py): worst-region TTC p99
+            # is the lower-is-better benchwatch cell; the decomposition
+            # ratio (consistency audit, perfect = 1.0) and the dominant
+            # failover share are direction-exempt context.
+            "tail_ttc_p99_ms": _tail_worst_p99(r.get("tail")),
+            "tail_decomp_ratio": _tail_worst_ratio(r.get("tail")),
+            "tail_failover_phase_share": _tail_failover_share(r.get("tail")),
         }
     return out
+
+
+def _tail_worst_p99(tail: dict | None) -> float | None:
+    p99s = [
+        (reg.get("ttc_ms") or {}).get("p99")
+        for reg in (tail or {}).get("regions", {}).values()
+    ]
+    p99s = [p for p in p99s if p is not None]
+    return max(p99s) if p99s else None
+
+
+def _tail_worst_ratio(tail: dict | None) -> float | None:
+    ratios = [
+        reg.get("decomp_ratio")
+        for reg in (tail or {}).get("regions", {}).values()
+        if reg.get("decomp_ratio") is not None
+    ]
+    # "worst" = farthest from the perfect 1.0
+    return max(ratios, key=lambda x: abs(x - 1.0)) if ratios else None
+
+
+def _tail_failover_share(tail: dict | None) -> float | None:
+    shares = [
+        (reg.get("phase_share") or {}).get("failover", 0.0)
+        for reg in (tail or {}).get("regions", {}).values()
+    ]
+    return max(shares) if shares else None
 
 
 def _kill_recovery_summary(recovery: list[dict]) -> dict:

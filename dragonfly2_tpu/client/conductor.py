@@ -26,6 +26,7 @@ from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata, TaskStor
 from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc.client import SchedulerConnection
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry import tailtrace
 from dragonfly2_tpu.telemetry.series import daemon_series
 from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.utils import dferrors
@@ -90,6 +91,12 @@ class PeerTaskConductor:
         self._refreshers: set[asyncio.Task] = set()
         self._done = asyncio.Event()
         self._error: Exception | None = None
+        # tail-attribution accumulator (telemetry/tailtrace.py): measured
+        # wall-ns per lifecycle phase, indexed by tailtrace.PH_* — a flat
+        # float list, never per-piece dicts. The daemon folds in its own
+        # failover phases and observes the finished download.
+        self.phase_ns = [0.0] * tailtrace.N_PHASES
+        self._wave = 0
 
     # ---------------------------------------------------------------- run
 
@@ -112,6 +119,7 @@ class PeerTaskConductor:
             ts.set_peer_id(self.peer_id)
         queue = self.conn.subscribe(self.peer_id)
         try:
+            t0 = time.perf_counter_ns()
             # blocking HEAD off-loop: a blackholed origin must not freeze
             # every other conductor/proxy on this daemon
             content_length = await asyncio.to_thread(self._probe_content_length)
@@ -132,6 +140,9 @@ class PeerTaskConductor:
                     total_piece_count=max(ts.meta.total_pieces, 0),
                     finished_pieces=kept or None,
                 )
+            )
+            self.phase_ns[tailtrace.PH_REGISTER] += (
+                time.perf_counter_ns() - t0
             )
             if self.shaper is not None:
                 self.shaper.register_task(self.task_id)
@@ -166,9 +177,13 @@ class PeerTaskConductor:
 
     async def _drive(self, ts: TaskStorage, queue: asyncio.Queue) -> None:
         while not self._done.is_set():
+            t0 = time.perf_counter_ns()
             try:
                 response = await asyncio.wait_for(queue.get(), self.schedule_timeout)
             except asyncio.TimeoutError:
+                self.phase_ns[tailtrace.PH_SCHEDULE_WAIT] += (
+                    time.perf_counter_ns() - t0
+                )
                 if self.back_source_allowed:
                     logger.warning("%s: schedule timeout, back-to-source", self.peer_id)
                     await self._back_to_source(ts)
@@ -177,12 +192,17 @@ class PeerTaskConductor:
                     f"{self.peer_id}: no schedule response in {self.schedule_timeout}s"
                 )
                 return
+            self.phase_ns[tailtrace.PH_SCHEDULE_WAIT] += (
+                time.perf_counter_ns() - t0
+            )
             if isinstance(response, msg.EmptyTaskResponse):
                 ts.mark_done(0, 0)
                 await self._finish(ts)
                 return
             if isinstance(response, msg.NeedBackToSourceResponse):
-                await self._back_to_source(ts)
+                await self._back_to_source(
+                    ts, trace_context=getattr(response, "trace_context", None)
+                )
                 return
             if isinstance(response, msg.ScheduleFailure):
                 if response.code == "Unavailable":
@@ -197,13 +217,17 @@ class PeerTaskConductor:
                     )
                     return
                 if self.back_source_allowed:
-                    await self._back_to_source(ts)
+                    await self._back_to_source(
+                        ts,
+                        trace_context=getattr(response, "trace_context", None),
+                    )
                     return
                 self._error = dferrors.FailedPrecondition(
                     f"schedule failed: {response.code} {response.description}"
                 )
                 return
             if isinstance(response, msg.NormalTaskResponse):
+                self._wave += 1
                 for number, digest in (response.piece_digests or {}).items():
                     self._attested_digests.setdefault(int(number), digest)
                 if response.task_digest and not self._attested_task_digest:
@@ -331,13 +355,16 @@ class PeerTaskConductor:
         task with no authoritative totals) gets the same eviction pass;
         either way the download stays resumable instead of hard-failing
         unattributed."""
+        t0 = time.perf_counter_ns()
         try:
             await asyncio.to_thread(
                 ts.mark_done, content_length, total_pieces,
                 expected_digest=self._attested_task_digest,
             )
+            self.phase_ns[tailtrace.PH_VERIFY] += time.perf_counter_ns() - t0
             return True
         except (dferrors.PieceCorrupted, dferrors.TaskIntegrityError) as e:
+            self.phase_ns[tailtrace.PH_VERIFY] += time.perf_counter_ns() - t0
             self._integrity_recoveries += 1
             if self._integrity_recoveries > 2:
                 # two eviction+re-fetch rounds already failed: the
@@ -489,6 +516,13 @@ class PeerTaskConductor:
             cost = time.perf_counter_ns() - t0
             self._inflight.discard(number)
             self._needed.discard(number)
+            # first-wave fetches are parent_fetch time; every wave after a
+            # reschedule is retry time (disjoint, so the phase vector
+            # still sums to the measured total)
+            self.phase_ns[
+                tailtrace.PH_PARENT_FETCH if self._wave <= 1
+                else tailtrace.PH_RETRY
+            ] += cost
             self.metrics.piece_task.labels().inc()
             self.dispatcher.report_cost(parent_id, cost)
             if self.shaper is not None:
@@ -505,7 +539,28 @@ class PeerTaskConductor:
 
     # ------------------------------------------------------------- source
 
-    async def _back_to_source(self, ts: TaskStorage) -> None:
+    async def _back_to_source(
+        self, ts: TaskStorage, trace_context: dict | None = None,
+    ) -> None:
+        """Origin fallback. ``trace_context`` is the triggering
+        response's propagated envelope (NeedBackToSource /
+        ScheduleFailure): the fallback span continues the SCHEDULER's
+        trace instead of silently truncating it at the hop most likely
+        to matter in a tail read (the timeout path has no response and
+        stays on the ambient context)."""
+        t0 = time.perf_counter_ns()
+        try:
+            with default_tracer().span(
+                "dfdaemon.back_to_source", remote_parent=trace_context,
+                task_id=self.task_id,
+            ):
+                await self._back_to_source_inner(ts)
+        finally:
+            self.phase_ns[tailtrace.PH_BACK_TO_SOURCE] += (
+                time.perf_counter_ns() - t0
+            )
+
+    async def _back_to_source_inner(self, ts: TaskStorage) -> None:
         await self.conn.send(
             msg.DownloadPeerBackToSourceStartedRequest(peer_id=self.peer_id)
         )

@@ -24,6 +24,7 @@ from dragonfly2_tpu.cluster import messages as msg
 from dragonfly2_tpu.rpc import resilience
 from dragonfly2_tpu.rpc.client import SchedulerClientPool
 from dragonfly2_tpu.telemetry import default_registry
+from dragonfly2_tpu.telemetry import tailtrace
 from dragonfly2_tpu.telemetry.flight import PhaseRecorder
 from dragonfly2_tpu.telemetry.tracing import default_tracer
 from dragonfly2_tpu.telemetry.series import daemon_series, register_version
@@ -297,6 +298,11 @@ class Daemon:
             piece_length=piece_length,
         ) as span:
             last_err: BaseException | None = None
+            task_t0 = time.perf_counter_ns()
+            # wall time burned by attempts that DIED mid-stream: the
+            # conductor those attempts measured into is discarded, so
+            # the whole lost attempt is failover time in the tail ledger
+            failed_attempt_ns = 0.0
             # One attempt per distinct ring node plus one retry of the
             # (possibly rebinding) primary: each attempt's for_task already
             # fails over across breaker-open/dial-dead candidates, so this
@@ -314,6 +320,7 @@ class Daemon:
                     # shared begin/mark cursor would clobber itself
                     # (PhaseRecorder.commit_phases).
                     phases: dict[str, float] = {}
+                    attempt_t0 = time.perf_counter_ns()
                     t0 = time.perf_counter()
                     if recovering:
                         # scheduler failover recovery, phase-timed into the
@@ -362,6 +369,7 @@ class Daemon:
                     # the hashring — already-written pieces resume from
                     # the task storage and ride the re-announce
                     last_err = e
+                    failed_attempt_ns += time.perf_counter_ns() - attempt_t0
                     span.attributes["retried"] = True
                     continue
                 if recovering:
@@ -374,9 +382,40 @@ class Daemon:
                     self.failover_recorder.commit_phases(phases)
                     self.metrics.scheduler_failover.labels().inc()
                 span.attributes["pieces"] = len(ts.meta.pieces)
+                self._observe_tail(conductor, task_t0, failed_attempt_ns, phases)
                 return ts
             assert last_err is not None
             raise last_err
+
+    def _observe_tail(
+        self, conductor: PeerTaskConductor, task_t0: int,
+        failed_attempt_ns: float, recovery_phases: dict[str, float],
+    ) -> None:
+        """Feed the completed download into the client-plane tail ledger.
+
+        The conductor measured its own lifecycle phases (register,
+        schedule waits, per-wave fetches, retries, back-to-source,
+        verify); this folds in what only the daemon sees — the wall time
+        of attempts that died mid-stream plus the measured recovery
+        phases (backoff/redial/reannounce, ms), both failover — and
+        reconciles the vector with the measured TTC so the decomposition
+        is always a PARTITION of wall time: unmeasured glue (event-loop
+        hops, storage open) books as schedule wait, and when concurrent
+        piece workers make the raw phase mass EXCEED elapsed time (N
+        overlapping fetch walls), the masses are scaled onto the wall
+        clock — they stay correct as relative weights, which is what a
+        critical-path read uses."""
+        ttc_ns = float(time.perf_counter_ns() - task_t0)
+        vec = list(conductor.phase_ns)
+        vec[tailtrace.PH_FAILOVER] += failed_attempt_ns
+        vec[tailtrace.PH_FAILOVER] += sum(recovery_phases.values()) * 1e6
+        total = sum(vec)
+        if total > ttc_ns > 0.0:
+            vec = [v * (ttc_ns / total) for v in vec]
+        elif ttc_ns > total:
+            vec[tailtrace.PH_SCHEDULE_WAIT] += ttc_ns - total
+        tail = tailtrace.default_tailtrace()
+        tail.observe(0, tail.next_seq(), ttc_ns, vec)
 
     def _report_piece_rot(self, task_id: str, number: int) -> None:
         """Verify-on-serve found local disk rot (upload.py; the piece is
@@ -468,19 +507,28 @@ class Daemon:
         # the scheduler only knows THIS registration after a failover
         peer_id = idgen.peer_id_v2()
         ts.set_peer_id(peer_id)
-        await conn.send(msg.RegisterPeerRequest(
-            peer_id=peer_id,
+        # continue the TRIGGERING scheduler's trace (the wire layer pins
+        # its envelope on the decoded trigger): the re-announce after a
+        # hashring failover used to start an orphan trace here, cutting
+        # exactly the hop a tail investigation needs to follow
+        with default_tracer().span(
+            "dfdaemon.reannounce",
+            remote_parent=getattr(trigger, "trace_context", None),
             task_id=ts.meta.task_id,
-            host=self.host_info(),
-            url=trigger.url,
-            content_length=max(ts.meta.content_length, 0),
-            piece_length=ts.meta.piece_length,
-            total_piece_count=max(ts.meta.total_pieces, 0),
-            priority=1,  # a seed must not re-trigger a seed
-            tag=trigger.tag,
-            application=trigger.application,
-            finished_pieces=sorted(ts.finished_pieces()),
-        ))
+        ):
+            await conn.send(msg.RegisterPeerRequest(
+                peer_id=peer_id,
+                task_id=ts.meta.task_id,
+                host=self.host_info(),
+                url=trigger.url,
+                content_length=max(ts.meta.content_length, 0),
+                piece_length=ts.meta.piece_length,
+                total_piece_count=max(ts.meta.total_pieces, 0),
+                priority=1,  # a seed must not re-trigger a seed
+                tag=trigger.tag,
+                application=trigger.application,
+                finished_pieces=sorted(ts.finished_pieces()),
+            ))
         self.metrics.seed_task_reannounce.labels().inc()
 
     async def _obtain_seed(self, trigger, conn=None) -> None:

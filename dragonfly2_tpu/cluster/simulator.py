@@ -257,7 +257,10 @@ class ClusterSimulator:
             pid for pid in list(svc._pending)
             if pid in self._task_of
         ]
-        for pid in list(self._partition_stalled):
+        # sorted: _partition_stalled is a set of peer-id strings, and set
+        # iteration order follows the per-process string-hash salt — the
+        # leave order must not (it drives free-list and pending order)
+        for pid in sorted(self._partition_stalled):
             if pid in self._task_of and pid not in svc._pending:
                 svc.leave_peer(pid)
         for pid in victims:
@@ -299,7 +302,12 @@ class ClusterSimulator:
         self._partitioned = partitioned_now
         if not healed:
             return
-        for pid in list(self._partition_stalled):
+        # sorted, not set order: healed peers re-enter the scheduler's
+        # pending queue right here, and the queue order maps candidate
+        # sample rows to children in the next tick — iterating the set
+        # raw would make parent selection follow the per-process string-
+        # hash salt (identical aggregates, different replicas)
+        for pid in sorted(self._partition_stalled):
             host_id = self._peer_host.get(pid)
             if host_id not in healed:
                 continue

@@ -35,6 +35,7 @@ UpgradeSpec, sampled by the same deterministic ScenarioEngine).
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import hashlib
 
@@ -89,6 +90,8 @@ class EventBatchEngine(ClusterSimulator):
         piece_length: int = 4 << 20,
         scenario=None,
         retire_after_rounds: int | None = None,
+        tail_capture: bool = True,
+        tail_failover_horizon: int = 8,
     ):
         wan_active = scenario is not None and scenario.wan.regions > 0
         cluster = (
@@ -129,6 +132,19 @@ class EventBatchEngine(ClusterSimulator):
         self._col_wave = np.zeros(cap, np.int32)
         self._col_cost_ns = np.zeros(cap, np.float64)
         self._col_done_round = np.full(cap, -1, np.int32)
+        # --- tail-attribution columns (telemetry/tailtrace.py): the
+        # registration round, rounds actually served a parent wave, and
+        # the disjoint retry/back-to-source slices of _col_cost_ns —
+        # everything _observe_tail needs to decompose a TTC, still SoA
+        self._col_reg_round = np.full(cap, -1, np.int32)
+        self._col_served = np.zeros(cap, np.int32)
+        self._col_retry_ns = np.zeros(cap, np.float64)
+        self._col_b2s_ns = np.zeros(cap, np.float64)
+        # crash victimhood: the latest scheduler crash this row was alive
+        # through (-1 = none) and the cost already accumulated at that
+        # moment — everything after the mark is failover-phase time
+        self._col_crash_round = np.full(cap, -1, np.int32)
+        self._col_crash_cost_ns = np.zeros(cap, np.float64)
         # completed/failed downloads pending retirement, in completion
         # order (round-based, so retirement is deterministic — the
         # megascale stand-in for the wall-clock TTL GC the oracle never
@@ -186,6 +202,22 @@ class EventBatchEngine(ClusterSimulator):
             name="megascale.slo",
             minutes_per_unit=self.minutes_per_round,
         )
+        # --- tail-attribution plane (telemetry/tailtrace.py) on the
+        # EVENT clock: every completion's virtual TTC decomposed into
+        # lifecycle phases (waits priced at the round width, transfer
+        # phases from the disjoint cost columns). Pure function of
+        # (spec, seed) — the tail digest is paired-seed-pinned.
+        from dragonfly2_tpu.telemetry import tailtrace as _tailtrace
+
+        self.tail_capture = bool(tail_capture)
+        self.tail_failover_horizon = int(tail_failover_horizon)
+        self._round_ns = self.minutes_per_round * 60.0 * 1e9
+        self._tail_vec = np.zeros(_tailtrace.N_PHASES, np.float64)
+        self.tail = _tailtrace.TailTrace(
+            [f"region-{r}" for r in range(n_regions)],
+            seed=seed,
+            name="megascale.tail",
+        )
 
     # ------------------------------------------------------------ columns
 
@@ -195,10 +227,13 @@ class EventBatchEngine(ClusterSimulator):
             return
         new = max(cap * 2, n)
         for name in ("_col_task", "_col_host", "_col_have", "_col_wave",
-                     "_col_cost_ns", "_col_done_round"):
+                     "_col_cost_ns", "_col_done_round", "_col_reg_round",
+                     "_col_served", "_col_retry_ns", "_col_b2s_ns",
+                     "_col_crash_round", "_col_crash_cost_ns"):
             old = getattr(self, name)
             grown = np.zeros(new, old.dtype)
-            if name in ("_col_task", "_col_host", "_col_done_round"):
+            if name in ("_col_task", "_col_host", "_col_done_round",
+                        "_col_reg_round", "_col_crash_round"):
                 grown[:] = -1
             grown[:cap] = old
             setattr(self, name, grown)
@@ -219,6 +254,12 @@ class EventBatchEngine(ClusterSimulator):
         self._col_wave[reg] = 0
         self._col_cost_ns[reg] = 0.0
         self._col_done_round[reg] = -1
+        self._col_reg_round[reg] = self._round
+        self._col_served[reg] = 0
+        self._col_retry_ns[reg] = 0.0
+        self._col_b2s_ns[reg] = 0.0
+        self._col_crash_round[reg] = -1
+        self._col_crash_cost_ns[reg] = 0.0
         return req
 
     def _finished_pieces(self, peer_id: str) -> list[int]:
@@ -233,6 +274,18 @@ class EventBatchEngine(ClusterSimulator):
         return [p for p in range(MEGA_MAX_PIECES) if bits >> p & 1]
 
     # ---------------------------------------------------------- traffic
+
+    def _apply_scheduler_crash(self) -> None:
+        """Columnar victim marking on top of the oracle's crash replay:
+        every download alive when the scheduler dies gets stamped with
+        the crash round and its cost-so-far, so the tail plane can
+        attribute everything AFTER the re-announce — remaining waits and
+        re-fetched waves alike — to the failover phase."""
+        n = self._reg_index
+        alive = (self._col_task[:n] >= 0) & (self._col_done_round[:n] < 0)
+        self._col_crash_round[:n][alive] = self._round
+        self._col_crash_cost_ns[:n][alive] = self._col_cost_ns[:n][alive]
+        super()._apply_scheduler_crash()
 
     def _extra_offline(self, round_idx: int) -> set[str]:
         """Rolling-upgrade cohort: the host-order restart window the
@@ -402,6 +455,14 @@ class EventBatchEngine(ClusterSimulator):
                 )
                 for r, sk in enumerate(self._ttc_sketch)
             },
+            # which lifecycle phase dominated the attributed time of THIS
+            # round's completions (telemetry/tailtrace.round_dominant) —
+            # the cause hint a firing TTC page names, recorded in the
+            # sample so dfslo's offline replay reproduces it exactly
+            "tail_dominant_phase": (
+                self.tail.round_dominant(self._round)
+                if self.tail_capture else None
+            ),
         }
         # SLO evaluation: derive every SLI from THIS sample and step the
         # engine at the event clock. The returned verdict columns ride
@@ -442,6 +503,72 @@ class EventBatchEngine(ClusterSimulator):
         region = int(self._region_of[host])
         if region < len(self._ttc_sketch):
             self._ttc_sketch[region].add(float(self._col_cost_ns[reg]) / 1e6)
+
+    def _observe_tail(self, reg: int) -> None:
+        """Decompose the completing download's virtual TTC into lifecycle
+        phases and feed the tail plane. TTC here includes wait time —
+        rounds alive but not served a parent wave, priced at the round
+        width — on top of the transfer-cost column the region percentiles
+        report; the phase vector is built from disjoint components
+        (waits + retry/b2s/fetch slices of the cost), so it sums to the
+        recorded TTC exactly. Failover absorbs everything a scheduler
+        death cost the download: for crash victims (alive at the kill,
+        per the crash-mark columns) ALL accrued wait is failover —
+        the re-announce reset their queue position, so pre-crash queue
+        time bought nothing and counting it as schedule_wait would hide
+        the kill — plus every wave re-fetched after the re-announce.
+        Downloads that registered into a still-recovering scheduler
+        (within ``tail_failover_horizon`` rounds of a crash) also stall
+        on the rebuild, not on steady-state backlog, so their waits are
+        failover too; all other waits are schedule_wait."""
+        if not self.tail_capture:
+            return
+        host = int(self._col_host[reg])
+        if host < 0:
+            return
+        from dragonfly2_tpu.telemetry import tailtrace as tt
+
+        cost_ns = float(self._col_cost_ns[reg])
+        reg_round = int(self._col_reg_round[reg])
+        done_round = int(self._col_done_round[reg])
+        served = int(self._col_served[reg])
+        wait_rounds = max(done_round - reg_round + 1 - max(served, 1), 0)
+        crash_round = int(self._col_crash_round[reg])
+        fail_cost = 0.0
+        fail_wait = 0
+        if crash_round >= 0:
+            # lived through a crash: split the cost at the mark — the
+            # pre-crash slice keeps its retry/b2s decomposition, the
+            # post-re-announce slice is failover re-work — and charge
+            # ALL wait to failover (wasted-wait attribution: the
+            # re-announce threw away the queue position)
+            pre = min(float(self._col_crash_cost_ns[reg]), cost_ns)
+            fail_cost = cost_ns - pre
+            fail_wait = wait_rounds
+            cost_ns = pre
+        elif wait_rounds and self._crash_rounds:
+            # registered into a recovering scheduler: its waits are the
+            # crash's queue backlog, not steady-state schedule wait
+            k = bisect.bisect_right(self._crash_rounds, reg_round) - 1
+            if k >= 0 and reg_round - self._crash_rounds[k] \
+                    <= self.tail_failover_horizon:
+                fail_wait = wait_rounds
+        b2s = min(float(self._col_b2s_ns[reg]), cost_ns)
+        retry = min(float(self._col_retry_ns[reg]), max(cost_ns - b2s, 0.0))
+        fetch = max(cost_ns - b2s - retry, 0.0)
+        rns = self._round_ns
+        vec = self._tail_vec
+        vec[:] = 0.0
+        vec[tt.PH_SCHEDULE_WAIT] = (wait_rounds - fail_wait) * rns
+        vec[tt.PH_FAILOVER] = fail_wait * rns + fail_cost
+        vec[tt.PH_PARENT_FETCH] = fetch
+        vec[tt.PH_RETRY] = retry
+        vec[tt.PH_BACK_TO_SOURCE] = b2s
+        self.tail.observe(
+            int(self._region_of[host]), reg,
+            cost_ns + fail_cost + wait_rounds * rns, vec,
+            round_idx=done_round,
+        )
 
     # -------------------------------------------------------- event batch
 
@@ -492,6 +619,10 @@ class EventBatchEngine(ClusterSimulator):
             if ca is not None:
                 prior = int(self._col_have[reg]).bit_count()
                 crash_cut[i] = max(1, ca - prior)
+        # one response per in-flight download per tick, so `regs` has no
+        # duplicates: rounds NOT counted here are rounds the download sat
+        # waiting for the scheduler (the tail plane's wait basis)
+        self._col_served[regs] += 1
 
         total = self._task_pieces[self._col_task[regs]]
         have = self._col_have[regs]
@@ -576,6 +707,12 @@ class EventBatchEngine(ClusterSimulator):
             sums = np.zeros(m)
             np.add.at(sums, done_rows, cost[done].astype(np.float64))
             self._col_cost_ns[regs] += sums
+            # waves past the first are the retry slice of the cost —
+            # disjoint from the back-to-source slice by construction, so
+            # the tail decomposition sums exactly
+            retry_rows = waves > 1
+            if retry_rows.any():
+                self._col_retry_ns[regs[retry_rows]] += sums[retry_rows]
         faulted = np.flatnonzero(fault != 0)
         if faulted.size:
             self._fault_events += int(faulted.size)
@@ -645,6 +782,7 @@ class EventBatchEngine(ClusterSimulator):
                 origin_ns += wan.back_to_source_penalty_ms * 1e6
                 self.mega.cross_region_b2s += 1
         self._col_cost_ns[reg] += origin_ns
+        self._col_b2s_ns[reg] += origin_ns
 
     def _register_refused(self, req) -> None:
         """Scheduler refused the registration (hot-task DAG full under a
@@ -659,6 +797,7 @@ class EventBatchEngine(ClusterSimulator):
         self._charge_origin_fetch(reg, int(req.content_length))
         self._col_done_round[reg] = self._round
         self._record_ttc(reg)
+        self._observe_tail(reg)
         self.stats.completed += 1
         # never registered with the scheduler: nothing to retire, just
         # drop the sim-side identity maps
@@ -673,6 +812,7 @@ class EventBatchEngine(ClusterSimulator):
     def _complete(self, peer_id: str, reg: int) -> None:
         self._col_done_round[reg] = self._round
         self._record_ttc(reg)
+        self._observe_tail(reg)
         self._retire_later(peer_id)
 
     def _back_to_source(self, peer_id: str) -> None:

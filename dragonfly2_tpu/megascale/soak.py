@@ -195,6 +195,13 @@ def run_megascale(
         # tools/dfslo.py reproduces the same block offline from the
         # `timeline` array above
         "slo": _slo_report(sim),
+        # tail attribution (telemetry/tailtrace.py): per-region TTC
+        # decomposition quantiles, phase shares, dominant-phase
+        # histogram, kill-window attribution over the crash rounds,
+        # exemplars and the paired-seed-pinned digest — deterministic,
+        # so it rides deterministic_view; tools/dftail.py recomputes
+        # the window/dominant view offline from this block alone
+        "tail": _tail_report(sim),
         "timing": {
             "setup_s": round(setup_s, 2),
             "wall_s": round(wall, 2),
@@ -214,6 +221,18 @@ def run_megascale(
         # the skewed codec, and any round-trip mismatch (must be empty —
         # the skew soak gate asserts on it)
         report["wire_skew"] = driver.report()
+    return report
+
+
+def _tail_report(sim) -> dict:
+    """The megascale run's tail block (telemetry/tailtrace.report),
+    windowed over the rounds the scheduler actually died plus the
+    per-round phase matrix — the offline basis tools/dftail.py replays
+    the window attribution from."""
+    report = sim.tail.report(crash_rounds=sim._crash_rounds)
+    report["round_phase_ms"] = sim.tail.round_phase_matrix_ms()
+    report["round_slow_ms"] = sim.tail.round_slow_matrix_ms()
+    report["crash_rounds"] = [int(r) for r in sim._crash_rounds]
     return report
 
 

@@ -385,7 +385,7 @@ def _span_summary(span) -> dict:
 # backward-compatible core — older consumers index them directly).
 DUMP_SECTIONS = (
     "ticks", "jit", "active_spans", "costcards", "timelines", "decisions",
-    "slo",
+    "slo", "tail",
 )
 # Hard payload bound for the HTTP debug surfaces: flight.dump has grown
 # costcards + timelines + decisions on top of the tick ring, and an
@@ -422,6 +422,9 @@ def _truncate_dump(body: dict, max_bytes: int) -> dict:
         for name, eng in (b.get("slo") or {}).items():
             if isinstance(eng, dict) and isinstance(eng.get("alert_log"), list):
                 out.append((f"slo.{name}.alert_log", eng, "alert_log"))
+        for name, tr in (b.get("tail") or {}).items():
+            if isinstance(tr, dict) and isinstance(tr.get("exemplars"), list):
+                out.append((f"tail.{name}.exemplars", tr, "exemplars"))
         spans = b.get("active_spans")
         if isinstance(spans, list) and spans:
             out.append(("active_spans", b, "active_spans"))
@@ -435,17 +438,34 @@ def _truncate_dump(body: dict, max_bytes: int) -> dict:
             (key, holder, field) for key, holder, field in _lists(body)
             if holder[field]
         ]
-        if not candidates:
-            break  # nothing left to shed; scalar floor
-        # shed from the largest list first, oldest half at a time
-        key, holder, field = max(
-            candidates, key=lambda c: len(c[1][c[2]])
-        )
-        lst = holder[field]
-        keep = len(lst) // 2
-        dropped[key] = dropped.get(key, 0) + (len(lst) - keep)
-        holder[field] = lst[-keep:] if keep else []
-        body["truncated"] = {"max_bytes": max_bytes, "dropped": dict(dropped)}
+        if candidates:
+            # shed from the largest list first, oldest half at a time
+            key, holder, field = max(
+                candidates, key=lambda c: len(c[1][c[2]])
+            )
+            lst = holder[field]
+            keep = len(lst) // 2
+            dropped[key] = dropped.get(key, 0) + (len(lst) - keep)
+            holder[field] = lst[-keep:] if keep else []
+            body["truncated"] = {
+                "max_bytes": max_bytes, "dropped": dict(dropped)
+            }
+            continue
+        tails = body.get("tail")
+        if isinstance(tails, dict) and tails:
+            # every ring-backed list is already empty, yet the body still
+            # exceeds the cap: shed whole tail ledgers, largest first.
+            # Unlike every other section, the tail section's scalar floor
+            # grows with the number of LIVE tracers (the daemon singleton
+            # plus one per engine), and the byte cap is a hard promise.
+            name = max(tails, key=lambda n: _dump_nbytes(tails[n]))
+            del tails[name]
+            dropped[f"tail.{name}"] = 1
+            body["truncated"] = {
+                "max_bytes": max_bytes, "dropped": dict(dropped)
+            }
+            continue
+        break  # nothing left to shed; scalar floor
     return body
 
 
@@ -493,7 +513,8 @@ def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
          max_bytes: int | None = DUMP_MAX_BYTES) -> dict:
     """The flight-recorder snapshot: last-N tick phase breakdowns, jit
     compile/retrace counters, spans currently open, cost cards, soak
-    timelines, and the decision ledger. Pure plain data (dicts/lists/
+    timelines, the decision ledger, the SLO engines, and the tail
+    tracers. Pure plain data (dicts/lists/
     scalars) so it rides the wire codec and JSON as-is.
     `registry_fallback=False` skips the process-global recorder lookup —
     a service reporting about ITSELF (the manager's own section) must not
@@ -558,6 +579,13 @@ def dump(last_n: int = 64, recorder: PhaseRecorder | None = None,
         body["slo"] = {
             name: eng.dump(last_n=last_n)
             for name, eng in sorted(_slo.live_engines().items())
+        }
+    if "tail" in want:
+        from dragonfly2_tpu.telemetry import tailtrace as _tailtrace
+
+        body["tail"] = {
+            name: tr.dump(last_n=last_n)
+            for name, tr in sorted(_tailtrace.live_tracers().items())
         }
     if max_bytes is not None and _dump_nbytes(body) > max_bytes:
         body = _truncate_dump(body, max_bytes)

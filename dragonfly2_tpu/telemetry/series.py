@@ -490,5 +490,46 @@ def slo_series(reg) -> _Namespace:
     )
 
 
+def tail_series(reg) -> _Namespace:
+    """Tail-attribution families (telemetry/tailtrace.py): per-region
+    completion/dominant-phase counters bumped on every observed
+    download, plus the TTC-quantile, phase-share and exemplar-retention
+    gauges refreshed at dump/report time — the live-scrape mirror of the
+    deterministic ``tail`` block in megascale artifacts and the
+    ``tail`` section of ``/debug/flight``."""
+    return _Namespace(
+        completions=reg.counter(
+            "dragonfly_tail_completions_total",
+            "downloads whose TTC was decomposed by the tail plane",
+            ("source", "region"),
+        ),
+        dominant=reg.counter(
+            "dragonfly_tail_dominant_total",
+            "downloads whose attributed time was dominated by this "
+            "lifecycle phase",
+            ("source", "region", "phase"),
+        ),
+        ttc_ms=reg.gauge(
+            "dragonfly_tail_ttc_ms",
+            "time-to-complete quantile (ms) from the tail plane's "
+            "streaming sketch — includes scheduler-wait time, unlike the "
+            "transfer-only region percentiles",
+            ("source", "region", "quantile"),
+        ),
+        phase_share=reg.gauge(
+            "dragonfly_tail_phase_share",
+            "fraction of all attributed download time spent in this "
+            "lifecycle phase (shares sum to 1 per region)",
+            ("source", "region", "phase"),
+        ),
+        exemplars_kept=reg.gauge(
+            "dragonfly_tail_exemplars_kept",
+            "exemplar downloads currently retained by the deterministic "
+            "sampler (slowest-K always kept; uniform ring bounded)",
+            ("source", "kind"),
+        ),
+    )
+
+
 def register_version(reg, service: str) -> None:
     _version.register_version_gauge(reg, service)

@@ -233,6 +233,10 @@ class SLOEngine:
         self.tickets_fired = 0
         self._last_eval: dict[str, dict] = {}
         self._last_t: float | None = None
+        # cause enrichment from the tail plane (telemetry/tailtrace.py):
+        # the lifecycle phase dominating the interval's completions —
+        # set per-sample so a firing TTC page can NAME what it burns on
+        self._tail_hint: str | None = None
         from dragonfly2_tpu.telemetry import metrics as _metrics
         from dragonfly2_tpu.telemetry.series import slo_series
 
@@ -243,6 +247,16 @@ class SLOEngine:
             register_engine(name, self)
 
     # ------------------------------------------------------------- feeding
+
+    def set_tail_hint(self, phase: "str | None") -> None:
+        """Record the lifecycle phase dominating the current interval's
+        completions (tailtrace.round_dominant). TTC-objective causes in
+        the next verdict carry it as ``dominant_phase`` — a firing TTC
+        page then names WHERE the burn lives. Fed from the timeline
+        sample (a pure function of it), so offline replays reproduce the
+        identical enriched causes."""
+        with self._mu:
+            self._tail_hint = phase or None
 
     def observe(self, sli: str, good: float = 0.0, bad: float = 0.0) -> None:
         """Accumulate good/bad events for ``sli`` into the open interval
@@ -376,7 +390,7 @@ class SLOEngine:
             spec = self.specs[slo_name]
             rule = next(r for r in spec.burn_rules if r.name == rule_name)
             burn = (self._last_eval.get(slo_name) or {}).get("burn", {})
-            causes.append({
+            cause = {
                 "slo": slo_name,
                 "rule": rule_name,
                 "severity": rule.severity,
@@ -385,7 +399,12 @@ class SLOEngine:
                     k: (burn.get(rule_name) or {}).get(k)
                     for k in ("burn_long", "burn_short")
                 },
-            })
+            }
+            if slo_name.startswith("ttc") and self._tail_hint:
+                # the tail plane's per-interval attribution: the phase a
+                # firing TTC objective is actually burning on
+                cause["dominant_phase"] = self._tail_hint
+            causes.append(cause)
         if any(c["severity"] == SEVERITY_PAGE for c in causes):
             state_name = VERDICT_CRITICAL
         elif causes:
@@ -643,6 +662,8 @@ def feed_megascale_sample(engine: SLOEngine, sample: Mapping[str, Any]) -> dict:
     timelines from identical samples (pinned by tests/test_slo.py)."""
     pieces = int(sample.get("pieces") or 0)
     corruptions = int(sample.get("corruptions") or 0)
+    hint = sample.get("tail_dominant_phase")
+    engine.set_tail_hint(hint if isinstance(hint, str) else None)
     engine.observe(
         "integrity", good=max(pieces - corruptions, 0), bad=corruptions
     )

@@ -1,0 +1,28 @@
+"""dflint red fixture: DET001 (unseeded rng deciding exemplar keeps) +
+DET002 (wall-clock read stamping an observation) + DET003 (set-ordered
+iteration over live tracers) — in a file the test configures as a
+decision module, the way telemetry/tailtrace.py is in the real DET
+domain."""
+
+import random
+import time
+
+
+class BadTailLedger:
+    def __init__(self):
+        self.tracers = set()
+
+    def observe(self, seq, ttc_ns):
+        # a process-global rng makes "was this download kept" differ
+        # between paired-seed runs — the digest pin breaks
+        keep = random.random() < 1 / 64  # <- DET001
+        # stamping observations off the wall clock puts machine load
+        # into the ledger instead of the caller's (virtual) clock
+        t = time.time()  # <- DET002
+        return {"seq": seq, "ttc_ns": ttc_ns, "kept": keep, "t": t}
+
+    def dump(self):
+        out = []
+        for name in self.tracers:  # <- DET003 (order differs per process)
+            out.append({"tracer": name})
+        return out

@@ -1,0 +1,31 @@
+"""dflint green twin of bad_tail.py: counter-hashed sampling, a
+caller-supplied clock (perf_counter only measures), and sorted tracer
+iteration — zero findings."""
+
+import time
+
+
+def hash_u01(seed, seq):
+    return ((seed * 0x9E3779B97F4A7C15 + seq) & ((1 << 64) - 1)) / 2.0**64
+
+
+class GoodTailLedger:
+    def __init__(self, seed=0):
+        self.seed = seed
+        self.tracers = set()
+
+    def observe(self, seq, ttc_ns):
+        # the keep decision hashes the download's own sequence number:
+        # pure function of (seed, seq), identical across paired runs
+        keep = hash_u01(self.seed, seq) < 1 / 64
+        # perf_counter is the one exempt clock (measuring, never
+        # deciding); the recorded value is the caller's ttc_ns
+        wall = time.perf_counter()
+        return {"seq": seq, "ttc_ns": ttc_ns, "kept": keep,
+                "observe_wall_s": wall}
+
+    def dump(self):
+        out = []
+        for name in sorted(self.tracers):
+            out.append({"tracer": name})
+        return out

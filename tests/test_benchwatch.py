@@ -316,6 +316,50 @@ def test_slo_metrics_direction_table(tmp_path):
     assert "REGRESSION soak_1000_slo_budget_burn" in text
 
 
+def test_tail_metrics_direction_table(tmp_path):
+    """ISSUE 16 red/green: worst-region tail TTC p99 is a lower-is-better
+    cell (an adjacent-round tail blow-up fails the gate); phase shares
+    are compositions and the decomposition ratio is a consistency audit
+    (perfect = 1.0) — both direction-exempt, never normalized into a
+    comparable metric."""
+    from tools.benchwatch import direction_exempt
+
+    assert lower_is_better("soak_100000_tail_ttc_p99_ms")
+    assert direction_exempt("soak_100000_tail_failover_phase_share")
+    assert direction_exempt("soak_100000_tail_decomp_ratio")
+
+    def mega(p99, share, ratio):
+        return {
+            "schema_version": 2, "cmd": "python bench_megascale.py",
+            "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                         "machine": "x86_64", "python": "3.10"},
+            "summary": {"soak_1000": {
+                "pieces_per_sec": 1000.0, "completed": 10,
+                "origin_traffic_fraction": 0.05,
+                "tail_ttc_p99_ms": p99,
+                "tail_failover_phase_share": share,
+                "tail_decomp_ratio": ratio,
+            }},
+            "runs": [{"scenario": "soak", "hosts": 1000, "stats": {},
+                      "timing": {}}],
+        }
+
+    # GREEN: failover share and ratio wobble, p99 steady — passes
+    _write(tmp_path, "BENCH_r01.json", mega(p99=12000.0, share=0.1, ratio=1.0))
+    _write(tmp_path, "BENCH_r02.json", mega(p99=12100.0, share=0.4, ratio=0.97))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 0, out.getvalue()
+    entry = normalize(mega(12100.0, 0.4, 0.97), "mega", "BENCH_r02.json")
+    assert "soak_1000_tail_failover_phase_share" not in entry["metrics"]
+    assert "soak_1000_tail_decomp_ratio" not in entry["metrics"]
+    assert entry["metrics"]["soak_1000_tail_ttc_p99_ms"] == 12100.0
+    # RED: the tail blows up between adjacent rounds — the gate fails
+    _write(tmp_path, "BENCH_r03.json", mega(p99=20000.0, share=0.4, ratio=1.0))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION soak_1000_tail_ttc_p99_ms" in out.getvalue()
+
+
 def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
     """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
     direction — they stay out of the normalized metrics entirely."""

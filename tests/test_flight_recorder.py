@@ -25,6 +25,7 @@ from dragonfly2_tpu.telemetry.series import (
     scheduler_series,
     serving_series,
     slo_series,
+    tail_series,
     timeline_series,
     trainer_series,
 )
@@ -229,6 +230,15 @@ def test_metric_naming_convention_registry_walk():
     # the SLO verdict plane (dragonfly_slo_*: budget remaining, burn
     # rates, alert state/fire transitions, SLI events, verdict)
     slo_series(reg)
+    # the tail-attribution plane (dragonfly_tail_*: completions,
+    # dominant-phase counts, TTC quantiles, phase shares, exemplars)
+    tail_series(reg)
+    for family in ("dragonfly_tail_completions_total",
+                   "dragonfly_tail_dominant_total",
+                   "dragonfly_tail_ttc_ms",
+                   "dragonfly_tail_phase_share",
+                   "dragonfly_tail_exemplars_kept"):
+        assert family in reg._metrics, f"{family} missing from the sweep"
     assert any(
         name.startswith("dragonfly_scheduler_decision_")
         for name in reg._metrics
@@ -245,7 +255,7 @@ def test_metric_naming_convention_registry_walk():
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
         r"^dragonfly_(scheduler|dfdaemon|manager|trainer|costcard|timeline"
-        r"|serving|megascale|slo)_[a-z0-9_]+$"
+        r"|serving|megascale|slo|tail)_[a-z0-9_]+$"
     )
     assert reg._metrics, "registry walk found nothing"
     for name, metric in reg._metrics.items():
@@ -433,6 +443,115 @@ def test_flight_dump_slo_section_round_trip():
     finally:
         del eng
         gc.collect()
+
+
+def _tail_tracer_with_rows(name, rows=48):
+    """A registered TailTrace carrying deterministic observations whose
+    exemplar ring has real content for the byte cap to shed."""
+    from dragonfly2_tpu.telemetry import tailtrace
+
+    tr = tailtrace.TailTrace(
+        ("east", "west"), seed=3, name=name,
+        sample_rate=1.0, exemplar_capacity=64, registry=m.Registry(),
+    )
+    for i in range(rows):
+        vec = [0.0] * tailtrace.N_PHASES
+        vec[tailtrace.PH_PARENT_FETCH] = 4e9 + i * 1e7
+        vec[tailtrace.PH_SCHEDULE_WAIT] = 1e9
+        tr.observe(i % 2, i, sum(vec), vec, round_idx=i // 8)
+    return tr
+
+
+def test_flight_dump_tail_section_round_trip():
+    """Tentpole surface (ISSUE 16): the `tail` section rides flight.dump
+    behind the existing section/max_bytes query machinery —
+    parse_flight_query round-trips it, the dump carries live tracers'
+    per-region decomposition + exemplars, and the byte cap sheds the
+    exemplar list with the truncation marker."""
+    import gc
+
+    from dragonfly2_tpu.telemetry import flight
+
+    kwargs = flight.parse_flight_query("section=tail&last_n=8")
+    assert kwargs == {"last_n": 8, "sections": ("tail",)}
+    tr = _tail_tracer_with_rows("test.flight-tail")
+    try:
+        body = flight.dump(**kwargs)
+        assert "tail" in body and "ticks" not in body and "jit" not in body
+        section = body["tail"]["test.flight-tail"]
+        assert section["completions"] == 48
+        assert len(section["exemplars"]) == 8  # last_n bounds the ring
+        east = section["regions"]["east"]
+        assert east["dominant_phase"] == "parent_fetch"
+        assert east["decomp_ratio"] == 1.0
+        # the exemplar ring is the section's only unbounded list: the
+        # cap sheds it oldest-first and stamps the truncation marker
+        capped = flight.dump(sections=("tail",), max_bytes=2048, last_n=64)
+        size = len(json.dumps(capped, separators=(",", ":"), default=str))
+        assert size <= 2048, size
+        assert capped.get("truncated"), "cap under-shed without a marker"
+    finally:
+        del tr
+        gc.collect()
+    assert "test.flight-tail" not in flight.dump(sections=("tail",)).get(
+        "tail", {}
+    ), "weak registry leaked a dead tracer"
+
+
+def test_mux_and_monitor_serve_debug_flight_tail_section():
+    """Satellite (ISSUE 16): /debug/flight?section=tail on BOTH debug
+    surfaces — the mux sniffer and the monitor server hand back the
+    same tail block, honor max_bytes, and 400 on unknown sections."""
+    import asyncio
+    import gc
+
+    from dragonfly2_tpu.rpc.mux import MuxServer
+
+    tr = _tail_tracer_with_rows("test.route-tail")
+
+    def check_surface(get):
+        body = json.loads(get("/debug/flight?section=tail&last_n=4"))
+        section = body["tail"]["test.route-tail"]
+        assert section["regions"]["west"]["dominant_phase"] == "parent_fetch"
+        assert len(section["exemplars"]) == 4
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/debug/flight?section=nope")
+        assert e.value.code == 400
+        raw = get("/debug/flight?section=tail&max_bytes=2048&last_n=64")
+        assert len(raw) <= 2048
+
+    server = m.serve_metrics(m.Registry(), port=0)
+    try:
+        port = server.server_address[1]
+
+        def get_monitor(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ).read()
+
+        check_surface(get_monitor)
+    finally:
+        server.shutdown()
+
+    async def run():
+        async def rpc_handler(reader, writer):
+            writer.close()
+
+        srv = MuxServer(rpc_handler)
+        host, port = await srv.start()
+        try:
+            def get_mux(path):
+                return urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=5
+                ).read()
+
+            await asyncio.to_thread(check_surface, get_mux)
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+    del tr
+    gc.collect()
 
 
 def test_mux_and_monitor_serve_debug_health():

@@ -143,6 +143,13 @@ def test_megascale_determinism_same_seed():
         and "slo_pages_fired" in s and "ttc_ms_p95" in s
         for s in r1["timeline"]
     )
+    # tail-attribution plane (ISSUE 16): the whole tail block — regions,
+    # windows, exemplars, round matrices, AND the blake2b digest over
+    # every ledger column and sketch — is paired-seed IDENTICAL
+    assert r1["tail"]["digest"] == r2["tail"]["digest"]
+    assert r1["tail"] == r2["tail"]
+    assert r1["tail"]["completions"] > 0
+    assert all("tail_dominant_phase" in s for s in r1["timeline"])
 
 
 def test_megascale_seed_sensitivity():

@@ -239,6 +239,40 @@ def test_slo_determinism_fixtures_and_domain():
     assert real.unwaived() == [], [f.render() for f in real.unwaived()]
 
 
+def test_tail_determinism_fixtures_and_domain():
+    """ISSUE 16 satellite: telemetry/tailtrace.py is a DET domain
+    (paired-seed megascale runs pin its digest bit for bit, so the
+    ledger may never read the wall clock, draw from a process rng, or
+    iterate a set into output), pinned by a red/green fixture pair
+    shaped like the tail ledger."""
+    from tools.dflint.passes.determinism import DEFAULT_DECISION_SUFFIXES
+
+    assert any(
+        s.endswith("telemetry/tailtrace.py") for s in DEFAULT_DECISION_SUFFIXES
+    ), DEFAULT_DECISION_SUFFIXES
+    det = DeterminismPass(
+        decision_suffixes=("bad_tail.py", "good_tail.py"),
+        set_iter_suffixes=("bad_tail.py", "good_tail.py"),
+    )
+    report, _ = _lint([det], "bad_tail.py", "good_tail.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"DET001": 1, "DET002": 1, "DET003": 1}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    # the green twin (counter-hashed sampler, caller-stamped clock,
+    # sorted tracer iteration) stays silent
+    assert not any("good_tail" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_tail" in f.path
+    ]
+    # and the real module is clean under the default domain set
+    real = run_dflint(
+        ROOT,
+        files=[ROOT / "dragonfly2_tpu" / "telemetry" / "tailtrace.py"],
+        passes=[DeterminismPass()],
+    )[0]
+    assert real.unwaived() == [], [f.render() for f in real.unwaived()]
+
+
 def test_shape_donation_fixtures():
     report, _ = _lint(
         [ShapeDonationPass()],
@@ -613,6 +647,7 @@ def test_typecheck_runner_gates_or_passes():
         "dragonfly2_tpu/state", "dragonfly2_tpu/graph", "dragonfly2_tpu/ops",
         "dragonfly2_tpu/telemetry/flight.py",
         "dragonfly2_tpu/telemetry/slo.py",
+        "dragonfly2_tpu/telemetry/tailtrace.py",
         "dragonfly2_tpu/cluster/quarantine.py",
         "dragonfly2_tpu/scenarios/spec.py",
         "dragonfly2_tpu/rpc/wire.py",

@@ -1,0 +1,458 @@
+"""Tail-attribution plane (telemetry/tailtrace.py + tools/dftail.py).
+
+Pins the PR-16 tentpole end to end: the deterministic sampler against
+its vectorized twin, paired-stream digest equality, the chaos-soak
+decomposition invariants (phase sums ≈ measured TTC, scheduler kills
+attributed to failover, schedule_wait baseline), the bounded exemplar
+memory, the client-plane trace continuity fixes (back-to-source and
+re-announce spans riding the triggering envelope), the daemon's
+fold-in of dead attempts, dfslo cause enrichment, and the offline
+dftail verdicts (0 consistent / 1 tolerance / 2 drift)."""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.client import conductor as conductor_mod
+from dragonfly2_tpu.client import daemon as daemon_mod
+from dragonfly2_tpu.client.conductor import PeerTaskConductor
+from dragonfly2_tpu.client.daemon import Daemon
+from dragonfly2_tpu.client.storage import StorageManager, TaskMetadata
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.megascale import topology
+from dragonfly2_tpu.megascale.soak import run_megascale
+from dragonfly2_tpu.telemetry import metrics as m
+from dragonfly2_tpu.telemetry import tailtrace
+from dragonfly2_tpu.telemetry.slo import (
+    SLOEngine,
+    SLOSpec,
+    feed_megascale_sample,
+    megascale_slo_specs,
+)
+from dragonfly2_tpu.telemetry.tailtrace import (
+    DEFAULT_TOLERANCE,
+    N_PHASES,
+    PHASES,
+    PH_BACK_TO_SOURCE,
+    PH_FAILOVER,
+    PH_PARENT_FETCH,
+    PH_REGISTER,
+    PH_SCHEDULE_WAIT,
+    PH_VERIFY,
+    TailTrace,
+    hash_u01_scalar,
+)
+from dragonfly2_tpu.telemetry.tracing import Tracer
+from dragonfly2_tpu.utils import dferrors
+from tools import dftail
+
+
+def _tracer(regions=("r0",), **kw):
+    kw.setdefault("registry", m.Registry())
+    return TailTrace(regions, **kw)
+
+
+def _vec(**ms):
+    v = [0.0] * N_PHASES
+    for name, val in ms.items():
+        v[PHASES.index(name)] = val * 1e6
+    return v
+
+
+# ------------------------------------------------- deterministic sampler
+
+
+def test_hash_u01_scalar_matches_vectorized_twin():
+    """The scalar splitmix64 sampler is bit-identical to the megascale
+    topology's vectorized hash — the exemplar keep/drop decision is the
+    same pure function on both planes."""
+    for seed in (0, 7, 2**31):
+        for key in (0, 1, 63, 10_000, 2**40):
+            want = float(
+                topology.hash_u01(seed, "tail_exemplar", np.array([key]))[0]
+            )
+            assert hash_u01_scalar(seed, "tail_exemplar", key) == want
+    # distinct kinds decorrelate
+    a = hash_u01_scalar(7, "tail_exemplar", 5)
+    b = hash_u01_scalar(7, "other_kind", 5)
+    assert a != b
+
+
+def test_paired_stream_digests_identical():
+    t1 = _tracer(("r0", "r1"), seed=7)
+    t2 = _tracer(("r0", "r1"), seed=7)
+    for t in (t1, t2):
+        for i in range(500):
+            t.observe(
+                i % 2,
+                t.next_seq(),
+                (1 + i % 37) * 1e6,
+                _vec(parent_fetch=1 + i % 37),
+                round_idx=i % 9,
+            )
+    assert t1.deterministic_digest() == t2.deterministic_digest()
+    assert t1.report() == t2.report()
+    # one observation off by one ns is visible in the digest
+    t2.observe(0, t2.next_seq(), 1e6 + 1, _vec(parent_fetch=1.0))
+    t1.observe(0, t1.next_seq(), 1e6, _vec(parent_fetch=1.0))
+    assert t1.deterministic_digest() != t2.deterministic_digest()
+
+
+# ------------------------------------------------- chaos-soak invariants
+
+
+@pytest.fixture(scope="module")
+def soak_report():
+    """One tier-1-scale chaos soak (scheduler kills at rounds 16/32/48/80;
+    kills 16 and 48 land on loaded rounds at 1500 hosts)."""
+    return run_megascale(
+        "soak",
+        num_hosts=1500,
+        num_tasks=32,
+        seed=7,
+        arrivals_per_round=24,
+        retire_after_rounds=24,
+    )
+
+
+def test_soak_decomposition_sums_to_measured_ttc(soak_report):
+    tail = soak_report["tail"]
+    assert tail["completions"] > 0
+    assert tail["phases"] == list(PHASES)
+    for name, reg in tail["regions"].items():
+        if not reg["completed"]:
+            continue
+        ratio = reg["decomp_ratio"]
+        assert ratio is not None, name
+        assert abs(ratio - 1.0) <= DEFAULT_TOLERANCE, (name, ratio)
+    # chaos run exercised the expensive phases: scheduler kills produce
+    # failover time, origin fallback produces back_to_source time
+    shares = [r["phase_share"] for r in tail["regions"].values()]
+    assert any(s.get("failover", 0.0) > 0.0 for s in shares)
+    assert any(s.get("back_to_source", 0.0) > 0.0 for s in shares)
+
+
+def test_soak_kill_windows_attributed_to_failover(soak_report):
+    tail = soak_report["tail"]
+    by_round = {w["round"]: w for w in tail["windows"]}
+    assert sorted(by_round) == [16, 32, 48, 80]
+    # the two kills that land on loaded rounds at this scale dominate by
+    # MASS and by the window's slowest download; the 100k artifact pins
+    # all four (trough kills need planetary arrival volume to dominate)
+    for k in (16, 48):
+        w = by_round[k]
+        assert w["dominant_phase"] == "failover", w
+        assert w["tail_dominant_phase"] == "failover", w
+        assert w["slowest_ttc_ms"] > 0.0
+    for w in by_round.values():
+        assert w["until"] - w["round"] <= TailTrace.DEFAULT_WINDOW_ROUNDS - 1
+    assert by_round[16]["until"] == 16 + TailTrace.DEFAULT_WINDOW_ROUNDS - 1
+    # outside kill windows the fleet waits on the scheduler queue
+    assert tail["baseline_dominant_phase"] == "schedule_wait"
+    assert len(tail["digest"]) == 32
+    # the offline matrices ride the report for dftail replay
+    assert all(len(row) == N_PHASES for row in tail["round_phase_ms"])
+    assert all(len(row) == N_PHASES + 1 for row in tail["round_slow_ms"])
+
+
+def test_soak_timeline_carries_tail_hint(soak_report):
+    samples = soak_report["timeline"]
+    assert samples and all("tail_dominant_phase" in s for s in samples)
+    phases = {s["tail_dominant_phase"] for s in samples}
+    assert "failover" in phases  # the kill intervals name their burn
+
+
+# ------------------------------------------------- bounded exemplar memory
+
+
+def test_exemplar_memory_bound_10k_to_100k():
+    """Ten times the observations, zero extra exemplar bytes: the ring
+    is fixed-capacity, slowest-K replaces in place, and the per-round
+    matrices grow with ROUNDS only (round_idx pinned here)."""
+    t = _tracer(seed=3, slowest_k=4, sample_rate=1 / 64, exemplar_capacity=64)
+    bounded = (
+        "_ring_seq", "_ring_region", "_ring_round", "_ring_ttc",
+        "_ring_phase", "_slow_ttc", "_slow_seq", "_slow_round",
+        "_slow_phase", "_round_phase_ns", "_round_slow_ttc",
+        "_round_slow_phase",
+    )
+
+    def feed(upto):
+        while t._seq < upto:
+            s = t.next_seq()
+            t.observe(0, s, (1 + s % 101) * 1e6, _vec(parent_fetch=1 + s % 101))
+
+    feed(10_000)
+    sizes = {a: getattr(t, a).nbytes for a in bounded}
+    feed(100_000)
+    assert {a: getattr(t, a).nbytes for a in bounded} == sizes
+    samp = t.report()["sampling"]
+    assert samp["uniform_kept"] <= 64
+    # counter-hashed keep decisions at rate 1/64 over 100k observations
+    assert 1_000 < samp["uniform_sampled"] < 2_500
+    rows = t.exemplar_rows()
+    assert len(rows) <= 64 + 4
+    kinds = {r["kind"] for r in rows}
+    assert kinds == {"uniform", "slowest"}
+
+
+# ------------------------------------------------- client-plane continuity
+
+
+class _Conn:
+    def __init__(self):
+        self.sent = []
+
+    async def send(self, message):
+        self.sent.append(message)
+
+
+class _DeadOrigin:
+    def download_source(self, ts, url, headers, on_piece):
+        raise dferrors.DFError("origin down")
+
+
+def test_back_to_source_span_continues_scheduler_trace(tmp_path, monkeypatch):
+    """The origin-fallback span rides the triggering response's wire
+    envelope (NeedBackToSource/ScheduleFailure) instead of starting an
+    orphan trace, and its wall time books into PH_BACK_TO_SOURCE."""
+    tracer = Tracer()
+    spans = tracer.export_to_memory()
+    monkeypatch.setattr(conductor_mod, "default_tracer", lambda: tracer)
+    storage = StorageManager(tmp_path)
+    c = PeerTaskConductor(
+        _Conn(), storage, msg.HostInfo(host_id="h1"),
+        peer_id="p1", task_id="t1", url="http://origin/x",
+    )
+    c.piece_manager = _DeadOrigin()
+    ts = storage.register_task(
+        TaskMetadata(task_id="t1", peer_id="p1", url="http://origin/x",
+                     piece_length=4 << 20)
+    )
+    ctx = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    asyncio.run(c._back_to_source(ts, trace_context=ctx))
+    b2s = [s for s in spans if s.name == "dfdaemon.back_to_source"]
+    assert len(b2s) == 1
+    assert b2s[0].trace_id == ctx["trace_id"]
+    assert b2s[0].parent_id == ctx["span_id"]
+    assert c.phase_ns[PH_BACK_TO_SOURCE] > 0.0
+
+
+def test_reannounce_span_rides_trigger_envelope(tmp_path, monkeypatch):
+    """After a hashring failover the seed's re-announce continues the
+    TRIGGERING scheduler's trace — the hop a tail read follows — and
+    re-registers every finished piece under a fresh peer id."""
+    tracer = Tracer()
+    spans = tracer.export_to_memory()
+    monkeypatch.setattr(daemon_mod, "default_tracer", lambda: tracer)
+    storage = StorageManager(tmp_path)
+    ts = storage.register_task(
+        TaskMetadata(task_id="t9", peer_id="old-peer", url="http://origin/y",
+                     piece_length=4 << 20)
+    )
+    ts.write_piece(0, 0, b"x" * 16)
+
+    class _Seed:
+        def __init__(self):
+            from dragonfly2_tpu.telemetry.series import daemon_series
+            self.metrics = daemon_series(m.Registry())
+
+        def host_info(self):
+            return msg.HostInfo(host_id="seed-host")
+
+    class _Trigger:
+        url = "http://origin/y"
+        tag = ""
+        application = ""
+        trace_context = {"trace_id": "11" * 16, "span_id": "22" * 8}
+
+    conn = _Conn()
+    asyncio.run(Daemon._announce_completed(_Seed(), conn, ts, _Trigger()))
+    re = [s for s in spans if s.name == "dfdaemon.reannounce"]
+    assert len(re) == 1
+    assert re[0].trace_id == _Trigger.trace_context["trace_id"]
+    assert re[0].parent_id == _Trigger.trace_context["span_id"]
+    assert len(conn.sent) == 1
+    reg = conn.sent[0]
+    assert isinstance(reg, msg.RegisterPeerRequest)
+    assert reg.finished_pieces == [0]
+    assert reg.priority == 1
+    assert reg.peer_id == ts.meta.peer_id != "old-peer"
+
+
+def test_daemon_observe_tail_folds_failover_and_residual(monkeypatch):
+    """Dead attempts + measured recovery phases book as failover; the
+    unmeasured glue becomes schedule_wait so the vector still sums to
+    the measured TTC (decomp_ratio 1.0)."""
+    fresh = _tracer(("local",), seed=0)
+    monkeypatch.setattr(tailtrace, "_DEFAULT", fresh)
+
+    class _Cond:
+        phase_ns = _vec(register=1.0, parent_fetch=5.0, verify=0.5)
+
+    task_t0 = time.perf_counter_ns() - int(20e6)  # measured TTC ~20ms
+    Daemon._observe_tail(
+        object.__new__(Daemon), _Cond(), task_t0,
+        failed_attempt_ns=2e6, recovery_phases={"backoff": 1.0, "redial": 0.5},
+    )
+    rep = fresh.report()["regions"]["local"]
+    assert rep["completed"] == 1
+    assert rep["decomp_ratio"] == 1.0
+    share = rep["phase_share"]
+    # 2ms dead attempt + 1.5ms recovery == 3.5ms failover of ~20ms
+    assert share["failover"] == pytest.approx(3.5 / 20.0, rel=0.2)
+    assert share["schedule_wait"] > 0.0  # the residual landed somewhere
+
+
+def test_daemon_observe_tail_scales_overlapping_workers(monkeypatch):
+    """Concurrent piece workers book overlapping fetch walls, so the
+    raw phase mass can EXCEED the elapsed TTC; the fold-in scales the
+    vector onto the wall clock (ratio stays 1.0, relative weights
+    preserved)."""
+    fresh = _tracer(("local",), seed=0)
+    monkeypatch.setattr(tailtrace, "_DEFAULT", fresh)
+
+    class _Cond:
+        # 4 workers × 30ms overlapping fetches inside a ~40ms download
+        phase_ns = _vec(parent_fetch=120.0, verify=2.0)
+
+    task_t0 = time.perf_counter_ns() - int(40e6)
+    Daemon._observe_tail(
+        object.__new__(Daemon), _Cond(), task_t0,
+        failed_attempt_ns=0.0, recovery_phases={},
+    )
+    rep = fresh.report()["regions"]["local"]
+    assert rep["decomp_ratio"] == 1.0
+    share = rep["phase_share"]
+    assert share["parent_fetch"] == pytest.approx(120.0 / 122.0, rel=1e-3)
+    assert share["verify"] == pytest.approx(2.0 / 122.0, rel=1e-3)
+
+
+# ------------------------------------------------- dfslo cause enrichment
+
+
+def test_ttc_page_cause_names_dominant_phase():
+    eng = SLOEngine(
+        [SLOSpec("ttc_local", sli="s", objective=0.999)],
+        minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    for t in range(1, 9):
+        eng.observe("s", good=100)
+        eng.step(t)
+    eng.set_tail_hint("failover")
+    eng.observe("s", good=10, bad=90)
+    eng.step(9)
+    v = eng.verdict()
+    assert v["state"] == "critical"
+    ttc_causes = [c for c in v["causes"] if c["slo"] == "ttc_local"]
+    assert ttc_causes
+    assert all(c["dominant_phase"] == "failover" for c in ttc_causes)
+    # non-TTC objectives never carry the hint
+    assert all(
+        "dominant_phase" not in c for c in v["causes"]
+        if not c["slo"].startswith("ttc")
+    )
+
+
+def test_feed_megascale_sample_threads_tail_hint():
+    eng = SLOEngine(
+        megascale_slo_specs(["region-0"]),
+        minutes_per_unit=15.0, registry=m.Registry(),
+    )
+    sample = {
+        "t": 1, "pieces": 100, "corruptions": 0, "completed": 10,
+        "reannounce_backlog": 0, "origin_fraction": 0.0, "breaker_open": 0,
+        "ttc_ms_p95": {"region-0": 4000.0},
+        "tail_dominant_phase": "retry",
+    }
+    feed_megascale_sample(eng, sample)
+    assert eng._tail_hint == "retry"
+    sample2 = dict(sample, t=2)
+    del sample2["tail_dominant_phase"]
+    feed_megascale_sample(eng, sample2)  # pre-tail samples clear the hint
+    assert eng._tail_hint is None
+
+
+# ------------------------------------------------- dftail offline replay
+
+
+@pytest.fixture()
+def soak_artifact(soak_report, tmp_path):
+    # deep-copy: the tamper tests below mutate the doc, and the tail
+    # block must not leak edits back into the module-scoped report
+    doc = json.loads(json.dumps(
+        {"scenario": "soak", "hosts": 1500, "tail": soak_report["tail"]}
+    ))
+    p = tmp_path / "report.json"
+    p.write_text(json.dumps(doc))
+    return p, doc
+
+
+def test_dftail_reproduces_attribution_offline(soak_artifact, capsys):
+    p, _ = soak_artifact
+    assert dftail.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "== soak_1500 ==" in out
+    assert "kill@16" in out and "baseline: schedule_wait" in out
+
+
+def test_dftail_detects_window_drift(soak_artifact, capsys):
+    p, doc = soak_artifact
+    doc["tail"]["windows"][0]["dominant_phase"] = "verify"
+    p.write_text(json.dumps(doc))
+    assert dftail.main([str(p)]) == 2
+    assert "DRIFT" in capsys.readouterr().out
+
+
+def test_dftail_flags_tolerance_violation(soak_artifact, capsys):
+    p, doc = soak_artifact
+    region = next(iter(doc["tail"]["regions"]))
+    doc["tail"]["regions"][region]["decomp_ratio"] = 2.0
+    p.write_text(json.dumps(doc))
+    assert dftail.main([str(p)]) == 1
+    assert "TOLERANCE" in capsys.readouterr().out
+
+
+def test_dftail_list_and_download(soak_artifact, capsys):
+    p, doc = soak_artifact
+    assert dftail.main([str(p), "--list"]) == 0
+    listed = capsys.readouterr().out
+    assert "seq=" in listed
+    seq = int(doc["tail"]["exemplars"][0]["seq"])
+    assert dftail.main([str(p), "--download", str(seq)]) == 0
+    assert f"seq={seq}" in capsys.readouterr().out
+    assert dftail.main([str(p), "--download", "999999999"]) == 2
+
+
+def test_checked_in_mega_artifact_attribution(capsys):
+    """The shipped BENCH_mega.json reproduces the paper's tail claim
+    offline: every scheduler-kill window's slowest download is
+    failover-dominated, the quiet baseline waits on the scheduler
+    queue, and every region's decomposition sums to its measured TTC."""
+    import pathlib
+
+    p = pathlib.Path(__file__).resolve().parents[1] / "BENCH_mega.json"
+    assert dftail.main([str(p), "--run", "soak_100000"]) == 0
+    doc = json.loads(p.read_text())
+    rc, verdicts = dftail.judge(doc, "soak_100000")
+    assert rc == 0
+    (v,) = verdicts
+    assert len(v["windows"]) == 4
+    assert all(
+        w["tail_dominant_phase"] == "failover" for w in v["windows"]
+    )
+    assert v["baseline_dominant_phase"] == "schedule_wait"
+    for reg in v["regions"].values():
+        assert abs(reg["decomp_ratio"] - 1.0) <= DEFAULT_TOLERANCE
+
+
+def test_dftail_rejects_artifact_without_tail(tmp_path, capsys):
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps({"scenario": "soak", "hosts": 10}))
+    assert dftail.main([str(p)]) == 2
+    p2 = tmp_path / "broken.json"
+    p2.write_text("{nope")
+    assert dftail.main([str(p2)]) == 2

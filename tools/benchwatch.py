@@ -80,6 +80,12 @@ _NO_DIRECTION_SUFFIXES = (
     # verdict states are categories (0=ok/1=degraded/2=critical), not a
     # magnitude — the directional cells are the alert/budget ones above
     "_verdict_state",
+    # tail plane (telemetry/tailtrace.py): a phase's share of attributed
+    # time is a composition (shifting time between phases moves it with
+    # no better direction), and the decomposition ratio is a
+    # consistency audit (perfect = 1.0) — the directional tail cell is
+    # tail_ttc_p99_ms, which _ms already pins lower-better
+    "_phase_share", "_decomp_ratio",
 )
 
 
@@ -274,9 +280,12 @@ def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
              s.get("decision_regret_fail_rate"))
         # SLO cells: alert counts + budget burn compare lower-is-better;
         # the categorical verdict state is direction-exempt and skipped
+        # tail cells: p99 TTC compares lower-is-better (_ms); the
+        # decomposition-ratio audit and phase shares are direction-exempt
         for key in ("slo_pages_fired", "slo_tickets_fired",
                     "slo_alerts_fired", "slo_budget_burn",
-                    "slo_verdict_state"):
+                    "slo_verdict_state", "tail_ttc_p99_ms",
+                    "tail_decomp_ratio", "tail_failover_phase_share"):
             metric = f"{cell}_{key}"
             if direction_exempt(metric):
                 continue

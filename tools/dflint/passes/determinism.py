@@ -48,6 +48,11 @@ DEFAULT_DECISION_SUFFIXES = (
     # depend on machine load (perf_counter stays exempt: live engines
     # use it for window arithmetic, never for replay decisions)
     "telemetry/slo.py",
+    # the tail ledger: paired-seed megascale runs pin its digest bit for
+    # bit, and every recorded value must derive from the caller's clock
+    # (virtual ns on the event plane) and the counter-hashed sampler —
+    # a wall-clock read or unseeded rng here breaks the digest pin
+    "telemetry/tailtrace.py",
 )
 # DET003 also guards the scheduler: the selection/response stream it
 # produces is exactly what the paired-seed oracles compare

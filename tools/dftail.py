@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""dftail — replay a recorded tail-attribution block and answer "what
+made the slow downloads slow?".
+
+The tail plane (telemetry/tailtrace.py) ships its complete offline
+basis inside every ``run_megascale`` report: the per-round phase
+matrix, the per-round slowest-completion rows, and the crash schedule.
+The kill-window attribution is a PURE function of those arrays, so
+this tool re-derives it offline over any artifact that carries a
+``tail`` block —
+
+- a ``BENCH_mega.json`` (``{"runs": [...]}``; every run replays),
+- a single ``run_megascale`` report (``{"tail": {...}, ...}``),
+- or a bare tail block (``{"round_phase_ms": [...], ...}``)
+
+— prints the per-region TTC decomposition table and the kill-window
+verdicts, and drift-checks the recomputation against the recorded
+windows (they can only differ if the window derivation changed since
+the run). The decomposition audit re-checks that attributed phase time
+sums to measured TTC within tolerance, per region AND per kept
+exemplar.
+
+Usage:
+    python tools/dftail.py BENCH_mega.json [--run soak] [--json]
+    python tools/dftail.py report.json --list
+    python tools/dftail.py report.json --download 1234
+
+Exit codes: 0 = attribution consistent and recomputation matches the
+recorded windows, 1 = decomposition tolerance violated (a region or
+exemplar's phases no longer sum to its TTC within --tolerance), 2 = no
+tail block / unreadable artifact / recomputed windows drift from the
+recorded ones (an attribution you can't reproduce offline is not an
+attribution).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from dragonfly2_tpu.telemetry.tailtrace import (  # noqa: E402
+    DEFAULT_TOLERANCE,
+    N_PHASES,
+    PHASES,
+    TailTrace,
+)
+
+DEFAULT_WINDOW_ROUNDS = TailTrace.DEFAULT_WINDOW_ROUNDS
+
+
+def _extract_tails(doc: dict, which: str | None) -> list[tuple[str, dict]]:
+    """(label, tail block) pairs from any supported artifact shape."""
+    if isinstance(doc.get("runs"), list):
+        runs = [r for r in doc["runs"] if isinstance(r, dict)]
+    elif isinstance(doc.get("tail"), dict) or isinstance(
+        doc.get("round_phase_ms"), list
+    ):
+        runs = [doc]
+    else:
+        raise SystemExit(
+            "dftail: artifact carries neither 'runs' nor a tail block"
+        )
+    if which is not None:
+        runs = [
+            r for r in runs
+            if str(r.get("scenario", "")) == which
+            or f"{r.get('scenario')}_{r.get('hosts')}" == which
+        ]
+        if not runs:
+            raise SystemExit(f"dftail: no run matches --run {which!r}")
+    out: list[tuple[str, dict]] = []
+    for r in runs:
+        tail = r.get("tail") if isinstance(r.get("tail"), dict) else (
+            r if isinstance(r.get("round_phase_ms"), list) else None
+        )
+        label = str(r.get("scenario") or r.get("name") or "run")
+        if r.get("hosts"):
+            label = f"{label}_{r['hosts']}"
+        if tail is None:
+            print(
+                f"dftail: skipping {label} "
+                "(no tail block — artifact predates the tail plane)",
+                file=sys.stderr,
+            )
+            continue
+        out.append((label, tail))
+    if not out:
+        raise SystemExit("dftail: no selected run carries a tail block")
+    return out
+
+
+def recompute_windows(
+    tail: dict, window_rounds: int = DEFAULT_WINDOW_ROUNDS
+) -> tuple[list[dict], str | None]:
+    """Re-derive the kill-window attribution from the shipped round
+    matrices — the same arithmetic as TailTrace._windows_locked, over
+    the ms-rounded offline copies."""
+    matrix = tail.get("round_phase_ms") or []
+    slow = tail.get("round_slow_ms") or []
+    crash_rounds = sorted(int(k) for k in tail.get("crash_rounds") or [])
+    last = len(matrix) - 1
+    in_window = [False] * (last + 1)
+    windows: list[dict] = []
+    for k in crash_rounds:
+        lo = max(int(k), 0)
+        hi = min(lo + window_rounds - 1, last)
+        if hi < lo:
+            windows.append({
+                "round": int(k), "until": int(k),
+                "dominant_phase": None, "tail_dominant_phase": None,
+            })
+            continue
+        row = [0.0] * N_PHASES
+        for r in range(lo, hi + 1):
+            in_window[r] = True
+            for p in range(N_PHASES):
+                row[p] += matrix[r][p]
+        dominant = (
+            PHASES[max(range(N_PHASES), key=lambda p: row[p])]
+            if sum(row) > 0.0 else None
+        )
+        tail_dom = None
+        rows = [(slow[r][0], r) for r in range(lo, hi + 1) if r < len(slow)]
+        if rows:
+            best_ttc, best_r = max(rows)
+            if best_ttc > 0.0:
+                ph = slow[best_r][1:]
+                tail_dom = PHASES[max(range(N_PHASES), key=lambda p: ph[p])]
+        windows.append({
+            "round": int(k), "until": hi,
+            "dominant_phase": dominant, "tail_dominant_phase": tail_dom,
+        })
+    baseline = None
+    base = [0.0] * N_PHASES
+    for r in range(last + 1):
+        if not in_window[r]:
+            for p in range(N_PHASES):
+                base[p] += matrix[r][p]
+    if sum(base) > 0.0:
+        baseline = PHASES[max(range(N_PHASES), key=lambda p: base[p])]
+    return windows, baseline
+
+
+def _check_recorded(tail: dict, windows: list[dict],
+                    baseline: str | None) -> list[str]:
+    """Recomputed-vs-recorded drift, dominants only: the offline matrix
+    is ms-rounded, so sums differ in the noise but the argmax must not."""
+    drift: list[str] = []
+    recorded = tail.get("windows")
+    if isinstance(recorded, list) and len(recorded) == len(windows):
+        for rec, rep in zip(recorded, windows):
+            for key in ("dominant_phase", "tail_dominant_phase"):
+                if key in rec and rec.get(key) != rep.get(key):
+                    drift.append(
+                        f"window {rep['round']}: recorded {key}="
+                        f"{rec.get(key)!r}, recomputed {rep.get(key)!r}"
+                    )
+    elif isinstance(recorded, list):
+        drift.append(
+            f"recorded {len(recorded)} windows, recomputed {len(windows)}"
+        )
+    rec_base = tail.get("baseline_dominant_phase")
+    if "baseline_dominant_phase" in tail and rec_base != baseline:
+        drift.append(
+            f"recorded baseline={rec_base!r}, recomputed {baseline!r}"
+        )
+    return drift
+
+
+def _check_tolerance(tail: dict, tolerance: float) -> list[str]:
+    """Attributed-sums-to-measured audit over everything the block
+    carries a pairing for."""
+    bad: list[str] = []
+    for name, reg in sorted((tail.get("regions") or {}).items()):
+        ratio = reg.get("decomp_ratio")
+        if ratio is not None and abs(float(ratio) - 1.0) > tolerance:
+            bad.append(f"region {name}: decomp_ratio {ratio} off by "
+                       f"more than {tolerance:.0%}")
+        p99x = (reg.get("tail") or {}).get("p99_exemplar") or {}
+        ttc, total = p99x.get("ttc_ms"), p99x.get("sum_ms")
+        if ttc and total is not None and abs(total / ttc - 1.0) > tolerance:
+            bad.append(f"region {name}: p99 exemplar phases sum to "
+                       f"{total} of ttc {ttc}")
+    for ex in tail.get("exemplars") or []:
+        ttc = float(ex.get("ttc_ms") or 0.0)
+        total = sum((ex.get("phases_ms") or {}).values())
+        if ttc > 0.0 and abs(total / ttc - 1.0) > tolerance:
+            bad.append(f"exemplar seq={ex.get('seq')}: phases sum to "
+                       f"{round(total, 2)} of ttc {round(ttc, 2)}")
+    return bad
+
+
+def judge(doc: dict, which: str | None = None,
+          window_rounds: int = DEFAULT_WINDOW_ROUNDS,
+          tolerance: float = DEFAULT_TOLERANCE) -> tuple[int, list[dict]]:
+    verdicts: list[dict] = []
+    worst = 0
+    for label, tail in _extract_tails(doc, which):
+        windows, baseline = recompute_windows(tail, window_rounds)
+        drift = _check_recorded(tail, windows, baseline)
+        bad = _check_tolerance(tail, tolerance)
+        rc = 2 if drift else (1 if bad else 0)
+        worst = max(worst, rc)
+        verdicts.append({
+            "run": label,
+            "exit": rc,
+            "windows": windows,
+            "baseline_dominant_phase": baseline,
+            "drift": drift,
+            "tolerance_violations": bad,
+            "regions": {
+                name: {
+                    "completed": reg.get("completed"),
+                    "ttc_ms": reg.get("ttc_ms"),
+                    "dominant_phase": reg.get("dominant_phase"),
+                    "decomp_ratio": reg.get("decomp_ratio"),
+                    "phase_share": reg.get("phase_share"),
+                }
+                for name, reg in sorted((tail.get("regions") or {}).items())
+            },
+        })
+    return worst, verdicts
+
+
+def _print_verdict(v: dict) -> None:
+    print(f"== {v['run']} ==")
+    for name, reg in v["regions"].items():
+        ttc = reg.get("ttc_ms") or {}
+        share = ", ".join(
+            f"{ph}={s:.1%}"
+            for ph, s in sorted((reg.get("phase_share") or {}).items(),
+                                key=lambda kv: -kv[1])
+        )
+        print(f"  {name}: n={reg.get('completed')} "
+              f"p50={ttc.get('p50')} p95={ttc.get('p95')} "
+              f"p99={ttc.get('p99')}ms "
+              f"dom={reg.get('dominant_phase')} "
+              f"ratio={reg.get('decomp_ratio')}")
+        if share:
+            print(f"    share: {share}")
+    for w in v["windows"]:
+        print(f"  kill@{w['round']}..{w['until']}: "
+              f"mass={w['dominant_phase']} tail={w['tail_dominant_phase']}")
+    print(f"  baseline: {v['baseline_dominant_phase']}")
+    for line in v["drift"]:
+        print(f"  DRIFT: {line}")
+    for line in v["tolerance_violations"]:
+        print(f"  TOLERANCE: {line}")
+
+
+def _exemplars(doc: dict, which: str | None) -> list[tuple[str, dict]]:
+    rows: list[tuple[str, dict]] = []
+    for label, tail in _extract_tails(doc, which):
+        for ex in tail.get("exemplars") or []:
+            rows.append((label, ex))
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dftail", description=__doc__.split("\n\n")[0]
+    )
+    ap.add_argument("artifact", help="BENCH_mega.json / report / tail dump")
+    ap.add_argument("--run", help="replay only the run matching "
+                    "scenario or scenario_hosts")
+    ap.add_argument("--list", action="store_true",
+                    help="list kept exemplars instead of judging")
+    ap.add_argument("--download", type=int, metavar="SEQ",
+                    help="print one kept download's decomposition")
+    ap.add_argument("--window-rounds", type=int,
+                    default=DEFAULT_WINDOW_ROUNDS,
+                    help="kill-window width in rounds "
+                    f"(default {DEFAULT_WINDOW_ROUNDS})")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="decomposition-sum tolerance "
+                    f"(default {DEFAULT_TOLERANCE})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdicts")
+    args = ap.parse_args(argv)
+
+    try:
+        doc = json.loads(pathlib.Path(args.artifact).read_text())
+    except (OSError, ValueError) as e:
+        print(f"dftail: cannot read {args.artifact}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(doc, dict):
+        print("dftail: artifact is not a JSON object", file=sys.stderr)
+        return 2
+
+    try:
+        if args.download is not None:
+            hits = [
+                (label, ex) for label, ex in _exemplars(doc, args.run)
+                if int(ex.get("seq", -1)) == args.download
+            ]
+            if not hits:
+                print(f"dftail: no kept exemplar with seq={args.download} "
+                      "(exemplars are sampled; try --list)", file=sys.stderr)
+                return 2
+            for label, ex in hits:
+                if args.json:
+                    print(json.dumps(ex, indent=2, sort_keys=True))
+                    continue
+                print(f"{label} seq={ex['seq']} [{ex.get('kind')}] "
+                      f"region={ex.get('region')} round={ex.get('round')} "
+                      f"ttc={ex.get('ttc_ms')}ms")
+                for ph, ms in sorted((ex.get("phases_ms") or {}).items(),
+                                     key=lambda kv: -kv[1]):
+                    print(f"  {ph:>16}: {ms}ms")
+            return 0
+        if args.list:
+            rows = _exemplars(doc, args.run)
+            if args.json:
+                print(json.dumps([ex for _, ex in rows], sort_keys=True))
+            else:
+                for label, ex in rows:
+                    dom = max(
+                        (ex.get("phases_ms") or {"?": 0.0}).items(),
+                        key=lambda kv: kv[1],
+                    )[0]
+                    print(f"{label} seq={ex.get('seq')} [{ex.get('kind')}] "
+                          f"{ex.get('region')} r{ex.get('round')} "
+                          f"ttc={ex.get('ttc_ms')}ms dom={dom}")
+            return 0
+        rc, verdicts = judge(doc, args.run, args.window_rounds,
+                             args.tolerance)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"exit": rc, "runs": verdicts},
+                         indent=2, sort_keys=True))
+    else:
+        for v in verdicts:
+            _print_verdict(v)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
