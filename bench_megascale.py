@@ -13,6 +13,10 @@ engine step-phase p50s, and peak RSS.
         # + flash crowds) and 100k-host soak (every fault family at once)
     python bench_megascale.py --scenario soak --hosts 1000000 \
         --rounds 30 --artifact BENCH_mega_1m.json     # slow-tier scale
+    python bench_megascale.py --fleet --hosts 1000000 --rounds 30
+        # the sharded-control-plane scaling pair: the fleet builtin at
+        # K=1 and K=4 scheduler replicas (summary cells fleet_<hosts>_r1
+        # / fleet_<hosts>_r4 with aggregate pieces/s + handoff counts)
 
 Everything outside each run's `timing` block is deterministic in
 (scenario, hosts, seed) — same contract as BENCH_scenarios.json.
@@ -32,6 +36,10 @@ def summarize(runs: list[dict]) -> dict:
     out = {}
     for r in runs:
         key = f"{r['scenario']}_{r['hosts']}"
+        if r.get("fleet"):
+            # sharded-control-plane rounds: one cell per replica count so
+            # benchwatch compares K=1 and K=4 each against their own lineage
+            key = f"{key}_r{r['fleet']['replicas']}"
         total = (r.get("origin_bytes") or 0) + (r.get("p2p_bytes") or 0)
         out[key] = {
             "pieces_per_sec": r["timing"]["pieces_per_sec"],
@@ -81,6 +89,17 @@ def summarize(runs: list[dict]) -> dict:
             "tail_decomp_ratio": _tail_worst_ratio(r.get("tail")),
             "tail_failover_phase_share": _tail_failover_share(r.get("tail")),
         }
+        if r.get("fleet"):
+            # fleet plane (megascale/fleet.py): aggregate pieces/s —
+            # pieces over the busiest shard's scheduler-compute seconds,
+            # the fleet's control-plane capacity — is the 1-vs-K scaling
+            # cell (higher-is-better in benchwatch); handoff counts
+            # track ring churn under the fault schedule and are
+            # direction-exempt context.
+            out[key]["aggregate_pieces_per_sec"] = (
+                r["timing"]["fleet"]["aggregate_pieces_per_sec"]
+            )
+            out[key]["fleet_handoffs"] = r["fleet"]["handoffs_total"]
     return out
 
 
@@ -140,39 +159,58 @@ def main() -> int:
     ap.add_argument("--algorithm", default="default")
     ap.add_argument("--retire", type=int, default=24,
                     help="retire completed downloads after this many rounds")
+    ap.add_argument("--max-peers-per-task", type=int, default=None,
+                    help="per-task peer cap (default: auto from arrivals, "
+                         "clamped at 8192 — a hot swarm past the cap spills "
+                         "its overflow to origin)")
     ap.add_argument("--quick", action="store_true",
                     help="10k-host smoke configuration")
     ap.add_argument("--full", action="store_true",
                     help="the acceptance pair: 100k planet + 100k soak")
+    ap.add_argument("--fleet", action="store_true",
+                    help="the scaling pair: fleet builtin at 1 and 4 "
+                         "scheduler replicas")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="scheduler replicas for a single fleet cell "
+                         "(default: no fleet, one scheduler)")
     ap.add_argument("--artifact", default=None,
                     help="write BENCH_mega.json-format artifact here")
     args = ap.parse_args()
 
     from dragonfly2_tpu.megascale.soak import run_megascale
 
-    cells: list[tuple[str, int]] = []
+    cells: list[tuple[str, int, int | None]] = []
     if args.full:
-        cells = [("planet", args.hosts), ("soak", args.hosts)]
+        cells = [("planet", args.hosts, None), ("soak", args.hosts, None)]
+    elif args.fleet:
+        cells = [("fleet", args.hosts, 1), ("fleet", args.hosts, 4)]
     else:
         if args.quick:
             args.hosts = 10_000
-        cells = [(args.scenario, args.hosts)]
+        cells = [(args.scenario, args.hosts, args.replicas)]
 
     runs = []
-    for scenario, hosts in cells:
+    for scenario, hosts, replicas in cells:
         report = run_megascale(
             scenario=scenario, num_hosts=hosts, num_tasks=args.tasks,
             seed=args.seed, rounds=args.rounds,
             arrivals_per_round=args.arrivals, algorithm=args.algorithm,
-            retire_after_rounds=args.retire,
+            retire_after_rounds=args.retire, fleet_replicas=replicas,
+            max_peers_per_task=args.max_peers_per_task,
         )
         runs.append(report)
-        print(json.dumps({
+        line = {
             "scenario": scenario, "hosts": hosts,
             "pieces_per_sec": report["timing"]["pieces_per_sec"],
             "wall_s": report["timing"]["wall_s"],
             "origin_traffic_fraction": report["origin_traffic_fraction"],
-        }))
+        }
+        if replicas is not None:
+            line["replicas"] = replicas
+            line["aggregate_pieces_per_sec"] = (
+                report["timing"]["fleet"]["aggregate_pieces_per_sec"]
+            )
+        print(json.dumps(line))
 
     summary = summarize(runs)
     print("bench_megascale_summary " + json.dumps(summary))

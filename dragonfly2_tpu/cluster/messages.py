@@ -267,6 +267,40 @@ class TriggerSeedRequest:
     headers: dict = dataclasses.field(default_factory=dict)
 
 
+# --------------------------------------------- scheduler fleet handoff
+
+@dataclasses.dataclass
+class PeerHandoffRequest:
+    """Scheduler -> scheduler: adopt an in-flight peer whose task's ring
+    owner moved (replica crash/restart or a rolling-upgrade restart
+    rebalancing the consistent hashring — the fleet analogue of the
+    daemon-side failover walk over ``HashRing.successors``). Carries
+    everything the new owner needs to re-register the peer as a
+    load-not-create plus the pieces the daemon kept on disk, so the
+    receiving scheduler ADOPTS the partial download through the same
+    ``RegisterPeerRequest.finished_pieces`` path instead of restarting
+    it. New fields must default (add-field-with-default wire
+    discipline): an N-1 scheduler that drops them still performs a
+    correct, if less attributed, adoption."""
+
+    peer_id: str
+    task_id: str
+    host: HostInfo
+    url: str = ""
+    content_length: int = -1
+    piece_length: int = 4 << 20
+    total_piece_count: int = 0
+    tag: str = ""
+    application: str = ""
+    # pieces the peer holds at handoff time (None = unknown/none): the
+    # adoption payload, same semantics as RegisterPeerRequest
+    finished_pieces: list[int] | None = None
+    # provenance for per-shard attribution: which replica released the
+    # peer and why ("crash" | "upgrade" | "rebalance")
+    from_scheduler: str = ""
+    reason: str = ""
+
+
 # ------------------------------------------------------ manager job edge
 
 @dataclasses.dataclass

@@ -326,6 +326,7 @@ class SchedulerService:
             msg.DownloadPeerBackToSourceFinishedRequest: self.back_to_source_finished,
             msg.DownloadPeerBackToSourceFailedRequest: self.back_to_source_failed,
             msg.RescheduleRequest: self.reschedule,
+            msg.PeerHandoffRequest: self.peer_handoff,
         }
         handler = handlers.get(type(request))
         if handler is None:
@@ -614,6 +615,30 @@ class SchedulerService:
         per-request responses (None = queued for the tick)."""
         with self.mu:
             return [self.register_peer(req) for req in reqs]
+
+    def peer_handoff(self, req: msg.PeerHandoffRequest):
+        """PeerHandoffRequest: adopt an in-flight peer released by another
+        scheduler replica whose hashring ownership of the task moved
+        (fleet crash/restart/rolling upgrade). Degrades to the exact
+        failover re-announce a daemon would perform on its own — a
+        RegisterPeerRequest carrying the kept pieces — so the PR-3
+        adoption path (`adopt_pieces`, load-not-create) does all the
+        work and an N-1 receiver that ignores the provenance fields
+        still lands the peer correctly."""
+        return self.register_peer(
+            msg.RegisterPeerRequest(
+                peer_id=req.peer_id,
+                task_id=req.task_id,
+                host=req.host,
+                url=req.url,
+                content_length=req.content_length,
+                piece_length=req.piece_length,
+                total_piece_count=req.total_piece_count,
+                tag=req.tag,
+                application=req.application,
+                finished_pieces=req.finished_pieces,
+            )
+        )
 
     def reschedule(self, req: msg.RescheduleRequest):
         """RescheduleRequest (:972): drop given parents, re-queue."""

@@ -579,15 +579,23 @@ class ClusterSimulator:
         )
         self.stats.completed += 1
 
+    def _service_for_peer(self, peer_id: str, task_id: str):
+        """The SchedulerService holding this peer's state. Base: the one
+        scheduler. The fleet engine resolves the peer's ring-owner replica
+        here so cause-split introspection (which reads service-internal
+        state, not the wire protocol) lands on the right shard."""
+        return self.scheduler
+
     def _back_to_source(self, peer_id: str) -> None:
         task = self._task_of[peer_id]
         # cause split: was there a live finished peer this child COULD
         # have pulled from when the scheduler gave up on it?
         from dragonfly2_tpu.state.fsm import PeerState
 
-        st = self.scheduler.state
+        svc = self._service_for_peer(peer_id, task["task_id"])
+        st = svc.state
         starved = True
-        for pid in self.scheduler._task_peers.get(task["task_id"], []):
+        for pid in svc._task_peers.get(task["task_id"], []):
             if pid == peer_id:
                 continue
             pidx = st.peer_index(pid)

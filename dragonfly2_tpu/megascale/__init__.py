@@ -15,7 +15,11 @@ bulk APIs (``pieces_finished_batch``, ``register_peers_batch``,
                 (parameterized RTT/bandwidth tiers per topology relation
                 — the analytic model of arXiv 2103.10515);
 - ``soak``:     the compressed 24h-in-production run (every fault family
-                at once) behind the ``soak`` scenario builtin.
+                at once) behind the ``soak`` scenario builtin;
+- ``fleet``:    ``SchedulerFleet`` (K task-sharded scheduler replicas
+                behind one consistent hashring, cross-scheduler peer
+                handoff on ring rebalance) + ``FleetEventBatchEngine``
+                (the fleet-routed engine) behind the ``fleet`` builtin.
 
 ``bench_megascale.py`` is the CLI; ``BENCH_mega.json`` the artifact.
 """
@@ -31,3 +35,8 @@ from dragonfly2_tpu.megascale.topology import (  # noqa: F401
     make_region_cluster,
 )
 from dragonfly2_tpu.megascale.soak import run_megascale  # noqa: F401
+from dragonfly2_tpu.megascale.fleet import (  # noqa: F401
+    FleetEventBatchEngine,
+    SchedulerFleet,
+    megascale_fleet,
+)

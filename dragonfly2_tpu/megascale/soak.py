@@ -53,6 +53,7 @@ def run_megascale(
     drain_rounds: int = 12,
     max_peers_per_task: int | None = None,
     wire_skew: dict | None = None,
+    fleet_replicas: int | None = None,
 ) -> dict:
     """One megascale replay. `arrivals_per_round` defaults to ~1.5 total
     downloads per host spread over the day; `rounds` defaults to one
@@ -65,7 +66,16 @@ def run_megascale(
     N-1 snapshot (tools/dflint/wirefuzz.SkewProxy) — the rolling-upgrade
     soak then replays the whole compressed day over cross-version frames
     and the report grows a `wire_skew` block (frame counts per type +
-    any codec mismatches) the skew gate asserts empty."""
+    any codec mismatches) the skew gate asserts empty.
+
+    `fleet_replicas` switches the control plane to a SchedulerFleet of
+    that many task-sharded scheduler replicas behind one hashring
+    (megascale/fleet.py) driven by the FleetEventBatchEngine; the report
+    grows a deterministic `fleet` block (per-shard counts/digests/tail,
+    handoff counters, crash-victim recovery) and a wall-derived
+    `timing.fleet` block (modeled parallel wall + aggregate pieces/s).
+    `fleet_replicas=1` is bit-identical to the plain run except for the
+    extra fleet columns — the K=1 equivalence oracle test pins that."""
     spec = resolve_scenario(scenario)
     day = spec.traffic.day_rounds or 96
     if rounds is None:
@@ -92,10 +102,20 @@ def run_megascale(
         # exactly the tradeoff a production per-task peer limit makes
         hottest = int(arrivals_per_round * 0.15 * window * 2)
         max_peers_per_task = min(8192, max(2048, 1 << hottest.bit_length()))
-    svc = megascale_service(
-        num_hosts, num_tasks=num_tasks, max_live_peers=max_live,
-        algorithm=algorithm, seed=seed, max_peers_per_task=max_peers_per_task,
-    )
+    if fleet_replicas is not None:
+        from dragonfly2_tpu.megascale.fleet import megascale_fleet
+
+        svc = megascale_fleet(
+            num_hosts, num_tasks=num_tasks, max_live_peers=max_live,
+            algorithm=algorithm, seed=seed,
+            max_peers_per_task=max_peers_per_task, replicas=fleet_replicas,
+        )
+    else:
+        svc = megascale_service(
+            num_hosts, num_tasks=num_tasks, max_live_peers=max_live,
+            algorithm=algorithm, seed=seed,
+            max_peers_per_task=max_peers_per_task,
+        )
     driver = svc
     if wire_skew is not None:
         # Deliberate tooling import inside the opt-in skew mode ONLY
@@ -105,11 +125,25 @@ def run_megascale(
         from tools.dflint.wirefuzz import SkewProxy
 
         driver = SkewProxy(svc, wire_skew)
+    # pre-compile the eval-bucket device programs during setup: a lazy
+    # XLA compile mid-day lands its seconds on whichever shard first
+    # ticks the new batch shape, skewing the fleet's per-shard capacity
+    # ledger with one-off cold-start noise (production replicas warm
+    # their caches before joining the serving ring for the same reason)
+    svc.warmup()
     t0 = time.perf_counter()
-    sim = EventBatchEngine(
-        driver, num_hosts=num_hosts, num_tasks=num_tasks, seed=seed,
-        scenario=spec, retire_after_rounds=retire_after_rounds,
-    )
+    if fleet_replicas is not None:
+        from dragonfly2_tpu.megascale.fleet import FleetEventBatchEngine
+
+        sim = FleetEventBatchEngine(
+            driver, fleet=svc, num_hosts=num_hosts, num_tasks=num_tasks,
+            seed=seed, scenario=spec, retire_after_rounds=retire_after_rounds,
+        )
+    else:
+        sim = EventBatchEngine(
+            driver, num_hosts=num_hosts, num_tasks=num_tasks, seed=seed,
+            scenario=spec, retire_after_rounds=retire_after_rounds,
+        )
     setup_s = time.perf_counter() - t0
 
     t1 = time.perf_counter()
@@ -216,6 +250,16 @@ def run_megascale(
         # `timing`, so deterministic_view strips it)
         "costcards": _drained_costcards(),
     }
+    if fleet_replicas is not None:
+        # sharded-control-plane block (megascale/fleet.py): handoff
+        # counters, per-shard counts/decision digests/tail attribution,
+        # the crash-victim schedule with per-victim recovery measured on
+        # the victim shard's own piece series — deterministic, rides
+        # deterministic_view; the wall-derived scaling numbers (modeled
+        # parallel wall, aggregate pieces/s — the 1-vs-K artifact) go
+        # under `timing` with the other clock-dependent fields
+        report["fleet"] = sim.fleet_report()
+        report["timing"]["fleet"] = sim.fleet_timing(wall)
     if wire_skew is not None:
         # mixed-version wire evidence: which frame types actually crossed
         # the skewed codec, and any round-trip mismatch (must be empty —

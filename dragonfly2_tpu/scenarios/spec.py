@@ -419,7 +419,13 @@ def megascale_scenarios() -> dict[str, ScenarioSpec]:
       so a 10^5-host run measures the engine and scheduler, not blake2b;
     - ``soak``: the compressed "24 h in production" trace — every fault
       family at once (control-plane chaos + partitions, corruption,
-      churn + rolling upgrades, flash crowds) on the WAN topology.
+      churn + rolling upgrades, flash crowds) on the WAN topology;
+    - ``fleet``: the sharded-control-plane soak — the chaos families
+      that exercise a SchedulerFleet's ring (scheduler crashes,
+      partitions, rolling-upgrade restarts) plus the flaky/churn
+      families that keep downloads in flight across rounds, WITHOUT
+      the corruption family, so a 10^6-host K-replica run measures
+      handoff/rebalance behavior rather than blake2b.
     """
     day = 96  # compressed day: 96 rounds = one "15-minute" tick per round
     wan = WanSpec(
@@ -476,6 +482,59 @@ def megascale_scenarios() -> dict[str, ScenarioSpec]:
                 partition_rate=0.08, partition_epoch_rounds=12,
             ),
             wan=wan, traffic=traffic, flash=flash,
+            upgrade=UpgradeSpec(
+                waves_per_day=1, wave_rounds=24, cohort_fraction=0.04
+            ),
+        ),
+        "fleet": ScenarioSpec(
+            name="fleet",
+            description=(
+                "sharded control-plane day: scheduler crashes, silent "
+                "partitions and rolling-upgrade waves against K hashring "
+                "replicas over the 4-region WAN; flaky parents + churn "
+                "keep downloads in flight across rounds (so a replica "
+                "kill catches real in-flight peers to hand off) but NO "
+                "corruption family — 10^6-host fleet runs measure the "
+                "ring, not blake2b"
+            ),
+            link=LinkSpec(
+                slow_fraction=0.3, slow_multiplier=0.25,
+                spine_oversubscription=2.0,
+            ),
+            churn=ChurnSpec(
+                peer_crash_rate=0.06, crash_progress=0.5,
+                host_leave_rate=0.04, leave_epoch_rounds=16,
+            ),
+            flaky=FlakySpec(
+                parent_fraction=0.18, piece_error_rate=0.10,
+                piece_stall_rate=0.05, stall_seconds=0.2,
+            ),
+            # milder popularity skew than the soak's (static fallback
+            # when the diurnal traffic model is off): task sharding puts
+            # each hot swarm wholly on ONE replica, so a zipf>=1 day is
+            # a single-swarm hot-spot benchmark, not a control-plane one
+            skew=SkewSpec(zipf_alpha=0.8),
+            control=ControlPlaneSpec(
+                scheduler_crash_rate=0.7, crash_epoch_rounds=16,
+                partition_rate=0.08, partition_epoch_rounds=12,
+            ),
+            wan=wan,
+            # the scaling cell measures the RING, so the day is a broad
+            # catalog: alpha 0.5 with 12 hot-set rotations keeps
+            # popularity skewed while the busiest replica's cut of the
+            # day stays near 1/K, and flash storms burst over 16 tasks
+            # instead of slamming one shard's band — the hottest swarm
+            # also stays inside the per-task peer cap rather than
+            # spilling its overflow to origin
+            traffic=TrafficSpec(
+                day_rounds=day, peak_multiplier=3.0,
+                trough_multiplier=0.25,
+                zipf_alpha=0.5, rotate_hot_tasks=12,
+            ),
+            flash=FlashCrowdSpec(
+                events_per_day=3, arrival_multiplier=2.0,
+                duration_rounds=4, hot_tasks=16,
+            ),
             upgrade=UpgradeSpec(
                 waves_per_day=1, wave_rounds=24, cohort_fraction=0.04
             ),

@@ -229,6 +229,38 @@ def megascale_series(reg) -> _Namespace:
     )
 
 
+def fleet_series(reg) -> _Namespace:
+    """Sharded control plane (megascale/fleet.py): K task-sharded
+    scheduler replicas behind one consistent hashring. Handoffs count
+    the cross-scheduler peer moves a ring rebalance forces (labelled by
+    why the owner moved), per-shard piece/restart counters attribute
+    load and churn to individual replicas, and the ring-membership gauge
+    is the live shard census a fleet dashboard alerts on."""
+    return _Namespace(
+        handoffs=reg.counter(
+            "dragonfly_fleet_peer_handoffs_total",
+            "in-flight peers handed off to a new ring-owner scheduler "
+            "replica, by cause of the ownership move",
+            ("reason",),
+        ),
+        shard_pieces=reg.counter(
+            "dragonfly_fleet_shard_pieces_total",
+            "piece-finished reports routed to each scheduler replica",
+            ("shard",),
+        ),
+        shard_restarts=reg.counter(
+            "dragonfly_fleet_shard_restarts_total",
+            "times each scheduler replica rejoined the ring after a "
+            "crash or rolling-upgrade restart",
+            ("shard",),
+        ),
+        shards_in_ring=reg.gauge(
+            "dragonfly_fleet_shards_in_ring",
+            "scheduler replicas currently serving ring ranges",
+        ),
+    )
+
+
 def daemon_series(reg) -> _Namespace:
     c = reg.counter
     return _Namespace(

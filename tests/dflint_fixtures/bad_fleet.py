@@ -1,0 +1,31 @@
+"""dflint red fixture: DET001 (process rng picking the crash victim) +
+DET002 (wall clock deciding a replica's down window) + DET003 (set-ordered
+iteration over the in-flight peers in a ring-rebalance sweep) — in a file
+the test configures as a decision module, the way megascale/fleet.py is
+in the real DET domain."""
+
+import random
+import time
+
+
+class BadFleet:
+    def __init__(self, k):
+        self.k = k
+        self.in_flight = set()
+        self.down_until = {}
+
+    def crash_victim(self):
+        # a process-global rng makes the victim schedule differ between
+        # paired-seed runs — the K=1 equivalence oracle breaks
+        return random.randrange(self.k)  # <- DET001
+
+    def shard_is_down(self, shard):
+        # wall-clock down windows make the handoff stream depend on
+        # machine load instead of the round counter
+        return self.down_until.get(shard, 0) > time.time()  # <- DET002
+
+    def rebalance(self, owner_of):
+        moved = []
+        for pid in self.in_flight:  # <- DET003 (order differs per process)
+            moved.append((pid, owner_of(pid)))
+        return moved

@@ -1,0 +1,33 @@
+"""dflint green twin of bad_fleet.py: round-robin victim selection, a
+round-counter down window, sorted ring-rebalance iteration, and a
+perf_counter that only measures — zero findings."""
+
+import time
+
+
+class GoodFleet:
+    def __init__(self, k):
+        self.k = k
+        self.crashes = 0
+        self.in_flight = set()
+        self.down_until = {}
+
+    def crash_victim(self):
+        # round-robin over the ring: pure function of the crash counter,
+        # identical across paired-seed runs
+        victim = self.crashes % self.k
+        self.crashes += 1
+        return victim
+
+    def shard_is_down(self, shard, round_idx):
+        # down windows live on the round counter, not the wall clock
+        return self.down_until.get(shard, -1) > round_idx
+
+    def rebalance(self, owner_of):
+        # sorted sweep: the handoff frame stream is byte-stable no matter
+        # what PYTHONHASHSEED did to the set's internal order
+        start = time.perf_counter()  # measuring the sweep, never deciding
+        moved = []
+        for pid in sorted(self.in_flight):
+            moved.append((pid, owner_of(pid)))
+        return moved, time.perf_counter() - start

@@ -360,6 +360,52 @@ def test_tail_metrics_direction_table(tmp_path):
     assert "REGRESSION soak_1000_tail_ttc_p99_ms" in out.getvalue()
 
 
+def test_fleet_metrics_direction_table(tmp_path):
+    """ISSUE 17 red/green: aggregate pieces/s across the sharded control
+    plane is a higher-is-better cell (an adjacent-round throughput drop
+    fails the gate); handoff counts track ring churn, not quality — they
+    swing with the fault schedule and are direction-exempt, never
+    normalized into a comparable metric."""
+    from tools.benchwatch import direction_exempt
+
+    assert not lower_is_better("fleet_1000000_r4_aggregate_pieces_per_sec")
+    assert not lower_is_better("fleet_1000_r1_aggregate_pieces_per_sec")
+    assert direction_exempt("fleet_1000000_r4_fleet_handoffs")
+    assert direction_exempt("fleet_1000_r1_fleet_handoffs")
+
+    def mega(agg, handoffs):
+        return {
+            "schema_version": 2, "cmd": "python bench_megascale.py",
+            "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                         "machine": "x86_64", "python": "3.10"},
+            "summary": {"fleet_1000_r4": {
+                "pieces_per_sec": 1000.0, "completed": 10,
+                "origin_traffic_fraction": 0.05,
+                "aggregate_pieces_per_sec": agg,
+                "fleet_handoffs": handoffs,
+            }},
+            "runs": [{"scenario": "fleet", "hosts": 1000, "stats": {},
+                      "timing": {}}],
+        }
+
+    # GREEN: handoff counts swing 40 -> 900 with the fault schedule,
+    # aggregate throughput steady — passes
+    _write(tmp_path, "BENCH_r01.json", mega(agg=4000.0, handoffs=40))
+    _write(tmp_path, "BENCH_r02.json", mega(agg=3950.0, handoffs=900))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 0, out.getvalue()
+    entry = normalize(mega(3950.0, 900), "mega", "BENCH_r02.json")
+    assert "fleet_1000_r4_fleet_handoffs" not in entry["metrics"]
+    assert entry["metrics"]["fleet_1000_r4_aggregate_pieces_per_sec"] == 3950.0
+    # RED: aggregate throughput drops >10% between adjacent rounds —
+    # the fleet stopped scaling and the gate fails
+    _write(tmp_path, "BENCH_r03.json", mega(agg=2500.0, handoffs=900))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert ("REGRESSION fleet_1000_r4_aggregate_pieces_per_sec"
+            in out.getvalue())
+
+
 def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
     """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
     direction — they stay out of the normalized metrics entirely."""

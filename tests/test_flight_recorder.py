@@ -17,6 +17,7 @@ from dragonfly2_tpu.telemetry.series import (
     costcard_series,
     daemon_series,
     decision_series,
+    fleet_series,
     jit_series,
     manager_series,
     megascale_series,
@@ -233,6 +234,14 @@ def test_metric_naming_convention_registry_walk():
     # the tail-attribution plane (dragonfly_tail_*: completions,
     # dominant-phase counts, TTC quantiles, phase shares, exemplars)
     tail_series(reg)
+    # the sharded control plane (dragonfly_fleet_*: cross-scheduler peer
+    # handoffs by reason, per-shard pieces, replica restarts, ring size)
+    fleet_series(reg)
+    for family in ("dragonfly_fleet_peer_handoffs_total",
+                   "dragonfly_fleet_shard_pieces_total",
+                   "dragonfly_fleet_shard_restarts_total",
+                   "dragonfly_fleet_shards_in_ring"):
+        assert family in reg._metrics, f"{family} missing from the sweep"
     for family in ("dragonfly_tail_completions_total",
                    "dragonfly_tail_dominant_total",
                    "dragonfly_tail_ttc_ms",
@@ -255,7 +264,7 @@ def test_metric_naming_convention_registry_walk():
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
         r"^dragonfly_(scheduler|dfdaemon|manager|trainer|costcard|timeline"
-        r"|serving|megascale|slo|tail)_[a-z0-9_]+$"
+        r"|serving|megascale|slo|tail|fleet)_[a-z0-9_]+$"
     )
     assert reg._metrics, "registry walk found nothing"
     for name, metric in reg._metrics.items():

@@ -273,6 +273,41 @@ def test_tail_determinism_fixtures_and_domain():
     assert real.unwaived() == [], [f.render() for f in real.unwaived()]
 
 
+def test_fleet_determinism_fixtures_and_domain():
+    """ISSUE 17 satellite: megascale/fleet.py is a DET domain (the K=1
+    equivalence oracle and paired-seed fleet soaks pin the handoff
+    stream bit for bit, so ring-rebalance sweeps may never iterate a
+    set into output, pick victims from a process rng, or put replica
+    down windows on the wall clock), pinned by a red/green fixture pair
+    shaped like the fleet's rebalance path."""
+    from tools.dflint.passes.determinism import DEFAULT_DECISION_SUFFIXES
+
+    assert any(
+        s.endswith("megascale/fleet.py") for s in DEFAULT_DECISION_SUFFIXES
+    ), DEFAULT_DECISION_SUFFIXES
+    det = DeterminismPass(
+        decision_suffixes=("bad_fleet.py", "good_fleet.py"),
+        set_iter_suffixes=("bad_fleet.py", "good_fleet.py"),
+    )
+    report, _ = _lint([det], "bad_fleet.py", "good_fleet.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"DET001": 1, "DET002": 1, "DET003": 1}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    # the green twin (round-robin victim, round-counter down window,
+    # sorted rebalance sweep) stays silent
+    assert not any("good_fleet" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_fleet" in f.path
+    ]
+    # and the real module is clean under the default domain set
+    real = run_dflint(
+        ROOT,
+        files=[ROOT / "dragonfly2_tpu" / "megascale" / "fleet.py"],
+        passes=[DeterminismPass()],
+    )[0]
+    assert real.unwaived() == [], [f.render() for f in real.unwaived()]
+
+
 def test_shape_donation_fixtures():
     report, _ = _lint(
         [ShapeDonationPass()],

@@ -352,6 +352,74 @@ def test_rolling_upgrade_soak_with_wire_skew_loses_zero_downloads(golden):
     assert skew["stats"]["completed"] == plain["stats"]["completed"]
 
 
+# ------------------------------------------- fleet handoff frame (ISSUE 17)
+
+
+def test_handoff_frame_in_snapshot_with_defaulted_provenance(golden):
+    """The PeerHandoffRequest wire message landed in the snapshot via
+    add-field-with-default discipline: only the identity triple is
+    required; the adoption payload and provenance fields all default,
+    so an N-1 decoder that drops them still lands the peer."""
+    fields = golden["messages"]["PeerHandoffRequest"]["fields"]
+    required = {k for k, spec in fields.items() if spec["required"]}
+    assert required == {"peer_id", "task_id", "host"}
+    for optional in ("finished_pieces", "from_scheduler", "reason"):
+        assert optional in fields and not fields[optional]["required"]
+
+
+def test_handoff_frame_roundtrips_and_replays_skew(golden):
+    """Codec roundtrip + both skew directions for the handoff frame
+    specifically: a live frame degraded to the snapshot still decodes,
+    and an N-1 schema that predates the message entirely passes the
+    frame through whole (new-message adds are compatible)."""
+    from dragonfly2_tpu.cluster import messages as msg
+
+    request = msg.PeerHandoffRequest(
+        peer_id="p1", task_id="t1",
+        host=msg.HostInfo(host_id="h1", ip="10.0.0.9"),
+        url="http://origin/t1", content_length=16 << 20,
+        total_piece_count=4, finished_pieces=[0, 2],
+        from_scheduler="scheduler-1", reason="crash",
+    )
+    decoded = wire.decode(wire.encode(request)[4:])  # [4:]: length header
+    assert decoded == request
+    # live -> N-1 degrade keeps the adoption payload intact
+    payload = wire._to_plain(request)
+    degraded = wirefuzz.degrade_payload(payload, golden,
+                                        "PeerHandoffRequest")
+    assert degraded["finished_pieces"] == [0, 2]
+    assert degraded["reason"] == "crash"
+    # an N-2 schema that has never heard of the message: degrade is a
+    # pass-through and the structured replay stays green
+    old = copy.deepcopy(golden)
+    del old["messages"]["PeerHandoffRequest"]
+    assert wirefuzz.degrade_payload(payload, old,
+                                    "PeerHandoffRequest") == payload
+    assert wirefuzz.replay_skew(old) == []
+
+
+def test_fleet_soak_with_wire_skew_covers_handoff_frames(golden):
+    """Skew soak over the SHARDED control plane: a K=4 fleet day with
+    every exchange round-tripping the N-1 codec moves real
+    PeerHandoffRequest frames, records zero codec mismatches, and is
+    bit-identical to the plain fleet run — cross-version handoff loses
+    zero downloads."""
+    from dragonfly2_tpu.megascale.soak import (
+        deterministic_view, run_megascale,
+    )
+
+    kwargs = dict(num_hosts=2000, num_tasks=24, seed=11, rounds=40,
+                  fleet_replicas=4)
+    plain = run_megascale("fleet", **kwargs)
+    skew = run_megascale("fleet", wire_skew=golden, **kwargs)
+    ws = skew.pop("wire_skew")
+    assert ws["mismatches"] == [], ws["mismatches"][:5]
+    assert ws["frames"].get("PeerHandoffRequest", 0) > 0, ws["frames"]
+    assert deterministic_view(skew) == deterministic_view(plain)
+    assert skew["stats"]["failed"] == 0
+    assert skew["fleet"]["handoffs"]["crash"] > 0
+
+
 # ------------------------------------------------------- property pins
 
 

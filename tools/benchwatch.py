@@ -86,6 +86,11 @@ _NO_DIRECTION_SUFFIXES = (
     # consistency audit (perfect = 1.0) — the directional tail cell is
     # tail_ttc_p99_ms, which _ms already pins lower-better
     "_phase_share", "_decomp_ratio",
+    # fleet plane (megascale/fleet.py): handoff counts scale with how
+    # much chaos the scenario injected and how the ring cut fell — more
+    # handoffs is neither regression nor improvement (the directional
+    # fleet cell is aggregate pieces/s, higher-better by default)
+    "_handoffs",
 )
 
 
@@ -285,7 +290,12 @@ def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
         for key in ("slo_pages_fired", "slo_tickets_fired",
                     "slo_alerts_fired", "slo_budget_burn",
                     "slo_verdict_state", "tail_ttc_p99_ms",
-                    "tail_decomp_ratio", "tail_failover_phase_share"):
+                    "tail_decomp_ratio", "tail_failover_phase_share",
+                    # fleet cells (megascale/fleet.py): aggregate
+                    # pieces/s against the modeled parallel wall is the
+                    # 1-vs-K scaling number (higher-better by default);
+                    # handoff counts are direction-exempt and skipped
+                    "aggregate_pieces_per_sec", "fleet_handoffs"):
             metric = f"{cell}_{key}"
             if direction_exempt(metric):
                 continue

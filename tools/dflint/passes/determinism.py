@@ -53,6 +53,14 @@ DEFAULT_DECISION_SUFFIXES = (
     # (virtual ns on the event plane) and the counter-hashed sampler —
     # a wall-clock read or unseeded rng here breaks the digest pin
     "telemetry/tailtrace.py",
+    # the sharded control plane: ring-rebalance handoff sweeps iterate
+    # the peer->shard routing map, and the K=1 equivalence oracle plus
+    # the paired-seed fleet soaks pin the handoff stream bit for bit —
+    # an unsorted dict/set walk here reorders PeerHandoffRequest frames
+    # across processes (PYTHONHASHSEED) and breaks both
+    # (perf_counter stays exempt: per-shard scheduler-seconds ledgers
+    # measure cost, never decide)
+    "megascale/fleet.py",
 )
 # DET003 also guards the scheduler: the selection/response stream it
 # produces is exactly what the paired-seed oracles compare
