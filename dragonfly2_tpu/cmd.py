@@ -245,28 +245,38 @@ async def _serve_scheduler(args) -> int:
         async def manager_loop():
             log = logging.getLogger(__name__)
             client = None
-            while True:
-                try:
-                    if client is None:
-                        client = await ManagerClient(
-                            mh, mp, ssl_context=tls_client_ctx
-                        ).connect()
-                        await client.call(RegisterInstanceRequest(
+            # Shutdown audit (the PR-15 seam, probed for real by the
+            # process planet's kill/restart churn): this loop holds the
+            # ONE persistent connection in the launcher; cancellation
+            # must close it, or finalization tears down a live transport
+            # under the event loop mid-teardown.
+            try:
+                while True:
+                    try:
+                        if client is None:
+                            client = await ManagerClient(
+                                mh, mp, ssl_context=tls_client_ctx
+                            ).connect()
+                            await client.call(RegisterInstanceRequest(
+                                source_type="scheduler", host_name=hostname,
+                                ip=host, port=port, cluster_id=args.cluster_id,
+                            ))
+                        response = await client.call(KeepAliveRequest(
                             source_type="scheduler", host_name=hostname,
-                            ip=host, port=port, cluster_id=args.cluster_id,
+                            ip=host, cluster_id=args.cluster_id,
                         ))
-                    response = await client.call(KeepAliveRequest(
-                        source_type="scheduler", host_name=hostname,
-                        ip=host, cluster_id=args.cluster_id,
-                    ))
-                    if response is None:  # EOF: manager went away
-                        raise ConnectionError("manager closed the connection")
-                except (ConnectionError, RuntimeError, OSError) as e:
-                    log.warning("manager keepalive/registration failed: %s", e)
-                    if client is not None:
+                        if response is None:  # EOF: manager went away
+                            raise ConnectionError("manager closed the connection")
+                    except (ConnectionError, RuntimeError, OSError) as e:
+                        log.warning("manager keepalive/registration failed: %s", e)
+                        if client is not None:
+                            await client.close()
+                            client = None
+                    await asyncio.sleep(args.keepalive_interval)
+            finally:
+                if client is not None:
+                    with contextlib.suppress(Exception):
                         await client.close()
-                        client = None
-                await asyncio.sleep(args.keepalive_interval)
 
         bg_tasks.append(asyncio.create_task(manager_loop()))
 
@@ -500,9 +510,24 @@ async def _serve_dfdaemon(args) -> int:
                 file=sys.stderr,
             )
         rules.append(ProxyRule(regex=regex, direct=direct, redirect=redirect))
+    injector = None
+    if args.scenario:
+        # Scenario-lab faults in a REAL daemon process (the process
+        # planet's flaky-parent knob): the injector attaches to the
+        # upload server, so THIS daemon serves pieces with the spec's
+        # deterministic error/stall schedule — same FaultInjector, same
+        # spec registry the in-proc simulator uses.
+        from dragonfly2_tpu.megascale.soak import resolve_scenario
+        from dragonfly2_tpu.scenarios.engine import FaultInjector
+
+        injector = FaultInjector(
+            resolve_scenario(args.scenario), seed=args.scenario_seed
+        )
     daemon = Daemon(
+        fault_injector=injector,
         data_dir=args.data_dir,
         scheduler_addresses=[_parse_addr(s) for s in args.scheduler],
+        hostname=args.hostname or "",
         ip=args.ip,
         host_type=args.host_type,
         idc=args.idc,
@@ -647,6 +672,12 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--scheduler", action="append", required=True,
                    help="host:port (repeatable)")
     d.add_argument("--ip", default="127.0.0.1")
+    d.add_argument("--hostname", default=None,
+                   help="peer identity (default: socket.gethostname(); MUST "
+                   "differ between daemons sharing one machine — the "
+                   "scheduler keys hosts on host-id-v2(ip, hostname), so "
+                   "two daemons with one identity collapse into one host "
+                   "and can never serve each other)")
     d.add_argument("--host-type", default="normal", choices=("normal", "super"))
     d.add_argument("--idc", default="")
     d.add_argument("--location", default="")
@@ -670,6 +701,12 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--proxy-rule", action="append", default=[],
                    help="P2P hijack rule REGEX[=>REDIRECT_HOST]; prefix "
                    "'direct:' to match-but-bypass (repeatable)")
+    d.add_argument("--scenario", default="",
+                   help="scenario-lab spec name (scenarios/spec.py); attaches "
+                   "the spec's FaultInjector to this daemon's upload server "
+                   "so it serves pieces as the deterministic flaky parent")
+    d.add_argument("--scenario-seed", type=int, default=0,
+                   help="seed for --scenario fault schedules")
     d.add_argument("--metrics-port", type=int, default=None)
     d.add_argument("--tls-dir", default=None,
                    help="cert.pem/key.pem/ca.pem dir; dials schedulers over mTLS")

@@ -539,4 +539,53 @@ def megascale_scenarios() -> dict[str, ScenarioSpec]:
                 waves_per_day=1, wave_rounds=24, cohort_fraction=0.04
             ),
         ),
+        "procday": ScenarioSpec(
+            name="procday",
+            description=(
+                "process-planet day: the compressed day the REAL "
+                "multi-process deployment (procworld) drives end to end "
+                "— 12 two-hour rounds over a 3-region WAN, a certain "
+                "scheduler kill every 5th round, one rolling-restart "
+                "wave covering a third of the fleet, flaky parents "
+                "keeping downloads in flight across kills; NO "
+                "corruption family (byte identity is asserted against "
+                "the attested chain, not injected against it). The "
+                "SAME spec runs through run_megascale for the "
+                "sim-vs-real divergence report, so every knob here is "
+                "sized for a 3-daemon planet: short stalls, certain "
+                "kills, coarse rounds"
+            ),
+            link=LinkSpec(slow_fraction=0.2, slow_multiplier=0.5),
+            flaky=FlakySpec(
+                # real sockets pay these stalls in wall time — keep
+                # them short but present, so kill windows land on
+                # genuinely in-flight transfers
+                parent_fraction=0.25, piece_error_rate=0.05,
+                piece_stall_rate=0.10, stall_seconds=0.05,
+            ),
+            control=ControlPlaneSpec(
+                # crash_rate=1.0: the kill schedule is CERTAIN, so the
+                # page-at-the-kill assertion is deterministic in the
+                # spec alone — kills at rounds 5 and 10 of a 12-round
+                # day, for sim and planet alike
+                scheduler_crash_rate=1.0, crash_epoch_rounds=5,
+                partition_rate=0.25, partition_epoch_rounds=6,
+            ),
+            wan=WanSpec(
+                regions=3, seeds_per_region=1, wan_rtt_ms=85.0,
+                wan_bandwidth_bps=20e6, back_to_source_penalty_ms=250.0,
+            ),
+            traffic=TrafficSpec(
+                # 12 rounds x 120 sim-minutes: coarse enough that a real
+                # round (seconds of wall time) stands in for a tick, and
+                # the SLO burn windows clamp to single-round width — a
+                # kill-round backlog pages AT the kill, not smeared
+                day_rounds=12, peak_multiplier=2.0,
+                trough_multiplier=0.5, zipf_alpha=0.8,
+                rotate_hot_tasks=2,
+            ),
+            upgrade=UpgradeSpec(
+                waves_per_day=1, wave_rounds=4, cohort_fraction=0.34
+            ),
+        ),
     }

@@ -563,5 +563,52 @@ def tail_series(reg) -> _Namespace:
     )
 
 
+def proc_series(reg) -> _Namespace:
+    """Real-process planet families (procworld/supervisor.py): the
+    supervision plane over actual OS processes — restarts (rolling
+    upgrades + crash recovery), SIGTERM->SIGKILL stop escalations,
+    liveness-probe failures, injected process-level chaos ops, the live
+    process census, and the sim-vs-real divergence gauges the harness
+    publishes after comparing a run against the simulated oracle."""
+    return _Namespace(
+        processes=reg.gauge(
+            "dragonfly_proc_processes",
+            "supervised service processes currently running, by role",
+            ("role",),
+        ),
+        restarts=reg.counter(
+            "dragonfly_proc_restarts_total",
+            "supervised process restarts (rolling-upgrade waves and "
+            "post-SIGKILL crash recovery), by role",
+            ("role",),
+        ),
+        stop_escalations=reg.counter(
+            "dragonfly_proc_stop_escalations_total",
+            "graceful stops that blew the grace window and escalated "
+            "to a harder signal",
+            ("signal",),
+        ),
+        liveness_failures=reg.counter(
+            "dragonfly_proc_liveness_failures_total",
+            "liveness probes that failed against a process the "
+            "supervisor believed alive, by role",
+            ("role",),
+        ),
+        chaos_ops=reg.counter(
+            "dragonfly_proc_chaos_ops_total",
+            "process-level chaos operations injected by the harness "
+            "(sigkill / sigstop / sigcont)",
+            ("op",),
+        ),
+        sim_real_divergence=reg.gauge(
+            "dragonfly_proc_sim_real_divergence",
+            "sim-vs-real divergence value per compared metric "
+            "(ratio or delta; each metric's tolerance band travels in "
+            "the BENCH_proc artifact, not here)",
+            ("metric",),
+        ),
+    )
+
+
 def register_version(reg, service: str) -> None:
     _version.register_version_gauge(reg, service)

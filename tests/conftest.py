@@ -59,6 +59,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "soak: megascale soak smoke (tier-1, time-budgeted)"
     )
+    # real-process planet: the tier-1 procworld smoke (2 schedulers + 3
+    # daemons + manager over real sockets, one SIGKILL + one rolling
+    # restart, time-budgeted); the full compressed day + divergence
+    # report lives in tools/dfproc.py
+    config.addinivalue_line(
+        "markers", "procworld: real-process planet harness (tier-1, "
+        "time-budgeted)"
+    )
 
 
 @pytest.fixture

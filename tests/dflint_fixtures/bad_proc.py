@@ -1,0 +1,25 @@
+"""dflint red fixture: DET001 (unseeded rng picking the divergence
+tolerance), DET002 (wall clock stamping a synthesized round), DET003
+(set-ordered sweep into the timeline) — shaped like the procworld
+replay path (sample synthesis + divergence judging)."""
+
+import random
+import time
+
+
+class Synthesizer:
+    def __init__(self):
+        self.regions = set()
+
+    def jitter_band(self, lo, hi):
+        return lo + random.random() * (hi - lo)  # <- DET001 (global rng)
+
+    def stamp_round(self, sample):
+        sample["t"] = time.time()  # <- DET002 (wall clock in replay path)
+        return sample
+
+    def region_rows(self):
+        rows = []
+        for region in self.regions:  # <- DET003 (set order into output)
+            rows.append({"region": region})
+        return rows

@@ -1,0 +1,29 @@
+"""dflint green fixture: the procworld replay idioms the pass must
+accept — bands declared as constants, round timestamps derived from the
+observation index (model clock), sorted region sweeps, and perf_counter
+confined to wall-time measurement."""
+
+import time
+
+BANDS = {"ttc_ms_p95": (1.5, "cpython proxy loop vs modeled service time")}
+
+
+class Synthesizer:
+    def __init__(self):
+        self.regions = set()
+
+    def band(self, name):
+        return BANDS[name]  # declared, argued, constant
+
+    def stamp_round(self, sample, round_idx, minutes_per_round):
+        sample["t"] = float(round_idx * minutes_per_round)  # model clock
+        return sample
+
+    def region_rows(self):
+        rows = []
+        for region in sorted(self.regions):  # deterministic order
+            rows.append({"region": region})
+        return rows
+
+    def measure_wall(self, started):
+        return time.perf_counter() - started  # measuring, not deciding

@@ -406,6 +406,73 @@ def test_fleet_metrics_direction_table(tmp_path):
             in out.getvalue())
 
 
+def test_proc_metrics_direction_table(tmp_path):
+    """ISSUE 18 red/green: the process-planet artifact kind. Lost
+    downloads and stop escalations are failure accounting (lower-better:
+    an adjacent-round increase fails the gate); kill/restart counts are
+    chaos dosage — they swing with the scenario's crash epochs and
+    upgrade waves and are direction-exempt; divergence ratios are
+    ratio-to-ideal comparisons gated by the artifact's own all_within
+    flag, never normalized into a comparable metric."""
+    from tools.benchwatch import direction_exempt
+
+    assert lower_is_better("proc_lost_downloads")
+    assert lower_is_better("proc_escalations")
+    assert lower_is_better("proc_pages_fired")
+    assert not lower_is_better("proc_completed")
+    assert not lower_is_better("proc_downloads_per_sec")
+    assert direction_exempt("proc_kills")
+    assert direction_exempt("proc_restarts")
+    assert direction_exempt("sim_real_divergence")
+
+    def proc(lost, restarts, dps=2.0):
+        return {
+            "schema_version": 2, "cmd": "python tools/dfproc.py",
+            "platform": {"jax": "0.4.37", "devices": ["TFRT_CPU_0"],
+                         "machine": "x86_64", "python": "3.10"},
+            "summary": {"scenario": "procday", "completed": 144,
+                        "lost_downloads": lost, "kills": 2,
+                        "restarts": restarts, "escalations": 0,
+                        "pages_fired": 2},
+            "runs": [{"scenario": "procday", "hosts": 3, "stats": {},
+                      "timing": {"downloads_per_sec": dps}}],
+            "divergence": {
+                "metrics": {"lost_downloads": {
+                    "band": [1.0, 1.0], "within": True,
+                    "argument": "exact agreement at 0"}},
+                "all_within": True,
+            },
+        }
+
+    # GREEN: restart count swings 10 -> 40 with the chaos schedule,
+    # zero lost both rounds — passes
+    _write(tmp_path, "BENCH_r01.json", proc(lost=0, restarts=10))
+    _write(tmp_path, "BENCH_r02.json", proc(lost=0, restarts=40))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 0, out.getvalue()
+    entry = normalize(proc(0, 40), "proc", "BENCH_r02.json")
+    assert "proc_restarts" not in entry["metrics"]
+    assert "proc_kills" not in entry["metrics"]
+    assert entry["metrics"]["proc_lost_downloads"] == 0.0
+    assert entry["metrics"]["proc_downloads_per_sec"] == 2.0
+    # RED: lost downloads grew between adjacent rounds — the invariant
+    # is eroding and the gate fails (zero-base rounds never anchor a
+    # ratio, so the red pair starts from 1)
+    _write(tmp_path, "BENCH_r02.json", proc(lost=1, restarts=40))
+    _write(tmp_path, "BENCH_r03.json", proc(lost=3, restarts=40))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION proc_lost_downloads" in out.getvalue()
+
+    # schema teeth: a divergence entry without its band argument is a
+    # contract violation, not a comparable artifact
+    bad = proc(lost=0, restarts=10)
+    del bad["divergence"]["metrics"]["lost_downloads"]["argument"]
+    assert detect_kind(bad, "BENCH_proc.json") == "proc"
+    with pytest.raises(SchemaError, match="argument"):
+        validate(bad, "proc", "BENCH_proc.json")
+
+
 def test_model_vs_measured_ratios_are_not_regression_compared(tmp_path):
     """Ratio-to-ideal metrics (perfect = 1.0) have no monotonic better
     direction — they stay out of the normalized metrics entirely."""

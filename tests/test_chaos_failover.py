@@ -9,9 +9,7 @@ scheduler-list shrink dropping ring nodes and breakers."""
 
 import asyncio
 import hashlib
-import http.server
 import os
-import threading
 import time
 
 import pytest
@@ -30,49 +28,8 @@ from dragonfly2_tpu.telemetry.series import daemon_series
 from dragonfly2_tpu.utils import idgen
 
 
-class _Origin:
-    def __init__(self, payload: bytes):
-        self.payload = payload
-        self.get_count = 0
-        outer = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
-
-            def do_HEAD(self):
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(outer.payload)))
-                self.end_headers()
-
-            def do_GET(self):
-                outer.get_count += 1
-                data = outer.payload
-                range_header = self.headers.get("Range")
-                status = 200
-                if range_header and range_header.startswith("bytes="):
-                    spec = range_header[len("bytes="):].split("-")
-                    start = int(spec[0]) if spec[0] else 0
-                    end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
-                    data = data[start:end + 1]
-                    status = 206
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
-
-    def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}/blob.bin"
-
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+# the origin this file hand-rolled is now the shared procworld one
+from dragonfly2_tpu.procworld import OriginServer as _Origin
 
 
 @pytest.fixture

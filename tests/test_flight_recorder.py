@@ -21,6 +21,7 @@ from dragonfly2_tpu.telemetry.series import (
     jit_series,
     manager_series,
     megascale_series,
+    proc_series,
     register_version,
     resilience_series,
     scheduler_series,
@@ -237,6 +238,17 @@ def test_metric_naming_convention_registry_walk():
     # the sharded control plane (dragonfly_fleet_*: cross-scheduler peer
     # handoffs by reason, per-shard pieces, replica restarts, ring size)
     fleet_series(reg)
+    # the real-process supervision plane (dragonfly_proc_*: live process
+    # census, restarts, stop escalations, liveness failures, chaos ops,
+    # and the sim-vs-real divergence gauges)
+    proc_series(reg)
+    for family in ("dragonfly_proc_processes",
+                   "dragonfly_proc_restarts_total",
+                   "dragonfly_proc_stop_escalations_total",
+                   "dragonfly_proc_liveness_failures_total",
+                   "dragonfly_proc_chaos_ops_total",
+                   "dragonfly_proc_sim_real_divergence"):
+        assert family in reg._metrics, f"{family} missing from the sweep"
     for family in ("dragonfly_fleet_peer_handoffs_total",
                    "dragonfly_fleet_shard_pieces_total",
                    "dragonfly_fleet_shard_restarts_total",
@@ -264,7 +276,7 @@ def test_metric_naming_convention_registry_walk():
     # "client" metrics live under the reference's service name, dfdaemon
     pattern = re.compile(
         r"^dragonfly_(scheduler|dfdaemon|manager|trainer|costcard|timeline"
-        r"|serving|megascale|slo|tail|fleet)_[a-z0-9_]+$"
+        r"|serving|megascale|slo|tail|fleet|proc)_[a-z0-9_]+$"
     )
     assert reg._metrics, "registry walk found nothing"
     for name, metric in reg._metrics.items():

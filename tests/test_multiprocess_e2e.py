@@ -7,89 +7,18 @@ against a live cluster; here pods are subprocesses.)"""
 
 import asyncio
 import hashlib
-import http.server
 import os
-import pathlib
 import signal
-import subprocess
-import sys
 import threading
 
 import pytest
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-
-
-def _spawn(args: list[str], tmp_path) -> tuple[subprocess.Popen, str, int]:
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
-    env["PYTHONPATH"] = str(REPO)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "dragonfly2_tpu.cmd", *args],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
-        text=True,
-        cwd=tmp_path,
-        env=env,
-    )
-    line = proc.stdout.readline().strip()
-    if not line.startswith("READY "):
-        proc.kill()
-        raise RuntimeError(f"service failed to start: {line!r}")
-    parts = line.split()  # "READY h p [INFER h p]"
-    host, port = parts[1], int(parts[2])
-    proc.ready_line = line
-    return proc, host, int(port)
-
-
-def _stop(proc: subprocess.Popen) -> None:
-    if proc.poll() is None:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-
-
-class _Origin:
-    def __init__(self, payload: bytes):
-        self.payload = payload
-        self.gets = 0
-        outer = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_HEAD(self):
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(outer.payload)))
-                self.end_headers()
-
-            def do_GET(self):
-                outer.gets += 1
-                body = outer.payload
-                rng = self.headers.get("Range")
-                status = 200
-                if rng and rng.startswith("bytes="):
-                    lo, _, hi = rng[6:].partition("-")
-                    lo = int(lo or 0)
-                    hi = int(hi) if hi else len(body) - 1
-                    body = body[lo : hi + 1]
-                    status = 206
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.port = self.srv.server_address[1]
-        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
-
-    def close(self):
-        self.srv.shutdown()
-        self.srv.server_close()
+# the hand-rolled _spawn/_stop/_Origin these tests grew are now the
+# procworld supervisor primitives (same contracts, plus log capture and
+# the bounded escalation ladder)
+from dragonfly2_tpu.procworld import OriginServer as _Origin
+from dragonfly2_tpu.procworld import spawn_cmd as _spawn
+from dragonfly2_tpu.procworld import stop_proc as _stop
 
 
 @pytest.mark.slow
@@ -447,24 +376,8 @@ def test_sigterm_under_load_bounded_exit_and_clean_restart(tmp_path):
     payload = os.urandom(2 * (1 << 20) + 999)
     digest = hashlib.sha256(payload).hexdigest()
 
-    class _SlowOrigin(_Origin):
-        def __init__(self, payload, delay=0.15):
-            self.delay = delay
-            super().__init__(payload)
-
-    origin = _SlowOrigin(payload)
-    # throttle GETs so the download is provably in flight at kill time;
-    # _Origin's handler class is defined per-instance (inside __init__),
-    # so this rebinding cannot leak into other tests' origins — but
-    # restore it in the finally block anyway for hygiene
-    base_handler = origin.srv.RequestHandlerClass
-    orig_get = base_handler.do_GET
-
-    def slow_get(handler):
-        _time.sleep(origin.delay)
-        orig_get(handler)
-
-    base_handler.do_GET = slow_get
+    # throttle GETs so the download is provably in flight at kill time
+    origin = _Origin(payload, delay_s=0.15)
 
     manager, m_host, m_port = _spawn(
         ["manager", "--db", str(tmp_path / "m.db")], tmp_path
@@ -515,7 +428,7 @@ def test_sigterm_under_load_bounded_exit_and_clean_restart(tmp_path):
         # fresh scheduler; SAME daemon data dir must reload cleanly and
         # complete the interrupted URL (partial-resume/persistent reload,
         # storage_manager.go:545,674 semantics)
-        origin.delay = 0.0
+        origin.delay_s = 0.0
         sched2, s2_host, s2_port = _spawn(
             ["scheduler", "--data-dir", str(tmp_path / "s2-data")], tmp_path
         )
@@ -534,9 +447,94 @@ def test_sigterm_under_load_bounded_exit_and_clean_restart(tmp_path):
         finally:
             _stop(sched2)
     finally:
-        base_handler.do_GET = orig_get
         _stop(sched)
         _stop(manager)
+        origin.close()
+
+
+@pytest.mark.slow
+def test_sigkill_mid_download_restart_adopts_reannounced_pieces(tmp_path):
+    """SIGKILL (no grace, the crash SIGTERM handling can't see) lands on
+    the ONLY scheduler while a child dfdaemon's download is in flight.
+    The supervisor restarts the scheduler on its pinned port with empty
+    in-memory state; the seed daemon's keepalive loop re-announces its
+    finished pieces (the PR-3 crash-recovery path), the restarted
+    scheduler ADOPTS them, and the child completes byte-identical with
+    ZERO additional origin GETs — every recovered byte came from the
+    seed's kept pieces, not a back-to-source refetch."""
+    import concurrent.futures
+    import time as _time
+
+    from dragonfly2_tpu.procworld import ProcessPlanet, wait_for
+    from dragonfly2_tpu.procworld.planet import _fetch_via_proxy, _scrape
+    from dragonfly2_tpu.telemetry.metrics import Registry
+
+    payload = os.urandom(2 * (1 << 20) + 333)
+    digest = hashlib.sha256(payload).hexdigest()
+    origin = _Origin(payload)
+    # the test_chaos_failover headroom, via the launcher's --config path:
+    # the recovering child must not escalate to back-to-source while the
+    # restarted scheduler is still adopting the seed's re-announced copy
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        "scheduler:\n  retry_back_to_source_limit: 50\n  retry_limit: 60\n"
+    )
+    try:
+        with ProcessPlanet(tmp_path, registry=Registry()) as planet:
+            planet.spawn_scheduler(
+                "scheduler-0", extra=("--config", str(cfg)))
+            addrs = planet.scheduler_addresses()
+            seed = planet.spawn_daemon("seed-0", addrs, host_type="super")
+            child0 = planet.spawn_daemon("child-0", addrs)
+            child1 = planet.spawn_daemon("child-1", addrs)
+            url = origin.url()
+
+            # seed back-sources the payload once and announces it
+            got, _, _ = _fetch_via_proxy(url, int(seed.ports["PROXY"]))
+            assert got == digest
+            gets_after_seed = origin.gets
+            assert gets_after_seed > 0
+
+            # child-0's download is submitted, then SIGKILL lands while
+            # its transfer is in flight (real TTC through the proxy path
+            # is ~1s; the kill cuts the announce stream mid-task)
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                fut = pool.submit(
+                    _fetch_via_proxy, url, int(child0.ports["PROXY"]))
+                _time.sleep(0.1)
+                planet.kill("scheduler-0")
+                fresh = planet.restart("scheduler-0")  # same pinned port
+                try:
+                    fut.result(timeout=60)
+                except Exception:
+                    pass  # the kill window caught the transfer — expected
+
+            # the seed's keepalive loop redials the restarted scheduler on
+            # its own (2s probe cadence); child-1 must not register before
+            # the seed is back, or the one-shot first-peer seed trigger
+            # fires into the void
+            wait_for(
+                lambda: _scrape(fresh.ports["METRICS"]).get(
+                    "dragonfly_scheduler_announce_host_total", 0) >= 1,
+                30, what="seed redial after scheduler restart",
+            )
+
+            # a fresh peer against the restarted (empty-state) scheduler:
+            # its register triggers the super-host seed, the seed finds
+            # the completed task on disk and re-announces every finished
+            # piece (PR-3), the scheduler ADOPTS the seed as parent, and
+            # child-1 completes P2P
+            got, _, _ = _fetch_via_proxy(url, int(child1.ports["PROXY"]))
+            assert got == digest, "post-restart download corrupt"
+            reann = _scrape(seed.ports["METRICS"]).get(
+                "dragonfly_dfdaemon_seed_task_reannounce_total", 0)
+            assert reann >= 1, "seed never re-announced kept pieces"
+            # zero origin re-fetches: recovery rode the adopted pieces
+            assert origin.gets == gets_after_seed, (
+                f"origin refetched after restart: {origin.gets} vs "
+                f"{gets_after_seed}"
+            )
+    finally:
         origin.close()
 
 
@@ -727,18 +725,8 @@ def test_preheat_survives_manager_kill_and_restart(tmp_path):
 
     payload = os.urandom(1 << 20)
 
-    class _SlowOrigin(_Origin):
-        pass
-
-    origin = _SlowOrigin(payload)
-    base_handler = origin.srv.RequestHandlerClass
-    orig_get = base_handler.do_GET
-
-    def slow_get(handler):
-        _time.sleep(0.1)  # keep seed downloads in flight at kill time
-        orig_get(handler)
-
-    base_handler.do_GET = slow_get
+    # keep seed downloads in flight at kill time
+    origin = _Origin(payload, delay_s=0.1)
 
     # fixed manager RPC port so schedulers reconnect to the RESTARTED
     # manager (their --manager flag pins host:port)
@@ -850,7 +838,6 @@ def test_preheat_survives_manager_kill_and_restart(tmp_path):
         finally:
             _stop(manager2)
     finally:
-        base_handler.do_GET = orig_get
         if seed_thread is not None and "stop" in loop_holder:
             loop_holder["loop"].call_soon_threadsafe(loop_holder["stop"].set)
             seed_thread.join(timeout=10)

@@ -1,0 +1,382 @@
+"""procworld — the real-process planet harness (ISSUE 18).
+
+Three layers:
+
+- unit tests for the supervisor primitives (READY parsing, the
+  SIGTERM→SIGKILL escalation ladder, SIGSTOP/SIGCONT, the unified
+  origin server) and the replay-facing reducers (megascale sample
+  schema, drift-free SLO synthesis, divergence bands);
+- THE tier-1 planet smoke (marker ``procworld``): 2 real schedulers +
+  3 real dfdaemons + a manager over real sockets drive a compressed
+  day segment through the real client path, survive a mid-flight
+  SIGKILL and a rolling-restart wave with zero lost downloads, and the
+  announce-stability page fires AT the kill and clears on recovery —
+  asserted from the artifact, replayed by dfslo with zero drift;
+- the checked-in ``BENCH_proc.json`` replay (the BENCH_mega pattern):
+  the shipped artifact reproduces its recorded verdicts offline, and
+  every sim-vs-real divergence metric sits inside its declared band.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+# ------------------------------------------------------------- origin
+
+
+def test_origin_server_superset_surface():
+    """The unified origin keeps every historical attribute/alias so the
+    four old per-test ``_Origin`` copies migrate by import swap."""
+    import urllib.request
+
+    from dragonfly2_tpu.procworld import OriginServer
+
+    payload = bytes(range(256)) * 64
+    origin = OriginServer(payload)
+    try:
+        assert origin.srv is origin._server
+        url = origin.url("blob.bin")
+        req = urllib.request.Request(url, method="HEAD")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert int(resp.headers["Content-Length"]) == len(payload)
+        assert origin.gets == 0  # HEAD is not a GET
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.read() == payload
+        ranged = urllib.request.Request(
+            url, headers={"Range": "bytes=256-511"}
+        )
+        with urllib.request.urlopen(ranged, timeout=5) as resp:
+            assert resp.status == 206
+            assert resp.read() == payload[256:512]
+        assert origin.gets == 2 and origin.get_count == 2
+    finally:
+        origin.stop()  # historical alias for close()
+
+
+# --------------------------------------------------------- supervisor
+
+
+def _python_child(script: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-u", "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_managed_proc_parses_ready_ports_and_stops_clean():
+    from dragonfly2_tpu.procworld import ManagedProc
+
+    popen = _python_child(
+        "import time\n"
+        "print('READY 127.0.0.1 1234 PROXY 77 METRICS 88', flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    proc = ManagedProc(["fake"], popen, None, name="fake")
+    proc.wait_ready(20)
+    assert (proc.host, proc.port) == ("127.0.0.1", 1234)
+    assert proc.ports == {"PROXY": 77, "METRICS": 88}
+    proc.stop(grace=10)
+    assert not proc.alive()
+    assert proc.escalations == 0
+
+
+def test_stop_escalation_ladder_sigkills_stubborn_child():
+    """The bounded SIGTERM→SIGKILL ladder (the fix for the old tests'
+    unbounded ``proc.wait()``): a child that ignores SIGTERM is KILLed
+    after the grace window and the escalation is counted."""
+    from dragonfly2_tpu.procworld import ManagedProc
+
+    popen = _python_child(
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('READY 127.0.0.1 1 ', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    proc = ManagedProc(["stubborn"], popen, None, name="stubborn")
+    proc.wait_ready(20)
+    t0 = time.monotonic()
+    proc.stop(grace=0.5)
+    assert time.monotonic() - t0 < 10, "stop() must stay bounded"
+    assert not proc.alive()
+    assert proc.escalations == 1
+
+
+def test_pause_resume_freezes_and_thaws_child():
+    from dragonfly2_tpu.procworld import ManagedProc, wait_for
+
+    popen = _python_child(
+        "import time\nprint('READY 127.0.0.1 1 ', flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    proc = ManagedProc(["pausy"], popen, None, name="pausy")
+    proc.wait_ready(20)
+
+    def state() -> str:
+        return pathlib.Path(f"/proc/{proc.pid}/stat").read_text().split()[2]
+
+    try:
+        proc.pause()
+        # signal delivery is asynchronous — poll the /proc state
+        wait_for(lambda: state() == "T", 10, what="SIGSTOP to land")
+        proc.resume()
+        wait_for(lambda: state() != "T", 10, what="SIGCONT to land")
+    finally:
+        proc.kill()
+
+
+# ------------------------------------------------- sample / synthesis
+
+
+def test_quantile_nearest_rank():
+    from dragonfly2_tpu.procworld import quantile
+
+    assert quantile([], 0.95) is None
+    assert quantile([5.0], 0.95) == 5.0
+    assert quantile([1, 2, 3, 4], 0.50) == 3.0
+    assert quantile([1, 2, 3, 4], 0.95) == 4.0
+
+
+def test_build_sample_matches_megascale_timeline_schema():
+    """The planet's sample carries EXACTLY the keys the megascale
+    engine records (pinned against the checked-in BENCH_mega timeline):
+    same schema in, same replayer out — that is the whole contract that
+    lets dfslo replay a planet artifact unchanged."""
+    from dragonfly2_tpu.procworld import RoundObservation, build_sample
+
+    mega_sample = json.loads(
+        (ROOT / "BENCH_mega.json").read_text()
+    )["runs"][0]["timeline"][0]
+    obs = RoundObservation(round_idx=1, completed=3, pieces=9,
+                           origin_pieces=3, ttc_ms={"region-0": [10.0]})
+    sample = build_sample(obs, minutes_per_round=120.0,
+                          regions=["region-0"])
+    slo_columns = {"t", "slo_verdict", "slo_alerts_firing",
+                   "slo_pages_fired", "slo_tickets_fired"}
+    assert set(sample) | slo_columns == set(mega_sample)
+
+
+def test_synthesized_timeline_replays_with_zero_drift():
+    """synthesize_timeline's recorded slo_* columns and alert log are
+    reproduced bit for bit by telemetry.slo.replay_timeline — the exact
+    check tools/dfslo.py performs on the artifact."""
+    from dragonfly2_tpu.procworld import (
+        RoundObservation, announce_page_rounds, synthesize_timeline,
+    )
+    from dragonfly2_tpu.telemetry.slo import replay_timeline
+
+    regions = ["region-0", "region-1"]
+    observations = []
+    for r in range(1, 9):
+        kill = 1 if r == 5 else 0
+        observations.append(RoundObservation(
+            round_idx=r, completed=10, pieces=30, origin_pieces=10,
+            reannounce_backlog=3 * kill, scheduler_crash=kill,
+            ttc_ms={rg: [100.0 + r, 200.0 + r] for rg in regions},
+        ))
+    timeline, slo_block = synthesize_timeline(
+        observations, minutes_per_round=120.0, regions=regions
+    )
+    replay = replay_timeline(timeline, 120.0)
+    for sample, col in zip(timeline, replay["samples"]):
+        for key in ("slo_verdict", "slo_alerts_firing",
+                    "slo_pages_fired", "slo_tickets_fired"):
+            assert sample[key] == col[key], (sample["t"], key)
+    assert replay["pages_fired"] == slo_block["pages_fired"]
+    assert replay["tickets_fired"] == slo_block["tickets_fired"]
+    assert replay["verdict_final"] == slo_block["verdict_final"]
+    assert replay["alert_log"] == slo_block["alert_log"][-len(
+        replay["alert_log"]):]
+    # the synthetic kill paged AT the kill round
+    assert announce_page_rounds(timeline, slo_block) == [5.0]
+
+
+# --------------------------------------------------------- divergence
+
+
+def _fake_sim_report():
+    return {
+        "timeline": [
+            {"t": 1.0, "ttc_ms_p95": {"region-0": 4000.0}},
+            {"t": 2.0, "ttc_ms_p95": {"region-0": 5000.0}},
+        ],
+        "mega": {"origin_bytes": 20, "p2p_bytes": 80},
+        "stats": {"pieces": 1000, "completed": 100, "failed": 0},
+        "failover": {"scheduler_crashes": 2, "crash_reannounced_peers": 5},
+        "expected_crash_rounds": [5, 10],
+        "slo": {
+            "verdict_final": "ok",
+            "alert_log": [
+                {"t": 5.0, "slo": "announce_stability", "rule": "fast_burn",
+                 "severity": "page", "event": "fired"},
+            ],
+        },
+    }
+
+
+def _fake_real_facts():
+    return {
+        "scenario": "procday", "seed": 7,
+        "ttc_ms_p95": {"region-0": 1500.0},
+        "origin_fraction": 0.4, "pieces": 300, "completed": 100,
+        "lost_downloads": 0, "kills": 2, "failovers": 2,
+        "kill_rounds": [5.0, 10.0],
+        "slo": {
+            "verdict_final": "ok",
+            "alert_log": [
+                {"t": 5.0, "slo": "announce_stability", "rule": "fast_burn",
+                 "severity": "page", "event": "fired"},
+                {"t": 10.0, "slo": "announce_stability", "rule": "fast_burn",
+                 "severity": "page", "event": "fired"},
+            ],
+        },
+    }
+
+
+def test_divergence_all_within_on_agreeing_runs():
+    from dragonfly2_tpu.procworld import compute_divergence
+
+    report = compute_divergence(_fake_real_facts(), _fake_sim_report())
+    assert report["all_within"], report
+    metrics = report["metrics"]
+    # every entry carries its band AND the argument for it — the bands
+    # travel in the artifact, not in this test
+    for name, entry in metrics.items():
+        assert len(entry["band"]) == 2, name
+        assert entry["argument"], name
+        assert entry["within"] is True, (name, entry)
+    assert metrics["ttc_p95_ratio_region-0"]["value"] == pytest.approx(
+        1500.0 / 5000.0)
+    assert metrics["origin_fraction_delta"]["value"] == pytest.approx(
+        0.4 - 0.2)
+    assert metrics["lost_downloads"]["value"] == 1.0
+
+
+def test_divergence_flags_out_of_band_and_disagreement():
+    from dragonfly2_tpu.procworld import compute_divergence
+
+    real = _fake_real_facts()
+    real["lost_downloads"] = 1          # the invariant breaks
+    real["ttc_ms_p95"] = {"region-0": 9000.0}  # slower than modeled WAN
+    sim = _fake_sim_report()
+    sim["slo"]["verdict_final"] = "degraded"   # verdict disagreement
+    report = compute_divergence(real, sim)
+    assert not report["all_within"]
+    m = report["metrics"]
+    assert not m["lost_downloads"]["within"]
+    assert not m["ttc_p95_ratio_region-0"]["within"]
+    assert not m["verdict_match"]["within"]
+    # a page NOT on a kill round fails the paged-at-kill agreement
+    real2 = _fake_real_facts()
+    real2["slo"]["alert_log"].append(
+        {"t": 7.0, "slo": "announce_stability", "rule": "fast_burn",
+         "severity": "page", "event": "fired"})
+    report2 = compute_divergence(real2, _fake_sim_report())
+    assert not report2["metrics"]["paged_at_kill"]["within"]
+
+
+# ------------------------------------------------- THE planet smoke
+
+
+@pytest.mark.procworld
+def test_planet_day_survives_sigkill_and_rolling_restart(tmp_path):
+    """THE tier-1 acceptance (ISSUE 18): 2 real scheduler processes + 3
+    real dfdaemons + a manager over real sockets drive 6 rounds of the
+    procday spec through the real client path (proxy-hijacked GETs,
+    byte-verified against the origin digest). Round 5 SIGKILLs a
+    scheduler MID-DOWNLOAD; rounds 3-6 roll a restart wave over every
+    daemon. Zero lost downloads, the kill produced observable failover,
+    and the announce-stability page fired AT the kill and cleared on
+    recovery — all read from the artifact, which dfslo replays with
+    zero drift."""
+    import tools.dfslo as dfslo
+    from dragonfly2_tpu.procworld import run_procday
+    from tools.bench_schema import write_artifact
+
+    t0 = time.monotonic()
+    run = run_procday(
+        tmp_path / "planet", rounds=6, schedulers=2, daemons=3,
+        tasks_per_round=4, with_manager=True,
+    )
+    wall = time.monotonic() - t0
+    assert wall < 420, f"planet smoke blew its time budget: {wall:.0f}s"
+
+    st = run["stats"]
+    # zero lost downloads, real P2P traffic, byte-identical completions
+    # (a digest mismatch counts as lost)
+    assert st["lost_downloads"] == 0, st
+    assert st["completed"] > 0 and st["via_p2p"] > 0, st
+    # the SIGKILL happened mid-run and daemons failed over
+    assert run["kill_rounds"] == [5.0]
+    assert st["kills"] == 1 and st["failovers"] >= 1, st
+    # the rolling-upgrade wave restarted daemons; the killed scheduler
+    # was restarted on its pinned port (recovery)
+    assert st["restarts"] >= 4, run["proc"]["restarts"]
+    assert run["proc"]["restarts"].get("scheduler-0", 0) >= 1
+    # the page fired AT the kill and cleared on recovery — from the
+    # recorded alert log, not test-local state
+    assert run["page_rounds"] == [5.0], run["slo"]["alert_log"]
+    cleared = [e["t"] for e in run["slo"]["alert_log"]
+               if e["slo"] == "announce_stability"
+               and e["severity"] == "page" and e["event"] == "cleared"]
+    assert cleared == [6.0], run["slo"]["alert_log"]
+    # every process exited the ladder cleanly (no lingering members)
+    assert all(code is not None for code in run["proc"]
+               ["exit_codes"].values())
+
+    # the artifact replays offline through dfslo UNCHANGED: recorded
+    # verdicts reproduced bit for bit (rc=2 == "it paged", not drift)
+    body = write_artifact(
+        tmp_path / "BENCH_proc.json", ["test"], {"scenario": "procday"},
+        runs=[run],
+    )
+    rc, results = dfslo.judge(body)
+    assert rc == 2 and len(results) == 1
+    assert results[0]["paged"] and results[0]["pages_fired"] == 1
+    assert not results[0]["recorded_drift"], results[0]["recorded_drift"]
+
+
+# ------------------------------------------- checked-in BENCH_proc
+
+
+def test_dfslo_reproduces_checked_in_bench_proc_verdicts():
+    """The BENCH_mega pattern for the planet: the shipped BENCH_proc
+    artifact replays offline to its recorded verdicts (pages at every
+    kill round, zero drift), and the sim-vs-real divergence report it
+    carries has every metric inside its declared band."""
+    import tools.dfslo as dfslo
+
+    doc = json.loads((ROOT / "BENCH_proc.json").read_text())
+    rc, results = dfslo.judge(doc)
+    assert len(results) == 1
+    run = results[0]
+    assert run["paged"] and run["pages_fired"] >= 1
+    assert rc == 2
+    assert not run["recorded_drift"], run["recorded_drift"]
+
+    # the invariant and the kill evidence, from the artifact alone
+    record = doc["runs"][0]
+    assert record["stats"]["lost_downloads"] == 0
+    assert record["stats"]["kills"] >= 1
+    assert record["page_rounds"] == record["kill_rounds"]
+
+    # the divergence report: bands + arguments carried in the artifact,
+    # every compared metric within its band
+    divergence = doc["divergence"]
+    assert divergence["all_within"]
+    assert divergence["metrics"], "empty divergence report"
+    for name, entry in divergence["metrics"].items():
+        assert entry["within"], (name, entry)
+        assert entry["argument"], name
+        lo, hi = entry["band"]
+        if entry["value"] is not None:
+            assert lo <= entry["value"] <= hi, (name, entry)
+    assert doc["summary"]["divergence_all_within"] is True

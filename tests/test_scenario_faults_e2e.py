@@ -11,8 +11,6 @@ acceptance gate that injected faults are NOT a simulator-only shortcut.
 
 import asyncio
 import hashlib
-import http.server
-import threading
 
 import pytest
 
@@ -20,55 +18,12 @@ from dragonfly2_tpu.client.daemon import Daemon
 from dragonfly2_tpu.cluster.probes import ProbeStore
 from dragonfly2_tpu.cluster.scheduler import SchedulerService
 from dragonfly2_tpu.config.config import Config
+# the origin this file hand-rolled is now the shared procworld one
+from dragonfly2_tpu.procworld import OriginServer as _Origin
 from dragonfly2_tpu.records.storage import TraceStorage
 from dragonfly2_tpu.rpc.server import SchedulerRPCServer
 from dragonfly2_tpu.scenarios import FaultInjector, ScenarioSpec
 from dragonfly2_tpu.scenarios.spec import FlakySpec
-
-
-class _Origin:
-    def __init__(self, payload: bytes):
-        self.payload = payload
-        self.get_count = 0
-        outer = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):
-                pass
-
-            def do_HEAD(self):
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(outer.payload)))
-                self.end_headers()
-
-            def do_GET(self):
-                outer.get_count += 1
-                data = outer.payload
-                range_header = self.headers.get("Range")
-                status = 200
-                if range_header and range_header.startswith("bytes="):
-                    spec = range_header[len("bytes="):].split("-")
-                    start = int(spec[0]) if spec[0] else 0
-                    end = int(spec[1]) if len(spec) > 1 and spec[1] else len(data) - 1
-                    data = data[start:end + 1]
-                    status = 206
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
-
-    def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}/blob.bin"
-
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
 
 
 @pytest.fixture

@@ -308,6 +308,47 @@ def test_fleet_determinism_fixtures_and_domain():
     assert real.unwaived() == [], [f.render() for f in real.unwaived()]
 
 
+def test_proc_determinism_fixtures_and_domain():
+    """ISSUE 18 satellite: the procworld replay path (sample synthesis
+    and the divergence verdict) is a DET domain — dfslo re-judges
+    BENCH_proc.json offline, so both must be pure functions of the
+    recorded observations (no wall clocks, no process rng, no
+    set-ordered output) — pinned by a red/green fixture pair shaped
+    like the synthesizer. The supervisor stays out of scope: it runs
+    real processes on the real clock by design."""
+    from tools.dflint.passes.determinism import DEFAULT_DECISION_SUFFIXES
+
+    for suffix in ("procworld/sample.py", "procworld/divergence.py"):
+        assert any(
+            s.endswith(suffix) for s in DEFAULT_DECISION_SUFFIXES
+        ), (suffix, DEFAULT_DECISION_SUFFIXES)
+    assert not any(
+        s.endswith("procworld/supervisor.py") for s in DEFAULT_DECISION_SUFFIXES
+    ), DEFAULT_DECISION_SUFFIXES
+    det = DeterminismPass(
+        decision_suffixes=("bad_proc.py", "good_proc.py"),
+        set_iter_suffixes=("bad_proc.py", "good_proc.py"),
+    )
+    report, _ = _lint([det], "bad_proc.py", "good_proc.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"DET001": 1, "DET002": 1, "DET003": 1}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    # the green twin (constant argued bands, model-clock round stamps,
+    # sorted region sweep, perf_counter measurement) stays silent
+    assert not any("good_proc" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_proc" in f.path
+    ]
+    # and the real modules are clean under the default domain set
+    real = run_dflint(
+        ROOT,
+        files=[ROOT / "dragonfly2_tpu" / "procworld" / "sample.py",
+               ROOT / "dragonfly2_tpu" / "procworld" / "divergence.py"],
+        passes=[DeterminismPass()],
+    )[0]
+    assert real.unwaived() == [], [f.render() for f in real.unwaived()]
+
+
 def test_shape_donation_fixtures():
     report, _ = _lint(
         [ShapeDonationPass()],

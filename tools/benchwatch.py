@@ -63,6 +63,10 @@ _LOWER_BETTER_SUFFIXES = (
     # SLO verdict plane (telemetry/slo.py): alerts fired and error-budget
     # burn are failure accounting — less is strictly better
     "_pages_fired", "_tickets_fired", "_alerts_fired", "_budget_burn",
+    # process planet (procworld): lost downloads break THE invariant and
+    # stop escalations mean graceful shutdown blew its grace window —
+    # both strictly lower-better
+    "_lost_downloads", "_escalations",
 )
 _LOWER_BETTER_EXACT = {
     "control_dispatch", "device_call", "candidate_fill", "apply_selection",
@@ -91,6 +95,11 @@ _NO_DIRECTION_SUFFIXES = (
     # handoffs is neither regression nor improvement (the directional
     # fleet cell is aggregate pieces/s, higher-better by default)
     "_handoffs",
+    # process planet (procworld): kill and restart counts scale with how
+    # much chaos the harness injected (the scenario's crash epochs and
+    # upgrade waves), not with how well the planet handled it — the
+    # directional proc cells are lost_downloads/escalations above
+    "_restarts", "_kills",
 )
 
 
@@ -122,10 +131,12 @@ def _require(doc: dict, key: str, types, where: str) -> None:
 
 
 def detect_kind(doc: dict, name: str) -> str:
-    """driver | bench | loop | mega | scenarios — by structural
+    """driver | bench | loop | mega | proc | scenarios — by structural
     signature. `bench` is `python bench.py --artifact` (the schema-v2
     successor of the driver-captured tail records: the same parsed
-    record, under `record`, plus the shared platform block)."""
+    record, under `record`, plus the shared platform block). `proc` is
+    tools/dfproc.py (a mega-shaped run plus the sim-vs-real divergence
+    report), so its check must precede the `runs` -> mega one."""
     if not isinstance(doc, dict):
         raise SchemaError(f"{name}: artifact must be a JSON object")
     keys = set(doc)
@@ -133,6 +144,8 @@ def detect_kind(doc: dict, name: str) -> str:
         return "driver"
     if "record" in keys:
         return "bench"
+    if "divergence" in keys:
+        return "proc"
     if "runs" in keys:
         return "mega"
     if "results" in keys:
@@ -155,7 +168,7 @@ def validate(doc: dict, kind: str, name: str) -> None:
             _require(parsed, "metric", str, f"{name}.parsed")
             _require(parsed, "value", (int, float), f"{name}.parsed")
         return
-    if kind in ("bench", "loop", "mega"):
+    if kind in ("bench", "loop", "mega", "proc"):
         _require(doc, "cmd", str, name)
         _require(doc, "platform", dict, name)
         _require(doc["platform"], "jax", str, f"{name}.platform")
@@ -180,6 +193,21 @@ def validate(doc: dict, kind: str, name: str) -> None:
                 for key, types in (("scenario", str), ("hosts", int),
                                    ("stats", dict), ("timing", dict)):
                     _require(run, key, types, where)
+        if kind == "proc":
+            # the divergence report's contract: every comparison carries
+            # its band AND the argument for the band — a band whose
+            # provenance is lost cannot be audited
+            _require(doc, "divergence", dict, name)
+            div = doc["divergence"]
+            _require(div, "metrics", dict, f"{name}.divergence")
+            _require(div, "all_within", bool, f"{name}.divergence")
+            for mname, entry in div["metrics"].items():
+                where = f"{name}.divergence.metrics[{mname}]"
+                if not isinstance(entry, dict):
+                    raise SchemaError(f"{where}: must be an object")
+                _require(entry, "band", list, where)
+                _require(entry, "within", bool, where)
+                _require(entry, "argument", str, where)
         return
     if kind == "scenarios":
         _require(doc, "scenarios", dict, name)
@@ -302,6 +330,27 @@ def _normalize_mega(doc: dict, metrics: dict, quarantined: dict) -> None:
             _put(metrics, quarantined, metric, s.get(key))
 
 
+def _normalize_proc(doc: dict, metrics: dict, quarantined: dict) -> None:
+    # the directional proc cells: lost_downloads/escalations/pages_fired
+    # lower-better (suffix tables), completed/downloads_per_sec higher-
+    # better by default; kills/restarts are chaos dosage (direction-
+    # exempt) and the divergence ratios are ratio-to-ideal comparisons
+    # gated by the artifact's own all_within flag plus the replay test —
+    # neither family enters the trajectory comparison
+    summary = doc.get("summary") or {}
+    for key in ("completed", "lost_downloads", "kills", "restarts",
+                "escalations", "pages_fired"):
+        metric = f"proc_{key}"
+        if direction_exempt(metric):
+            continue
+        _put(metrics, quarantined, metric, summary.get(key))
+    runs = doc.get("runs") or []
+    if runs and isinstance(runs[0], dict):
+        timing = runs[0].get("timing") or {}
+        _put(metrics, quarantined, "proc_downloads_per_sec",
+             timing.get("downloads_per_sec"))
+
+
 def _normalize_scenarios(doc: dict, metrics: dict, quarantined: dict) -> None:
     for sname, s in (doc.get("scenarios") or {}).items():
         ratio = (s.get("ml_vs_default") or {}).get("mean")
@@ -320,6 +369,7 @@ def normalize(doc: dict, kind: str, name: str) -> dict:
         "bench": _normalize_bench,
         "loop": _normalize_loop,
         "mega": _normalize_mega,
+        "proc": _normalize_proc,
         "scenarios": _normalize_scenarios,
     }[kind](doc, metrics, quarantined)
     return {

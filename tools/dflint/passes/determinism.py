@@ -61,6 +61,15 @@ DEFAULT_DECISION_SUFFIXES = (
     # (perf_counter stays exempt: per-shard scheduler-seconds ledgers
     # measure cost, never decide)
     "megascale/fleet.py",
+    # the real-process planet's replay-facing half: dfslo re-judges
+    # BENCH_proc.json offline, so the timeline synthesized from observed
+    # rounds and the sim-vs-real divergence verdict must be pure
+    # functions of the recorded observations — a wall-clock read or rng
+    # draw here would make the offline replay disagree with the live run
+    # (the supervisor itself is NOT in scope: it manages real processes
+    # on the real clock by design)
+    "procworld/sample.py",
+    "procworld/divergence.py",
 )
 # DET003 also guards the scheduler: the selection/response stream it
 # produces is exactly what the paired-seed oracles compare

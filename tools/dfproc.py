@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""dfproc — run the real-process planet day and emit BENCH_proc.json.
+
+Boots K real scheduler processes, M real dfdaemons, and a manager over
+real sockets (procworld.ProcessPlanet), drives the compressed scenario
+day through the real client path with process-level chaos (SIGKILL at
+the spec's kill rounds, SIGSTOP partitions, rolling restarts), then
+runs the SAME spec through the megascale simulator and writes the
+sim-vs-real divergence report next to the planet's timeline+SLO run —
+one artifact, bench_schema v2, replayable by ``tools/dfslo.py``
+unchanged:
+
+    python tools/dfproc.py --out BENCH_proc.json
+    python tools/dfproc.py --scenario procday --rounds 12 --daemons 3
+    python tools/dfslo.py BENCH_proc.json          # offline re-verdict
+
+Exit codes: 0 = zero lost downloads AND every divergence metric inside
+its declared band; 1 = a divergence band violated; 2 = lost downloads
+or a planet failure (the invariant, not a tolerance).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from dragonfly2_tpu.procworld import (  # noqa: E402
+    compute_divergence,
+    real_facts,
+    run_procday,
+)
+from tools.bench_schema import write_artifact  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="procday")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--schedulers", type=int, default=2)
+    ap.add_argument("--daemons", type=int, default=3)
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="default: the scenario's full compressed day")
+    ap.add_argument("--tasks-per-round", type=int, default=4)
+    ap.add_argument("--workdir", default=None,
+                    help="planet state dir (default: a fresh temp dir)")
+    ap.add_argument("--out", default="BENCH_proc.json")
+    ap.add_argument("--sim-hosts", type=int, default=300,
+                    help="host count for the divergence-side sim run")
+    ap.add_argument("--no-sim", action="store_true",
+                    help="skip the simulator leg (no divergence block)")
+    args = ap.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dfproc-")
+    print(f"dfproc: planet workdir {workdir}", flush=True)
+    run = run_procday(
+        workdir, scenario=args.scenario, seed=args.seed,
+        schedulers=args.schedulers, daemons=args.daemons,
+        rounds=args.rounds, tasks_per_round=args.tasks_per_round,
+    )
+    st = run["stats"]
+    print(
+        f"dfproc: {st['completed']} completed, {st['lost_downloads']} lost, "
+        f"{st['kills']} kills, {st['failovers']} failovers, "
+        f"{st['restarts']} restarts in {run['timing']['wall_s']}s",
+        flush=True,
+    )
+
+    divergence = None
+    if not args.no_sim:
+        from dragonfly2_tpu.megascale.soak import run_megascale
+
+        print("dfproc: running the same spec through the simulator…",
+              flush=True)
+        sim = run_megascale(
+            args.scenario, num_hosts=args.sim_hosts, num_tasks=24,
+            seed=args.seed, rounds=run["rounds"], arrivals_per_round=16,
+        )
+        divergence = compute_divergence(real_facts(run), sim)
+        for name in sorted(divergence["metrics"]):
+            m = divergence["metrics"][name]
+            flag = "ok" if m["within"] else "OUT-OF-BAND"
+            print(f"  {name}: real={m['real']} sim={m['sim']} "
+                  f"value={m['value']} band={m['band']} {flag}")
+
+    summary = {
+        "scenario": run["scenario"],
+        "completed": st["completed"],
+        "lost_downloads": st["lost_downloads"],
+        "kills": st["kills"],
+        "restarts": st["restarts"],
+        "escalations": st["escalations"],
+        "pages_fired": run["slo"].get("pages_fired", 0),
+        "verdict_final": run["slo"].get("verdict_final"),
+        "divergence_all_within": (
+            divergence["all_within"] if divergence else None
+        ),
+    }
+    extra = {"proc": run.pop("proc")}
+    if divergence is not None:
+        extra["divergence"] = divergence
+    write_artifact(args.out, sys.argv, summary, runs=[run], extra=extra)
+    print(f"dfproc: wrote {args.out}", flush=True)
+
+    if st["lost_downloads"] > 0:
+        print("dfproc: LOST DOWNLOADS — the invariant failed", flush=True)
+        return 2
+    if divergence is not None and not divergence["all_within"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
