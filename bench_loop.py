@@ -198,6 +198,18 @@ def run(
         # round-trip, so device_call − link_rtt_probe ≈ the tick
         # kernel's real compute+transfer cost.
         "phases_p50_ms": _phase_p50(svc, control_ms),
+        # Phase-accounting seam (ISSUE 19): under the fused tick the
+        # split becomes candidate_fill (host sampling+grids) /
+        # legality_recheck (quarantine+blocklist+DAG prefilters) / pack
+        # (staging build) / fused_dispatch + d2h_wait (the ONE device
+        # conversation, aggregated as fused_device_call — a NEW key so
+        # trajectories never compare it against the pre-fused trivial
+        # transport's device_call) / emit (decode+apply+responses).
+        # control_dispatch keeps meaning "all host-side work per tick"
+        # on BOTH paths — re-derived from the recorder at commit — so
+        # its longitudinal comparison against r06 stays apples-to-apples.
+        "phase_seam": "fused" if getattr(svc, "_tick_mirror", None)
+                      is not None else "vectorized",
     })
 
     # topology snapshot feeding the GNN dataset
@@ -504,6 +516,77 @@ def _serving_costcards(svc) -> list[dict]:
                     card.output_bytes / max(model["d2h_bytes"], 1), 4
                 )
             out.append(row)
+    out.extend(_fused_costcards(svc, led))
+    return out
+
+
+def _fused_costcards(svc, led) -> list[dict]:
+    """Cost cards for the fused tick program (ops/tick.fused_tick_chunk),
+    captured by the same ledger at warmup — ZERO new compile signatures.
+
+    The fused entry's arguments are the (bsz, ROW) staging buffer PLUS
+    the device-resident mirror columns, so its argument_bytes is NOT the
+    per-tick PCIe traffic: the columns stay on device between ticks and
+    only the staging rows ship per chunk. The model therefore splits the
+    measured argument size into h2d_staging_bytes (the real per-chunk
+    H2D) and resident_cols_bytes (device-side, paid once per mirror
+    sync scatter, not per dispatch); the d2h model is the flat output
+    layout (ops/tick.out_layout). A mismatch means the staging/output
+    transport contract drifted from what XLA actually moves."""
+    from dragonfly2_tpu.ops import tick as tk
+
+    mirror = getattr(svc, "_tick_mirror", None)
+    if mirror is None:
+        return []
+    import re
+
+    k = svc.config.scheduler.filter_parent_limit
+    limit = svc.config.scheduler.candidate_parent_limit
+    row_bytes = tk.inbuf_row_bytes(k)
+    emit_led = svc.decisions is not None
+    out = []
+    entry = "scheduler.tick.fused_tick_chunk"
+    for card in led.cards(entry):
+        row = {
+            "entry": entry,
+            "signature": card.signature,
+            "measured": {
+                "flops": card.flops,
+                "bytes_accessed": card.bytes_accessed,
+                "argument_bytes": card.argument_bytes,
+                "output_bytes": card.output_bytes,
+                "temp_bytes": card.temp_bytes,
+            },
+            "bound": card.bound(),
+        }
+        # the staging buffer is the first argument in the signature:
+        # uint8[B, ROW] — B is the bucket (XLA's argument_size accounting
+        # folds resident columns in ways that don't subtract cleanly, so
+        # the shape in the compile signature is the reliable key)
+        match = re.search(r"uint8\[(\d+),(\d+)\]", card.signature_repr)
+        bucket = int(match.group(1)) if match else -1
+        if bucket in tk._EVAL_BUCKETS and match.group(2) == str(row_bytes):
+            staging = bucket * row_bytes
+            d2h = 4 * sum(
+                size for _, size, _, _ in
+                tk.out_layout(bucket, k, limit, emit_led)
+            )
+            row["model"] = {
+                "bucket": bucket,
+                # the real per-chunk PCIe traffic: staging H2D + flat D2H
+                "h2d_staging_bytes": staging,
+                # device-side argument residual — the mirror columns,
+                # which ship via incremental scatter, never per dispatch
+                "resident_cols_bytes": card.argument_bytes - staging,
+                "d2h_bytes": d2h,
+            }
+            # > 1.0 on the emit_packed (shadow-scoring) variant: its
+            # output additionally carries the device-packed feature
+            # buffer for the ml shadow entry
+            row["d2h_model_vs_measured"] = round(
+                card.output_bytes / max(d2h, 1), 4
+            )
+        out.append(row)
     return out
 
 
@@ -552,7 +635,12 @@ def summarize(results: list[dict]) -> dict:
             summary["tick_p50_ms"] = leg.get("value")
             phases = leg.get("phases_p50_ms", {})
             for key in ("control_dispatch", "device_call", "candidate_fill",
-                        "apply_selection", "report_ingest", "link_rtt_probe"):
+                        "apply_selection", "report_ingest", "link_rtt_probe",
+                        # fused-tick phase split (ISSUE 19): host phases
+                        # + the fused device conversation under its own
+                        # key (see the phase_seam note on the leg)
+                        "legality_recheck", "pack", "emit",
+                        "fused_dispatch", "d2h_wait", "fused_device_call"):
                 if key in phases:
                     summary[key] = phases[key]
             # model-vs-measured transfer bytes for the biggest matched
@@ -577,9 +665,15 @@ def summarize(results: list[dict]) -> dict:
                 summary["decision_regret_ms"] = dec["regret_ttc_ms"]
         elif m == "full_loop_ab_piece_cost_ms":
             summary["ab_ml_vs_default_cost"] = leg.get("ml_vs_default")
-    if "control_dispatch" in summary and "device_call" in summary:
+    # on the fused path the device conversation lives under
+    # fused_device_call (device_call would be the pre-fused transport)
+    device_key = (
+        "fused_device_call" if "fused_device_call" in summary
+        else "device_call"
+    )
+    if "control_dispatch" in summary and device_key in summary:
         summary["control_under_device"] = (
-            summary["control_dispatch"] < summary["device_call"]
+            summary["control_dispatch"] < summary[device_key]
         )
     return summary
 
@@ -610,10 +704,35 @@ def main() -> int:
         # contract + platform block across every bench driver
         from tools.bench_schema import write_artifact
 
+        # the notes block documents the phase-accounting seam for anyone
+        # reading the artifact cold: which cells stay longitudinally
+        # comparable across the fused-tick program change, and why
+        notes = {
+            "phase_seam": {
+                "seam": next(
+                    (r["phase_seam"] for r in results
+                     if isinstance(r, dict) and r.get("phase_seam")),
+                    "packed",
+                ),
+                "control_dispatch": "all host-side work per tick "
+                    "(report_ingest + pre_schedule + candidate_fill + "
+                    "legality_recheck + pack + emit under the fused seam) "
+                    "— longitudinally comparable across seams by "
+                    "construction",
+                "fused_device_call": "fused_dispatch + d2h_wait — a NEW "
+                    "key, never compared against the pre-fused "
+                    "trivial-transport device_call (the fused program "
+                    "does strictly more)",
+                "per_tick_cells": "tick_p50_ms and the per-phase cells "
+                    "are seam-scoped by benchwatch (a seam change "
+                    "redefines what a tick contains; cross-seam deltas "
+                    "are rig moves, not regressions)",
+            },
+        }
         write_artifact(
             args.artifact,
             ["python", "bench_loop.py"] + __import__("sys").argv[1:],
-            summary, results=results,
+            summary, results=results, extra={"notes": notes},
         )
     return 0
 

@@ -99,6 +99,11 @@ class _PeerMeta:
 
 
 class SchedulerService:
+    # flush batches at or under this many rows absorb through the scalar
+    # twin (_absorb_piece_reports_small); larger ones amortise the numpy
+    # machinery. Class-level so the equivalence test can force either path.
+    _absorb_scalar_max = 64
+
     def __init__(
         self,
         config: Config | None = None,
@@ -169,6 +174,31 @@ class SchedulerService:
         # decision-equivalence oracle.
         self.vectorized_control = bool(getattr(sched, "vectorized_control", True))
         self._slot_pidx: dict[str, np.ndarray] = {}
+        # Device-resident fused tick (ROADMAP item 2, ops/tick.py): the
+        # hot columns mirror onto the device and candidate fill → feature
+        # gather → scoring → selection run as ONE donated bucket-padded
+        # dispatch per chunk; only DAG legality, blocklist resolution and
+        # response emission stay host-side. Eligibility is decided once —
+        # every input is fixed at construction: the ml and plugin arms
+        # keep their own transports, and the probed-nt arm needs the
+        # host-side RTT gather the fused program deliberately excludes
+        # (nt WITHOUT probes zero-fills RTT on both paths, so it stays
+        # eligible). fused_tick=False keeps the numpy fill + packed
+        # transport as the decision-equivalence oracle.
+        self.fused_tick = bool(getattr(sched, "fused_tick", True))
+        self._tick_mirror = None
+        self._fused_dirty_tasks: set[str] = set()
+        if (
+            self.fused_tick
+            and self.vectorized_control
+            and self.plugin_evaluator is None
+            and not (self.ml_evaluator is not None and self.algorithm == "ml")
+            and self.algorithm in ("default", "nt")
+            and (self.probes is None or self.algorithm != "nt")
+        ):
+            from dragonfly2_tpu.ops.tick import TickMirror
+
+            self._tick_mirror = TickMirror(self.state, self._dag_capacity)
         # Reverse of _PeerMeta.held_parents: parent peer_id -> children
         # holding one of its host's upload slots. _leave_peer used to scan
         # EVERY peer's held_parents to find them (~200 us per leave at 10k
@@ -191,6 +221,8 @@ class SchedulerService:
         self._piece_buf_mu = threading.Lock()
         self._pending: dict[str, _Pending] = {}
         self._host_info: dict[str, msg.HostInfo] = {}
+        # host_id -> (HostInfo identity, its HostRecord) — see _host_record
+        self._host_record_cache: dict[str, tuple] = {}
         # Seed-peer trigger path (resource/seed_peer.go TriggerTask): seed
         # hosts announce with a non-normal type; first-seen tasks enqueue a
         # trigger the RPC edge pushes to one of them round-robin.
@@ -558,6 +590,8 @@ class SchedulerService:
             created_at_ns=time.time_ns(),
         )
         self._slot_pidx[req.task_id][slot] = peer_idx
+        if self._tick_mirror is not None:
+            self._fused_dirty_tasks.add(req.task_id)
         self._task_peers.setdefault(req.task_id, []).append(req.peer_id)
 
         scope = (
@@ -763,6 +797,8 @@ class SchedulerService:
                 return 0
             self._piece_buf = []
         n = len(buf)
+        if n <= self._absorb_scalar_max:
+            return self._absorb_piece_reports_small(buf)
         cols = np.asarray(buf, np.float64)
         peer = cols[:, 0].astype(np.int64)
         piece = cols[:, 1].astype(np.int64)
@@ -839,6 +875,123 @@ class SchedulerService:
                     PieceRecord(length=int(plen[r]), cost=int(pcost[r]),
                                 created_at=now_ns)
                 )
+        return n
+
+    def _absorb_piece_reports_small(self, buf: list) -> int:
+        """Scalar twin of the vectorised absorb for small flushes.
+
+        The completion flush valves (peer finish/fail, leave) drain a
+        handful of rows — one peer's last wave, ~10-30 reports — where
+        the vectorised apply is pure numpy-call overhead (~0.4 ms per
+        flush, the replay throughput ceiling at BENCH scale). This path
+        applies the SAME column mutations in the SAME order with python
+        ints/floats: bit-or accumulation per (peer, word) with popcount
+        deltas, sequential cost-ring writes (last-`capacity` retention
+        falls out of write order), per-row upload-count increments,
+        serving-edge totals applied in first-occurrence pair order, and
+        per-(child, parent) stats walked in sorted pair-key order — each
+        matching the vectorised path's float op order exactly, so the
+        two are bit-identical, not just approximately equivalent."""
+        st = self.state
+        n = len(buf)
+        now = time.time()
+        cap = st.piece_cost_capacity
+        nwords = st.piece_bitset_words
+        peer_host_col = st.peer_host
+        host_of: dict[int, int] = {}
+        upload_inc: dict[int, int] = {}
+        bit_acc: dict[tuple[int, int], int] = {}
+        ring: dict[int, list[float]] = {}
+        edges: dict[tuple[int, int], list] = {}
+        pairs: dict[tuple[int, int], list] = {}
+        for row in buf:
+            p = int(row[0])
+            word, bit = divmod(int(row[1]), 64)
+            pcost = float(row[3])
+            if 0 <= word < nwords:
+                key = (p, word)
+                bit_acc[key] = bit_acc.get(key, 0) | (1 << bit)
+            costs = ring.get(p)
+            if costs is None:
+                costs = ring[p] = []
+            costs.append(pcost)
+            par = int(row[4])
+            if par < 0:
+                continue
+            plen = int(row[2])
+            ph = host_of.get(par)
+            if ph is None:
+                ph = host_of[par] = int(peer_host_col[par])
+            upload_inc[ph] = upload_inc.get(ph, 0) + 1
+            if pcost > 0:
+                ch = host_of.get(p)
+                if ch is None:
+                    ch = host_of[p] = int(peer_host_col[p])
+                acc = edges.get((ch, ph))
+                if acc is None:
+                    acc = edges[(ch, ph)] = [0.0, 0]
+                acc[0] += plen / (pcost / 1e9)
+                acc[1] += 1
+            rows2 = pairs.get((p, par))
+            if rows2 is None:
+                rows2 = pairs[(p, par)] = []
+            rows2.append((plen, pcost))
+        for (p, word), mask in bit_acc.items():
+            before = int(st.peer_finished_bitset[p, word])
+            after = before | mask
+            if after != before:
+                st.peer_finished_bitset[p, word] = after
+                st.peer_finished_count[p] += (
+                    after.bit_count() - before.bit_count()
+                )
+        for p, costs in ring.items():
+            cur = int(st.peer_cost_cursor[p])
+            m = len(costs)
+            st.peer_piece_costs[p, [(cur + i) % cap for i in range(m)]] = costs
+            st.peer_cost_cursor[p] = (cur + m) % cap
+            st.peer_piece_cost_count[p] = min(
+                int(st.peer_piece_cost_count[p]) + m, cap
+            )
+            st.peer_updated_at[p] = now
+            st.peer_dirty[p] = True
+            h = host_of.get(p)
+            if h is None:
+                h = host_of[p] = int(peer_host_col[p])
+            if 0 <= h < st.max_hosts and st.host_alive[h]:
+                st.host_updated_at[h] = now
+        for ph, inc in upload_inc.items():
+            st.host_upload_count[ph] += inc
+        for (ch, ph), (tput, cnt) in edges.items():
+            k4 = (ch, self._slot_gen.get(ch, 0), ph, self._slot_gen.get(ph, 0))
+            acc = self._serving_edges.get(k4)
+            if acc is None and len(self._serving_edges) < self._serving_edge_cap:
+                acc = self._serving_edges[k4] = [0.0, 0]
+            if acc is not None:
+                acc[0] += tput
+                acc[1] += cnt
+                self._dirty_host_slots.add(ch)
+                self._dirty_host_slots.add(ph)
+        if pairs:
+            now_ns = time.time_ns()
+            for c, par in sorted(pairs):
+                rows2 = pairs[(c, par)]
+                child_pid = st._peer_id[c]
+                parent_pid = st._peer_id[par]
+                if child_pid is None or parent_pid is None:
+                    continue
+                meta = self._peer_meta.get(child_pid)
+                if meta is None:
+                    continue
+                stats = meta.parents.setdefault(
+                    parent_pid, {"pieces": [], "bytes": 0}
+                )
+                stats["bytes"] += sum(r[0] for r in rows2)
+                room = 10 - len(stats["pieces"])
+                for plen, pcost in rows2[:room] if room > 0 else ():
+                    stats["pieces"].append(
+                        PieceRecord(length=plen, cost=int(pcost),
+                                    created_at=now_ns)
+                    )
         return n
 
     def piece_failed(self, req: msg.DownloadPieceFailedRequest):
@@ -1084,6 +1237,39 @@ class SchedulerService:
                         limit=limit,
                     )
                 np.asarray(out)
+        if self._tick_mirror is not None:
+            # Fused-tick warms (ops/tick.py): the fused program for every
+            # bucket (+ its emit_packed variant feeding the warmed ml
+            # shadow entry, when a snapshot already serves) and the
+            # mirror's donated scatter signatures — all on zero-filled
+            # throwaway arrays, never the live mirror, so this stays
+            # background-thread safe like the rest of warmup.
+            from dragonfly2_tpu.ops import tick as tk
+
+            cols = tk.warm_cols(self.state, self._dag_capacity)
+            cost_c = self.state.piece_cost_capacity
+            loc_l = self.state.host_location.shape[1]
+            num_n = self.state.host_numeric.shape[1]
+            emit_led = self.decisions is not None
+            algorithm = (
+                self.algorithm if self.algorithm in ("default", "nt")
+                else "default"
+            )
+            for bsz in _EVAL_BUCKETS:
+                out = tk.fused_tick_chunk(
+                    tk.warm_inputs(bsz, k), cols, bsz, k, cost_c, loc_l,
+                    num_n, algorithm=algorithm, limit=limit,
+                    emit_led=emit_led, emit_packed=False,
+                )
+                np.asarray(out)
+                if warm_ml_shadow:
+                    out, _sbuf = tk.fused_tick_chunk(
+                        tk.warm_inputs(bsz, k), cols, bsz, k, cost_c,
+                        loc_l, num_n, algorithm=algorithm, limit=limit,
+                        emit_led=emit_led, emit_packed=True,
+                    )
+                    np.asarray(out)
+            tk.warm_scatters(self.state, self._dag_capacity)
         if warm_ml_shadow:
             with self.mu:
                 self._shadow_ml_ready = True
@@ -1136,6 +1322,26 @@ class SchedulerService:
                     buf, bsz, k, c, l, n, limit=limit, record_used=False
                 )
                 np.asarray(out)  # land compile + execution off the tick
+                if self._tick_mirror is not None:
+                    # the first shadowed FUSED tick needs the emit_packed
+                    # variant of the fused program too — warm it with the
+                    # same zero-filled discipline (ops/tick.py)
+                    from dragonfly2_tpu.ops import tick as tk
+
+                    fout, _sbuf = tk.fused_tick_chunk(
+                        tk.warm_inputs(bsz, k), tk.warm_cols(
+                            self.state, self._dag_capacity
+                        ), bsz, k, c, l, n,
+                        algorithm=(
+                            self.algorithm
+                            if self.algorithm in ("default", "nt")
+                            else "default"
+                        ),
+                        limit=limit,
+                        emit_led=self.decisions is not None,
+                        emit_packed=True,
+                    )
+                    np.asarray(fout)
         except Exception:  # noqa: BLE001 - shadow stays off; serving unaffected
             logger.exception("background shadow warm failed")
             return
@@ -1271,6 +1477,12 @@ class SchedulerService:
 
         k = self.config.scheduler.filter_parent_limit
         b = len(work)
+        if self._tick_mirror is not None:
+            # Device-resident fused tick: fill/gather/score/select run as
+            # one donated dispatch per chunk over the column mirrors; the
+            # packed-transport path below stays as the decision-
+            # equivalence oracle (scheduler.fused_tick=False).
+            return self._tick_fused(work, responses, k, b)
         # Candidate sampling is the same vectorised per-task draw on both
         # fill paths (shared _sample_rows helper, identical rng call
         # sequence), so the vectorised and per-peer loop fills are
@@ -1586,6 +1798,287 @@ class SchedulerService:
         recorder.commit()
         return responses
 
+    # ------------------------------------------------- fused device tick
+
+    def _tick_fused(self, work: list, responses: list, k: int, b: int) -> list:
+        """Device-resident tick body (ops/tick.py): the host draws the
+        candidate samples and runs the legality prefilters; everything
+        else — slot→peer-row resolution, validity/self/quarantine
+        masking, compaction, feature gather, scoring, top-k — is ONE
+        donated `fused_tick_chunk` dispatch per chunk over the column
+        mirrors, pipelined exactly like the packed path (chunk i's
+        decode+apply overlaps chunk i+1's device call).
+
+        Decision equivalence with the oracle holds chunk-by-chunk
+        because BOTH paths freeze their scoring inputs before the first
+        dispatch: the oracle gathers features once up front, the fused
+        path snapshots the mirrors once at sync — upload-slot counts and
+        DAG edges mutated by an earlier chunk's apply are invisible to
+        later chunks either way.
+
+        Phase accounting (the benchwatch seam): candidate_fill is the
+        host sampling+grids, legality_recheck the quarantine/blocklist/
+        DAG prefilters, pack the staging-buffer build, emit the decode +
+        apply + response build; fused_dispatch/d2h_wait are the device
+        conversation, aggregated as fused_device_call — a NEW key, so
+        r06's 0.3 ms trivial-transport device_call is never compared
+        against a program that now does the whole tick. control_dispatch
+        keeps meaning "all host-side work per tick" (re-derived from the
+        recorder at commit), so its longitudinal comparison against r06
+        stays apples-to-apples."""
+        from dragonfly2_tpu.ops import tick as tk
+
+        recorder = self.recorder
+        st = self.state
+        led = self.decisions
+        limit = self.config.scheduler.candidate_parent_limit
+        # --- candidate fill, host half: the SAME per-task-group sample
+        # draw as _fill_candidates_vec (shared _sample_rows helper,
+        # identical rng call sequence and skip conditions — the
+        # equivalence anchor), but only the sample/in-degree grids are
+        # materialized; slot resolution moves on-device.
+        child_peer_idx = np.fromiter(
+            (st.peer_index(p.peer_id) for p in work), np.int64, b
+        ).astype(np.int32)
+        child_dag_slot = np.fromiter(
+            (self._peer_meta[p.peer_id].dag_slot for p in work), np.int64, b
+        )
+        groups = self._group_rows_by_task(work)
+        samples = np.full((b, k), -1, np.int64)
+        ind = np.zeros((b, k), np.int32)
+        task_row = np.full(b, -1, np.int64)
+        task_rows: list[tuple] = []
+        for task_id, rows in groups.items():
+            dag = self._task_dag(task_id)
+            spx = self._slot_pidx.get(task_id)
+            live = np.flatnonzero(dag.present)
+            # fromiter, not asarray: _tick_fused is on the jit-hygiene
+            # hot list with NO allowlisted sync leaf — the fused tick's
+            # only device read-back is _drain_fused's
+            r = np.fromiter(rows, np.int64, len(rows))
+            task_rows.append((task_id, dag, r))
+            if live.size == 0 or spx is None:
+                continue
+            trow = st.task_index(task_id)
+            if trow is not None:
+                task_row[r] = trow
+            s = _sample_rows(self.rng, live, r.size, k)
+            cols_r = np.arange(s.shape[1])
+            samples[r[:, None], cols_r] = s
+            ind[r[:, None], cols_r] = dag.in_degree[s]
+        recorder.mark("candidate_fill")
+        # --- legality prefilters, host half: quarantine mask (same
+        # decay/release side effects, at the same logical point, as the
+        # oracle's per-tick check), blocklist rows resolved to peer rows
+        # at SAMPLE positions, and the DAG-legality superset over every
+        # sampled slot — the device ANDs each with candidate validity,
+        # which lands exactly the oracle's post-compaction batches.
+        if self.quarantine.active_count():
+            qmask = self._quarantined_slot_mask()
+        else:
+            qmask = np.zeros(st.max_hosts, bool)
+        bl0 = np.zeros((b, k), bool)
+        for i, pending in enumerate(work):
+            if not pending.blocklist:
+                continue
+            bidx = {st.peer_index(x) for x in pending.blocklist}
+            bidx.discard(None)
+            spx = self._slot_pidx.get(self._peer_meta[pending.peer_id].task_id)
+            if not bidx or spx is None:
+                continue
+            srow = samples[i]
+            prow = np.where(srow >= 0, spx[np.clip(srow, 0, None)], -1)
+            bl0[i] = np.isin(
+                prow, np.fromiter(bidx, np.int64, len(bidx))
+            )
+        ca0 = np.zeros((b, k), bool)
+        for task_id, dag, r in task_rows:
+            sub = samples[r]
+            rr, cc = np.nonzero(sub >= 0)
+            if rr.size == 0:
+                continue
+            ca0[r[rr], cc] = dag.can_add_edges_pairs(
+                sub[rr, cc], child_dag_slot[r][rr]
+            )
+        recorder.mark("legality_recheck")
+
+        cost_c = st.piece_cost_capacity
+        loc_l = st.host_location.shape[1]
+        num_n = st.host_numeric.shape[1]
+        algorithm = (
+            self.algorithm if self.algorithm in ("default", "nt") else "default"
+        )
+        arm_code = ARM_CODES[algorithm]
+        # Counterfactual shadow arm: fused eligibility already excludes
+        # the ml/plugin active arms, so the only possible shadow is the
+        # committed ml snapshot re-scoring the same candidate batch —
+        # fed from the pack-identical buffer the fused program emits ON
+        # DEVICE (emit_packed), through the already-warmed packed entry.
+        shadow_mode = None
+        shadow_snap = None
+        shadow_arm_code = -1
+        shadow_due = (
+            self._tick_counter
+            % max(int(getattr(self.config.scheduler, "shadow_every", 1)), 1)
+            == 0
+        )
+        if (
+            led is not None
+            and self.shadow_scoring
+            and shadow_due
+            and self.ml_evaluator is not None
+        ):
+            shadow_snap = self.ml_evaluator.serving_snapshot()
+            if shadow_snap is not None:
+                if self._shadow_ml_ready:
+                    shadow_mode = "ml"
+                    shadow_arm_code = ARM_CODES["ml"]
+                else:
+                    self._ensure_shadow_warm()
+        # Whole-batch result arrays the per-chunk drains fill: the apply
+        # path (_apply_chunk_batch, UNCHANGED from the oracle) and the
+        # ledger indexing read full-batch arrays by row.
+        cand_peer_idx = np.zeros((b, k), np.int32)
+        cand_slots = np.full((b, k), -1, np.int64)
+        cand_host_slots = np.zeros((b, k), np.int32)
+        cand_count = np.zeros(b, np.int64)
+        emit_led = led is not None
+        led_feats = np.zeros((b, k, 8), np.float32) if emit_led else None
+        led_ctx = None
+        if led is not None:
+            led_ctx = {
+                "tick": self._tick_counter,
+                "arm": arm_code,
+                "feats": led_feats,
+                "child_peer_idx": child_peer_idx,
+                "child_host_slots": st.peer_host[child_peer_idx].astype(np.int32),
+                "cand_host_slots": cand_host_slots,
+                "slot_of_row": np.full(b, -1, np.int64),
+                "seq_of_row": np.full(b, -1, np.int64),
+            }
+        # Mirror sync: fold every dirty peer row / dirty task slot table /
+        # changed host column into the device mirrors and snapshot this
+        # tick's cols — part of the device conversation for attribution.
+        t0 = time.perf_counter()
+        cols = self._tick_mirror.sync(
+            self._slot_pidx, st.task_index, self._fused_dirty_tasks, qmask
+        )
+        recorder.add("fused_dispatch", (time.perf_counter() - t0) * 1e3)
+        recorder.sync()
+        qskip_total = 0
+        shadow_inflight: list[tuple[int, int, object]] = []
+
+        def _dispatch_fused(s: int, e: int):
+            """Build rows [s:e)'s staging buffer and issue the fused
+            device call WITHOUT blocking (jax async dispatch); with the
+            shadow arm on, its packed re-score dispatches right behind
+            the serving call on the device-built buffer."""
+            bsz = _bucket_rows(e - s)
+            t0 = time.perf_counter()
+            inbuf = tk.build_inbuf(
+                bsz, samples[s:e], ind[s:e], task_row[s:e],
+                child_peer_idx[s:e], bl0[s:e], ca0[s:e],
+            )
+            recorder.add("pack", (time.perf_counter() - t0) * 1e3)
+            recorder.sync()
+            t0 = time.perf_counter()
+            out = tk.fused_tick_chunk(
+                inbuf, cols, bsz, k, cost_c, loc_l, num_n,
+                algorithm=algorithm, limit=limit,
+                emit_led=emit_led, emit_packed=shadow_mode is not None,
+            )
+            recorder.add("fused_dispatch", (time.perf_counter() - t0) * 1e3)
+            recorder.sync()
+            if shadow_mode is not None:
+                out, sbuf = out
+                t_sh = time.perf_counter()
+                shadow_packed = self.ml_evaluator.schedule_from_packed(
+                    sbuf, bsz, k, cost_c, loc_l, num_n, limit=limit,
+                    snap=shadow_snap, record_used=False,
+                )
+                shadow_inflight.append((s, e, shadow_packed))
+                recorder.add(
+                    "shadow_score", (time.perf_counter() - t_sh) * 1e3
+                )
+                recorder.sync()
+            return out
+
+        def _drain_fused(s: int, e: int, out, overlapped: bool) -> None:
+            """Block on chunk [s:e)'s single D2H (the flat fused result
+            buffer — the tick's ONLY device read-back; jit-hygiene
+            D2H_ALLOWLIST row), decode it into the whole-batch arrays,
+            then run the UNCHANGED host apply: DAG edge adds, upload
+            accounting, response emission, ledger rows."""
+            nonlocal qskip_total
+            bsz = _bucket_rows(e - s)
+            t_wait = time.perf_counter()
+            arr = np.asarray(out)
+            t0 = time.perf_counter()
+            recorder.add("d2h_wait", (t0 - t_wait) * 1e3)
+            dec = tk.decode_out(arr, bsz, k, limit, emit_led)
+            m = e - s
+            cand_peer_idx[s:e] = dec["cand_peer_idx"][:m]
+            cand_slots[s:e] = dec["cand_slots"][:m]
+            cand_host_slots[s:e] = dec["cand_host_slots"][:m]
+            cand_count[s:e] = dec["cand_valid"][:m].sum(axis=1)
+            if led_feats is not None:
+                led_feats[s:e] = dec["led_feats"][:m]
+            qskip_total += int(dec["quarantine_skipped"][0])
+            selected, selected_valid, selected_scores = ev.unpack_selection(
+                np.ascontiguousarray(dec["selection"][:m])
+            )
+            self._apply_chunk_batch(
+                work, s, e, selected, selected_valid, selected_scores,
+                cand_peer_idx, cand_slots, cand_count, responses,
+                led_ctx=led_ctx,
+            )
+            dt = (time.perf_counter() - t0) * 1e3
+            recorder.add("emit", dt)
+            if overlapped:
+                recorder.add("overlap", dt)
+            recorder.sync()
+
+        # Double-buffered dispatch, the PR-4 pipeline: chunk i+1's
+        # staging build + device call issue before chunk i's D2H, chunk
+        # i's decode+apply runs while chunk i+1 executes on the device.
+        stride = _chunk_stride(b)
+        spans = [(s, min(s + stride, b)) for s in range(0, b, stride)]
+        in_flight: tuple | None = None
+        for s, e in spans:
+            t0 = time.perf_counter()
+            out = _dispatch_fused(s, e)
+            if in_flight is not None:
+                recorder.add("overlap", (time.perf_counter() - t0) * 1e3)
+                _drain_fused(*in_flight, overlapped=True)
+            in_flight = (s, e, out)
+        _drain_fused(*in_flight, overlapped=False)
+        if qskip_total:
+            # same counter, same tick, as the oracle's fill-time incs —
+            # the skip decision just came back from the device
+            self._series.quarantine_skipped.labels().inc(qskip_total)
+        if shadow_inflight and led_ctx is not None:
+            self._drain_shadow(
+                shadow_inflight, led_ctx["slot_of_row"],
+                led_ctx["seq_of_row"], shadow_arm_code,
+            )
+        # Phase-accounting seam (benchwatch longitudinal comparison):
+        # control_dispatch stays "all host-side work per tick" — the
+        # fused split's host phases — while the device conversation
+        # aggregates under the NEW fused_device_call key (comparing it
+        # against the trivial-transport r06 device_call would be a
+        # guaranteed false regression, the program does strictly more).
+        recorder.add("control_dispatch", (
+            recorder.value("report_ingest") + recorder.value("pre_schedule")
+            + recorder.value("candidate_fill")
+            + recorder.value("legality_recheck")
+            + recorder.value("pack") + recorder.value("emit")
+        ))
+        recorder.add("fused_device_call", (
+            recorder.value("fused_dispatch") + recorder.value("d2h_wait")
+        ))
+        recorder.commit()
+        return responses
+
     # ------------------------------------------------- columnar tick ops
 
     def _sample_candidates(self, work: list, k: int):
@@ -1794,23 +2287,39 @@ class SchedulerService:
         the decision ledger as one block record per chunk."""
         st = self.state
         limit = self.config.scheduler.candidate_parent_limit
-        # pass 1: decode selections per row, group DAG edge adds per task
+        # pass 1: decode selections per row, group DAG edge adds per task.
+        # One tolist() per array up front: the loop below touches every
+        # (row, j) cell, and python-list indexing beats numpy scalar
+        # indexing ~10x on this all-scalar walk (same values — tolist
+        # converts float32 cells to the identical python float the old
+        # per-cell float() produced).
+        sel_l = np.asarray(selected)[: e - s].tolist()
+        val_l = np.asarray(selected_valid)[: e - s].tolist()
+        sco_l = np.asarray(selected_scores)[: e - s].tolist()
+        cnt_l = np.asarray(cand_count[s:e]).tolist()
+        slots_l = np.asarray(cand_slots[s:e]).tolist()
+        cpi_l = np.asarray(cand_peer_idx[s:e]).tolist()
         rows_sel: list = [None] * (e - s)
         by_task: dict[str, list[int]] = {}
         for row, i in enumerate(range(s, e)):
             pending = work[i]
             meta = self._peer_meta[pending.peer_id]
-            count = int(cand_count[i])
+            count = cnt_l[row]
+            vrow = val_l[row]
+            srow = sel_l[row]
+            scrow = sco_l[row]
+            row_slots = slots_l[row]
+            row_pidx = cpi_l[row]
             pslots, ppidx, pscores, ppos = [], [], [], []
             for j in range(limit):
-                if not selected_valid[row, j]:
+                if not vrow[j]:
                     break
-                pos = int(selected[row, j])
+                pos = srow[j]
                 if pos >= count:
                     continue
-                pslots.append(int(cand_slots[i, pos]))
-                ppidx.append(int(cand_peer_idx[i, pos]))
-                pscores.append(float(selected_scores[row, j]))
+                pslots.append(row_slots[pos])
+                ppidx.append(row_pidx[pos])
+                pscores.append(scrow[j])
                 ppos.append(pos)
             if not pslots:
                 pending.retries += 1
@@ -1823,13 +2332,31 @@ class SchedulerService:
         accepted: dict[int, np.ndarray] = {}
         for task_id, task_rows in by_task.items():
             dag = self._task_dag(task_id)
+            if len(task_rows) == 1:
+                # dominant shape (~one decision per task per tick): the
+                # scalar single-group twin skips the grouped batch's array
+                # construction and staleness bookkeeping, same mask
+                r = task_rows[0]
+                accepted[r] = dag.add_edges_single(
+                    rows_sel[r][2], rows_sel[r][1].dag_slot
+                )
+                continue
             acc = dag.add_edges_grouped(
                 [np.asarray(rows_sel[r][2], np.int64) for r in task_rows],
                 np.asarray([rows_sel[r][1].dag_slot for r in task_rows], np.int64),
             )
             for r, a in zip(task_rows, acc):
-                accepted[r] = a
-        # pass 3: responses + upload accounting, in row order
+                accepted[r] = a.tolist()
+        # pass 3: responses + upload accounting, in row order (attribute
+        # lookups hoisted: this loop runs once per scheduled peer per tick
+        # and its dict/array accessors showed up in the tick profile)
+        peer_id_of = st._peer_id
+        peer_host_col = st.peer_host
+        peer_state_col = st.peer_state
+        meta_get = self._peer_meta.get
+        host_get = self._host_info.get
+        children_of = self._children_of_parent
+        pending_pop = self._pending.pop
         upload_hosts: list[int] = []
         rec_rows: list[int] = []
         rec_sel_pos: list = []
@@ -1852,18 +2379,16 @@ class SchedulerService:
                 if not ok:
                     kept_flags.append(False)
                     continue
-                pid = st._peer_id[pid_idx]
-                pmeta = self._peer_meta.get(pid) if pid is not None else None
+                pid = peer_id_of[pid_idx]
+                pmeta = meta_get(pid) if pid is not None else None
                 if pmeta is None:
                     kept_flags.append(False)
                     continue
                 kept_flags.append(True)
-                upload_hosts.append(int(st.peer_host[pid_idx]))
+                upload_hosts.append(int(peer_host_col[pid_idx]))
                 meta.held_parents.add(pid)
-                self._children_of_parent.setdefault(pid, set()).add(
-                    pending.peer_id
-                )
-                host = self._host_info.get(pmeta.host_id)
+                children_of.setdefault(pid, set()).add(pending.peer_id)
+                host = host_get(pmeta.host_id)
                 kept.append(
                     msg.CandidateParent(
                         peer_id=pid,
@@ -1871,7 +2396,7 @@ class SchedulerService:
                         ip=host.ip if host else "",
                         port=host.port if host else 0,
                         download_port=host.download_port if host else 0,
-                        state=_STATE_DISPLAY[int(st.peer_state[pid_idx])],
+                        state=_STATE_DISPLAY[int(peer_state_col[pid_idx])],
                         score=score,
                     )
                 )
@@ -1879,7 +2404,7 @@ class SchedulerService:
                 pending.retries += 1
                 continue  # stays pending (all selections DAG-rejected)
             responses.append(self._finish_normal_response(pending, meta, kept))
-            self._pending.pop(pending.peer_id, None)
+            pending_pop(pending.peer_id, None)
             if led_ctx is not None:
                 i = s + row
                 pad = limit_pad - len(ppos)
@@ -2067,7 +2592,7 @@ class SchedulerService:
                     ip=host.ip if host else "",
                     port=host.port if host else 0,
                     download_port=host.download_port if host else 0,
-                    state=PeerState(int(self.state.peer_state[pidx])).display,
+                    state=_STATE_DISPLAY[int(self.state.peer_state[pidx])],
                     score=score,
                 )
             )
@@ -2142,7 +2667,7 @@ class SchedulerService:
                     id=pid,
                     tag=pmeta.tag,
                     application=pmeta.application,
-                    state=PeerState(int(self.state.peer_state[pidx])).display,
+                    state=_STATE_DISPLAY[int(self.state.peer_state[pidx])],
                     cost=sum(p.cost for p in stats["pieces"]),
                     upload_piece_count=len(stats["pieces"]),
                     finished_piece_count=int(self.state.peer_finished_count[pidx]),
@@ -2179,6 +2704,22 @@ class SchedulerService:
         self.storage.create_download(record)
 
     def _host_record(self, host: msg.HostInfo) -> HostRecord:
+        # memoised per announcement object: a HostInfo is immutable once
+        # registered (re-announce replaces the _host_info entry, which
+        # misses the identity check and rebuilds), and records only ever
+        # serialise the HostRecord — so sharing one instance across the
+        # per-completion download records is safe and skips ~2 nested
+        # dataclass builds per record on the replay critical path
+        cached = self._host_record_cache.get(host.host_id)
+        if cached is not None and cached[0] is host:
+            return cached[1]
+        rec = self._build_host_record(host)
+        if len(self._host_record_cache) > 4 * self.state.max_hosts:
+            self._host_record_cache.clear()
+        self._host_record_cache[host.host_id] = (host, rec)
+        return rec
+
+    def _build_host_record(self, host: msg.HostInfo) -> HostRecord:
         return HostRecord(
             id=host.host_id,
             type=host.host_type,
@@ -2207,6 +2748,8 @@ class SchedulerService:
             self._dags[task_id] = dag
             # columnar twin of _dag_slot_peer: DAG slot -> SoA peer row
             self._slot_pidx[task_id] = np.full(self._dag_capacity, -1, np.int32)
+            if self._tick_mirror is not None:
+                self._fused_dirty_tasks.add(task_id)
         return dag
 
     def _alloc_dag_slot(self, task_id: str, peer_id: str, dag: TaskDAG) -> int:
@@ -2263,6 +2806,8 @@ class SchedulerService:
         spx = self._slot_pidx.get(meta.task_id)
         if spx is not None and 0 <= meta.dag_slot < spx.shape[0]:
             spx[meta.dag_slot] = -1
+            if self._tick_mirror is not None:
+                self._fused_dirty_tasks.add(meta.task_id)
         peers = self._task_peers.get(meta.task_id)
         if peers and peer_id in peers:
             peers.remove(peer_id)

@@ -80,6 +80,16 @@ class SchedulerConfig:
     # decision-equivalence oracle (tests/test_control_equivalence.py),
     # not as a production mode.
     vectorized_control: bool = True
+    # Device-resident fused tick (ops/tick.py): candidate fill, feature
+    # gather, scoring and selection run as ONE donated bucket-padded XLA
+    # program over device-mirrored SoA columns; only DAG legality,
+    # blocklist resolution and response emission stay host-side. False
+    # falls back to the numpy fill + packed-transport path, kept as the
+    # decision-equivalence oracle (tests/test_fused_tick.py) — paired
+    # seeds must produce IDENTICAL selections including scores. Only
+    # effective with vectorized_control on a rule-blend arm (the ml and
+    # plugin arms keep the packed/dict transports).
+    fused_tick: bool = True
     # Decision provenance ledger (telemetry/decisions.py): a bounded
     # columnar ring recording every applied selection's candidate set,
     # feature rows, scores, chosen parent and joined outcome. On by

@@ -156,6 +156,47 @@ class TaskDAG:
         n = parents.shape[0]
         if n == 0:
             return np.zeros(0, bool)
+        if n <= 32:
+            # scalar twin of the vectorised checks below: the tick calls
+            # this once per task with a handful of pairs (one pending peer
+            # x k samples, or a few selected parents), where a dozen
+            # whole-array numpy ops on 4-element arrays are pure call
+            # overhead (~25 us/call, two call sites per task per tick)
+            pl = parents.tolist()
+            cl = children.tolist()
+            cap = self.capacity
+            adj = self.adj
+            present = self.present
+            out_deg = self.out_degree
+            ok = np.zeros(n, bool)
+            need_idx: list[int] = []
+            for i in range(n):
+                p = pl[i]
+                c = cl[i]
+                if (
+                    p == c
+                    or not (0 <= p < cap and 0 <= c < cap)
+                    or not (present[p] and present[c])
+                    or (int(adj[p, c >> 6]) >> (c & 63)) & 1
+                ):
+                    continue
+                ok[i] = True
+                if out_deg[c] > 0:
+                    need_idx.append(i)
+            if need_idx:
+                from dragonfly2_tpu import native
+
+                idx = np.asarray(need_idx, np.int64)
+                batch = native.dag_reachable_batch(
+                    self.adj, children[idx], parents[idx]
+                )
+                if batch is not None:
+                    ok[idx] &= ~batch
+                else:
+                    for i in need_idx:
+                        if self.reachable(cl[i], pl[i]):
+                            ok[i] = False
+            return ok
         p_in = (parents >= 0) & (parents < self.capacity)
         c_in = (children >= 0) & (children < self.capacity)
         safe_p = np.where(p_in, parents, 0)
@@ -292,6 +333,69 @@ class TaskDAG:
                     affected |= self._reach_bitset(child)
             results.append(ok)
         return results
+
+    def add_edges_single(self, parents: list, child: int) -> list:
+        """Python-int twin of a ONE-group ``add_edges_grouped`` call — the
+        dominant shape on the batched apply path (~one scheduling decision
+        per task per tick leaves most groups with a single child). Same
+        accepted mask, no array construction or staleness bookkeeping:
+        with a single child the batch's `affected` set is always empty at
+        check time, and legality against the pre-call graph is sound for
+        the same reason as ``add_edges_from`` (every new edge ends at
+        `child`, so no add changes reachability FROM `child`).
+
+        `parents` is a plain list of python ints; returns a list of bools
+        aligned with it."""
+        cap = self.capacity
+        present = self.present
+        adj = self.adj
+        out_deg = self.out_degree
+        c = int(child)
+        n = len(parents)
+        ok = [False] * n
+        if not (0 <= c < cap and present[c]):
+            return ok
+        check_cycle = out_deg[c] > 0
+        need: list[int] = []
+        for i in range(n):
+            p = parents[i]
+            if (
+                p == c
+                or not (0 <= p < cap)
+                or not present[p]
+                or (int(adj[p, c >> 6]) >> (c & 63)) & 1
+            ):
+                continue
+            ok[i] = True
+            if check_cycle:
+                need.append(i)
+        if need:
+            from dragonfly2_tpu import native
+
+            idx = np.asarray(need, np.int64)
+            pn = np.asarray([parents[i] for i in need], np.int64)
+            batch = native.dag_reachable_batch(
+                adj, np.full(len(need), c, np.int64), pn
+            )
+            if batch is not None:
+                for j, i in enumerate(need):
+                    if batch[j]:
+                        ok[i] = False
+            else:
+                for i in need:
+                    if self.reachable(c, parents[i]):
+                        ok[i] = False
+        seen: set[int] = set()
+        for i in range(n):
+            if not ok[i]:
+                continue
+            p = parents[i]
+            if p in seen:
+                ok[i] = False
+                continue
+            seen.add(p)
+            self._add_edge_unchecked(p, c)
+        return ok
 
     def _reach_bitset(self, src: int) -> np.ndarray:
         """{src} ∪ descendants(src) as a word-bitset (numpy BFS over

@@ -1,0 +1,587 @@
+"""Device-resident fused tick: the scheduler control plane as ONE donated
+XLA program (ROADMAP item 2, the last of the original five tentpoles).
+
+BENCH_r06 pinned the imbalance this module removes: control_dispatch p50
+6.7 ms of host-side numpy per tick against 0.3 ms of device work. Every
+phase inside that 6.7 ms — masked candidate fill, validity/self/
+quarantine masking, feature gather, scoring, top-k — is exactly the
+gather/compact/reduce shape `jax.lax` compiles well (the sparse-on-dense
+move of PAPERS.md 1906.11786 applied to the control plane itself). So the
+hot scheduler columns live HERE as device arrays, updated incrementally
+from the SoA state's dirty tracking, and `fused_tick_chunk` runs fill →
+gather → score → select in a single bucket-padded dispatch. Only the DAG
+cycle re-check, blocklist resolution and response emission stay host-side,
+overlapped with the next chunk's device call per the PR-4 pipeline.
+
+Equivalence contract (tests/test_fused_tick.py): with the same seed, the
+fused tick and the numpy oracle (`scheduler.fused_tick=False`) produce
+IDENTICAL selections including scores. Three properties carry that:
+
+- the HOST still draws the candidate samples (shared `_sample_rows`, same
+  rng call sequence) — the device program consumes the sample grid, it
+  never randomizes;
+- every device-side gather replicates the oracle's junk-at-invalid
+  semantics (`safe` index 0 → peer row 0 / clipped host row 0) and the
+  packed transport's int64→int32 truncation (`astype` C-wrap), so the
+  scoring inputs are bit-identical to what `pack_eval_batch` ships;
+- scoring/selection reuse the SAME traced functions as the packed path
+  (`ops.evaluator.evaluate/filter_candidates`, `ops.topk.masked_top_k`),
+  not a reimplementation.
+
+Transport: one (bsz, ROW) uint8 staging buffer in (donated — fresh per
+chunk), one flat float32 buffer out (selection + compacted candidate
+columns + optional ledger features, int fields bitcast so the tick pays
+exactly one D2H per chunk). With ``emit_packed`` the program additionally
+emits a `pack_eval_batch`-identical uint8 buffer ON DEVICE, so the
+counterfactual shadow arm (PR 13) feeds `schedule_from_packed` without the
+host ever materializing features.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dragonfly2_tpu.config.constants import CONSTANTS
+from dragonfly2_tpu.ops import evaluator as ev
+from dragonfly2_tpu.ops.topk import masked_top_k
+
+# The fused entry and the mirror scatters run under the same closed bucket
+# discipline as the evaluator programs: every batch pads to one of these
+# row counts, so the compiled-signature set is fixed at warmup
+# (tools/dflint dfshape BUCKET lattice; cluster/scheduler.py warms each).
+_EVAL_BUCKETS = (64, 256, 1024)
+
+
+def _bucket_rows(n: int) -> int:
+    for cap in _EVAL_BUCKETS:
+        if n <= cap:
+            return cap
+    return _EVAL_BUCKETS[-1]
+
+
+# ---------------------------------------------------------------- inbuf
+# Host -> device staging row: the per-tick control inputs the host still
+# owns (sampled DAG slots, in-degrees, task/child rows, the blocklist and
+# DAG-legality supersets). i4 fields first (4-aligned at offset 0), u1
+# tails, row padded to x4 — the same alignment idiom as the evaluator's
+# packed transport.
+
+def inbuf_row_bytes(k: int) -> int:
+    return (4 * k + 4 * k + 4 + 4 + 2 * k + 3) // 4 * 4
+
+
+def build_inbuf(bsz: int, samples: np.ndarray, in_degree: np.ndarray,
+                task_row: np.ndarray, child_peer: np.ndarray,
+                blocklist0: np.ndarray, can_add0: np.ndarray) -> np.ndarray:
+    """(bsz, ROW) uint8 staging buffer for rows [0:b) of the tick's
+    control inputs; pad rows carry samples == -1 (0xFF bytes) so they are
+    fully invalid on device — a zero fill would alias DAG slot 0."""
+    b, k = samples.shape
+    buf = np.zeros((bsz, inbuf_row_bytes(k)), np.uint8)
+    if bsz > b:
+        buf[b:, : 4 * k] = 0xFF
+    buf[:b, : 4 * k] = (
+        np.ascontiguousarray(samples.astype(np.int32)).view(np.uint8).reshape(b, 4 * k)
+    )
+    buf[:b, 4 * k : 8 * k] = (
+        np.ascontiguousarray(in_degree.astype(np.int32)).view(np.uint8).reshape(b, 4 * k)
+    )
+    buf[:b, 8 * k : 8 * k + 4] = (
+        np.ascontiguousarray(task_row.astype(np.int32)).view(np.uint8).reshape(b, 4)
+    )
+    buf[:b, 8 * k + 4 : 8 * k + 8] = (
+        np.ascontiguousarray(child_peer.astype(np.int32)).view(np.uint8).reshape(b, 4)
+    )
+    buf[:b, 8 * k + 8 : 9 * k + 8] = blocklist0.astype(np.uint8)
+    buf[:b, 9 * k + 8 : 10 * k + 8] = can_add0.astype(np.uint8)
+    return buf
+
+
+def _decode_inbuf(buf, b: int, k: int) -> dict:
+    """Traced inverse of `build_inbuf`: static-offset slices + bitcasts."""
+    def i32(lo: int, hi: int):
+        seg = jax.lax.slice(buf, (0, lo), (b, hi))
+        return jax.lax.bitcast_convert_type(seg.reshape(b, -1, 4), jnp.int32)
+
+    return {
+        "samples": i32(0, 4 * k),                            # (b, k)
+        "in_degree": i32(4 * k, 8 * k),                      # (b, k)
+        "task_row": i32(8 * k, 8 * k + 4)[:, 0],             # (b,)
+        "child_peer": i32(8 * k + 4, 8 * k + 8)[:, 0],       # (b,)
+        "blocklist0": jax.lax.slice(
+            buf, (0, 8 * k + 8), (b, 9 * k + 8)).astype(bool),
+        "can_add0": jax.lax.slice(
+            buf, (0, 9 * k + 8), (b, 10 * k + 8)).astype(bool),
+    }
+
+
+# ----------------------------------------------------------------- out
+# Device -> host result: ONE flat float32 buffer per chunk (int segments
+# bitcast, never arithmetically converted), so the drain pays a single
+# D2H regardless of how many logical outputs ride along.
+
+def out_layout(b: int, k: int, limit: int, emit_led: bool) -> list[tuple]:
+    """[(name, flat_size, shape, dtype)] segments of the flat output."""
+    segs = [
+        ("selection", b * limit * 2, (b, limit, 2), np.float32),
+        ("cand_peer_idx", b * k, (b, k), np.int32),
+        ("cand_slots", b * k, (b, k), np.int32),
+        ("cand_host_slots", b * k, (b, k), np.int32),
+        ("cand_valid", b * k, (b, k), np.int32),
+        ("quarantine_skipped", 1, (1,), np.int32),
+    ]
+    if emit_led:
+        segs.append(("led_feats", b * k * 8, (b, k, 8), np.float32))
+    return segs
+
+
+def decode_out(arr: np.ndarray, b: int, k: int, limit: int,
+               emit_led: bool) -> dict:
+    """Host-side decode of the flat fused output (a contiguous float32
+    np array — the drain's single np.asarray) into named views."""
+    out = {}
+    off = 0
+    for name, size, shape, dt in out_layout(b, k, limit, emit_led):
+        seg = arr[off : off + size]
+        if dt is np.int32:
+            seg = seg.view(np.int32)
+        out[name] = seg.reshape(shape)
+        off += size
+    return out
+
+
+# ------------------------------------------------------------ the program
+
+def _i32_as_f32(x):
+    return jax.lax.bitcast_convert_type(x.astype(jnp.int32), jnp.float32)
+
+
+def _ring_ordered(ring, cursor, count, c: int):
+    """Traced twin of state.cluster._ordered_costs_batch: unroll (..., C)
+    cost rings so index 0 is oldest."""
+    idx = jnp.arange(c, dtype=jnp.int32)
+    start = jnp.where(count[..., None] >= c, cursor[..., None], 0)
+    gather = (start + idx) % c
+    return jnp.take_along_axis(ring, gather, axis=-1)
+
+
+def _device_pack(values: dict, b: int, k: int, c: int, l: int, n: int):
+    """Build a `pack_eval_batch`-identical uint8 buffer ON DEVICE from the
+    fused program's gathered features — byte-for-byte the buffer the host
+    oracle would pack, so `schedule_from_packed` (the shadow arm) consumes
+    it with its already-warmed bucket signatures and nothing recompiles."""
+    layout, total = ev._packed_layout(b, k, c, l, n)
+    segs = []
+    pos = 0
+    for name, dt, shape, off, nbytes in layout:
+        if off > pos:
+            segs.append(jnp.zeros(off - pos, jnp.uint8))
+        v = values[name]
+        if dt == "u1":
+            seg = v.astype(jnp.uint8).reshape(-1)
+        elif dt == "i1":
+            seg = jax.lax.bitcast_convert_type(
+                v.astype(jnp.int8), jnp.uint8).reshape(-1)
+        elif dt == "i4":
+            seg = jax.lax.bitcast_convert_type(
+                v.astype(jnp.int32), jnp.uint8).reshape(-1)
+        else:  # f4
+            seg = jax.lax.bitcast_convert_type(
+                v.astype(jnp.float32), jnp.uint8).reshape(-1)
+        segs.append(seg)
+        pos = off + nbytes
+    if total > pos:
+        segs.append(jnp.zeros(total - pos, jnp.uint8))
+    return jnp.concatenate(segs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "b", "k", "c", "l", "n", "algorithm", "limit", "emit_led",
+        "emit_packed",
+    ),
+    # The staging buffer is consumed exactly once (the tick builds a
+    # fresh one per chunk, warmup likewise), so XLA may reuse its device
+    # allocation for outputs/scratch. Callers pass a host np.uint8 array;
+    # donation touches only the transient device copy.
+    donate_argnums=(0,),
+)
+def fused_tick_chunk(
+    inbuf,
+    cols: dict,
+    b: int,
+    k: int,
+    c: int,
+    l: int,
+    n: int,
+    algorithm: str = "default",
+    limit: int = CONSTANTS.CANDIDATE_PARENT_LIMIT,
+    emit_led: bool = True,
+    emit_packed: bool = False,
+):
+    """ONE dispatch = slot→peer-row resolution + validity/self/quarantine
+    masking + stable left-compaction + feature gather + scoring + masked
+    top-k, over the device-resident column mirrors in `cols`.
+
+    Returns the flat float32 result buffer (`decode_out` layout), plus a
+    pack-identical uint8 shadow buffer when ``emit_packed``.
+    """
+    f = _decode_inbuf(inbuf, b, k)
+    samples, ind0 = f["samples"], f["in_degree"]
+    task_row, child = f["task_row"], f["child_peer"]
+    ps = cols["peer_scalars"]          # (P, 7) int32
+    slot_tbl = cols["slot_pidx"]       # (T, S) int32
+
+    # --- fill: slot matrix -> peer rows, validity, quarantine ----------
+    # (the oracle's _fill_candidates_vec lines, as array ops on mirrors)
+    tclip = jnp.clip(task_row, 0, slot_tbl.shape[0] - 1)
+    sclip = jnp.clip(samples, 0, slot_tbl.shape[1] - 1)
+    pidx = slot_tbl[tclip[:, None], sclip]
+    pidx = jnp.where((samples >= 0) & (task_row[:, None] >= 0), pidx, -1)
+    valid = pidx >= 0
+    safe = jnp.where(valid, pidx, 0)
+    psg = ps[safe]                                      # (b, k, 7)
+    valid = valid & (psg[..., _PS_ALIVE] != 0)
+    valid = valid & (pidx != child[:, None])
+    host = psg[..., _PS_HOST]
+    qmask = cols["qmask"]
+    would = valid & qmask[jnp.clip(host, 0, qmask.shape[0] - 1)]
+    qskip = would.sum(dtype=jnp.int32)
+    valid = valid & ~would
+
+    # --- stable left-compaction (preserves sample order, matching the
+    # oracle's np.argsort(~valid, kind="stable") exactly) ---------------
+    order = jnp.argsort(~valid, axis=1, stable=True)
+    take = lambda a: jnp.take_along_axis(a, order, axis=1)  # noqa: E731
+    cand_valid = take(valid)
+    cand_pidx = jnp.where(cand_valid, take(safe), 0)
+    cand_slots = jnp.where(cand_valid, take(jnp.where(valid, samples, 0)), -1)
+    cand_host = jnp.where(cand_valid, take(host), 0)
+    in_degree = jnp.where(cand_valid, take(ind0), 0)
+    blocklist = take(f["blocklist0"]) & cand_valid
+    can_add = take(f["can_add0"]) & cand_valid
+
+    # --- feature gather: the state.gather_candidates formulas over the
+    # mirrors, junk-at-invalid included (safe index 0 -> peer/host row 0,
+    # clip like the host gather) ---------------------------------------
+    safe_cand = jnp.where(cand_valid, cand_pidx, 0)
+    pg = ps[safe_cand]                                  # (b, k, 7)
+    cg = ps[child]                                      # (b, 7)
+    safe_cand_host = jnp.maximum(pg[..., _PS_HOST], 0)
+    safe_child_host = jnp.maximum(cg[:, _PS_HOST], 0)
+    child_task = jnp.maximum(cg[:, _PS_TASK], 0)
+    feats = {
+        "valid": cand_valid & (pg[..., _PS_ALIVE] != 0),
+        "finished_pieces": pg[..., _PS_FINISHED],
+        "child_finished_pieces": cg[:, _PS_FINISHED],
+        "total_piece_count": cols["task_total"][child_task],
+        "upload_count": cols["host_upload_count"][safe_cand_host],
+        "upload_failed_count": cols["host_upload_failed"][safe_cand_host],
+        "upload_limit": cols["host_upload_limit"][safe_cand_host],
+        "upload_used": cols["host_upload_used"][safe_cand_host],
+        "host_type": cols["host_type"][safe_cand_host],
+        "peer_state": pg[..., _PS_STATE],
+        "parent_idc": cols["host_idc"][safe_cand_host],
+        "child_idc": cols["host_idc"][safe_child_host],
+        "parent_location": cols["host_location"][safe_cand_host],
+        "child_location": cols["host_location"][safe_child_host],
+        "parent_host_id": cols["host_id_hash"][safe_cand_host],
+        "child_host_id": cols["host_id_hash"][safe_child_host],
+        "piece_costs": _ring_ordered(
+            cols["peer_ring"][safe_cand], pg[..., _PS_CURSOR],
+            pg[..., _PS_COST_COUNT], c,
+        ),
+        "piece_cost_count": pg[..., _PS_COST_COUNT],
+        # fused gating excludes the probed-nt arm (host RTT gather), so
+        # the probe inputs are the oracle's zero fill, bit-identical
+        "avg_rtt_ns": jnp.zeros((b, k), jnp.float32),
+        "has_rtt": jnp.zeros((b, k), bool),
+    }
+
+    # --- score + select: the SAME traced functions as the packed path --
+    scores = ev.evaluate(feats, algorithm)
+    mask = ev.filter_candidates(feats, blocklist, in_degree, can_add)
+    values, indices, sel_valid = masked_top_k(scores, mask, limit)
+    selection = ev._pack_selection(values, indices, sel_valid)
+
+    parts = [
+        selection.reshape(-1),
+        _i32_as_f32(cand_pidx).reshape(-1),
+        _i32_as_f32(cand_slots).reshape(-1),
+        _i32_as_f32(cand_host).reshape(-1),
+        _i32_as_f32(cand_valid.astype(jnp.int32)).reshape(-1),
+        _i32_as_f32(qskip.reshape(1)).reshape(-1),
+    ]
+    if emit_led:
+        # compact per-candidate ledger rows, the traced twin of
+        # telemetry.decisions.compact_features (int64 idc/location hashes
+        # ride the mirrors' i32 truncation — equality-only fields, same
+        # contract as the packed transport)
+        child_idc = feats["child_idc"][:, None]
+        same_idc = (
+            (feats["parent_idc"] == child_idc) & (child_idc != 0)
+        ).astype(jnp.float32)
+        cloc = feats["child_location"][:, None, :]
+        ploc = feats["parent_location"]
+        elem_eq = (ploc == cloc) & (ploc != 0) & (cloc != 0)
+        prefix = jnp.cumprod(elem_eq.astype(jnp.int32), axis=-1)
+        loc_match = prefix.sum(axis=-1).astype(jnp.float32) / l
+        led = jnp.stack(
+            [
+                feats["finished_pieces"].astype(jnp.float32),
+                feats["upload_count"].astype(jnp.float32),
+                feats["upload_failed_count"].astype(jnp.float32),
+                (feats["upload_limit"] - feats["upload_used"]).astype(jnp.float32),
+                feats["host_type"].astype(jnp.float32),
+                in_degree.astype(jnp.float32),
+                same_idc,
+                loc_match,
+            ],
+            axis=-1,
+        )
+        parts.append(led.reshape(-1))
+    out = jnp.concatenate(parts)
+
+    if not emit_packed:
+        return out
+    shadow_values = dict(feats)
+    shadow_values.update(
+        blocklist=blocklist,
+        can_add_edge=can_add,
+        in_degree=in_degree,
+        child_host_slot=cg[:, _PS_HOST],
+        cand_host_slot=cand_host,
+        numeric=cols["host_numeric"][safe_cand_host],
+        child_numeric=cols["host_numeric"][safe_child_host],
+    )
+    return out, _device_pack(shadow_values, b, k, c, l, n)
+
+
+# peer_scalars mirror column order (ONE (P, 7) int32 matrix so the fused
+# gather reads every per-peer scalar in a single fancy index)
+(_PS_ALIVE, _PS_STATE, _PS_HOST, _PS_TASK, _PS_FINISHED, _PS_COST_COUNT,
+ _PS_CURSOR) = range(7)
+_PS_COLS = 7
+
+
+def _snap(a: np.ndarray, dtype=None):
+    """Device upload with SNAPSHOT semantics: `jnp.asarray` zero-copies a
+    large (and suitably aligned) numpy buffer on the CPU backend, which
+    would alias the LIVE scheduler column into the device program — the
+    fused chunk then reads whatever the host has mutated by the time XLA
+    actually executes, and the pipelined drain mutates upload accounting
+    while the next chunk is still in flight. Whether a given column
+    crosses the zero-copy threshold even varies with allocator alignment
+    from run to run, so the symptom is paired-seed nondeterminism, not a
+    clean failure. An explicit private copy (owned only by the returned
+    jax Array) pins the freeze-inputs-at-sync contract the decision-
+    equivalence oracle relies on."""
+    return jnp.asarray(np.array(a, dtype=dtype or a.dtype, copy=True))
+
+
+@functools.partial(jax.jit, static_argnames=("nb",), donate_argnums=(0,))
+def _scatter_rows(col, idx, rows, nb: int):
+    """Donated incremental row scatter into a resident mirror column:
+    `col[idx] = rows`, with the update batch padded to the closed bucket
+    `nb` (out-of-range pad indices drop). The donated argument is the
+    mirror itself — the caller immediately rebinds its attribute to the
+    result, so the donated buffer is never read again."""
+    del nb
+    return col.at[idx].set(rows, mode="drop")
+
+
+class TickMirror:
+    """Device-resident mirrors of the scheduler's hot SoA columns.
+
+    Incremental by construction: peer rows ride `state.peer_dirty` (set by
+    every peer-column mutator, cleared here) through donated bucket-padded
+    row scatters; the slot→peer-row table rides the scheduler's dirty-task
+    set; static host columns re-upload only when `state.host_epoch` moved;
+    the small dynamic host/task columns (upload counters, total pieces)
+    and the quarantine mask re-upload wholesale every sync — they are a
+    few hundred KB and their per-element dirty tracking would cost more
+    than the transfer. int64 identity columns are truncated to int32 with
+    the same `astype` C-wrap as the packed transport (equality-only
+    fields; bit-identical semantics).
+
+    Not mirrored: the have-bitsets themselves — scoring consumes only
+    their popcount projection (`peer_finished_count`), which IS mirrored,
+    so the bitsets stay host-only words the absorb valves maintain.
+    """
+
+    def __init__(self, state, dag_capacity: int):
+        self.state = state
+        self.dag_capacity = dag_capacity
+        self._host_epoch = -1
+        scal = np.zeros((state.max_peers, _PS_COLS), np.int32)
+        scal[:, _PS_HOST] = -1
+        scal[:, _PS_TASK] = -1
+        self.peer_scalars = jnp.asarray(scal)
+        self.peer_ring = jnp.zeros(
+            (state.max_peers, state.piece_cost_capacity), jnp.float32
+        )
+        self.slot_pidx = jnp.full(
+            (state.max_tasks, dag_capacity), -1, jnp.int32
+        )
+        self.host_static: dict = {}
+        self.host_dyn: dict = {}
+
+    def _peer_rows(self, idx: np.ndarray) -> np.ndarray:
+        st = self.state
+        rows = np.empty((idx.size, _PS_COLS), np.int32)
+        rows[:, _PS_ALIVE] = st.peer_alive[idx]
+        rows[:, _PS_STATE] = st.peer_state[idx]
+        rows[:, _PS_HOST] = st.peer_host[idx]
+        rows[:, _PS_TASK] = st.peer_task[idx]
+        rows[:, _PS_FINISHED] = st.peer_finished_count[idx]
+        rows[:, _PS_COST_COUNT] = st.peer_piece_cost_count[idx]
+        rows[:, _PS_CURSOR] = st.peer_cost_cursor[idx]
+        return rows
+
+    def sync(self, slot_pidx_host: dict, task_index, dirty_tasks: set,
+             qmask: np.ndarray) -> dict:
+        """Fold every change since the last sync into the mirrors and
+        return the `cols` dict for this tick's fused dispatches."""
+        st = self.state
+        dirty = np.flatnonzero(st.peer_dirty)
+        if dirty.size:
+            st.peer_dirty[dirty] = False
+            for s in range(0, dirty.size, _EVAL_BUCKETS[-1]):
+                part = dirty[s : s + _EVAL_BUCKETS[-1]]
+                nb = _bucket_rows(part.size)
+                idx = np.full(nb, st.max_peers, np.int32)  # pad rows drop
+                idx[: part.size] = part
+                rows = np.zeros((nb, _PS_COLS), np.int32)
+                rows[: part.size] = self._peer_rows(part)
+                ring = np.zeros((nb, st.piece_cost_capacity), np.float32)
+                ring[: part.size] = st.peer_piece_costs[part]
+                # nb passed positionally: the retrace tripwire reads the
+                # bucket dim out of the positional signature (SERVING_B_ARGS)
+                self.peer_scalars = _scatter_rows(self.peer_scalars, idx, rows, nb)
+                self.peer_ring = _scatter_rows(self.peer_ring, idx, ring, nb)
+        if dirty_tasks:
+            updates: dict[int, np.ndarray] = {}
+            empty = np.full(self.dag_capacity, -1, np.int32)
+            for task_id in dirty_tasks:
+                row = task_index(task_id)
+                spx = slot_pidx_host.get(task_id)
+                if row is None:
+                    continue  # dropped task: its row is only ever read
+                    # again after a successor task re-registers it dirty
+                if spx is None:
+                    updates[row] = empty
+                else:
+                    updates[row] = spx.astype(np.int32, copy=False)
+            dirty_tasks.clear()
+            if updates:
+                rlist = np.fromiter(updates.keys(), np.int64, len(updates))
+                for s in range(0, rlist.size, _EVAL_BUCKETS[-1]):
+                    part = rlist[s : s + _EVAL_BUCKETS[-1]]
+                    nb = _bucket_rows(part.size)
+                    idx = np.full(nb, st.max_tasks, np.int32)
+                    idx[: part.size] = part
+                    rows = np.zeros((nb, self.dag_capacity), np.int32)
+                    for j, r in enumerate(part):
+                        rows[j] = updates[int(r)]
+                    self.slot_pidx = _scatter_rows(self.slot_pidx, idx, rows, nb)
+        if st.host_epoch != self._host_epoch:
+            self._host_epoch = st.host_epoch
+            self.host_static = {
+                "host_type": _snap(st.host_type),
+                "host_idc": _snap(st.host_idc, np.int32),
+                "host_location": _snap(st.host_location, np.int32),
+                "host_id_hash": _snap(st.host_id_hash, np.int32),
+                "host_numeric": _snap(st.host_numeric),
+            }
+        self.host_dyn = {
+            "host_upload_count": _snap(st.host_upload_count, np.int32),
+            "host_upload_failed": _snap(st.host_upload_failed, np.int32),
+            "host_upload_limit": _snap(st.host_upload_limit),
+            "host_upload_used": _snap(st.host_upload_used),
+            "task_total": _snap(st.task_total_pieces),
+        }
+        return {
+            "peer_scalars": self.peer_scalars,
+            "peer_ring": self.peer_ring,
+            "slot_pidx": self.slot_pidx,
+            "qmask": _snap(qmask),
+            **self.host_static,
+            **self.host_dyn,
+        }
+
+
+def warm_cols(state, dag_capacity: int) -> dict:
+    """Zero-filled cols dict with the serving shapes/dtypes, for warmup
+    compiles of `fused_tick_chunk`. Thread-safe by construction: reads
+    only the state's DIMENSIONS, never its columns or the live mirror —
+    warmup may run on a background thread while the service ticks."""
+    return {
+        "peer_scalars": jnp.zeros((state.max_peers, _PS_COLS), jnp.int32),
+        "peer_ring": jnp.zeros(
+            (state.max_peers, state.piece_cost_capacity), jnp.float32
+        ),
+        "slot_pidx": jnp.full((state.max_tasks, dag_capacity), -1, jnp.int32),
+        "qmask": jnp.zeros(state.max_hosts, bool),
+        "host_type": jnp.zeros(state.max_hosts, jnp.int8),
+        "host_idc": jnp.zeros(state.max_hosts, jnp.int32),
+        "host_location": jnp.zeros(state.host_location.shape, jnp.int32),
+        "host_id_hash": jnp.zeros(state.max_hosts, jnp.int32),
+        "host_numeric": jnp.zeros(state.host_numeric.shape, jnp.float32),
+        "host_upload_count": jnp.zeros(state.max_hosts, jnp.int32),
+        "host_upload_failed": jnp.zeros(state.max_hosts, jnp.int32),
+        "host_upload_limit": jnp.zeros(state.max_hosts, jnp.int32),
+        "host_upload_used": jnp.zeros(state.max_hosts, jnp.int32),
+        "task_total": jnp.zeros(state.max_tasks, jnp.int32),
+    }
+
+
+def warm_inputs(bsz: int, k: int):
+    """All-invalid staging inputs for one warm chunk: samples -1, zero
+    grids — compiles the bucket signature without touching real state."""
+    samples = np.full((bsz, k), -1, np.int64)
+    zi = np.zeros((bsz, k), np.int64)
+    zt = np.full(bsz, -1, np.int64)
+    zc = np.zeros(bsz, np.int64)
+    zb = np.zeros((bsz, k), bool)
+    return build_inbuf(bsz, samples, zi, zt, zc, zb, zb)
+
+
+def warm_scatters(state, dag_capacity: int) -> None:
+    """Compile the mirror's donated row scatter for every (column kind x
+    bucket) signature off the tick path, on throwaway device arrays (the
+    live mirror's buffers are never donated here)."""
+    shapes = [
+        ((state.max_peers, _PS_COLS), np.int32),
+        ((state.max_peers, state.piece_cost_capacity), np.float32),
+        ((state.max_tasks, dag_capacity), np.int32),
+    ]
+    for shape, dt in shapes:
+        for nb in _EVAL_BUCKETS:
+            idx = np.full(nb, shape[0], np.int32)  # all pads: drop
+            rows = np.zeros((nb, shape[1]), dt)
+            np.asarray(_scatter_rows(jnp.zeros(shape, dt), idx, rows, nb))
+
+
+# Flight-recorder instrumentation (telemetry/flight.py), the evaluator
+# discipline: compile/retrace counts per signature, block=False so the
+# pipelined tick's async dispatch survives the wrapper, costcards=True so
+# the first compile of each bucket signature queues an AOT cost-card
+# capture (telemetry/costcard.py) that warmup's drain lands — the fused
+# program gets a flops/bytes budget and measured-vs-card MFU from day one
+# with zero new compile signatures (the card lowers the already-warmed
+# signature).
+from dragonfly2_tpu.telemetry.flight import instrument_jit as _instrument_jit  # noqa: E402
+
+fused_tick_chunk = _instrument_jit(
+    fused_tick_chunk, "tick.fused_tick_chunk", service="scheduler",
+    block=False, costcards=True,
+)
+_scatter_rows = _instrument_jit(
+    _scatter_rows, "tick.scatter_rows", service="scheduler", block=False,
+)

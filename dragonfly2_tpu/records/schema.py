@@ -417,3 +417,134 @@ def to_row(record) -> list:
     out: list = []
     _compiled_writer(type(record))(record, out)
     return out
+
+
+# --------------------------------------------------------------------------
+# Compiled CSV line writer — the trace-recording hot path.
+#
+# `to_row` + csv.writer costs ~0.35 ms per DownloadRecord: 1,745 values walk
+# through per-field closures into a list, then through the csv module again.
+# But most of those columns are PAD (empty parent/piece slots whose flattened
+# defaults never change), and the live fields are overwhelmingly numbers that
+# never need quoting. `to_line` therefore compiles, once per record class, a
+# direct record -> CSV-text emitter: live scalars render through one f-string
+# segment per contiguous run, empty list slots append a PRE-JOINED pad string,
+# and only str-typed fields pass through the quote check. Output is
+# byte-identical to csv.writer(lineterminator="\n") over `to_row` (pinned by
+# tests/test_records.py) — QUOTE_MINIMAL quotes a field iff it contains the
+# delimiter, the quotechar, or a lineterminator character.
+
+
+def _csv_field(value) -> str:
+    s = str(value)
+    if '"' in s or "," in s or "\n" in s:
+        return '"' + s.replace('"', '""') + '"'
+    return s
+
+
+# Nested sub-records of these classes serialize through an identity-keyed
+# segment memo: the scheduler reuses ONE HostRecord instance per announced
+# host across every download record it emits (scheduler._host_record), so
+# the 44-column host segment — the bulk of a record's live fields, repeated
+# once per parent — reduces to a dict hit after the first write. Entries
+# hold a strong ref and re-verify `is` on lookup, so a recycled id() can
+# never alias. Contract: records are frozen once handed to storage (true
+# everywhere in this repo); mutating a memoized sub-record AFTER it has
+# been serialized once would re-emit the stale segment.
+_SEGMENT_MEMO_CLASSES = ("HostRecord",)
+
+
+def _compile_line_writer(cls: type):
+    ctx: dict = {"_q": _csv_field}
+    lines: list[str] = []
+    exprs: list[str] = []
+    counters = {"v": 0, "l": 0, "m": 0}
+
+    def flush() -> None:
+        if not exprs:
+            return
+        body = ",".join("{" + e + "}" for e in exprs)
+        lines.append(f'    parts.append(f"{body}")')
+        exprs.clear()
+
+    def emit(cls: type, var: str) -> None:
+        template = cls()
+        hints = _class_hints(cls)
+        for f in dataclasses.fields(cls):
+            current = getattr(template, f.name)
+            if dataclasses.is_dataclass(current):
+                if type(current).__name__ in _SEGMENT_MEMO_CLASSES:
+                    counters["m"] += 1
+                    k = counters["m"]
+                    ctx[f"_msub{k}"] = _compiled_line_writer(type(current))
+                    ctx[f"_memo{k}"] = {}
+                    flush()
+                    lines.append(f"    _o = {var}.{f.name}")
+                    lines.append(f"    _ent = _memo{k}.get(id(_o))")
+                    lines.append("    if _ent is not None and _ent[0] is _o:")
+                    lines.append("        parts.append(_ent[1])")
+                    lines.append("    else:")
+                    lines.append("        _p2 = []")
+                    lines.append(f"        _msub{k}(_o, _p2)")
+                    lines.append("        _seg = ','.join(_p2)")
+                    lines.append(f"        if len(_memo{k}) > 8192:")
+                    lines.append(f"            _memo{k}.clear()")
+                    lines.append(f"        _memo{k}[id(_o)] = (_o, _seg)")
+                    lines.append("        parts.append(_seg)")
+                    continue
+                counters["v"] += 1
+                sub = f"_v{counters['v']}"
+                lines.append(f"    {sub} = {var}.{f.name}")
+                emit(type(current), sub)
+            elif isinstance(current, list):
+                width = _list_width(cls, f.name)
+                elem_cls = _element_type(cls, f.name)
+                counters["l"] += 1
+                k = counters["l"]
+                ctx[f"_sub{k}"] = _compiled_line_writer(elem_cls)
+                one = ",".join(
+                    _csv_field(v) if isinstance(v, str) else str(v)
+                    for v in flatten(elem_cls()).values()
+                )
+                ctx[f"_pads{k}"] = tuple(
+                    ",".join([one] * j) for j in range(width + 1)
+                )
+                flush()
+                lines.append(f"    _it = {var}.{f.name}")
+                lines.append("    _n = len(_it)")
+                lines.append(f"    if _n > {width}:")
+                lines.append(
+                    f"        raise ValueError("
+                    f"f\"{cls.__name__}.{f.name} has {{_n}} items,"
+                    f" max {width}\")"
+                )
+                lines.append('    parts.append(f"{_n}")')
+                lines.append("    for _e in _it:")
+                lines.append(f"        _sub{k}(_e, parts)")
+                lines.append(f"    if _n < {width}:")
+                lines.append(f"        parts.append(_pads{k}[{width} - _n])")
+            else:
+                if hints[f.name] is str:
+                    exprs.append(f"_q({var}.{f.name})")
+                else:
+                    exprs.append(f"{var}.{f.name}")
+
+    emit(cls, "obj")
+    flush()
+    src = "def _write(obj, parts):\n" + "\n".join(lines or ["    pass"])
+    exec(src, ctx)  # noqa: S102 - compiled from the dataclass schema only
+    return ctx["_write"]
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_line_writer(cls: type):
+    return _compile_line_writer(cls)
+
+
+def to_line(record) -> str:
+    """Record -> its finished CSV text line (terminated with \\n), exactly
+    what ``csv.writer(..., lineterminator="\\n").writerow(to_row(record))``
+    would produce, without materialising the positional row."""
+    parts: list[str] = []
+    _compiled_line_writer(type(record))(record, parts)
+    return ",".join(parts) + "\n"

@@ -131,6 +131,18 @@ class ClusterState:
         self._peer_by_id: dict[str, int] = {}
         self._peer_id: list[str | None] = [None] * max_peers
 
+        # --- device-mirror change tracking (ops/tick.py TickMirror) ---
+        # peer_dirty: rows whose hot columns changed since the mirror's
+        # last incremental sync — set by every peer-column mutator below,
+        # cleared by the mirror. A boolean store per mutation, cheap
+        # enough to maintain unconditionally (fused tick off included).
+        # host_epoch: bumped on any host upsert/remove so the mirror can
+        # re-upload the static host columns (type/idc/location/id_hash/
+        # numeric) only when one actually changed; the per-tick dynamic
+        # columns (upload counts/limits) are re-uploaded every sync.
+        self.peer_dirty = np.zeros(max_peers, bool)
+        self.host_epoch = 0
+
     # ------------------------------------------------------------- hosts
 
     def upsert_host(
@@ -168,6 +180,7 @@ class ClusterState:
         if numeric is not None:
             self.host_numeric[idx] = numeric
         self.host_updated_at[idx] = time.time()
+        self.host_epoch += 1
         return idx
 
     def host_index(self, host_id: str) -> int | None:
@@ -186,6 +199,7 @@ class ClusterState:
         self.host_alive[idx] = False
         self._host_id[idx] = None
         self._host_free.release(idx)
+        self.host_epoch += 1
 
     def host_free_upload(self, idx: int) -> int:
         return int(self.host_upload_limit[idx] - self.host_upload_used[idx])
@@ -249,6 +263,7 @@ class ClusterState:
         self.peer_piece_cost_count[idx] = 0
         self.peer_cost_cursor[idx] = 0
         self.peer_updated_at[idx] = time.time()
+        self.peer_dirty[idx] = True
         self.touch_peer_host(idx)
         return idx
 
@@ -270,6 +285,7 @@ class ClusterState:
         current = PeerState(int(self.peer_state[idx]))
         self.peer_state[idx] = int(peer_transition(current, event))
         self.peer_updated_at[idx] = time.time()
+        self.peer_dirty[idx] = True
         self.touch_peer_host(idx)
 
     def remove_peer(self, peer_id: str) -> None:
@@ -279,6 +295,7 @@ class ClusterState:
         self.peer_alive[idx] = False
         self._peer_id[idx] = None
         self._peer_free.release(idx)
+        self.peer_dirty[idx] = True
 
     def record_piece(self, peer_idx: int, piece_number: int, cost_ns: float) -> None:
         """Piece finished: set bitset bit, append cost to the ring buffer
@@ -296,6 +313,7 @@ class ClusterState:
             int(self.peer_piece_cost_count[peer_idx]) + 1, self.piece_cost_capacity
         )
         self.peer_updated_at[peer_idx] = time.time()
+        self.peer_dirty[peer_idx] = True
         self.touch_peer_host(peer_idx)
 
     def record_pieces_batch(
@@ -386,6 +404,7 @@ class ClusterState:
 
         # --- liveness touch (peer + its host, like touch_peer_host) ------
         self.peer_updated_at[upeers] = now
+        self.peer_dirty[upeers] = True
         hosts = self.peer_host[upeers]
         hosts = hosts[(hosts >= 0) & (hosts < self.max_hosts)]
         hosts = hosts[self.host_alive[hosts]]
@@ -410,6 +429,7 @@ class ClusterState:
                 adopted += 1
         if adopted:
             self.peer_updated_at[peer_idx] = time.time()
+            self.peer_dirty[peer_idx] = True
             self.touch_peer_host(peer_idx)
         return adopted
 

@@ -1,0 +1,46 @@
+"""dflint red fixture: the fused-tick defects the registries must catch.
+
+SHAPE001 (runtime batch dim into the registered ``fused_tick_chunk``
+entry), SHAPE002 (runtime value into its static ``limit``), DON001 (read
+of the donated staging buffer after the fused call), and JIT003 (a
+mid-pipeline fused read-back in the hot ``_dispatch_fused`` instead of
+the single allowlisted ``_drain_fused`` D2H point).
+"""
+
+import numpy as np
+
+from dragonfly2_tpu.cluster.scheduler import _bucket_rows
+from dragonfly2_tpu.ops import tick as tk
+
+
+def unbucketed_fused_batch(work, inbuf, cols, k, c, l, n):
+    b = len(work)  # runtime-varying
+    return tk.fused_tick_chunk(inbuf, cols, b, k, c, l, n)  # <- SHAPE001
+
+
+def runtime_fused_limit(parents, inbuf, cols, k, c, l, n):
+    return tk.fused_tick_chunk(
+        inbuf, cols, 64, k, c, l, n, limit=len(parents)  # <- SHAPE002
+    )
+
+
+def staging_reuse(inbuf, cols, k, c, l, n):
+    out = tk.fused_tick_chunk(inbuf, cols, 64, k, c, l, n)
+    checksum = inbuf.sum()  # <- DON001 (inbuf was donated above)
+    return out, checksum
+
+
+def _dispatch_fused(chunks, cols, k, c, l, n):
+    outs = []
+    for s, e, inbuf in chunks:
+        bsz = _bucket_rows(e - s)
+        out = tk.fused_tick_chunk(inbuf, cols, bsz, k, c, l, n)
+        # <- JIT003: mid-pipeline fused read-back (re-serializes the
+        # dispatch pipeline; only the end-of-chunk drain may block)
+        outs.append(np.asarray(out))
+    return outs
+
+
+def _drain_fused(inflight):
+    # allowlisted single D2H point of the fused tick
+    return [np.asarray(out) for _s, _e, out in inflight]

@@ -1,0 +1,39 @@
+"""dflint green fixture: the fused-tick idioms the passes must prove.
+
+Bucketed fused dispatch (``_bucket_rows`` producer, ``_EVAL_BUCKETS``
+warm iteration), fresh staging buffer per donated call, and the mirror's
+attribute-rebind scatter idiom (the donated resident column is rebound
+to the call's result in the same statement). All silent.
+"""
+
+from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS, _bucket_rows
+from dragonfly2_tpu.ops import tick as tk
+
+
+def warm_fused_buckets(state, cols, k, c, l, n, config):
+    limit = config.scheduler.candidate_parent_limit  # config: fixed
+    outs = []
+    for bsz in _EVAL_BUCKETS:  # bucket-set iteration
+        buf = tk.warm_inputs(bsz, k)  # fresh staging per donation
+        outs.append(
+            tk.fused_tick_chunk(buf, cols, bsz, k, c, l, n, limit=limit)
+        )
+    return outs
+
+
+def dispatch_fused_chunk(samples, ind, task_row, child, bl0, ca0, cols,
+                         s, e, k, c, l, n):
+    bsz = _bucket_rows(e - s)  # bucket producer
+    inbuf = tk.build_inbuf(
+        bsz, samples[s:e], ind[s:e], task_row[s:e], child[s:e],
+        bl0[s:e], ca0[s:e],
+    )
+    return tk.fused_tick_chunk(inbuf, cols, bsz, k, c, l, n)
+
+
+def mirror_scatter_sync(mirror, idx, rows, nrows):
+    nb = _bucket_rows(nrows)
+    # donated resident column immediately rebound to the result: the
+    # donated buffer is never read again (the TickMirror.sync idiom)
+    mirror.peer_scalars = tk._scatter_rows(mirror.peer_scalars, idx, rows, nb)
+    return mirror.peer_scalars

@@ -495,3 +495,65 @@ def test_new_writer_output_is_schema_valid(tmp_path):
     entries, errors = load_entries([tmp_path / "BENCH_r09.json"])
     assert errors == [] and entries[0]["kind"] == "loop"
     assert entries[0]["schema_version"] == SCHEMA_VERSION
+
+
+def _seamed_loop_artifact(pieces_per_sec=25_000.0, tick_p50=9.0,
+                          control_dispatch=6.0, seam="fused"):
+    doc = _loop_artifact(pieces_per_sec, tick_p50=tick_p50)
+    doc["summary"]["control_dispatch"] = control_dispatch
+    doc["results"].append({"metric": "full_loop_tick_p50_ms",
+                           "value": tick_p50, "phase_seam": seam})
+    return doc
+
+
+def test_seam_scoped_cells_never_compare_across_a_seam_change(tmp_path):
+    """A phase-seam change (the fused tick moved fill/gather/score/top-k
+    into one device program) redefines what a tick CONTAINS, so per-tick
+    cells across the seam are "we moved rigs", not "same benchmark got
+    worse" — tick_p50_ms re-enters the gate as fused_tick_p50_ms and
+    the 7 -> 9 ms cross-seam delta anchors no verdict."""
+    pre = _loop_artifact(20_000.0, tick_p50=7.0)
+    pre["summary"]["control_dispatch"] = 6.7
+    _write(tmp_path, "BENCH_r01.json", pre)
+    _write(tmp_path, "BENCH_r02.json",
+           _seamed_loop_artifact(25_000.0, tick_p50=9.0, control_dispatch=6.3))
+    assert check(tmp_path, out=io.StringIO()) == 0
+
+
+def test_control_dispatch_still_compares_across_the_seam(tmp_path):
+    """control_dispatch keeps meaning "all host-side work per tick" by
+    construction of the seam, so its longitudinal comparison survives
+    the program-shape change — a real host-side regression under the
+    fused seam still fails the gate."""
+    pre = _loop_artifact(20_000.0, tick_p50=7.0)
+    pre["summary"]["control_dispatch"] = 6.7
+    _write(tmp_path, "BENCH_r01.json", pre)
+    _write(tmp_path, "BENCH_r02.json",
+           _seamed_loop_artifact(25_000.0, tick_p50=9.0, control_dispatch=9.5))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION control_dispatch" in out.getvalue()
+
+
+def test_seam_scoped_cells_compare_within_a_seam(tmp_path):
+    """Two fused-seam rounds form a normal series: a >10% fused-tick
+    regression between them fails the gate under the prefixed name."""
+    _write(tmp_path, "BENCH_r01.json", _seamed_loop_artifact(tick_p50=9.0))
+    _write(tmp_path, "BENCH_r02.json", _seamed_loop_artifact(tick_p50=13.0))
+    out = io.StringIO()
+    assert check(tmp_path, out=out) == 1
+    assert "REGRESSION fused_tick_p50_ms" in out.getvalue()
+
+
+def test_noise_floor_ignores_microsecond_jitter(tmp_path):
+    """report_ingest 0.002 -> 0.003 ms is +50% relative but 1 us
+    absolute — below the phase timer's noise floor, it anchors no
+    verdict; a 5 ms absolute regression on the same family still does
+    (test_lower_is_better_regression_direction)."""
+    a = _loop_artifact(20_000.0, tick_p50=7.0)
+    a["summary"]["report_ingest"] = 0.002
+    b = _loop_artifact(20_000.0, tick_p50=7.0)
+    b["summary"]["report_ingest"] = 0.003
+    _write(tmp_path, "BENCH_r01.json", a)
+    _write(tmp_path, "BENCH_r02.json", b)
+    assert check(tmp_path, out=io.StringIO()) == 0

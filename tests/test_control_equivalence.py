@@ -23,6 +23,10 @@ from dragonfly2_tpu.scenarios import builtin_scenarios
 def _run(vectorized: bool, scenario, seed: int, rounds: int = 10):
     cfg = Config()
     cfg.scheduler.vectorized_control = vectorized
+    # pin the numpy oracle: THIS test is the vectorised-vs-loop pairing;
+    # the device-resident fused tick has its own equivalence suite
+    # against the vectorised oracle (tests/test_fused_tick.py)
+    cfg.scheduler.fused_tick = False
     svc = SchedulerService(config=cfg, seed=seed + 100)
     sim = ClusterSimulator(
         svc, num_hosts=40, num_tasks=5, seed=seed,

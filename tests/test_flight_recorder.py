@@ -33,14 +33,19 @@ from dragonfly2_tpu.telemetry.series import (
 )
 from dragonfly2_tpu.telemetry.tracing import Tracer
 
-# The pipelined tick split the old monolithic device_call phase into
-# dispatch (pack -> async device call issued) and d2h_wait (blocking host
-# read of the packed selection), so chunk overlap is visible in the ring;
-# multi-chunk ticks additionally record an `overlap` phase (not listed:
-# single-chunk ticks legitimately omit it).
+# The DEFAULT loop is the fused tick (scheduler.fused_tick): feature
+# gather, scoring, and selection live inside the single donated device
+# program, so the host-visible phases are the fused split — candidate
+# sampling, the legality prefilters, staging pack, the async device
+# dispatch, the blocking D2H read, and the decode+apply+response emit.
+# Multi-chunk ticks additionally record an `overlap` phase (not listed:
+# single-chunk ticks legitimately omit it). The legacy packed pipeline's
+# phase names (feature_gather/dispatch/apply_selection) are pinned where
+# that path is explicitly selected (test_serving_pipeline's
+# fused_tick=False overlap test).
 TICK_PHASES = (
-    "pre_schedule", "candidate_fill", "feature_gather", "pack",
-    "dispatch", "d2h_wait", "apply_selection",
+    "pre_schedule", "candidate_fill", "legality_recheck", "pack",
+    "fused_dispatch", "d2h_wait", "emit",
 )
 
 
@@ -93,7 +98,7 @@ def test_tick_phase_histograms_populated_by_normal_loop():
         assert set(TICK_PHASES) <= set(tick)
     assert set(TICK_PHASES) <= set(dump["ticks"]["p50_ms"])
     # the serving entry point is instrumented: its compile counter moved
-    ev_stats = dump["jit"]["scheduler.evaluator.schedule_from_packed"]
+    ev_stats = dump["jit"]["scheduler.tick.fused_tick_chunk"]
     assert ev_stats["retraces"] >= 1 and ev_stats["calls"] >= n
 
 

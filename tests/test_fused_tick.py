@@ -1,0 +1,212 @@
+"""Device-resident fused tick (ISSUE 19): the donated single-dispatch
+control plane (ops/tick.py) is pinned decision-equivalent to the
+vectorised numpy oracle, and its compile-signature set stays closed
+over the proven buckets.
+
+Equivalence is the acceptance contract: candidate fill -> feature
+gather -> scoring -> selection fused into one XLA program must produce
+IDENTICAL parent selections — scores included — to the host-side
+`_fill_candidates_vec`/`_apply_chunk_batch` path on paired seeded
+simulator runs. Both paths draw candidates through one sampler
+(scheduler._sample_rows) and score through the same traced evaluator
+functions, so any divergence is a real defect in the mirror sync, the
+staging transport, or the device-side gather/masking — not noise.
+
+The shape test is the other half of the perf story: warmup() compiles
+every (bucket, static) signature the fused entry will ever serve, and
+ticks across all bucket regimes add ZERO new compiles (the
+retrace-tripwire contract, same as the packed evaluator entry).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from dragonfly2_tpu.cluster import messages as msg
+from dragonfly2_tpu.cluster.scheduler import _EVAL_BUCKETS, SchedulerService
+from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+from dragonfly2_tpu.config.config import Config
+from dragonfly2_tpu.scenarios import builtin_scenarios
+from dragonfly2_tpu.telemetry.flight import jit_wrappers
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _run(fused: bool, scenario, seed: int, rounds: int = 10):
+    cfg = Config()
+    cfg.scheduler.vectorized_control = True
+    cfg.scheduler.fused_tick = fused
+    svc = SchedulerService(config=cfg, seed=seed + 100)
+    # the flag must actually select the path under test
+    assert (svc._tick_mirror is not None) == fused
+    sim = ClusterSimulator(
+        svc, num_hosts=40, num_tasks=5, seed=seed,
+        scenario=scenario, deterministic_peer_ids=True,
+    )
+    selections = []
+    for _ in range(rounds):
+        for resp in sim.run_round(new_downloads=5):
+            if hasattr(resp, "candidate_parents"):
+                selections.append((
+                    resp.peer_id,
+                    tuple((p.peer_id, round(p.score, 6))
+                          for p in resp.candidate_parents),
+                ))
+    return selections, sim.stats
+
+
+@pytest.mark.parametrize("topology", [None, "bandwidth_skew", "chaos"])
+def test_fused_matches_vectorized_oracle_selections(topology):
+    scenario = builtin_scenarios()[topology] if topology else None
+    for seed in (3, 17):
+        fused, st_fused = _run(True, scenario, seed)
+        oracle, st_oracle = _run(False, scenario, seed)
+        assert fused, f"no selections produced (topology={topology})"
+        assert fused == oracle, (
+            f"fused/oracle divergence on topology={topology} "
+            f"seed={seed}: first mismatch "
+            f"{next((a, b) for a, b in zip(fused, oracle) if a != b)}"
+        )
+        # the downstream replay stayed paired too
+        assert st_fused.pieces == st_oracle.pieces
+        assert st_fused.completed == st_oracle.completed
+        assert st_fused.piece_cost_ns_total == st_oracle.piece_cost_ns_total
+
+
+# ------------------------------------------------- compile-shape stability
+
+
+def _host(i: int, seed: bool = False) -> msg.HostInfo:
+    return msg.HostInfo(
+        host_id=f"ft-h{i}", hostname=f"ft-n{i}",
+        ip=f"10.13.{i // 250}.{i % 250}",
+        host_type="super" if seed else "normal", idc="idc-a",
+        location="na|zone|rack",
+        concurrent_upload_limit=100_000,
+    )
+
+
+def _register(svc, peer_id, host, task_id):
+    return svc.register_peer(
+        msg.RegisterPeerRequest(
+            peer_id=peer_id, task_id=task_id, host=host,
+            url="https://e.com/blob", content_length=4 * (4 << 20),
+            total_piece_count=4,
+        )
+    )
+
+
+def test_fused_tick_compile_shapes_stable_across_buckets():
+    """Ticks across all three bucket regimes, twice each, add ZERO jit
+    signatures beyond what warmup() compiled — for the fused entry AND
+    the mirror's scatter. A failure here means a tick can eat an XLA
+    compile mid-serving, which is the exact stall the fused design
+    exists to kill."""
+    from dragonfly2_tpu.telemetry import metrics as m
+
+    svc = SchedulerService(metrics_registry=m.Registry())
+    assert svc._tick_mirror is not None, "fused tick must be on by default"
+    hosts = [_host(i) for i in range(64)]
+    for i in range(16):
+        seed_host = _host(1000 + i, seed=True)
+        _register(svc, f"ft-seed-{i}", seed_host, f"ft-task-{i}")
+        svc.peer_finished(
+            msg.DownloadPeerFinishedRequest(peer_id=f"ft-seed-{i}",
+                                            piece_count=4)
+        )
+    svc.tick()  # drain the pre_schedule-only seed tick
+    svc.warmup()
+    tick_wrapper = jit_wrappers()["scheduler.tick.fused_tick_chunk"]
+    scatter_wrapper = jit_wrappers()["scheduler.tick.scatter_rows"]
+    after_warmup = (
+        tick_wrapper.stats()["signatures"],
+        scatter_wrapper.stats()["signatures"],
+    )
+
+    reg_counter = [0]
+
+    def _top_up(target: int) -> None:
+        while len(svc._pending) < target:
+            i = reg_counter[0]
+            reg_counter[0] += 1
+            _register(
+                svc, f"ft-child-{i}", hosts[i % len(hosts)],
+                f"ft-task-{i % 16}",
+            )
+
+    # one tick per bucket regime, twice: 64 -> single 64-chunk;
+    # 300 -> 256 + 64 chunks; 1025 -> 1024 + 64 chunks
+    for _ in range(2):
+        for target in (64, 300, 1025):
+            _top_up(target)
+            svc.tick()
+    assert (
+        tick_wrapper.stats()["signatures"],
+        scatter_wrapper.stats()["signatures"],
+    ) == after_warmup, (
+        "fused tick reached a signature warmup never compiled"
+    )
+
+    # dfshape acceptance: the statically-derived bucket set (retracer
+    # parses _EVAL_BUCKETS out of scheduler.py) exactly matches the
+    # runtime-observed batch dims of the fused entry — warmup plus ticks
+    # across every regime compiled all proven buckets and nothing else
+    from tools.dflint import retracer
+
+    name = "scheduler.tick.fused_tick_chunk"
+    derived = retracer.derive_static_signature_sets(ROOT)[name]
+    observed = retracer.observed_batch_buckets(
+        tick_wrapper, retracer.SERVING_B_ARGS[name]
+    )
+    assert observed == set(derived), (observed, derived)
+    # the scatter's update batches are bucket-padded too
+    sname = "scheduler.tick.scatter_rows"
+    sobserved = retracer.observed_batch_buckets(
+        scatter_wrapper, retracer.SERVING_B_ARGS[sname]
+    )
+    assert sobserved <= set(
+        retracer.derive_static_signature_sets(ROOT)[sname]
+    ), sobserved
+
+
+def test_fused_tick_records_split_phases():
+    """The phase seam (ISSUE 19 satellite 6): a fused tick records the
+    fused split — candidate_fill / legality_recheck / pack /
+    fused_dispatch / d2h_wait / emit — and control_dispatch is
+    re-derived as the HOST-side sum (device wait excluded), while
+    fused_device_call carries the device dispatch+wait. The aggregate
+    keeps meaning 'all host work per tick' across the oracle and fused
+    paths, so BENCH trajectories stay comparable."""
+    from dragonfly2_tpu.telemetry import metrics as m
+
+    svc = SchedulerService(metrics_registry=m.Registry())
+    assert svc._tick_mirror is not None
+    hosts = [_host(i) for i in range(32)]
+    for i in range(8):
+        seed_host = _host(2000 + i, seed=True)
+        _register(svc, f"ft-ph-seed-{i}", seed_host, f"ft-ph-task-{i}")
+        svc.peer_finished(
+            msg.DownloadPeerFinishedRequest(peer_id=f"ft-ph-seed-{i}",
+                                            piece_count=4)
+        )
+    svc.tick()
+    for i in range(80):
+        _register(svc, f"ft-ph-{i}", hosts[i % len(hosts)],
+                  f"ft-ph-task-{i % 8}")
+    svc.tick()
+    phases = svc.recorder.ring[-1]
+    for key in ("candidate_fill", "legality_recheck", "pack",
+                "fused_dispatch", "d2h_wait", "emit",
+                "control_dispatch", "fused_device_call"):
+        assert key in phases, (key, sorted(phases))
+    host_side = (
+        phases.get("report_ingest", 0.0) + phases.get("pre_schedule", 0.0)
+        + phases["candidate_fill"] + phases["legality_recheck"]
+        + phases["pack"] + phases["emit"]
+    )
+    assert phases["control_dispatch"] == pytest.approx(host_side, rel=1e-6)
+    assert phases["fused_device_call"] == pytest.approx(
+        phases["fused_dispatch"] + phases["d2h_wait"], rel=1e-6
+    )

@@ -138,3 +138,47 @@ def test_iter_records_skips_foreign_rows(tmp_path):
     (tmp_path / "download-1.csv").write_text(",".join(bad_header) + "\n")
 
     assert store.list_downloads() == recs
+
+
+def test_to_line_matches_csv_writer_bytes():
+    """The compiled direct-to-text codec (schema.to_line) must stay
+    byte-identical to csv.writer over to_row — storage.create writes
+    through it, so any divergence silently corrupts traces on disk."""
+    import io
+    import csv
+
+    from dragonfly2_tpu.records import schema
+    from dragonfly2_tpu.records.schema import (
+        DownloadRecord,
+        ErrorRecord,
+        HostRecord,
+        ParentRecord,
+        PieceRecord,
+        to_row,
+    )
+
+    def via_csv(rec):
+        out = io.StringIO()
+        csv.writer(out, lineterminator="\n").writerow(to_row(rec))
+        return out.getvalue()
+
+    _, downloads, topologies = _sample_records(n=12)
+    for rec in downloads + topologies:
+        assert schema.to_line(rec) == via_csv(rec)
+
+    # adversarial quoting + shared (memoized) HostRecord sub-records
+    shared = HostRecord(id="h-1", hostname='na"me,with\nnasties', ip="10.0.0.1")
+    tricky = DownloadRecord(
+        id="d,1",
+        tag='t"ag',
+        error=ErrorRecord(code="E", message='boom "x", y\nz'),
+        host=shared,
+        parents=[
+            ParentRecord(id="p1", host=shared,
+                         pieces=[PieceRecord(length=64, cost=7)]),
+            ParentRecord(id="p2", host=shared),
+        ],
+    )
+    # twice: second pass serializes through the warm segment memo
+    assert schema.to_line(tricky) == via_csv(tricky)
+    assert schema.to_line(tricky) == via_csv(tricky)

@@ -18,6 +18,7 @@ from dragonfly2_tpu.cluster.scheduler import (
     _chunk_stride,
 )
 from dragonfly2_tpu.cluster.simulator import ClusterSimulator
+from dragonfly2_tpu.config.config import Config
 from dragonfly2_tpu.models.graphsage import GraphSAGERanker
 from dragonfly2_tpu.ops import evaluator as ev
 from dragonfly2_tpu.ops.segment import gather_coo_subgraph
@@ -56,10 +57,14 @@ def _register(svc, peer_id, host, task_id):
     )
 
 
-def _pipeline_service(num_tasks: int = 16, num_hosts: int = 64):
+def _pipeline_service(num_tasks: int = 16, num_hosts: int = 64,
+                      fused: bool = True):
     """Service with one finished seed parent per task, so every child the
-    tick schedules has a rooted candidate."""
-    svc = SchedulerService(metrics_registry=m.Registry())
+    tick schedules has a rooted candidate. `fused=False` selects the
+    legacy packed pipeline (the decision-equivalence oracle path)."""
+    cfg = Config()
+    cfg.scheduler.fused_tick = fused
+    svc = SchedulerService(config=cfg, metrics_registry=m.Registry())
     hosts = [_host(i) for i in range(num_hosts)]
     for i in range(num_tasks):
         seed_host = _host(1000 + i, seed=True)
@@ -173,8 +178,11 @@ def test_ml_serving_jit_signature_set_matches_static(tmp_path):
 def test_pipelined_tick_overlaps_dispatch_and_apply():
     """A multi-chunk tick records the split phases AND nonzero overlap:
     host work (pack of chunk i+1, apply of chunk i) ran while a device
-    call was in flight."""
-    svc, hosts = _pipeline_service()
+    call was in flight. Pinned on the LEGACY packed pipeline
+    (fused_tick=False) — it stays reachable as the decision-equivalence
+    oracle; the fused default's phase split + overlap is pinned by
+    tests/test_fused_tick.py::test_fused_tick_records_split_phases."""
+    svc, hosts = _pipeline_service(fused=False)
     for i in range(200):  # > _EVAL_BUCKETS[0] -> at least two chunks
         _register(svc, f"sp-ov-{i}", hosts[i % len(hosts)], f"sp-task-{i % 16}")
     responses = svc.tick()

@@ -370,6 +370,60 @@ def test_shape_donation_fixtures():
     ]
 
 
+def test_fused_tick_fixtures():
+    """ISSUE 19 satellite: the fused-tick entries in the dfshape design
+    document are live, pinned red/green. bad_tick.py must trip exactly
+    one of each registered defect — a runtime batch dim into
+    `fused_tick_chunk` (SHAPE001), a runtime `limit` static (SHAPE002),
+    a read of the donated staging buffer after the fused call (DON001) —
+    and a mid-pipeline fused read-back in the hot `_dispatch_fused`
+    trips JIT003 while the allowlisted `_drain_fused` drain stays
+    silent. good_tick.py carries the production idioms (bucketed batch
+    dims, fresh staging per donation, the mirror's attribute-rebind
+    scatter) and must stay silent under both passes."""
+    from tools.dflint.passes.jit_hygiene import D2H_ALLOWLIST
+    from tools.dflint.passes.shape import SERVING_JIT_REGISTRY
+
+    report, _ = _lint([ShapeDonationPass()], "bad_tick.py", "good_tick.py")
+    by_rule = {rule: len(fs) for rule, fs in report.by_rule().items()}
+    assert by_rule == {"SHAPE001": 1, "SHAPE002": 1, "DON001": 1}, (
+        by_rule, [f.render() for f in report.findings]
+    )
+    assert not any("good_tick" in f.path for f in report.findings), [
+        f.render() for f in report.findings if "good_tick" in f.path
+    ]
+    assert sorted(f.finding_id for f in report.findings) == [
+        "DON001@tests/dflint_fixtures/bad_tick.py:staging_reuse",
+        "SHAPE001@tests/dflint_fixtures/bad_tick.py:unbucketed_fused_batch",
+        "SHAPE002@tests/dflint_fixtures/bad_tick.py:runtime_fused_limit",
+    ]
+    # the fused drain discipline: one allowlisted D2H point per tick
+    jit_pass = JitHygienePass(
+        hot_functions={
+            ("bad_tick.py", "_dispatch_fused"),
+            ("bad_tick.py", "_drain_fused"),
+        },
+        allowlist={
+            ("bad_tick.py", "_drain_fused", "asarray"):
+                "fixture: the single end-of-chunk fused drain valve",
+        },
+    )
+    report2, _ = _lint([jit_pass], "bad_tick.py", "good_tick.py")
+    jit003 = report2.by_rule().get("JIT003", [])
+    assert len(jit003) == 1 and jit003[0].symbol == "_dispatch_fused", [
+        f.render() for f in report2.findings
+    ]
+    assert not any("good_tick" in f.path for f in report2.findings)
+    # the registry rows the fixtures exercise exist and donate the
+    # staging buffer / resident column respectively
+    assert SERVING_JIT_REGISTRY["fused_tick_chunk"]["donate"] == (0,)
+    assert SERVING_JIT_REGISTRY["fused_tick_chunk"]["b_arg"] == 2
+    assert SERVING_JIT_REGISTRY["_scatter_rows"]["donate"] == (0,)
+    # the production fused drain point is on the real allowlist, argued
+    key = ("cluster/scheduler.py", "_drain_fused", "asarray")
+    assert key in D2H_ALLOWLIST and len(D2H_ALLOWLIST[key]) >= 20
+
+
 def test_wire_contract_fixtures():
     """dfwire red/green goldens (ISSUE 15): every WIRE001-004 shape
     fires exactly once per crafted defect in bad_wire.py — unregistered

@@ -72,6 +72,12 @@ _LOWER_BETTER_EXACT = {
     "control_dispatch", "device_call", "candidate_fill", "apply_selection",
     "report_ingest", "pack", "pre_schedule", "link_rtt_probe",
     "shadow_score",
+    # fused-tick phase split (ISSUE 19): fused_device_call is the fused
+    # program's dispatch+d2h aggregate — a NEW key, never compared
+    # against the pre-fused trivial-transport device_call (adjacent
+    # rounds only share keys they both carry)
+    "legality_recheck", "emit", "fused_dispatch", "d2h_wait",
+    "fused_device_call",
 }
 
 # Metrics with NO monotonic better-direction — excluded from regression
@@ -103,6 +109,38 @@ _NO_DIRECTION_SUFFIXES = (
 )
 
 
+# Per-tick cells are SEAM-SCOPED: when the tick's program shape changes
+# (the artifact's tick record carries a `phase_seam` — "fused" moved
+# fill/gather/score/top-k into one device program), per-tick wall and
+# per-phase cells measure a DIFFERENT program, so a cross-seam
+# comparison is "we moved rigs", not "same benchmark got worse" — the
+# fused_device_call-vs-device_call new-key argument, applied to every
+# cell the seam redefines. Seam-scoped cells normalize under a
+# `<seam>_` prefix and re-enter the gate as a new series from their
+# first seam round. Deliberately NOT seam-scoped: `control_dispatch`
+# ("all host-side work per tick" — the seam preserves that meaning by
+# construction), `link_rtt_probe` (bare transport, no program inside),
+# and every loop-level cell (pieces/s, ml/decision/ab families).
+_SEAM_SCOPED = {
+    "tick_p50_ms", "candidate_fill", "apply_selection", "report_ingest",
+    "legality_recheck", "pack", "emit", "dispatch", "d2h_wait",
+    "device_call", "feature_gather", "shadow_score", "pre_schedule",
+    "overlap",
+}
+_KNOWN_SEAMS = ("fused", "packed")
+
+# Phase timers at these batch sizes jitter by tens of microseconds run
+# to run; a relative threshold alone flags 1 us -> 2 us as +100%. A
+# lower-better ms-scale cell must regress by at least this much in
+# ABSOLUTE terms before it anchors a verdict.
+NOISE_FLOOR_MS = 0.05
+
+
+def _seam_stripped(metric: str) -> str:
+    head, _, rest = metric.partition("_")
+    return rest if head in _KNOWN_SEAMS and rest else metric
+
+
 def direction_exempt(metric: str) -> bool:
     return metric.endswith(_NO_DIRECTION_SUFFIXES)
 
@@ -111,6 +149,19 @@ def lower_is_better(metric: str) -> bool:
     return (
         metric in _LOWER_BETTER_EXACT
         or metric.endswith(_LOWER_BETTER_SUFFIXES)
+        # seam-scoped per-tick cells keep their direction under the prefix
+        or _seam_stripped(metric) in _LOWER_BETTER_EXACT
+    )
+
+
+def _ms_scale(metric: str) -> bool:
+    """Cells measured in milliseconds (the phase/latency families) —
+    the only cells the absolute noise floor applies to."""
+    stripped = _seam_stripped(metric)
+    return (
+        metric.endswith("_ms")
+        or stripped.endswith("_ms")
+        or stripped in _LOWER_BETTER_EXACT
     )
 
 
@@ -286,7 +337,18 @@ def _normalize_bench(doc: dict, metrics: dict, quarantined: dict) -> None:
     _normalize_driver({"parsed": doc.get("record")}, metrics, quarantined)
 
 
+def _loop_seam(doc: dict) -> str | None:
+    """The tick record's phase_seam, if the artifact carries one (the
+    pre-seam artifacts r01..r06 don't — their cells keep their
+    historical unprefixed names, anchoring the pre-seam series)."""
+    for rec in doc.get("results") or []:
+        if isinstance(rec, dict) and rec.get("phase_seam"):
+            return str(rec["phase_seam"])
+    return None
+
+
 def _normalize_loop(doc: dict, metrics: dict, quarantined: dict) -> None:
+    seam = _loop_seam(doc)
     for key, v in (doc.get("summary") or {}).items():
         if key in ("metric", "control_under_device"):
             continue
@@ -295,6 +357,9 @@ def _normalize_loop(doc: dict, metrics: dict, quarantined: dict) -> None:
             # divergence/disagreement rates); drift is caught by the
             # bench's own assertions, not the trajectory gate
             continue
+        if seam and key in _SEAM_SCOPED:
+            # per-tick cells measure the seam's program — new series
+            key = f"{seam}_{key}"
         _put(metrics, quarantined, key, v)
 
 
@@ -412,6 +477,13 @@ def find_regressions(entries: list[dict],
                 change = (b - a) / abs(a)
                 worse = change > threshold if lower_is_better(metric) \
                     else change < -threshold
+                if (
+                    worse
+                    and lower_is_better(metric)
+                    and _ms_scale(metric)
+                    and (b - a) < NOISE_FLOOR_MS
+                ):
+                    continue  # sub-floor absolute delta: timer noise
                 if worse:
                     out.append({
                         "metric": metric,

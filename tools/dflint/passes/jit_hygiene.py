@@ -88,6 +88,9 @@ DEFAULT_HOT_FUNCTIONS = {
     ("cluster/scheduler.py", "_drain_shadow"),
     ("cluster/scheduler.py", "_warm_shadow_ml"),
     ("cluster/scheduler.py", "warmup"),
+    ("cluster/scheduler.py", "_tick_fused"),
+    ("cluster/scheduler.py", "_dispatch_fused"),
+    ("cluster/scheduler.py", "_drain_fused"),
     ("registry/serving.py", "_perform_refresh"),
 }
 
@@ -141,6 +144,14 @@ D2H_ALLOWLIST: dict[tuple[str, str, str], str] = {
         "d2h_wait, so the shadow D2H can never re-serialize the pipelined "
         "tick — an in-tick shadow read-back anywhere else fails JIT003 "
         "(pinned by the bad_shadow fixture)"
+    ),
+    ("cluster/scheduler.py", "_drain_fused", "asarray"): (
+        "THE single D2H of the fused tick (ops/tick.py): one flat result "
+        "buffer per chunk — selection + compacted candidate columns + "
+        "ledger features, int segments bitcast — read back exactly once, "
+        "timed as d2h_wait, while chunk i+1's fused dispatch is already "
+        "in flight (the PR-4 pipeline); any other read-back on the fused "
+        "path fails JIT003 (pinned by the bad_tick fixture)"
     ),
 }
 

@@ -107,6 +107,29 @@ SERVING_JIT_REGISTRY: dict[str, dict] = {
         "static_kw": ("limit", "algorithm"),
         "donate": (3,),
     },
+    # ops/tick.fused_tick_chunk(inbuf, cols, b, k, c, l, n, algorithm,
+    # limit, emit_led, emit_packed): the device-resident fused tick —
+    # the staging buffer is donated, every scalar after cols is a
+    # compile-key static, and b is the closed-bucket batch dim.
+    "fused_tick_chunk": {
+        "b_arg": 2,
+        "static_args": (2, 3, 4, 5, 6, 7, 8, 9, 10),
+        "static_kw": (
+            "b", "k", "c", "l", "n", "algorithm", "limit", "emit_led",
+            "emit_packed",
+        ),
+        "donate": (0,),
+    },
+    # ops/tick._scatter_rows(col, idx, rows, nb): the mirror's donated
+    # incremental row scatter — the resident column is donated (callers
+    # rebind the attribute to the result) and nb is the bucket-padded
+    # update batch size.
+    "_scatter_rows": {
+        "b_arg": 3,
+        "static_args": (3,),
+        "static_kw": ("nb",),
+        "donate": (0,),
+    },
 }
 
 # cross-file donating callables: leaf name -> donated positional indexes

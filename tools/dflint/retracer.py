@@ -52,6 +52,11 @@ BUCKET_CONST = "_EVAL_BUCKETS"
 SERVING_B_ARGS: dict[str, int] = {
     "scheduler.evaluator.schedule_from_packed": 1,
     "scheduler.ml.schedule_from_packed": 4,
+    # device-resident fused tick (ops/tick.py): the fused program's
+    # bucket-padded batch dim, and the mirror scatter's bucket-padded
+    # update-batch dim — both closed over _EVAL_BUCKETS
+    "scheduler.tick.fused_tick_chunk": 2,
+    "scheduler.tick.scatter_rows": 3,
 }
 
 
@@ -268,6 +273,10 @@ class DonationGuard:
 GUARDED_SERVING_JITS: tuple[tuple[str, str, tuple[int, ...]], ...] = (
     ("dragonfly2_tpu.ops.evaluator", "schedule_from_packed", (0,)),
     ("dragonfly2_tpu.registry.serving", "_ml_schedule_from_packed", (3,)),
+    # fused tick: the per-chunk uint8 staging buffer is the donated
+    # one-shot host array (_scatter_rows donates a resident DEVICE
+    # buffer, which the guard's np-only check correctly ignores)
+    ("dragonfly2_tpu.ops.tick", "fused_tick_chunk", (0,)),
 )
 
 
